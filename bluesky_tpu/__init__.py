@@ -1,0 +1,24 @@
+"""bluesky_tpu — a TPU-native air-traffic-simulation framework.
+
+A ground-up redesign of the capabilities of BlueSky (the open ATM simulator,
+reference: /root/reference) for TPU hardware: the N-aircraft simulation state
+is a padded struct-of-arrays JAX pytree advanced by a jitted, `lax.scan`-
+wrapped step function; the O(N^2) conflict detection and MVP resolution are
+batched all-pairs kernels; geodesy/atmosphere primitives are jitted ops; the
+aircraft axis shards over a `jax.sharding.Mesh` for large N, and Monte-Carlo
+ensembles vmap over a replica axis.
+
+Package layout:
+  ops/        pure jitted math: geodesy, atmosphere, conflict detection,
+              conflict resolution kernels (jnp + Pallas variants)
+  core/       simulation state pytree, traffic facade, kinematics, autopilot,
+              pilot arbitration, performance model, step function
+  parallel/   device-mesh sharding of the aircraft axis, ensemble axis
+  stack/      the text-command stack (the universal user/API surface)
+  simulation/ the fixed-dt simulation loop + node
+  network/    zmq server/client/node process fabric
+  models/     aircraft performance coefficient tables
+  utils/      datalog, areafilter, timers, misc parsing
+"""
+
+__version__ = "0.1.0"

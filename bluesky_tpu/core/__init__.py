@@ -1,0 +1,1 @@
+"""Simulation core: state pytree, traffic facade, physics, step function."""

@@ -1,0 +1,117 @@
+"""Airborne Separation Assurance: device-side CD&R coordinator.
+
+Parity with the reference ASAS coordinator (``bluesky/traffic/asas/asas.py``):
+per-interval conflict detection -> resolution -> pair bookkeeping ->
+resume-navigation recovery (asas.py:473-504, 409-471), with protected-zone
+radii/margins and resolver configuration.
+
+TPU-first: the reference keeps conflict pairs as Python lists/sets of
+callsign tuples and loops over them.  Here the whole update is jitted: the
+pair state is the [N,N] ``resopairs`` matrix, bookkeeping is boolean algebra,
+and the conflict/LoS *counts* are device scalars.  Host-side code (stack
+commands CONF/LOS lists, logging) extracts pair lists lazily via
+``ops.cd.pairs_from_mask`` only when asked.
+
+Resolver selection: MVP is the default (and currently only) device resolver;
+the registry hook mirrors the reference's CDmethods/CRmethods dicts
+(asas.py:41-55) for host-side extension.
+"""
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from ..ops import aero, cd as cdops, cr_mvp
+from .state import SimState
+
+
+class AsasConfig(NamedTuple):
+    """ASAS settings (reference asas.py:10-13 defaults + setters).
+
+    Static under jit: toggling recompiles (cached per configuration), which
+    matches how rarely these change vs how hot the step loop is.
+    """
+    swasas: bool = True
+    dtasas: float = 1.0          # [s] CD&R interval
+    dtlookahead: float = 300.0   # [s]
+    rpz: float = 5.0 * aero.nm   # [m] protected-zone radius (R)
+    hpz: float = 1000.0 * aero.ft  # [m] protected-zone half-height (dh)
+    mar: float = 1.05            # resolution margin factor
+    resofach: float = 1.05       # horizontal resolution factor (Rm = R*fac)
+    resofacv: float = 1.05       # vertical resolution factor
+    swresohoriz: bool = False
+    swresospd: bool = False
+    swresohdg: bool = False
+    swresovert: bool = False
+    reso_on: bool = True         # conflict resolution enabled (RESO MVP/OFF)
+    vmin: float = 100.0 * aero.kts   # [m/s] resolution speed caps
+    vmax: float = 180.0 * aero.kts   # (reference asas.py setters)
+    vsmin: float = -3000.0 * aero.fpm
+    vsmax: float = 3000.0 * aero.fpm
+
+    @property
+    def rpz_m(self):
+        return self.rpz * self.resofach
+
+    @property
+    def hpz_m(self):
+        return self.hpz * self.resofacv
+
+
+def update(state: SimState, cfg: AsasConfig) -> SimState:
+    """One ASAS interval: detect, resolve, bookkeep, resume (asas.py:473-504)."""
+    ac, asas = state.ac, state.asas
+
+    cd = cdops.detect(ac.lat, ac.lon, ac.trk, ac.gs, ac.alt, ac.vs,
+                      ac.active, cfg.rpz, cfg.hpz, cfg.dtlookahead)
+
+    if cfg.reso_on:
+        mvpcfg = cr_mvp.MVPConfig(
+            rpz_m=cfg.rpz_m, hpz_m=cfg.hpz_m, tlookahead=cfg.dtlookahead,
+            swresohoriz=cfg.swresohoriz, swresospd=cfg.swresospd,
+            swresohdg=cfg.swresohdg, swresovert=cfg.swresovert)
+        newtrk, newgs, newvs, newalt, asase, asasn = cr_mvp.resolve(
+            cd, ac.alt, ac.gseast, ac.gsnorth, ac.vs, ac.trk, ac.gs,
+            ac.selalt, state.ap.vs, asas.alt,
+            cfg.vmin, cfg.vmax, cfg.vsmin, cfg.vsmax, mvpcfg,
+            noreso=asas.noreso, resooff=asas.resooff)
+        # Only aircraft with conflicts get fresh commands; others keep the
+        # previous resolution state (the reference overwrites all, but only
+        # `active` aircraft consume them — keeping them avoids NaN leakage
+        # from padding garbage).
+        upd = cd.inconf
+        asas = asas.replace(
+            trk=jnp.where(upd, newtrk, asas.trk),
+            tas=jnp.where(upd, newgs, asas.tas),
+            vs=jnp.where(upd, newvs, asas.vs),
+            alt=jnp.where(upd, newalt, asas.alt),
+            asase=jnp.where(upd, asase, asas.asase),
+            asasn=jnp.where(upd, asasn, asas.asasn))
+
+    # Pair bookkeeping (asas.py:489-502): resopairs accumulates conflicts
+    resopairs = asas.resopairs | cd.swconfl
+
+    # ResumeNav (asas.py:409-471)
+    resopairs, active = cr_mvp.resume_nav(
+        resopairs, cd.swlos, ac.lat, ac.lon, ac.gseast, ac.gsnorth, ac.trk,
+        ac.active, cfg.rpz, cfg.rpz * cfg.resofach)
+
+    asas = asas.replace(
+        resopairs=resopairs,
+        active=active & cfg.reso_on,
+        inconf=cd.inconf,
+        tcpamax=cd.tcpamax,
+        nconf_cur=jnp.sum(cd.swconfl, dtype=jnp.int32),
+        nlos_cur=jnp.sum(cd.swlos, dtype=jnp.int32))
+    return state.replace(asas=asas), cd
+
+
+def detect_only(state: SimState, cfg: AsasConfig):
+    """CD without resolution (RESO OFF path) — still updates flags/counts."""
+    ac = state.ac
+    cd = cdops.detect(ac.lat, ac.lon, ac.trk, ac.gs, ac.alt, ac.vs,
+                      ac.active, cfg.rpz, cfg.hpz, cfg.dtlookahead)
+    asas = state.asas.replace(
+        inconf=cd.inconf, tcpamax=cd.tcpamax,
+        nconf_cur=jnp.sum(cd.swconfl, dtype=jnp.int32),
+        nlos_cur=jnp.sum(cd.swlos, dtype=jnp.int32))
+    return state.replace(asas=asas), cd

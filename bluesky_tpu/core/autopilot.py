@@ -1,0 +1,206 @@
+"""FMS / autopilot guidance, fully vectorized over the aircraft axis.
+
+Parity with the reference ``bluesky/traffic/autopilot.py`` + the waypoint-
+reached predicate of ``activewpdata.py`` + the waypoint-advance semantics of
+``Route.getnextwp`` (route.py:741-800).  The reference interleaves a scalar
+per-aircraft Python loop (waypoint switching, autopilot.py:71-137, scalar
+``ComputeVNAV`` autopilot.py:207-304) with vectorized continuous guidance
+(autopilot.py:144-204).  That loop is unusable under jit, so here:
+
+* Flight plans are dense ``[N, W]`` waypoint tables (core/state.RouteArrays)
+  with a per-aircraft active index; altitude-constraint lookahead
+  (``wptoalt/wpxtoalt``, computed by ``Route.calcfp`` in the reference) is
+  precomputed host-side at route-edit time.
+* Waypoint advance is a masked gather: ``reached`` aircraft bump their index
+  and pull the next row out of the tables with ``take_along_axis``.
+* ``ComputeVNAV``'s three branches (descend-late / climb-now / level) become
+  a ``jnp.where`` lattice evaluated for switching aircraft only.
+
+Behavioural notes kept faithful to the reference:
+* ComputeVNAV's writes to ``actwp.vs``/``ap.alt`` are clobbered by the
+  continuous-guidance block in the same update (autopilot.py:171-185 runs
+  after the loop, unconditionally) — so only its nextaltco/xtoalt/dist2vs
+  outputs are durable, and that is what we compute.
+* The runway-landing auto-delete (route.py:744-776) is host-side stack
+  business and is handled by the host route manager, not here.
+"""
+import jax.numpy as jnp
+
+from ..ops import aero, geo
+from .state import SimState
+
+# Default descent steepness: 3000 ft per 10 nm (reference autopilot.py:21)
+STEEPNESS = 3000.0 * aero.ft / (10.0 * aero.nm)
+FMS_DT = 1.01  # [s] FMS scheduling interval (reference autopilot.py:18)
+
+
+def degto180(angle):
+    """Wrap angle to (-180, 180] (reference tools/misc.py degto180)."""
+    return (angle + 180.0) % 360.0 - 180.0
+
+
+def calcturn(tas, bank, wpqdr, next_wpqdr):
+    """Turn-anticipation distance and turn radius (activewpdata.py:57-66)."""
+    turnrad = tas * tas / (jnp.maximum(0.01, jnp.tan(bank)) * aero.g0)
+    turndist = jnp.abs(
+        turnrad * jnp.tan(jnp.radians(0.5 * jnp.abs(
+            degto180(wpqdr % 360.0 - next_wpqdr % 360.0)))))
+    return turndist, turnrad
+
+
+def _gather(table, idx):
+    """table[i, idx[i]] for [N,W] table and [N] int index (clipped)."""
+    safe = jnp.clip(idx, 0, table.shape[1] - 1)
+    return jnp.take_along_axis(table, safe[:, None], axis=1)[:, 0]
+
+
+def update_fms(state: SimState) -> SimState:
+    """The dt-gated FMS update: waypoint switching + continuous guidance.
+
+    Mirrors Autopilot.update's gated body (autopilot.py:61-199).  Call only
+    when the FMS timer fires; ``update_continuous`` runs every step.
+    """
+    ac, actwp, ap, route = state.ac, state.actwp, state.ap, state.route
+
+    # --- LNAV geometry to the current active waypoint -----------------------
+    qdr, distnm = geo.qdrdist(ac.lat, ac.lon, actwp.lat, actwp.lon)
+    dist = distnm * aero.nm
+
+    # --- Waypoint-reached predicate (activewpdata.Reached, :31-55) ----------
+    next_qdr_eff = jnp.where(actwp.next_qdr < -900.0, qdr, actwp.next_qdr)
+    turndist_r, turnrad = calcturn(ac.tas, ac.bank, qdr, next_qdr_eff)
+    # flyby scales both outputs in the reference (tuple * array broadcast)
+    turndist_r = actwp.flyby * turndist_r
+    turnrad = actwp.flyby * turnrad
+
+    away = jnp.abs(degto180(ac.trk % 360.0 - qdr % 360.0)) > 90.0
+    incircle = dist < turnrad * 1.01
+    circling = away & incircle
+    reached = ac.swlnav & ((dist < turndist_r) | circling) & ac.active
+
+    # --- Advance to next waypoint for reached aircraft (masked gather) ------
+    # Route.getnextwp semantics (route.py:778-800): lnavon iff another
+    # waypoint exists; the index saturates at the last waypoint.
+    lnavon = route.iactwp + 1 < route.nwp
+    iact_new = jnp.where(reached & lnavon, route.iactwp + 1, route.iactwp)
+
+    wplat = _gather(route.wplat, iact_new)
+    wplon = _gather(route.wplon, iact_new)
+    wpalt = _gather(route.wpalt, iact_new)
+    wpspd = _gather(route.wpspd, iact_new)
+    wpflyby = _gather(route.wpflyby, iact_new)
+    wptoalt = _gather(route.wptoalt, iact_new)
+    wpxtoalt = _gather(route.wpxtoalt, iact_new)
+    # next leg bearing: from new wp to the one after (route.getnextqdr)
+    have_next = iact_new + 1 < route.nwp
+    nxtlat = _gather(route.wplat, iact_new + 1)
+    nxtlon = _gather(route.wplon, iact_new + 1)
+    legqdr, _ = geo.qdrdist(wplat, wplon, nxtlat, nxtlon)
+    next_qdr_new = jnp.where(have_next, legqdr, -999.0)
+
+    # Save the speed constraint of the waypoint we are passing: VNAV speeds
+    # are FROM-speeds (autopilot.py:73-78)
+    oldspd = actwp.spd
+
+    swlnav = jnp.where(reached, ac.swlnav & lnavon, ac.swlnav)
+    swvnav = ac.swvnav & swlnav
+
+    new_wplat = jnp.where(reached, wplat, actwp.lat)
+    new_wplon = jnp.where(reached, wplon, actwp.lon)
+    new_flyby = jnp.where(reached, wpflyby, actwp.flyby)
+    new_nextaltco = jnp.where(reached & (wpalt >= -0.01), wpalt,
+                              actwp.nextaltco)
+    new_xtoalt = jnp.where(reached, wpxtoalt, actwp.xtoalt)
+
+    # Speed constraint with crossover-altitude conversion (autopilot.py:99-113)
+    spd_valid = (wpspd > -990.0) & swlnav & swvnav
+    spd_conv = jnp.where(
+        ac.abco & (wpspd > 1.0), aero.vcas2mach(wpspd, ac.alt),
+        jnp.where(ac.belco & (0.0 < wpspd) & (wpspd <= 1.0),
+                  aero.vmach2cas(wpspd, ac.alt), wpspd))
+    new_wpspd = jnp.where(reached,
+                          jnp.where(spd_valid, spd_conv, -999.0), actwp.spd)
+
+    # VNAV from-speed becomes the selected speed while passing (ap.py:118-119)
+    selspd = jnp.where(reached & swvnav & (oldspd > 0.0), oldspd, ac.selspd)
+
+    # Recompute qdr/turndist for the new active waypoint (autopilot.py:121-134)
+    qdr_new, _ = geo.qdrdist(ac.lat, ac.lon, new_wplat, new_wplon)
+    qdr = jnp.where(reached, qdr_new, qdr)
+    local_next_qdr = jnp.where(next_qdr_new < -900.0, qdr, next_qdr_new)
+    turndist_new, _ = calcturn(ac.tas, ac.bank, qdr, local_next_qdr)
+    new_turndist = jnp.where(reached, turndist_new, actwp.turndist)
+    new_next_qdr = jnp.where(reached, next_qdr_new, actwp.next_qdr)
+
+    # --- ComputeVNAV for switching aircraft (autopilot.py:207-304) ----------
+    # Durable outputs only: nextaltco, xtoalt (already set), dist2vs.
+    toalt = wptoalt
+    novnav = (toalt < 0.0) | ~swvnav
+    descend = ac.alt > toalt + 10.0 * aero.ft
+    climb = ac.alt < toalt - 10.0 * aero.ft
+
+    nextaltco_d = jnp.minimum(ac.alt, toalt + wpxtoalt * STEEPNESS)
+    dist2vs_d = new_turndist + jnp.abs(ac.alt - nextaltco_d) / STEEPNESS
+
+    vnav_nextaltco = jnp.where(descend, nextaltco_d,
+                               jnp.where(climb, toalt, new_nextaltco))
+    vnav_dist2vs = jnp.where(descend, dist2vs_d,
+                             jnp.where(climb, 99999.0 * aero.nm, -999.0))
+    vnav_dist2vs = jnp.where(novnav, -999.0, vnav_dist2vs)
+    # With VNAV off the constraint stays as set above; with it on and a
+    # climb/descent ahead, dial in the computed constraint altitude.
+    new_nextaltco = jnp.where(reached & ~novnav & (descend | climb),
+                              vnav_nextaltco, new_nextaltco)
+    dist2vs = jnp.where(reached, vnav_dist2vs, ap.dist2vs)
+
+    actwp = actwp.replace(lat=new_wplat, lon=new_wplon, flyby=new_flyby,
+                          nextaltco=new_nextaltco, xtoalt=new_xtoalt,
+                          spd=new_wpspd, turndist=new_turndist,
+                          next_qdr=new_next_qdr)
+    route = route.replace(iactwp=iact_new)
+
+    # --- Continuous FMS guidance (autopilot.py:144-199) ---------------------
+    dy = actwp.lat - ac.lat
+    dx = (actwp.lon - ac.lon) * ac.coslat
+    dist2wp = 60.0 * aero.nm * jnp.sqrt(dx * dx + dy * dy)
+
+    startdescent = (dist2wp < dist2vs) | (actwp.nextaltco > ac.alt)
+    swvnavvs = swvnav & jnp.where(swlnav, startdescent,
+                                  dist <= jnp.maximum(185.2, actwp.turndist))
+
+    t2go2alt = jnp.maximum(0.0, dist2wp + actwp.xtoalt - actwp.turndist) \
+        / jnp.maximum(0.5, ac.gs)
+    actwp_vs = jnp.maximum(STEEPNESS * ac.gs,
+                           jnp.abs(actwp.nextaltco - ac.alt)
+                           / jnp.maximum(1.0, t2go2alt))
+    actwp = actwp.replace(vs=actwp_vs)
+
+    vnavvs = jnp.where(swvnavvs, actwp_vs, ap.vnavvs)
+    selvs_eff = jnp.where(jnp.abs(ac.selvs) > 0.1, ac.selvs, ac.apvsdef)
+    ap_vs = jnp.where(swvnavvs, vnavvs, selvs_eff)
+    ap_alt = jnp.where(swvnavvs, actwp.nextaltco, ac.selalt)
+    selalt = jnp.where(swvnavvs, actwp.nextaltco, ac.selalt)
+
+    ap_trk = jnp.where(swlnav, qdr, ap.trk)
+
+    # FMS speed guidance with deceleration-distance anticipation
+    # (autopilot.py:190-199)
+    nexttas = aero.vcasormach2tas(actwp.spd, ac.alt)
+    tasdiff = nexttas - ac.tas
+    dtspdchg = jnp.abs(tasdiff) / jnp.maximum(0.01, jnp.abs(ac.ax))
+    dxspdchg = (0.5 * jnp.sign(tasdiff) * jnp.abs(ac.ax) * dtspdchg * dtspdchg
+                + ac.tas * dtspdchg)
+    usespdcon = (dist2wp < dxspdchg) & (actwp.spd > -990.0) & swvnav
+    selspd = jnp.where(usespdcon, actwp.spd, selspd)
+
+    ac = ac.replace(swlnav=swlnav, swvnav=swvnav, selspd=selspd,
+                    selalt=selalt)
+    ap = ap.replace(trk=ap_trk, alt=ap_alt, vs=ap_vs, vnavvs=vnavvs,
+                    swvnavvs=swvnavvs, dist2vs=dist2vs)
+    return state.replace(ac=ac, actwp=actwp, ap=ap, route=route)
+
+
+def update_continuous(state: SimState) -> SimState:
+    """Per-step TAS command from the selected CAS/Mach (autopilot.py:202-203)."""
+    ap_tas = aero.vcasormach2tas(state.ac.selspd, state.ac.alt)
+    return state.replace(ap=state.ap.replace(tas=ap_tas))

@@ -1,0 +1,98 @@
+"""Aircraft kinematics: airspeed/heading/VS dynamics + WGS-84 integration.
+
+Pure-function parity with the reference's ``Traffic.UpdateAirSpeed /
+UpdateGroundSpeed / UpdatePosition`` (traffic.py:425-483): first-order
+acceleration toward the pilot-commanded TAS, bank-limited turn toward the
+commanded heading, fixed-acceleration vertical-speed capture, wind-vector
+addition, and explicit-Euler integration of lat/lon on the mean-radius
+sphere.  All elementwise over the padded aircraft axis — XLA fuses the whole
+thing into a couple of kernels.
+"""
+import jax.numpy as jnp
+
+from ..ops import aero
+
+
+def update_airspeed(ac, pilot, accel, simdt, eps=0.01):
+    """TAS/heading/VS dynamics toward pilot targets (traffic.py:425-454).
+
+    Args:
+      ac:     AircraftArrays
+      pilot:  PilotArrays (arbitrated targets)
+      accel:  [N] per-aircraft acceleration magnitude [m/s2] (perf model)
+    Returns updated AircraftArrays (tas/cas/mach, hdg, vs, ax, swhdgsel,
+    swaltsel updated).
+    """
+    # Horizontal acceleration toward commanded TAS, dead-banded at 1 kt
+    delta_spd = pilot.tas - ac.tas
+    need_ax = jnp.abs(delta_spd) > aero.kts
+    ax = need_ax * jnp.sign(delta_spd) * accel
+    tas = ac.tas + ax * simdt
+    cas = aero.vtas2cas(tas, ac.alt)
+    mach = aero.vtas2mach(tas, ac.alt)
+
+    # Bank-limited turn toward commanded heading
+    turnrate = jnp.degrees(aero.g0 * jnp.tan(ac.bank)
+                           / jnp.maximum(tas, eps))
+    delhdg = (pilot.hdg - ac.hdg + 180.0) % 360.0 - 180.0
+    swhdgsel = jnp.abs(delhdg) > jnp.abs(2.0 * simdt * turnrate)
+    hdg = (ac.hdg + simdt * turnrate * swhdgsel * jnp.sign(delhdg)) % 360.0
+
+    # Vertical-speed capture toward commanded altitude: the target VS keeps
+    # the commanded magnitude |pilot.vs| signed toward the altitude error;
+    # VS itself changes at a fixed 300 fpm/s (~1.6 m/s2) acceleration.
+    delta_alt = pilot.alt - ac.alt
+    swaltsel = jnp.abs(delta_alt) > jnp.maximum(
+        10.0 * aero.ft, jnp.abs(2.0 * simdt * jnp.abs(ac.vs)))
+    target_vs = swaltsel * jnp.sign(delta_alt) * jnp.abs(pilot.vs)
+    delta_vs = target_vs - ac.vs
+    need_az = jnp.abs(delta_vs) > 300.0 * aero.fpm
+    az = need_az * jnp.sign(delta_vs) * (300.0 * aero.fpm)
+    vs = jnp.where(need_az, ac.vs + az * simdt, target_vs)
+    vs = jnp.where(jnp.isfinite(vs), vs, 0.0)
+
+    return ac.replace(tas=tas, cas=cas, mach=mach, hdg=hdg, vs=vs, ax=ax,
+                      swhdgsel=swhdgsel, swaltsel=swaltsel)
+
+
+def update_groundspeed(ac, windn=None, winde=None):
+    """Ground-speed/track from heading, TAS and wind (traffic.py:456-476).
+
+    windn/winde: [N] wind components at aircraft positions, or None for calm.
+    """
+    hdgrad = jnp.radians(ac.hdg)
+    tasnorth = ac.tas * jnp.cos(hdgrad)
+    taseast = ac.tas * jnp.sin(hdgrad)
+    if windn is None:
+        return ac.replace(gsnorth=tasnorth, gseast=taseast,
+                          gs=ac.tas, trk=ac.hdg)
+    # Wind applies only when airborne (alt > 50 ft)
+    airborne = ac.alt > 50.0 * aero.ft
+    gsnorth = tasnorth + windn * airborne
+    gseast = taseast + winde * airborne
+    gs = jnp.where(airborne, jnp.sqrt(gsnorth * gsnorth + gseast * gseast),
+                   ac.tas)
+    trk = jnp.where(airborne,
+                    jnp.degrees(jnp.arctan2(gseast, gsnorth)) % 360.0,
+                    ac.hdg)
+    return ac.replace(gsnorth=gsnorth, gseast=gseast, gs=gs, trk=trk)
+
+
+def update_position(ac, pilot, simdt):
+    """Explicit-Euler position integration (traffic.py:478-483).
+
+    Altitude snaps to the pilot-commanded altitude once within capture range
+    (``swaltsel`` False), exactly like the reference; lat/lon advance on the
+    mean-radius sphere with the cos(lat) meridian-convergence factor.
+    """
+    alt = jnp.where(ac.swaltsel, ac.alt + ac.vs * simdt, pilot.alt)
+    lat = ac.lat + jnp.degrees(simdt * ac.gsnorth / aero.Rearth)
+    coslat = jnp.cos(jnp.radians(lat))
+    lon = ac.lon + jnp.degrees(simdt * ac.gseast / coslat / aero.Rearth)
+    return ac.replace(alt=alt, lat=lat, lon=lon, coslat=coslat)
+
+
+def update_atmosphere(ac):
+    """Refresh p/rho/T at current altitudes (traffic.py:389)."""
+    p, rho, temp = aero.vatmos(ac.alt)
+    return ac.replace(p=p, rho=rho, temp=temp)

@@ -1,0 +1,99 @@
+"""Noise models: turbulence and the ADS-B transmission model (PRNG-keyed).
+
+Parity with reference ``traffic/turbulence.py`` (gaussian positional jitter
+in flight/wing/vertical axes scaled by sqrt(dt), turbulence.py:24-46) and
+``traffic/adsbmodel.py`` (last-broadcast state with optional gaussian
+position/altitude error and truncated update times, adsbmodel.py:44-60).
+
+TPU-first: ``np.random`` becomes explicit `jax.random` keys threaded through
+the state — same-seed runs are bitwise reproducible, which is this
+framework's substitute for the reference's (absent) race detection story
+(SURVEY.md §5.2).
+"""
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..ops import aero
+
+
+class NoiseConfig(NamedTuple):
+    """Noise switches/levels (reference SetNoise + SetStandards)."""
+    turb_active: bool = False
+    turb_sd_hf: float = 1e-6    # [m/s] flight-direction sd (ref default 0)
+    turb_sd_hw: float = 0.1     # [m/s] wing-direction sd
+    turb_sd_vert: float = 0.1   # [m/s] vertical sd
+    adsb_transnoise: bool = False
+    adsb_truncated: bool = False
+    adsb_err_latlon: float = 1e-4        # [deg]
+    adsb_err_alt: float = 100.0 * aero.ft  # [m]
+    adsb_trunctime: float = 0.0          # [s]
+
+
+@struct.dataclass
+class AdsbArrays:
+    """Last-broadcast surveillance state (reference adsbmodel.py:14-23)."""
+    lastupdate: jnp.ndarray
+    lat: jnp.ndarray
+    lon: jnp.ndarray
+    alt: jnp.ndarray
+    trk: jnp.ndarray
+    tas: jnp.ndarray
+    gs: jnp.ndarray
+    vs: jnp.ndarray
+
+
+def make_adsb(nmax: int, dtype=jnp.float32) -> AdsbArrays:
+    z = lambda: jnp.zeros((nmax,), dtype)
+    return AdsbArrays(lastupdate=z(), lat=z(), lon=z(), alt=z(),
+                      trk=z(), tas=z(), gs=z(), vs=z())
+
+
+def turbulence_woosh(ac, key, simdt, cfg: NoiseConfig):
+    """Positional turbulence jitter (turbulence.py:24-46)."""
+    if not cfg.turb_active:
+        return ac
+    n = ac.lat.shape[0]
+    timescale = jnp.sqrt(simdt)
+    k1, k2, k3 = jax.random.split(key, 3)
+    turbhf = jax.random.normal(k1, (n,), ac.lat.dtype) \
+        * (cfg.turb_sd_hf * timescale)
+    turbhw = jax.random.normal(k2, (n,), ac.lat.dtype) \
+        * (cfg.turb_sd_hw * timescale)
+    turbalt = jax.random.normal(k3, (n,), ac.lat.dtype) \
+        * (cfg.turb_sd_vert * timescale)
+
+    trkrad = jnp.radians(ac.trk)
+    turblat = jnp.cos(trkrad) * turbhf - jnp.sin(trkrad) * turbhw
+    turblon = jnp.sin(trkrad) * turbhf + jnp.cos(trkrad) * turbhw
+
+    live = ac.active
+    return ac.replace(
+        alt=jnp.where(live, ac.alt + turbalt, ac.alt),
+        lat=jnp.where(live, ac.lat + jnp.degrees(turblat / aero.Rearth), ac.lat),
+        lon=jnp.where(live,
+                      ac.lon + jnp.degrees(turblon / aero.Rearth / ac.coslat),
+                      ac.lon))
+
+
+def adsb_update(adsb: AdsbArrays, ac, key, simt, cfg: NoiseConfig):
+    """Refresh broadcast state for aircraft whose truncation window elapsed
+    (adsbmodel.py:44-59)."""
+    up = adsb.lastupdate + cfg.adsb_trunctime < simt
+    if cfg.adsb_transnoise:
+        n = ac.lat.shape[0]
+        k1, k2, k3 = jax.random.split(key, 3)
+        lat = ac.lat + jax.random.normal(k1, (n,), ac.lat.dtype) * cfg.adsb_err_latlon
+        lon = ac.lon + jax.random.normal(k2, (n,), ac.lat.dtype) * cfg.adsb_err_latlon
+        alt = ac.alt + jax.random.normal(k3, (n,), ac.lat.dtype) * cfg.adsb_err_alt
+    else:
+        lat, lon, alt = ac.lat, ac.lon, ac.alt
+    sel = lambda new, old: jnp.where(up, new, old)
+    return adsb.replace(
+        lat=sel(lat, adsb.lat), lon=sel(lon, adsb.lon), alt=sel(alt, adsb.alt),
+        trk=sel(ac.trk, adsb.trk), tas=sel(ac.tas, adsb.tas),
+        gs=sel(ac.gs, adsb.gs), vs=sel(ac.vs, adsb.vs),
+        lastupdate=jnp.where(up, adsb.lastupdate + cfg.adsb_trunctime,
+                             adsb.lastupdate))

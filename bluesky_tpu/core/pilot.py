@@ -1,0 +1,55 @@
+"""Pilot arbitration: choose AP or ASAS targets, apply envelope limits.
+
+Parity with reference ``bluesky/traffic/pilot.py``: per-aircraft selection of
+the conflict-resolution command set when ASAS is active (pilot.py:28-63),
+wind-drift heading correction, and envelope limiting through the performance
+model (pilot.py:65-82, OpenAP path).
+"""
+import jax.numpy as jnp
+
+from . import perf as perfmod
+from .state import SimState
+
+
+def ap_or_asas(state: SimState, windn=None, winde=None) -> SimState:
+    """Arbitrate desired states from ASAS (in conflict) or AP (pilot.py:28-63)."""
+    ac, ap, asas = state.ac, state.ap, state.asas
+
+    if windn is not None:
+        # ASAS commands ground-frame velocities; convert to TAS by removing
+        # the wind vector (pilot.py:31-35).
+        asastasnorth = asas.tas * jnp.cos(jnp.radians(asas.trk)) - windn
+        asastaseast = asas.tas * jnp.sin(jnp.radians(asas.trk)) - winde
+        asastas = jnp.sqrt(asastasnorth ** 2 + asastaseast ** 2)
+    else:
+        asastas = asas.tas
+
+    active = asas.active
+    trk = jnp.where(active, asas.trk, ap.trk)
+    tas = jnp.where(active, asastas, ap.tas)
+    alt = jnp.where(active, asas.alt, ap.alt)
+    vs = jnp.where(active, asas.vs, ap.vs)
+    # Sign of VS is reapplied from the altitude error in the kinematics;
+    # keep the magnitude only (pilot.py:46-48).
+    vs = jnp.abs(vs)
+
+    if windn is not None:
+        vw = jnp.sqrt(windn * windn + winde * winde)
+        winddir = jnp.arctan2(winde, windn)
+        drift = jnp.radians(trk) - winddir
+        steer = jnp.arcsin(jnp.clip(
+            vw * jnp.sin(drift) / jnp.maximum(0.001, ac.tas), -1.0, 1.0))
+        hdg = (trk + jnp.degrees(steer)) % 360.0
+    else:
+        hdg = trk % 360.0
+
+    pilot = state.pilot.replace(trk=trk, tas=tas, alt=alt, vs=vs, hdg=hdg)
+    return state.replace(pilot=pilot)
+
+
+def apply_limits(state: SimState) -> SimState:
+    """Clip pilot intents to the performance envelope (pilot.py:65-68)."""
+    pilot = state.pilot
+    tas, vs, alt = perfmod.limits(state.perf, pilot.tas, pilot.vs, pilot.alt,
+                                  state.ac.ax)
+    return state.replace(pilot=pilot.replace(tas=tas, vs=vs, alt=alt))

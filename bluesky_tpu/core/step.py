@@ -1,0 +1,131 @@
+"""The simulation step: one fused, jittable state -> state function.
+
+Mirrors the reference hot loop ``Traffic.update`` (traffic.py:383-423) and
+its caller ``Simulation.step`` (simulation/qtgl/simulation.py:62-128), with
+the reference's time-staggered scheduling (FMS at ~1.01 s, ASAS at 1 s,
+kinematics every simdt=0.05 s) reproduced *inside* jit via ``lax.cond`` on
+device clocks — so a whole chunk of steps runs as one ``lax.scan`` with a
+single host sync per chunk instead of the reference's per-step Python
+dispatch.
+
+Pipeline order per step (identical to traffic.py:383-423, OpenAP flavour):
+  atmosphere -> ADS-B -> FMS (gated) -> ASAS CD&R (gated) -> AP/ASAS
+  arbitration -> performance update -> envelope limits -> airspeed ->
+  groundspeed (wind) -> position -> turbulence
+"""
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from . import asas as asasmod
+from . import autopilot, kinematics, noise, perf as perfmod, pilot, wind as windmod
+from .asas import AsasConfig
+from .noise import NoiseConfig
+from .state import SimState
+
+
+class SimConfig(NamedTuple):
+    """Static simulation configuration (hashable -> jit-static).
+
+    Changing a field recompiles the step (cached per value) — these change
+    at stack-command cadence, not step cadence.
+    """
+    simdt: float = 0.05          # [s] (reference simulation.py:15)
+    fms_dt: float = autopilot.FMS_DT
+    asas: AsasConfig = AsasConfig()
+    noise: NoiseConfig = NoiseConfig()
+    use_wind: bool = False
+
+
+def step(state: SimState, cfg: SimConfig) -> SimState:
+    """Advance the simulation by one simdt. Pure; jit/scan/donate-friendly."""
+    simdt = jnp.asarray(cfg.simdt, state.simt.dtype)
+    simt = state.simt
+
+    # ---------- Atmosphere (traffic.py:389) ----------
+    state = state.replace(ac=kinematics.update_atmosphere(state.ac))
+
+    # ---------- ADS-B broadcast model (traffic.py:392) ----------
+    rng, k_adsb, k_turb = jax.random.split(state.rng, 3)
+    state = state.replace(
+        rng=rng,
+        adsb=noise.adsb_update(state.adsb, state.ac, k_adsb, simt, cfg.noise))
+
+    # ---------- FMS / autopilot (traffic.py:395), gated at fms_dt ----------
+    fms_due = (state.fms_t0 + cfg.fms_dt < simt) | (simt < state.fms_t0) \
+        | (simt < cfg.fms_dt)
+
+    def run_fms(s):
+        return autopilot.update_fms(s).replace(fms_t0=simt)
+
+    state = jax.lax.cond(fms_due, run_fms, lambda s: s, state)
+    state = autopilot.update_continuous(state)
+
+    # ---------- ASAS CD&R (traffic.py:396), gated at dtasas ----------
+    if cfg.asas.swasas:
+        asas_due = simt >= state.asas_tnext
+
+        def run_asas(s):
+            s2, _cd = asasmod.update(s, cfg.asas)
+            return s2.replace(
+                asas_tnext=s.asas_tnext
+                + jnp.asarray(cfg.asas.dtasas, s.asas_tnext.dtype))
+
+        state = jax.lax.cond(asas_due, run_asas, lambda s: s, state)
+
+    # ---------- Pilot arbitration (traffic.py:397) ----------
+    if cfg.use_wind:
+        windn, winde = windmod.getdata(state.wind, state.ac.lat,
+                                       state.ac.lon, state.ac.alt)
+    else:
+        windn = winde = None
+    state = pilot.ap_or_asas(state, windn, winde)
+
+    # ---------- Performance model update (traffic.py:399-401) ----------
+    new_perf, bank = perfmod.update(state.perf, state.ac.tas, state.ac.vs,
+                                    state.ac.alt)
+    state = state.replace(perf=new_perf, ac=state.ac.replace(bank=bank))
+
+    # ---------- Envelope limits (traffic.py:404) ----------
+    state = pilot.apply_limits(state)
+
+    # ---------- Kinematics (traffic.py:406-409) ----------
+    accel = perfmod.acceleration(state.perf.phase)
+    ac = kinematics.update_airspeed(state.ac, state.pilot, accel, simdt)
+    ac = kinematics.update_groundspeed(ac, windn, winde)
+    ac = kinematics.update_position(ac, state.pilot, simdt)
+
+    # ---------- Turbulence (traffic.py:416) ----------
+    ac = noise.turbulence_woosh(ac, k_turb, simdt, cfg.noise)
+
+    # Freeze padding slots: inactive rows keep their values bit-exactly so
+    # garbage can never leak into streams/logs.
+    live = ac.active
+    frz = lambda new, old: jnp.where(live, new, old)
+    ac = ac.replace(
+        lat=frz(ac.lat, state.ac.lat), lon=frz(ac.lon, state.ac.lon),
+        alt=frz(ac.alt, state.ac.alt), hdg=frz(ac.hdg, state.ac.hdg),
+        trk=frz(ac.trk, state.ac.trk), tas=frz(ac.tas, state.ac.tas),
+        gs=frz(ac.gs, state.ac.gs), vs=frz(ac.vs, state.ac.vs))
+
+    return state.replace(ac=ac, simt=simt + simdt)
+
+
+@partial(jax.jit, static_argnames=("cfg", "nsteps"), donate_argnums=0)
+def run_steps(state: SimState, cfg: SimConfig, nsteps: int) -> SimState:
+    """Advance nsteps with one compiled scan; state buffers are donated.
+
+    This is the reference's lockstep ``STEP``/fast-forward chunk
+    (simulation.py:216-223) as a single device program: host syncs once per
+    chunk, matching SURVEY.md §2.10's "lax.scan over k steps inside one jit".
+    """
+    def body(s, _):
+        return step(s, cfg), None
+
+    state, _ = jax.lax.scan(body, state, None, length=nsteps)
+    return state
+
+
+step_jit = jax.jit(step, static_argnames=("cfg",))

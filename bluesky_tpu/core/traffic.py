@@ -1,0 +1,336 @@
+"""Host-side Traffic facade: create/delete/lookup over the device state.
+
+This is the replacement for the reference's ``Traffic`` singleton
+(traffic.py:55-756) *minus* the physics (which lives in jitted functions in
+this package).  It owns:
+
+* the device ``SimState`` (padded arrays + active mask),
+* host-only bookkeeping the device must never see: callsign and type strings,
+  the id->slot map (replacing ``id2idx``'s list.index, traffic.py:485-501).
+
+Creation semantics follow reference ``Traffic.create`` (traffic.py:192-312):
+random defaults in an area, CAS-or-Mach initial speed, atmosphere init, AP /
+active-waypoint / ASAS / ADS-B / performance child rows.  Deletion is a mask
+flip (the reference compacts arrays, traffic.py:365-381; slot identity is
+stable here, which also keeps the [N,N] pair matrices valid).
+
+Writes are *batched*: stack commands queue slot writes and ``flush()``
+applies them in one ``.at[idx].set`` sweep per field before the next step
+chunk, so a 4000-line scenario costs a handful of device calls, not 4000.
+"""
+from typing import List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..models import perf_coeffs
+from ..ops import aero
+from .state import SimState, make_state
+
+
+class Traffic:
+    """Host facade over a padded SimState."""
+
+    def __init__(self, nmax: int = 64, wmax: int = 32, dtype=jnp.float32,
+                 openap_path: Optional[str] = None, rng_seed: int = 0,
+                 area=(-1.0, 1.0, -1.0, 1.0)):
+        self.nmax = nmax
+        self.wmax = wmax
+        self.dtype = dtype
+        self.state: SimState = make_state(nmax, wmax, dtype, rng_seed)
+        self.coeffdb = perf_coeffs.CoeffDB(openap_path)
+        self.area = area  # default creation area (lat0, lat1, lon0, lon1)
+        self._rng = np.random.default_rng(rng_seed)
+        # Host-side per-slot bookkeeping
+        self.ids: List[Optional[str]] = [None] * nmax
+        self.types: List[Optional[str]] = [None] * nmax
+        self._id2slot = {}
+        self._pending = []          # queued creation dicts
+        self._autoid = 0
+
+    # ------------------------------------------------------------------ info
+    @property
+    def ntraf(self) -> int:
+        return len(self._id2slot) + len(self._pending)
+
+    def id2idx(self, acid):
+        """Slot index of a callsign; -1 if unknown (traffic.py:485-501)."""
+        if not isinstance(acid, str):
+            return [self.id2idx(a) for a in acid]
+        if acid in ('#', '*'):
+            # last created
+            if self._pending:
+                return -2  # pending, unknown slot yet; flush first
+            slots = [s for s, i in enumerate(self.ids) if i is not None]
+            return slots[-1] if slots else -1
+        return self._id2slot.get(acid.upper(), -1)
+
+    # ---------------------------------------------------------------- create
+    def create(self, n=1, actype="B744", acalt=None, acspd=None, dest=None,
+               aclat=None, aclon=None, achdg=None, acid=None):
+        """Queue creation of n aircraft (reference traffic.py:192-252)."""
+        if acid is None:
+            pre = chr(self._rng.integers(65, 91)) + chr(self._rng.integers(65, 91))
+            acid = [f"{pre}{self._autoid + i:>05}" for i in range(n)]
+            self._autoid += n
+        elif isinstance(acid, str):
+            if acid.upper() in self._id2slot:
+                return False, acid + " already exists."
+            acid = [acid.upper()]
+        if isinstance(actype, str):
+            actype = n * [actype]
+
+        lat0, lat1, lon0, lon1 = self.area
+        if aclat is None:
+            aclat = self._rng.random(n) * (lat1 - lat0) + lat0
+        if aclon is None:
+            aclon = self._rng.random(n) * (lon1 - lon0) + lon0
+        aclat = np.atleast_1d(np.asarray(aclat, dtype=np.float64))
+        aclon = np.atleast_1d(np.asarray(aclon, dtype=np.float64))
+        aclon = np.where(aclon > 180.0, aclon - 360.0, aclon)
+        aclon = np.where(aclon < -180.0, aclon + 360.0, aclon)
+        if achdg is None:
+            achdg = self._rng.integers(1, 360, n).astype(np.float64)
+        if acalt is None:
+            acalt = self._rng.integers(2000, 39000, n) * aero.ft
+        if acspd is None:
+            acspd = self._rng.integers(250, 450, n) * aero.kts
+        achdg = np.broadcast_to(np.atleast_1d(np.asarray(achdg, np.float64)), (n,))
+        acalt = np.broadcast_to(np.atleast_1d(np.asarray(acalt, np.float64)), (n,))
+        acspd = np.broadcast_to(np.atleast_1d(np.asarray(acspd, np.float64)), (n,))
+
+        self._pending.append(dict(
+            acid=[a.upper() for a in acid], actype=[t.upper() for t in actype],
+            lat=aclat, lon=aclon, hdg=achdg, alt=acalt, spd=acspd))
+        return True, None
+
+    def _free_slots(self, n):
+        free = [i for i, v in enumerate(self.ids) if v is None]
+        if len(free) < n:
+            raise RuntimeError(
+                f"traffic full: need {n} slots, {len(free)} free "
+                f"(nmax={self.nmax}); raise nmax")
+        return np.asarray(free[:n])
+
+    def flush(self):
+        """Apply all queued creations in one batched device write."""
+        if not self._pending:
+            return
+        batch = self._pending
+        self._pending = []
+        ids = sum((b['acid'] for b in batch), [])
+        types = sum((b['actype'] for b in batch), [])
+        lat = np.concatenate([b['lat'] for b in batch])
+        lon = np.concatenate([b['lon'] for b in batch])
+        hdg = np.concatenate([b['hdg'] for b in batch])
+        alt = np.concatenate([b['alt'] for b in batch])
+        spd = np.concatenate([b['spd'] for b in batch])
+        n = len(ids)
+        slots = self._free_slots(n)
+        for k, (i, t) in enumerate(zip(ids, types)):
+            s = int(slots[k])
+            self.ids[s] = i
+            self.types[s] = t
+            self._id2slot[i] = s
+
+        st = self.state
+        ac, ap, actwp, asas, adsb = st.ac, st.ap, st.actwp, st.asas, st.adsb
+
+        # Initial speeds: CAS-or-Mach interpretation (traffic.py:268-272)
+        import numpy as onp
+        tas, cas, mach = (onp.asarray(x) for x in _np_vcasormach(spd, alt))
+        hdgrad = onp.radians(hdg)
+        gsnorth = tas * onp.cos(hdgrad)
+        gseast = tas * onp.sin(hdgrad)
+        p, rho, temp = _np_vatmos(alt)
+
+        idx = jnp.asarray(slots)
+        put = lambda arr, val: arr.at[idx].set(
+            jnp.asarray(val, arr.dtype) if not isinstance(val, (int, float, bool))
+            else val)
+        ac = ac.replace(
+            active=ac.active.at[idx].set(True),
+            lat=put(ac.lat, lat), lon=put(ac.lon, lon), alt=put(ac.alt, alt),
+            hdg=put(ac.hdg, hdg), trk=put(ac.trk, hdg),
+            tas=put(ac.tas, tas), gs=put(ac.gs, tas),
+            gsnorth=put(ac.gsnorth, gsnorth), gseast=put(ac.gseast, gseast),
+            cas=put(ac.cas, cas), mach=put(ac.mach, mach),
+            vs=put(ac.vs, np.zeros(n)),
+            p=put(ac.p, p), rho=put(ac.rho, rho), temp=put(ac.temp, temp),
+            selspd=put(ac.selspd, cas), selalt=put(ac.selalt, alt),
+            selvs=put(ac.selvs, np.zeros(n)),
+            swlnav=ac.swlnav.at[idx].set(False),
+            swvnav=ac.swvnav.at[idx].set(False),
+            abco=ac.abco.at[idx].set(False),
+            belco=ac.belco.at[idx].set(True),
+            apvsdef=put(ac.apvsdef, np.full(n, 1500.0 * aero.fpm)),
+            aphi=put(ac.aphi, np.full(n, np.radians(25.0))),
+            ax=put(ac.ax, np.full(n, aero.kts)),
+            bank=put(ac.bank, np.full(n, np.radians(25.0))),
+            coslat=put(ac.coslat, np.cos(np.radians(lat))),
+        )
+        # Child rows (reference create() of each TrafficArrays child)
+        ap = ap.replace(trk=put(ap.trk, hdg), tas=put(ap.tas, tas),
+                        alt=put(ap.alt, alt), vs=put(ap.vs, np.zeros(n)),
+                        dist2vs=put(ap.dist2vs, np.full(n, -999.0)))
+        actwp = actwp.replace(
+            lat=put(actwp.lat, np.full(n, 89.99)),
+            lon=put(actwp.lon, np.zeros(n)),
+            spd=put(actwp.spd, np.full(n, -999.0)),
+            turndist=put(actwp.turndist, np.ones(n)),
+            flyby=put(actwp.flyby, np.ones(n)),
+            next_qdr=put(actwp.next_qdr, np.full(n, -999.0)),
+            nextaltco=put(actwp.nextaltco, np.zeros(n)),
+            xtoalt=put(actwp.xtoalt, np.zeros(n)))
+        asas = asas.replace(trk=put(asas.trk, hdg), tas=put(asas.tas, tas),
+                            alt=put(asas.alt, alt), vs=put(asas.vs, np.zeros(n)),
+                            active=asas.active.at[idx].set(False))
+        adsb = adsb.replace(lat=put(adsb.lat, lat), lon=put(adsb.lon, lon),
+                            alt=put(adsb.alt, alt), trk=put(adsb.trk, hdg),
+                            tas=put(adsb.tas, tas), gs=put(adsb.gs, tas),
+                            lastupdate=put(adsb.lastupdate, np.zeros(n)))
+
+        # Performance coefficients per type (perfoap.py:49-113)
+        perf = st.perf
+        cols = {}
+        for k in range(n):
+            vals = perf_coeffs.slot_values(self.coeffdb.get(types[k]))
+            for name, v in vals.items():
+                cols.setdefault(name, []).append(v)
+        for name, v in cols.items():
+            arr = getattr(perf, name)
+            perf = perf.replace(**{name: arr.at[idx].set(
+                jnp.asarray(np.asarray(v), arr.dtype))})
+
+        # Route tables: clear the slots
+        route = st.route
+        route = route.replace(
+            nwp=route.nwp.at[idx].set(0),
+            iactwp=route.iactwp.at[idx].set(-1))
+
+        self.state = st.replace(ac=ac, ap=ap, actwp=actwp, asas=asas,
+                                adsb=adsb, perf=perf, route=route)
+
+    # ---------------------------------------------------------------- delete
+    def delete(self, idx):
+        """Deactivate slot(s); stable slot identity (cf. traffic.py:365-381)."""
+        self.flush()
+        if np.isscalar(idx):
+            idx = [int(idx)]
+        idx = [int(i) for i in np.atleast_1d(np.asarray(idx))]
+        for i in idx:
+            if self.ids[i] is not None:
+                del self._id2slot[self.ids[i]]
+                self.ids[i] = None
+                self.types[i] = None
+        st = self.state
+        jidx = jnp.asarray(np.asarray(idx))
+        ac = st.ac.replace(active=st.ac.active.at[jidx].set(False))
+        # Clear any conflict-pair state involving the slot
+        rp = st.asas.resopairs.at[jidx, :].set(False).at[:, jidx].set(False)
+        asas = st.asas.replace(resopairs=rp,
+                               active=st.asas.active.at[jidx].set(False))
+        self.state = st.replace(ac=ac, asas=asas)
+        return True
+
+    def reset(self):
+        seed = int(self._rng.integers(0, 2**31 - 1))
+        self.state = make_state(self.nmax, self.wmax, self.dtype, seed)
+        self.ids = [None] * self.nmax
+        self.types = [None] * self.nmax
+        self._id2slot = {}
+        self._pending = []
+        self._autoid = 0
+
+    # ------------------------------------------------------------- creconfs
+    def creconfs(self, acid, actype, targetidx, dpsi, cpa, tlosh,
+                 dh=None, tlosv=None, spd=None,
+                 pzr_nm=5.0, pzh_ft=1000.0):
+        """Create an aircraft on a synthetic conflict course with target
+        (reference traffic.py:314-363)."""
+        self.flush()
+        st = self.state
+        getf = lambda a: float(np.asarray(a)[targetidx])
+        latref, lonref = getf(st.ac.lat), getf(st.ac.lon)
+        altref = getf(st.ac.alt)
+        trkref = np.radians(getf(st.ac.trk))
+        gsref = getf(st.ac.gs)
+        vsref = getf(st.ac.vs)
+        cpa_m = cpa * aero.nm
+        pzr = pzr_nm * aero.nm
+        pzh = pzh_ft * aero.ft
+
+        trk = trkref + np.radians(dpsi)
+        gs = gsref if spd is None else spd
+        if dh is None:
+            acalt = altref
+            acvs = 0.0
+        else:
+            acalt = altref + dh
+            tlosv = tlosh if tlosv is None else tlosv
+            acvs = vsref - np.sign(dh) * (abs(dh) - pzh) / tlosv
+
+        gsn, gse = gs * np.cos(trk), gs * np.sin(trk)
+        vreln = gsref * np.cos(trkref) - gsn
+        vrele = gsref * np.sin(trkref) - gse
+        vrel = np.sqrt(vreln * vreln + vrele * vrele)
+        drelcpa = tlosh * vrel + (0 if cpa_m > pzr
+                                  else np.sqrt(pzr * pzr - cpa_m * cpa_m))
+        dist = np.sqrt(drelcpa * drelcpa + cpa_m * cpa_m)
+        rd = drelcpa / dist
+        rx = cpa_m / dist
+        brn = np.degrees(np.arctan2(-rx * vreln + rd * vrele,
+                                    rd * vreln + rx * vrele))
+        from ..ops import geo as jgeo
+        aclat, aclon = (float(x) for x in
+                        jgeo.qdrpos(jnp.float64(latref) if self.dtype == jnp.float64
+                                    else jnp.asarray(latref, self.dtype),
+                                    jnp.asarray(lonref, self.dtype),
+                                    jnp.asarray(brn, self.dtype),
+                                    jnp.asarray(dist / aero.nm, self.dtype)))
+        acspd = float(_np_vtas2cas(np.hypot(gsn, gse), acalt))
+        achdg = float(np.degrees(np.arctan2(gse, gsn)))
+        self.create(1, actype, acalt, acspd, None, aclat, aclon, achdg, acid)
+        self.flush()
+        s = self._id2slot[acid.upper()]
+        st = self.state
+        self.state = st.replace(ac=st.ac.replace(
+            vs=st.ac.vs.at[s].set(acvs),
+            selalt=st.ac.selalt.at[s].set(altref),
+            selvs=st.ac.selvs.at[s].set(acvs)))
+
+
+# --- Host-side NumPy twins of the aero conversions used at creation time ----
+# (creation happens on host with float64; the device versions live in
+# ops/aero.py — same formulas, reference aero.py:62-168)
+
+def _np_vatmos(h):
+    T = np.maximum(288.15 - 0.0065 * h, 216.65)
+    rhotrop = 1.225 * (T / 288.15) ** 4.256848030018761
+    dhstrat = np.maximum(0.0, h - 11000.0)
+    rho = rhotrop * np.exp(-dhstrat / 6341.552161)
+    return rho * 287.05287 * T, rho, T
+
+
+def _np_vtas2cas(tas, h):
+    p, rho, _ = _np_vatmos(h)
+    qdyn = p * ((1.0 + rho * tas * tas / (7.0 * p)) ** 3.5 - 1.0)
+    cas = np.sqrt(7.0 * aero.p0 / aero.rho0
+                  * ((qdyn / aero.p0 + 1.0) ** (2.0 / 7.0) - 1.0))
+    return np.where(tas < 0, -cas, cas)
+
+
+def _np_vcas2tas(cas, h):
+    p, rho, _ = _np_vatmos(h)
+    qdyn = aero.p0 * ((1.0 + aero.rho0 * cas * cas / (7.0 * aero.p0)) ** 3.5 - 1.0)
+    tas = np.sqrt(7.0 * p / rho * ((1.0 + qdyn / p) ** (2.0 / 7.0) - 1.0))
+    return np.where(cas < 0, -tas, tas)
+
+
+def _np_vcasormach(spd, h):
+    a = np.sqrt(1.4 * 287.05287 * np.maximum(288.15 - 0.0065 * h, 216.65))
+    ismach = (0.1 < spd) & (spd < 1.0)
+    tas = np.where(ismach, spd * a, _np_vcas2tas(spd, h))
+    cas = np.where(ismach, _np_vtas2cas(tas, h), spd)
+    mach = np.where(ismach, spd, tas / a)
+    return tas, cas, mach

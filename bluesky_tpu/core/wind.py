@@ -1,0 +1,123 @@
+"""Wind field: fixed-capacity point-defined field with altitude profiles.
+
+Parity with reference ``bluesky/traffic/windfield.py`` (+ the ``WindSim``
+stack adapter in ``windsim.py``): wind vectors are defined at lat/lon points,
+optionally with altitude profiles resampled onto a fixed altitude axis;
+queries interpolate inverse-distance-squared horizontally and linearly in
+altitude (windfield.py:123-213).
+
+TPU-first: the reference appends columns to a growing (nalt, nvec) matrix.
+Here the field is a fixed-capacity ``[PMAX, KALT]`` pytree with an active
+mask — adding/removing points is a host-side slot write, queries are one
+fused gather+reduction that vmaps over aircraft.  The 0/1/2/3-D dimension
+dance of the reference collapses: inactive points get zero weight, a single
+point degenerates to constant wind, and constant-profile points just hold a
+constant row — no branching.
+"""
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..ops import aero
+
+ALTMAX = 45000.0 * aero.ft
+ALTSTEP = 100.0 * aero.ft   # reference windfield.py:43-44
+KALT = int(ALTMAX / ALTSTEP) + 1
+
+
+@struct.dataclass
+class WindState:
+    """Fixed-capacity wind field (device side)."""
+    lat: jnp.ndarray      # [P] deg
+    lon: jnp.ndarray      # [P] deg
+    vnorth: jnp.ndarray   # [P,K] m/s on the fixed altitude axis
+    veast: jnp.ndarray    # [P,K] m/s
+    active: jnp.ndarray   # [P] bool
+    winddim: jnp.ndarray  # scalar int: 0 none, 1 const, 2 planar, 3 profiles
+
+
+def make_windstate(pmax: int = 16, dtype=jnp.float32) -> WindState:
+    return WindState(
+        lat=jnp.zeros((pmax,), dtype), lon=jnp.zeros((pmax,), dtype),
+        vnorth=jnp.zeros((pmax, KALT), dtype),
+        veast=jnp.zeros((pmax, KALT), dtype),
+        active=jnp.zeros((pmax,), dtype=bool),
+        winddim=jnp.zeros((), jnp.int32))
+
+
+def add_point(wind: WindState, lat, lon, winddir, windspd,
+              windalt=None) -> WindState:
+    """Host-side: write a wind point into the first free slot.
+
+    winddir [deg] is the direction the wind comes FROM (the +pi in reference
+    windfield.py:84-92 converts to the blow-to vector).  windspd [m/s].
+    With ``windalt`` (list), dir/spd are arrays per altitude, linearly
+    resampled onto the fixed axis.
+    """
+    altaxis = np.arange(0.0, KALT) * ALTSTEP
+    if windalt is None:
+        wdir = np.full(KALT, float(np.atleast_1d(winddir)[0]))
+        wspd = np.full(KALT, float(np.atleast_1d(windspd)[0]))
+        vn = wspd * np.cos(np.radians(wdir) + np.pi)
+        ve = wspd * np.sin(np.radians(wdir) + np.pi)
+        prof3d = False
+    else:
+        wdir = np.asarray(winddir, dtype=float)
+        wspd = np.asarray(windspd, dtype=float)
+        altvn = wspd * np.cos(np.radians(wdir) + np.pi)
+        altve = wspd * np.sin(np.radians(wdir) + np.pi)
+        vn = np.interp(altaxis, np.asarray(windalt, dtype=float), altvn)
+        ve = np.interp(altaxis, np.asarray(windalt, dtype=float), altve)
+        prof3d = True
+
+    free = np.where(~np.asarray(wind.active))[0]
+    if len(free) == 0:
+        raise ValueError("wind field full; increase pmax")
+    i = int(free[0])
+    nactive = int(np.sum(np.asarray(wind.active))) + 1
+    winddim = int(wind.winddim)
+    if winddim < 3:
+        winddim = min(2, nactive)
+    if prof3d:
+        winddim = 3
+    return wind.replace(
+        lat=wind.lat.at[i].set(float(lat)),
+        lon=wind.lon.at[i].set(float(lon)),
+        vnorth=wind.vnorth.at[i].set(jnp.asarray(vn, wind.vnorth.dtype)),
+        veast=wind.veast.at[i].set(jnp.asarray(ve, wind.veast.dtype)),
+        active=wind.active.at[i].set(True),
+        winddim=jnp.asarray(winddim, jnp.int32))
+
+
+def getdata(wind: WindState, lat, lon, alt):
+    """Wind (vnorth, veast) [m/s] at positions — jit-safe.
+
+    Inverse-distance-squared horizontal weights over active points, linear
+    interpolation on the altitude axis (reference windfield.py:155-205).
+    Returns zeros when no points are defined.
+    """
+    eps = 1e-20
+    cavelat = jnp.cos(jnp.radians(0.5 * (lat[None, :] + wind.lat[:, None])))
+    dy = lat[None, :] - wind.lat[:, None]
+    dx = cavelat * (lon[None, :] - wind.lon[:, None])
+    invd2 = wind.active[:, None] / (eps + dx * dx + dy * dy)   # [P, N]
+    total = jnp.maximum(jnp.sum(invd2, axis=0, keepdims=True), 1e-30)
+    horfact = invd2 / total                                    # [P, N]
+
+    idxalt = jnp.maximum(0.0, jnp.minimum(ALTMAX - 1e-6, alt)) / ALTSTEP
+    ialt = jnp.floor(idxalt).astype(jnp.int32)
+    falt = idxalt - ialt
+
+    vn_lo = wind.vnorth[:, :].T[ialt, :]       # [N, P] rows at lower level
+    vn_hi = wind.vnorth[:, :].T[jnp.minimum(ialt + 1, KALT - 1), :]
+    ve_lo = wind.veast[:, :].T[ialt, :]
+    ve_hi = wind.veast[:, :].T[jnp.minimum(ialt + 1, KALT - 1), :]
+
+    w = horfact.T                               # [N, P]
+    vnorth = (1.0 - falt) * jnp.sum(vn_lo * w, axis=1) \
+        + falt * jnp.sum(vn_hi * w, axis=1)
+    veast = (1.0 - falt) * jnp.sum(ve_lo * w, axis=1) \
+        + falt * jnp.sum(ve_hi * w, axis=1)
+
+    haswind = wind.winddim > 0
+    return jnp.where(haswind, vnorth, 0.0), jnp.where(haswind, veast, 0.0)

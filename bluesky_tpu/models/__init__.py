@@ -1,0 +1,1 @@
+"""Aircraft performance coefficient tables and loaders."""

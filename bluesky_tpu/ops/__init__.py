@@ -1,0 +1,1 @@
+"""Pure jitted math ops: geodesy, atmosphere, conflict detection/resolution."""

@@ -1,0 +1,156 @@
+"""ISA atmosphere + airspeed conversions as jitted JAX functions.
+
+Parity with reference ``bluesky/tools/aero.py`` (vectorized ``v*`` family,
+aero.py:62-172): two-layer ISA (troposphere + isothermal stratosphere up to
+22 km), CAS/TAS/EAS/Mach conversions, and the crossover-aware ``vcasormach``.
+Everything is elementwise math — ideal XLA fusion food — and works for both
+scalars and arrays in any float dtype.  The scalar 8-layer ``atmos`` of the
+reference (aero.py:178-260) is only used for ground-level utilities; the
+vectorized 2-layer model is what the simulation loop uses, and that is what
+we provide.
+"""
+import jax.numpy as jnp
+
+# Constants (reference aero.py:11-29)
+kts = 0.514444          # m/s per knot
+ft = 0.3048             # m per foot
+fpm = ft / 60.0         # m/s per foot-per-minute
+inch = 0.0254
+sqft = 0.09290304
+nm = 1852.0             # m per nautical mile
+lbs = 0.453592          # kg per pound
+g0 = 9.80665            # m/s2
+R = 287.05287           # J/kg/K specific gas constant of air
+p0 = 101325.0           # Pa sea-level ISA pressure
+rho0 = 1.225            # kg/m3 sea-level ISA density
+T0 = 288.15             # K sea-level ISA temperature
+Tstrat = 216.65         # K stratosphere temperature
+gamma = 1.40
+gamma1 = 0.2            # (gamma-1)/2
+gamma2 = 3.5            # gamma/(gamma-1)
+beta = -0.0065          # K/m tropospheric lapse rate
+Rearth = 6371000.0      # m mean earth radius
+a0 = float(jnp.sqrt(gamma * R * T0))  # sea-level speed of sound
+
+
+def vtemp(h):
+    """ISA temperature [K] at altitude h [m] (reference aero.py:77-79)."""
+    return jnp.maximum(T0 + beta * h, Tstrat)
+
+
+def vatmos(h):
+    """ISA pressure [Pa], density [kg/m3], temperature [K] at h [m].
+
+    Troposphere: rho ~ T^(g/(beta R) - 1); stratosphere: exponential decay.
+    Constants match reference aero.py:62-74 digit for digit.
+    """
+    T = vtemp(h)
+    rhotrop = rho0 * (T / T0) ** 4.256848030018761
+    dhstrat = jnp.maximum(0.0, h - 11000.0)
+    rho = rhotrop * jnp.exp(-dhstrat / 6341.552161)  # = g0/(R*Tstrat)
+    p = rho * R * T
+    return p, rho, T
+
+
+def vpressure(h):
+    return vatmos(h)[0]
+
+
+def vdensity(h):
+    return vatmos(h)[1]
+
+
+def vvsound(h):
+    """Speed of sound [m/s] at altitude h [m]."""
+    return jnp.sqrt(gamma * R * vtemp(h))
+
+
+def vtas2mach(tas, h):
+    return tas / vvsound(h)
+
+
+def vmach2tas(M, h):
+    return M * vvsound(h)
+
+
+def veas2tas(eas, h):
+    return eas * jnp.sqrt(rho0 / vdensity(h))
+
+
+def vtas2eas(tas, h):
+    return tas * jnp.sqrt(vdensity(h) / rho0)
+
+
+def vcas2tas(cas, h):
+    """CAS -> TAS [m/s] via compressible-flow dynamic pressure (aero.py:128-136)."""
+    p, rho, _ = vatmos(h)
+    qdyn = p0 * ((1.0 + rho0 * cas * cas / (7.0 * p0)) ** 3.5 - 1.0)
+    tas = jnp.sqrt(7.0 * p / rho * ((1.0 + qdyn / p) ** (2.0 / 7.0) - 1.0))
+    return jnp.where(cas < 0, -tas, tas)
+
+
+def vtas2cas(tas, h):
+    """TAS -> CAS [m/s] (aero.py:139-147)."""
+    p, rho, _ = vatmos(h)
+    qdyn = p * ((1.0 + rho * tas * tas / (7.0 * p)) ** 3.5 - 1.0)
+    cas = jnp.sqrt(7.0 * p0 / rho0 * ((qdyn / p0 + 1.0) ** (2.0 / 7.0) - 1.0))
+    return jnp.where(tas < 0, -cas, cas)
+
+
+def vmach2cas(M, h):
+    return vtas2cas(vmach2tas(M, h), h)
+
+
+def vcas2mach(cas, h):
+    return vtas2mach(vcas2tas(cas, h), h)
+
+
+def vcasormach(spd, h):
+    """Interpret spd as Mach if 0.1 < spd < 1 else as CAS [m/s].
+
+    Returns (tas, cas, mach) — reference aero.py:163-168.
+    """
+    ismach = jnp.logical_and(0.1 < spd, spd < 1.0)
+    tas = jnp.where(ismach, vmach2tas(spd, h), vcas2tas(spd, h))
+    cas = jnp.where(ismach, vtas2cas(tas, h), spd)
+    m = jnp.where(ismach, spd, vtas2mach(tas, h))
+    return tas, cas, m
+
+
+def vcasormach2tas(spd, h):
+    """TAS from a CAS-or-Mach command value (|spd|<1 => Mach), aero.py:170-172."""
+    return jnp.where(jnp.abs(spd) < 1.0, vmach2tas(spd, h), vcas2tas(spd, h))
+
+
+def crossoveralt(cas, mach):
+    """Crossover altitude [m] where given CAS and Mach coincide.
+
+    Standard ISA relation; used for above/below-crossover speed-hold logic
+    (reference traffic keeps ``abco``/``belco`` flags, traffic.py:137-140).
+    """
+    # Impact pressure ratio at sea level for the CAS
+    dp = (1.0 + gamma1 * (cas / a0) ** 2) ** gamma2 - 1.0
+    # Pressure ratio at which the same impact pressure gives the target Mach
+    pratio = dp / ((1.0 + gamma1 * mach * mach) ** gamma2 - 1.0)
+    # Invert the tropospheric pressure law p/p0 = (T/T0)^(-g/(beta R))
+    texp = -beta * R / g0  # ~ 0.19026
+    return T0 / beta * (pratio ** texp - 1.0)
+
+
+# Aliases matching the reference's scalar names (same vectorized code — JAX
+# functions are shape-polymorphic, so no separate scalar implementations).
+atmos = vatmos
+temp = vtemp
+pressure = vpressure
+density = vdensity
+vsound = vvsound
+tas2mach = vtas2mach
+mach2tas = vmach2tas
+eas2tas = veas2tas
+tas2eas = vtas2eas
+cas2tas = vcas2tas
+tas2cas = vtas2cas
+mach2cas = vmach2cas
+cas2mach = vcas2mach
+casormach = vcasormach
+casormach2tas = vcasormach2tas

@@ -1,0 +1,228 @@
+"""Modified Voltage Potential (MVP) conflict resolution, fully vectorized.
+
+Semantic parity with the reference's ``bluesky/traffic/asas/MVP.py``: each
+conflict pair contributes a displacement-at-CPA repulsion vector scaled by the
+intrusion depth; contributions are summed per ownship; the combined velocity
+change is direction-limited and capped.
+
+TPU-first redesign: the reference loops over a Python list of conflict pairs
+calling a scalar ``MVP()`` per pair (MVP.py:33-61).  Here the per-pair
+displacement is computed for *all* N x N pairs as one masked broadcast, and
+the per-ownship accumulation (``dv[id1] -= dv_mvp``) becomes a masked row-sum
+— mathematically identical because contributions are additive.  Pair order
+never matters (addition is commutative up to float reassociation; golden tests
+compare at tolerance, see tests/test_cr_mvp.py).
+
+The priority rulesets (FF1-3/LAY1-2, MVP.py:235-300) act per pair on the sign
+and vertical component of each contribution; they are implemented as masks on
+the same pair matrices.  NORESO/RESOOFF lists arrive as boolean per-aircraft
+masks from the host.
+"""
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class MVPConfig(NamedTuple):
+    """Static-ish resolver configuration (device scalars / small arrays)."""
+    rpz_m: float          # protected zone radius with margin Rm [m]
+    hpz_m: float          # protected zone half-height with margin dhm [m]
+    tlookahead: float     # [s]
+    swresohoriz: bool = False   # resolve horizontally only
+    swresospd: bool = False     # ... with speed changes only
+    swresohdg: bool = False     # ... with heading changes only
+    swresovert: bool = False    # resolve vertically only
+
+
+def pair_contributions(cd, alt, gseast, gsnorth, vs, cfg):
+    """Per-pair MVP displacement vectors for all pairs.
+
+    Mirrors the scalar ``MVP()`` body (MVP.py:149-231) on [N,N] operands.
+    Returns (dve, dvn, dvv, tsolv): east/north/vertical velocity-change
+    contribution of pair (i,j) *to ownship i*, and the vertical solve time.
+    Entries where ``cd.swconfl`` is False are garbage; callers mask.
+    """
+    qdr = jnp.radians(cd.qdr)
+    dist = cd.dist
+    tcpa = cd.tcpa
+    tlos = cd.tinconf
+
+    # Relative position of intruder j w.r.t. ownship i (MVP.py:157-159)
+    drel_e = jnp.sin(qdr) * dist
+    drel_n = jnp.cos(qdr) * dist
+    drel_v = alt[None, :] - alt[:, None]
+
+    # Relative velocity (v2 - v1, MVP.py:162-164)
+    vrel_e = gseast[None, :] - gseast[:, None]
+    vrel_n = gsnorth[None, :] - gsnorth[:, None]
+    vrel_v = vs[None, :] - vs[:, None]
+
+    # Horizontal displacement at CPA (MVP.py:170-171)
+    dcpa_e = drel_e + vrel_e * tcpa
+    dcpa_n = drel_n + vrel_n * tcpa
+    dabsh = jnp.sqrt(dcpa_e * dcpa_e + dcpa_n * dcpa_n)
+
+    # Horizontal intrusion w.r.t. the margin-scaled zone radius (MVP.py:174)
+    ih = cfg.rpz_m - dabsh
+
+    # Head-on degenerate geometry: rotate drel 90 degrees (MVP.py:178-181)
+    headon = dabsh <= 10.0
+    safe_dist = jnp.maximum(dist, 1e-9)
+    dcpa_e = jnp.where(headon, drel_n / safe_dist * 10.0, dcpa_e)
+    dcpa_n = jnp.where(headon, -drel_e / safe_dist * 10.0, dcpa_n)
+    dabsh = jnp.where(headon, 10.0, dabsh)
+
+    abstcpa = jnp.maximum(jnp.abs(tcpa), 1e-9)
+    dve = (ih * dcpa_e) / (abstcpa * dabsh)
+    dvn = (ih * dcpa_n) / (abstcpa * dabsh)
+
+    # Non-grazing correction factor when intruder outside own PZ
+    # (MVP.py:190-193).  Guard the arcsin args; the branch condition already
+    # implies they are < 1 for pairs where it applies.
+    apply_err = (cfg.rpz_m < dist) & (dabsh < dist)
+    ratio1 = jnp.clip(cfg.rpz_m / safe_dist, -1.0, 1.0)
+    ratio2 = jnp.clip(dabsh / safe_dist, -1.0, 1.0)
+    erratum = jnp.cos(jnp.arcsin(ratio1) - jnp.arcsin(ratio2))
+    erratum = jnp.where(apply_err, erratum, 1.0)
+    # erratum can be ~0 for extreme geometry; reference divides unguarded, we
+    # clamp to keep the kernel NaN-free under padding garbage.
+    erratum = jnp.where(jnp.abs(erratum) < 1e-9, 1e-9, erratum)
+    dve = dve / erratum
+    dvn = dvn / erratum
+
+    # Vertical resolution (MVP.py:198-215)
+    has_dvs = jnp.abs(vrel_v) > 0.0
+    iv = jnp.where(has_dvs, cfg.hpz_m, cfg.hpz_m - jnp.abs(drel_v))
+    tsolv = jnp.where(has_dvs,
+                      jnp.abs(drel_v / jnp.where(has_dvs, vrel_v, 1.0)),
+                      tlos)
+    # Too slow to solve vertically within lookahead: solve within tLOS
+    slow = tsolv > cfg.tlookahead
+    tsolv = jnp.where(slow, tlos, tsolv)
+    iv = jnp.where(slow, cfg.hpz_m, iv)
+    tsolv_safe = jnp.where(jnp.abs(tsolv) < 1e-9, 1e-9, tsolv)
+    dvv = jnp.where(has_dvs,
+                    (iv / tsolv_safe) * (-jnp.sign(vrel_v)),
+                    iv / tsolv_safe)
+    return dve, dvn, dvv, tsolv
+
+
+def resolve(cd, alt, gseast, gsnorth, vs, trk, gs,
+            selalt, ap_vs, prev_alt,
+            vmin, vmax, vsmin, vsmax, cfg,
+            noreso=None, resooff=None):
+    """Compute per-aircraft resolution commands from the conflict matrix.
+
+    Args mirror the data the reference resolver reads from ``traf``/``asas``:
+      cd:           ConflictData from ops.cd.detect
+      alt..gs:      [N] current state
+      selalt:       [N] autopilot selected altitude [m]
+      ap_vs:        [N] autopilot commanded vertical speed [m/s]
+      prev_alt:     [N] previous ASAS altitude command (persistent state)
+      vmin..vsmax:  ASAS velocity caps (scalars or [N])
+      noreso:       [N] bool — aircraft nobody needs to avoid (MVP.py:52-56)
+      resooff:      [N] bool — aircraft that do not resolve (MVP.py:58-61)
+
+    Returns (newtrk, newgs, newvs, newalt, asase, asasn): the ASAS command
+    arrays (reference stores these on the asas object, MVP.py:103-143).
+    """
+    dve_p, dvn_p, dvv_p, tsolv_p = pair_contributions(
+        cd, alt, gseast, gsnorth, vs, cfg)
+
+    mask = cd.swconfl
+    # Nobody avoids a noreso intruder: drop contributions where j is noreso
+    # (reference adds the term back, MVP.py:52-56 — same net effect).
+    if noreso is not None:
+        mask = mask & ~noreso[None, :]
+
+    maskf = mask.astype(dve_p.dtype)
+    # dv[i] -= sum_j dv_mvp(i,j); vertical component halved because the
+    # resolution is cooperative (both aircraft manoeuvre, MVP.py:48-50).
+    dve = -jnp.sum(dve_p * maskf, axis=1)
+    dvn = -jnp.sum(dvn_p * maskf, axis=1)
+    dvv = -0.5 * jnp.sum(dvv_p * maskf, axis=1)
+
+    # Resooff aircraft do no resolutions at all (MVP.py:58-61)
+    if resooff is not None:
+        keep = ~resooff
+        dve = jnp.where(keep, dve, 0.0)
+        dvn = jnp.where(keep, dvn, 0.0)
+        dvv = jnp.where(keep, dvv, 0.0)
+
+    # Vertical solve time: min over this ownship's conflicts (MVP.py:41-42)
+    tsolv = jnp.min(jnp.where(mask, tsolv_p, 1e9), axis=1)
+
+    # New velocity vector (MVP.py:67-76)
+    newv_e = dve + gseast
+    newv_n = dvn + gsnorth
+    newv_v = dvv + vs
+    has_reso = dve * dve + dvn * dvn > 0.0
+
+    # Direction limiting (MVP.py:81-101)
+    full_trk = jnp.degrees(jnp.arctan2(newv_e, newv_n)) % 360.0
+    full_gs = jnp.sqrt(newv_e * newv_e + newv_n * newv_n)
+    if cfg.swresohoriz:
+        if cfg.swresospd and not cfg.swresohdg:
+            newtrk, newgs_, newvs = trk, full_gs, vs
+        elif cfg.swresohdg and not cfg.swresospd:
+            newtrk, newgs_, newvs = full_trk, gs, vs
+        else:
+            newtrk, newgs_, newvs = full_trk, full_gs, vs
+    elif cfg.swresovert:
+        newtrk, newgs_, newvs = trk, gs, newv_v
+    else:
+        newtrk, newgs_, newvs = full_trk, full_gs, newv_v
+
+    # Velocity caps (MVP.py:106-109)
+    newgs_ = jnp.clip(newgs_, vmin, vmax)
+    newvs = jnp.clip(newvs, vsmin, vsmax)
+
+    # Resolution vector for display/streams (MVP.py:117-118)
+    asase = jnp.where(has_reso, newgs_ * jnp.sin(jnp.radians(newtrk)), 0.0)
+    asasn = jnp.where(has_reso, newgs_ * jnp.cos(jnp.radians(newtrk)), 0.0)
+
+    # ASAS altitude command (MVP.py:123-143): follow the AP level-off
+    # altitude when it also resolves the conflict...
+    signdvs = jnp.sign(newvs - ap_vs * jnp.sign(selalt - alt))
+    signalt = jnp.sign(prev_alt - selalt)
+    newalt = jnp.where((signdvs == 0) | (signdvs == signalt), prev_alt, selalt)
+    # ...else aim at the altitude reached after the vertical solve time
+    altcond = (tsolv < cfg.tlookahead) & (jnp.abs(dvv) > 0.0)
+    newalt = jnp.where(altcond, newvs * tsolv + alt, newalt)
+    if cfg.swresohoriz:
+        newalt = selalt
+    return newtrk, newgs_, newvs, newalt, asase, asasn
+
+
+def resume_nav(resopairs, swlos_unused, lat, lon, gseast, gsnorth, trk,
+               active_ac, rpz, rpz_m):
+    """Vectorized ResumeNav (reference asas.py:409-471).
+
+    Decides per surviving resolution pair whether ASAS stays engaged: a pair
+    is kept while the aircraft have not yet passed their CPA, are in
+    horizontal LOS, or are in a "bouncing" near-parallel encounter.  The
+    reference iterates a Python set of pairs; here ``resopairs`` is an [N,N]
+    bool matrix and the same predicates are evaluated for all pairs at once.
+
+    Returns (new_resopairs, asas_active):
+      asas_active[i] = any pair (i, j) still demanding resolution.
+    """
+    re = 6371000.0
+    dist_e = re * (jnp.radians(lon[None, :] - lon[:, None])
+                   * jnp.cos(0.5 * jnp.radians(lat[None, :] + lat[:, None])))
+    dist_n = re * jnp.radians(lat[None, :] - lat[:, None])
+
+    vrel_e = gseast[None, :] - gseast[:, None]
+    vrel_n = gsnorth[None, :] - gsnorth[:, None]
+
+    past_cpa = dist_e * vrel_e + dist_n * vrel_n > 0.0
+    hdist = jnp.sqrt(dist_e * dist_e + dist_n * dist_n)
+    hor_los = hdist < rpz
+    is_bouncing = (jnp.abs(trk[:, None] - trk[None, :]) < 30.0) & (hdist < rpz_m)
+
+    # Drop pairs whose intruder was deleted (reference asas.py:419-421)
+    alive = active_ac[:, None] & active_ac[None, :]
+    keep = (~past_cpa | hor_los | is_bouncing) & alive
+    new_resopairs = resopairs & keep
+    asas_active = jnp.any(new_resopairs, axis=1)
+    return new_resopairs, asas_active

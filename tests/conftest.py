@@ -1,0 +1,20 @@
+"""Test harness config: run on a virtual 8-device CPU mesh with x64.
+
+Mirrors SURVEY.md §7: sharding is tested on a CPU mesh
+(xla_force_host_platform_device_count), and golden tests compare against
+float64 NumPy reference implementations — so tests enable x64. The TPU bench
+path (bench.py) runs float32 on the real chip instead.
+
+Env vars must be set before jax is imported anywhere.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
