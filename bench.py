@@ -1,0 +1,63 @@
+"""Benchmark: aircraft-steps/sec on one chip with full CD&R pipeline.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference runs 600-800 aircraft in real time on a desktop CPU
+(BlueSky ICRAT-2016 paper §IX; see BASELINE.md) at simdt=0.05 s =>
+~700 * 20 = 14,000 aircraft-steps/sec with the full pipeline.  vs_baseline is
+our aircraft-steps/sec divided by that.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_AC_STEPS_PER_SEC = 700 * 20.0
+
+
+def main(n_ac=10000, nsteps=200, reps=5):
+    import jax
+    import jax.numpy as jnp
+    from bluesky_tpu.core.step import SimConfig, run_steps
+    from bluesky_tpu.core.traffic import Traffic
+
+    nmax = n_ac
+    traf = Traffic(nmax=nmax, dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    traf.create(n_ac, "B744",
+                rng.uniform(3000.0, 11000.0, n_ac),
+                rng.uniform(130.0, 240.0, n_ac), None,
+                rng.uniform(51.0, 53.0, n_ac),
+                rng.uniform(3.0, 6.0, n_ac),
+                rng.uniform(0.0, 360.0, n_ac))
+    traf.flush()
+
+    cfg = SimConfig()  # full pipeline: FMS + ASAS CD&R (1 Hz) + perf + kinematics
+    state = traf.state
+
+    # warmup/compile
+    state = run_steps(state, cfg, nsteps)
+    jax.block_until_ready(state)
+
+    best = 0.0
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        state = run_steps(state, cfg, nsteps)
+        jax.block_until_ready(state)
+        dt = time.perf_counter() - t0
+        best = max(best, n_ac * nsteps / dt)
+
+    result = {
+        "metric": "aircraft-steps/sec/chip (N=%d, CD+MVP @1Hz, simdt=0.05)" % n_ac,
+        "value": round(best, 1),
+        "unit": "aircraft-steps/s",
+        "vs_baseline": round(best / BASELINE_AC_STEPS_PER_SEC, 2),
+    }
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 10000
+    main(n_ac=n)

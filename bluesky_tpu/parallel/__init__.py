@@ -1,0 +1,1 @@
+"""Device-mesh parallelism: aircraft-axis sharding, ensemble replication."""

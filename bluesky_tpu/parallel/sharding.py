@@ -1,0 +1,114 @@
+"""Shard the simulation over a device mesh.
+
+The reference scales two ways (SURVEY.md §2.10): NumPy vectorization within a
+process, and a process farm for *independent* scenarios.  Neither helps one
+big traffic scene.  Here the aircraft axis itself is sharded over a
+``jax.sharding.Mesh``:
+
+* every per-aircraft array ``[N]`` is split along axis 0 ('ac'),
+* the O(N^2) pair matrices ``[N, N]`` are split along rows — each device owns
+  the conflict rows of its aircraft block and all-gathers the column side
+  (position/velocity of all aircraft) over ICI, which is exactly the
+  block-distributed CD with halo exchange called for in SURVEY.md §5.7,
+* waypoint tables ``[N, W]`` split along rows; scalars/PRNG keys replicate.
+
+We annotate shardings and let GSPMD insert the collectives (all-gather of the
+broadcast operands of ``ops/cd.py``'s [N,1] x [1,N] math) rather than
+hand-writing shard_map — the step stays one jitted program on any mesh size,
+and the same code runs single-chip when the mesh has one device.
+
+A second mesh axis ('ens') replicates whole scenarios for Monte-Carlo
+ensembles (BASELINE config #4): see ``ensemble_step``.
+"""
+from functools import partial
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.state import SimState
+from ..core.step import SimConfig, step
+
+
+def make_mesh(n_devices=None, devices=None):
+    """1-D mesh over the aircraft axis."""
+    devices = devices if devices is not None else jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), ("ac",))
+
+
+def state_shardings(state: SimState, mesh: Mesh):
+    """NamedSharding pytree for a SimState: rank>=1 arrays with a leading
+    aircraft axis shard on 'ac'; scalars and the PRNG key replicate."""
+    nmax = state.nmax
+
+    def spec(leaf):
+        if hasattr(leaf, "ndim") and leaf.ndim >= 1 and leaf.shape[0] == nmax:
+            return NamedSharding(mesh, P("ac", *([None] * (leaf.ndim - 1))))
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(spec, state)
+
+
+def shard_state(state: SimState, mesh: Mesh) -> SimState:
+    """Place a host-built state onto the mesh with the canonical shardings."""
+    return jax.tree.map(lambda x, s: jax.device_put(x, s), state,
+                        state_shardings(state, mesh))
+
+
+def sharded_step_fn(mesh: Mesh, cfg: SimConfig, nsteps: int = 1):
+    """Compile the (scanned) step with explicit in/out shardings on mesh."""
+
+    def run(state):
+        def body(s, _):
+            return step(s, cfg), None
+        out, _ = jax.lax.scan(body, state, None, length=nsteps)
+        return out
+
+    return jax.jit(run, donate_argnums=0)
+
+
+# --------------------------------------------------------------------------
+# Monte-Carlo ensembles: vmap over a replica axis, sharded over devices.
+# Replaces the reference's BATCH process farm (server.py:269-287) with a
+# single SPMD program: each device owns whole replicas, no cross-device
+# traffic at all (embarrassingly parallel, DCN-friendly across slices).
+# --------------------------------------------------------------------------
+
+def make_ensemble_mesh(n_devices=None, devices=None):
+    devices = devices if devices is not None else jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), ("ens",))
+
+
+def ensemble_step_fn(mesh: Mesh, cfg: SimConfig, nsteps: int = 1):
+    """vmapped step over a leading replica axis, replicas sharded on 'ens'.
+
+    Input: a SimState pytree whose every leaf has a leading replica axis
+    (build with ``stack_replicas``).
+    """
+    def run_one(state):
+        def body(s, _):
+            return step(s, cfg), None
+        out, _ = jax.lax.scan(body, state, None, length=nsteps)
+        return out
+
+    vrun = jax.vmap(run_one)
+
+    def espec(leaf):
+        return NamedSharding(mesh, P("ens", *([None] * (leaf.ndim - 1))))
+
+    def run(states):
+        states = jax.lax.with_sharding_constraint(
+            states, jax.tree.map(espec, states))
+        return vrun(states)
+
+    return jax.jit(run, donate_argnums=0)
+
+
+def stack_replicas(states):
+    """Stack a list of equal-shape SimStates into one leading replica axis."""
+    import jax.numpy as jnp
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *states)

@@ -9,7 +9,11 @@ Env vars must be set before jax is imported anywhere.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the outer environment pins JAX_PLATFORMS=axon (the TPU tunnel),
+# which must never be used by the test suite (x64 golden tests + 8-device
+# virtual mesh are CPU-only concerns, and the single TPU is left free for
+# bench runs).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

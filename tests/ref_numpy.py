@@ -1,0 +1,105 @@
+"""Independent NumPy float64 oracles for golden tests.
+
+These implement the *documented semantics* of BlueSky's geodesy and
+state-based conflict detection (see SURVEY.md §2.2 / ops/cd.py docstrings) as
+straight NumPy, to validate the JAX kernels against an implementation that
+shares no code with them.  Kept deliberately simple and loop-free.
+"""
+import numpy as np
+
+NM = 1852.0
+A = 6378137.0
+B = 6356752.314245
+
+
+def rwgs84(latd):
+    lat = np.radians(latd)
+    cl, sl = np.cos(lat), np.sin(lat)
+    an, bn = A * A * cl, B * B * sl
+    ad, bd = A * cl, B * sl
+    return np.sqrt((an * an + bn * bn) / (ad * ad + bd * bd))
+
+
+def qdrdist_matrix(lat1, lon1, lat2, lon2):
+    """All-pairs bearing/distance with the reference's radius-at-sum quirk."""
+    la1 = np.asarray(lat1, np.float64)[:, None]
+    lo1 = np.asarray(lon1, np.float64)[:, None]
+    la2 = np.asarray(lat2, np.float64)[None, :]
+    lo2 = np.asarray(lon2, np.float64)[None, :]
+
+    diff_hemisphere = la1 * la2 < 0
+    r_same = rwgs84(la1 + la2)
+    denom = np.abs(la1) + np.abs(la2) + (la1 == 0.0) * 1e-6
+    r_diff = 0.5 * (np.abs(la1) * (rwgs84(la1) + A)
+                    + np.abs(la2) * (rwgs84(la2) + A)) / denom
+    r = np.where(diff_hemisphere, r_diff, r_same)
+
+    f1, f2 = np.radians(la1), np.radians(la2)
+    g1, g2 = np.radians(lo1), np.radians(lo2)
+    sdlat = np.sin(0.5 * (f2 - f1))
+    sdlon = np.sin(0.5 * (g2 - g1))
+    h = sdlat ** 2 + np.cos(f1) * np.cos(f2) * sdlon ** 2
+    dist = 2.0 * r * np.arctan2(np.sqrt(h), np.sqrt(1.0 - h)) / NM
+
+    qdr = np.degrees(np.arctan2(
+        np.sin(g2 - g1) * np.cos(f2),
+        np.cos(f1) * np.sin(f2) - np.sin(f1) * np.cos(f2) * np.cos(g2 - g1)))
+    return qdr, dist
+
+
+def detect(lat, lon, trk, gs, alt, vs, rpz, hpz, tlook):
+    """All-pairs state-based CD oracle. Returns dict of matrices/flags."""
+    n = len(lat)
+    I = np.eye(n)
+    qdr, distnm = qdrdist_matrix(lat, lon, lat, lon)
+    dist = distnm * NM + 1e9 * I
+
+    qdrrad = np.radians(qdr)
+    dx = dist * np.sin(qdrrad)
+    dy = dist * np.cos(qdrrad)
+
+    u = gs * np.sin(np.radians(trk))
+    v = gs * np.cos(np.radians(trk))
+    du = u[None, :] - u[:, None]
+    dv = v[None, :] - v[:, None]
+
+    dv2 = du * du + dv * dv
+    dv2 = np.where(np.abs(dv2) < 1e-6, 1e-6, dv2)
+    vrel = np.sqrt(dv2)
+
+    tcpa = -(du * dx + dv * dy) / dv2 + 1e9 * I
+    dcpa2 = dist * dist - tcpa * tcpa * dv2
+    R2 = rpz * rpz
+    swhorconf = dcpa2 < R2
+    dtinhor = np.sqrt(np.maximum(0.0, R2 - dcpa2)) / vrel
+    tinhor = np.where(swhorconf, tcpa - dtinhor, 1e8)
+    touthor = np.where(swhorconf, tcpa + dtinhor, -1e8)
+
+    dalt = alt[None, :] - alt[:, None] + 1e9 * I
+    dvs = vs[None, :] - vs[:, None]
+    dvs = np.where(np.abs(dvs) < 1e-6, 1e-6, dvs)
+    tcrosshi = (dalt + hpz) / -dvs
+    tcrosslo = (dalt - hpz) / -dvs
+    tinver = np.minimum(tcrosshi, tcrosslo)
+    toutver = np.maximum(tcrosshi, tcrosslo)
+
+    tinconf = np.maximum(tinver, tinhor)
+    toutconf = np.minimum(toutver, touthor)
+    swconfl = (swhorconf & (tinconf <= toutconf) & (toutconf > 0.0)
+               & (tinconf < tlook) & ~I.astype(bool))
+    swlos = (dist < rpz) & (np.abs(dalt) < hpz)
+    return dict(qdr=qdr, dist=dist, tcpa=tcpa, dcpa2=dcpa2, tinconf=tinconf,
+                toutconf=toutconf, swconfl=swconfl, swlos=swlos,
+                inconf=swconfl.any(axis=1),
+                tcpamax=(tcpa * swconfl).max(axis=1))
+
+
+def super_circle(nac, radius_deg=0.5, alt=3000.0, gs=150.0):
+    """SYN SUPER-style geometry: nac aircraft on a circle all flying to the
+    centre (cf. reference stack/synthetic.py SUPER)."""
+    ang = np.arange(nac) * 360.0 / nac
+    lat = radius_deg * np.cos(np.radians(ang + 180.0))
+    lon = radius_deg * np.sin(np.radians(ang + 180.0))
+    trk = ang.astype(np.float64)
+    return (lat, lon, trk, np.full(nac, gs), np.full(nac, alt),
+            np.zeros(nac))

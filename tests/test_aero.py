@@ -1,0 +1,71 @@
+"""ISA atmosphere and speed-conversion tests (vs published ISA values and
+roundtrip identities)."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from bluesky_tpu.ops import aero
+
+
+def test_isa_sea_level():
+    p, rho, T = aero.vatmos(jnp.asarray(0.0))
+    assert float(p) == pytest.approx(101325.0, rel=1e-6)
+    assert float(rho) == pytest.approx(1.225, rel=1e-6)
+    assert float(T) == pytest.approx(288.15, rel=1e-9)
+
+
+def test_isa_tropopause_and_stratosphere():
+    p11, rho11, T11 = aero.vatmos(jnp.asarray(11000.0))
+    assert float(T11) == pytest.approx(216.65, abs=1e-6)
+    assert float(p11) == pytest.approx(22632.0, rel=2e-3)  # published ISA
+    p20, _, T20 = aero.vatmos(jnp.asarray(20000.0))
+    assert float(T20) == pytest.approx(216.65, abs=1e-6)
+    assert float(p20) == pytest.approx(5474.9, rel=5e-3)
+
+
+def test_sound_speed():
+    assert float(aero.vvsound(jnp.asarray(0.0))) == pytest.approx(340.3, rel=1e-3)
+
+
+def test_speed_conversion_roundtrips():
+    h = jnp.asarray(np.linspace(0.0, 13000.0, 14))
+    cas = jnp.full_like(h, 140.0)
+    tas = aero.vcas2tas(cas, h)
+    back = aero.vtas2cas(tas, h)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(cas), rtol=1e-10)
+    # TAS >= CAS above sea level
+    assert np.all(np.asarray(tas)[1:] > 140.0)
+
+    m = aero.vtas2mach(tas, h)
+    tas2 = aero.vmach2tas(m, h)
+    np.testing.assert_allclose(np.asarray(tas2), np.asarray(tas), rtol=1e-12)
+
+    eas = aero.vtas2eas(tas, h)
+    tas3 = aero.veas2tas(eas, h)
+    np.testing.assert_allclose(np.asarray(tas3), np.asarray(tas), rtol=1e-12)
+
+
+def test_casormach_dispatch():
+    h = jnp.asarray(10000.0)
+    tas_m, cas_m, m_m = aero.vcasormach(jnp.asarray(0.8), h)
+    assert float(m_m) == pytest.approx(0.8)
+    assert float(tas_m) == pytest.approx(float(aero.vmach2tas(0.8, h)))
+    tas_c, cas_c, m_c = aero.vcasormach(jnp.asarray(140.0), h)
+    assert float(cas_c) == pytest.approx(140.0)
+    assert float(tas_c) == pytest.approx(float(aero.vcas2tas(140.0, h)))
+
+
+def test_negative_speeds_preserved():
+    assert float(aero.vcas2tas(jnp.asarray(-100.0), 5000.0)) < 0
+    assert float(aero.vtas2cas(jnp.asarray(-100.0), 5000.0)) < 0
+
+
+def test_crossover_altitude_consistency():
+    cas = 150.0
+    mach = 0.78
+    hx = float(aero.crossoveralt(cas, mach))
+    assert 5000.0 < hx < 15000.0
+    # At the crossover altitude the two speed definitions agree
+    tas_from_cas = float(aero.vcas2tas(jnp.asarray(cas), hx))
+    tas_from_mach = float(aero.vmach2tas(jnp.asarray(mach), hx))
+    assert tas_from_cas == pytest.approx(tas_from_mach, rel=5e-3)

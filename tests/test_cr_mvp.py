@@ -1,0 +1,162 @@
+"""MVP resolver tests: vectorized pair-sum vs an independent per-pair oracle."""
+import numpy as np
+import jax.numpy as jnp
+
+from bluesky_tpu.ops import cd, cr_mvp
+import ref_numpy as ref
+
+NM = 1852.0
+FT = 0.3048
+RPZ = 5.0 * NM
+HPZ = 1000.0 * FT
+TLOOK = 300.0
+RPZ_M = RPZ * 1.05
+HPZ_M = HPZ * 1.05
+
+
+def mvp_pair_oracle(drel, v1, v2, qdr_deg, dist, tcpa, tlos):
+    """Scalar MVP displacement for one conflict pair (independent NumPy
+    implementation of the documented semantics, cf. ops/cr_mvp.py)."""
+    vrel = v2 - v1
+    dcpa = drel + vrel * tcpa
+    dabsh = float(np.hypot(dcpa[0], dcpa[1]))
+    ih = RPZ_M - dabsh
+    if dabsh <= 10.0:
+        dabsh = 10.0
+        dcpa[0] = drel[1] / dist * dabsh
+        dcpa[1] = -drel[0] / dist * dabsh
+    dv1 = ih * dcpa[0] / (abs(tcpa) * dabsh)
+    dv2 = ih * dcpa[1] / (abs(tcpa) * dabsh)
+    if RPZ_M < dist and dabsh < dist:
+        err = np.cos(np.arcsin(RPZ_M / dist) - np.arcsin(dabsh / dist))
+        dv1 /= err
+        dv2 /= err
+    if abs(vrel[2]) > 0.0:
+        iv = HPZ_M
+        tsolv = abs(drel[2] / vrel[2])
+    else:
+        iv = HPZ_M - abs(drel[2])
+        tsolv = tlos
+    if tsolv > TLOOK:
+        tsolv = tlos
+        iv = HPZ_M
+    dv3 = (iv / tsolv) * (-np.sign(vrel[2])) if abs(vrel[2]) > 0 else iv / tsolv
+    return np.array([dv1, dv2, dv3]), tsolv
+
+
+def _run_case(lat, lon, trk, gs, alt, vs):
+    n = len(lat)
+    j = lambda x: jnp.asarray(np.asarray(x, np.float64))
+    active = jnp.ones(n, dtype=bool)
+    out = cd.detect(j(lat), j(lon), j(trk), j(gs), j(alt), j(vs),
+                    active, RPZ, HPZ, TLOOK)
+    gseast = gs * np.sin(np.radians(trk))
+    gsnorth = gs * np.cos(np.radians(trk))
+    cfg = cr_mvp.MVPConfig(rpz_m=RPZ_M, hpz_m=HPZ_M, tlookahead=TLOOK)
+    dve_p, dvn_p, dvv_p, tsolv_p = cr_mvp.pair_contributions(
+        out, j(alt), j(gseast), j(gsnorth), j(vs), cfg)
+    return out, (np.asarray(dve_p), np.asarray(dvn_p), np.asarray(dvv_p),
+                 np.asarray(tsolv_p)), (gseast, gsnorth)
+
+
+def test_pair_contributions_match_scalar_oracle():
+    lat, lon, trk, gs, alt, vs = ref.super_circle(8)
+    # give some vertical motion to exercise the vertical branch
+    vs = vs + np.array([0, 1, 0, -1, 0, 2, 0, 0], np.float64)
+    alt = alt + np.array([0, 100, 0, -120, 0, 50, 0, 0], np.float64)
+    out, (dve_p, dvn_p, dvv_p, tsolv_p), (gse, gsn) = _run_case(
+        lat, lon, trk, gs, alt, vs)
+    sw = np.asarray(out.swconfl)
+    qdr = np.asarray(out.qdr)
+    dist = np.asarray(out.dist)
+    tcpa = np.asarray(out.tcpa)
+    tlos = np.asarray(out.tinconf)
+    assert sw.any()
+    for i, jdx in zip(*np.where(sw)):
+        qr = np.radians(qdr[i, jdx])
+        drel = np.array([np.sin(qr) * dist[i, jdx],
+                         np.cos(qr) * dist[i, jdx],
+                         alt[jdx] - alt[i]])
+        v1 = np.array([gse[i], gsn[i], vs[i]])
+        v2 = np.array([gse[jdx], gsn[jdx], vs[jdx]])
+        dv_exp, tsolv_exp = mvp_pair_oracle(
+            drel, v1, v2, qdr[i, jdx], dist[i, jdx], tcpa[i, jdx], tlos[i, jdx])
+        np.testing.assert_allclose(
+            [dve_p[i, jdx], dvn_p[i, jdx], dvv_p[i, jdx]], dv_exp,
+            rtol=1e-9, atol=1e-12, err_msg=f"pair {i},{jdx}")
+        np.testing.assert_allclose(tsolv_p[i, jdx], tsolv_exp, rtol=1e-9)
+
+
+def test_resolve_pushes_track_away_and_caps_speed():
+    lat, lon, trk, gs, alt, vs = ref.super_circle(2, radius_deg=0.3)
+    n = 2
+    j = lambda x: jnp.asarray(np.asarray(x, np.float64))
+    active = jnp.ones(n, dtype=bool)
+    out = cd.detect(j(lat), j(lon), j(trk), j(gs), j(alt), j(vs),
+                    active, RPZ, HPZ, TLOOK)
+    assert bool(out.swconfl[0, 1])
+    gse = gs * np.sin(np.radians(trk))
+    gsn = gs * np.cos(np.radians(trk))
+    cfg = cr_mvp.MVPConfig(rpz_m=RPZ_M, hpz_m=HPZ_M, tlookahead=TLOOK)
+    vmin, vmax = 100.0, 160.0
+    newtrk, newgs, newvs, newalt, asase, asasn = cr_mvp.resolve(
+        out, j(alt), j(gse), j(gsn), j(vs), j(trk), j(gs),
+        j(alt), j(np.zeros(n)), j(alt),
+        vmin, vmax, -15.0, 15.0, cfg)
+    newtrk = np.asarray(newtrk)
+    newgs = np.asarray(newgs)
+    # Head-on: both must turn off the collision track
+    dtrk0 = (newtrk[0] - trk[0] + 180.0) % 360.0 - 180.0
+    dtrk1 = (newtrk[1] - trk[1] + 180.0) % 360.0 - 180.0
+    assert abs(dtrk0) > 0.5 and abs(dtrk1) > 0.5
+    # MVP is cooperative: turns should be opposite in the ground frame
+    assert np.all(newgs >= vmin - 1e-9) and np.all(newgs <= vmax + 1e-9)
+
+
+def test_noreso_and_resooff_masks():
+    lat, lon, trk, gs, alt, vs = ref.super_circle(2, radius_deg=0.3)
+    n = 2
+    j = lambda x: jnp.asarray(np.asarray(x, np.float64))
+    active = jnp.ones(n, dtype=bool)
+    out = cd.detect(j(lat), j(lon), j(trk), j(gs), j(alt), j(vs),
+                    active, RPZ, HPZ, TLOOK)
+    gse = gs * np.sin(np.radians(trk))
+    gsn = gs * np.cos(np.radians(trk))
+    cfg = cr_mvp.MVPConfig(rpz_m=RPZ_M, hpz_m=HPZ_M, tlookahead=TLOOK)
+    args = (out, j(alt), j(gse), j(gsn), j(vs), j(trk), j(gs),
+            j(alt), j(np.zeros(n)), j(alt), 50.0, 500.0, -15.0, 15.0, cfg)
+    # resooff on ac0: its commands revert to current state
+    _, _, _, _, asase, asasn = cr_mvp.resolve(
+        *args, resooff=jnp.asarray([True, False]))
+    assert float(asase[0]) == 0.0 and float(asasn[0]) == 0.0
+    assert float(asase[1]) != 0.0 or float(asasn[1]) != 0.0
+    # noreso on ac1: nobody avoids it -> ac0 gets no contribution either
+    _, _, _, _, asase2, _ = cr_mvp.resolve(
+        *args, noreso=jnp.asarray([False, True]))
+    assert float(asase2[0]) == 0.0
+
+
+def test_resume_nav_keeps_pre_cpa_drops_post_cpa():
+    # Pair approaching: dot(dist, vrel) < 0 -> keep resolving
+    lat = np.array([0.0, 0.0])
+    lon = np.array([-0.3, 0.3])
+    trk = np.array([90.0, 270.0])
+    gs = np.array([150.0, 150.0])
+    j = lambda x: jnp.asarray(np.asarray(x, np.float64))
+    gse = gs * np.sin(np.radians(trk))
+    gsn = gs * np.cos(np.radians(trk))
+    resopairs = jnp.asarray(np.array([[False, True], [True, False]]))
+    active = jnp.ones(2, dtype=bool)
+    newpairs, act = cr_mvp.resume_nav(resopairs, None, j(lat), j(lon),
+                                      j(gse), j(gsn), j(trk), active,
+                                      RPZ, RPZ_M)
+    assert bool(act[0]) and bool(act[1])
+    # Diverging (already passed): drop and deactivate
+    trk2 = np.array([270.0, 90.0])
+    gse2 = gs * np.sin(np.radians(trk2))
+    gsn2 = gs * np.cos(np.radians(trk2))
+    newpairs2, act2 = cr_mvp.resume_nav(resopairs, None, j(lat), j(lon),
+                                        j(gse2), j(gsn2), j(trk2), active,
+                                        RPZ, RPZ_M)
+    assert not bool(act2[0]) and not bool(act2[1])
+    assert not np.asarray(newpairs2).any()

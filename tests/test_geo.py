@@ -1,0 +1,85 @@
+"""Geodesy op tests: JAX kernels vs the independent NumPy oracle +
+self-consistency properties."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from bluesky_tpu.ops import geo
+import ref_numpy as ref
+
+
+RNG = np.random.default_rng(42)
+LATS = RNG.uniform(-80, 80, 32)
+LONS = RNG.uniform(-179, 179, 32)
+
+
+def test_rwgs84_range_and_known_values():
+    r = np.asarray(geo.rwgs84(jnp.asarray(LATS)))
+    assert np.all(r > 6.33e6) and np.all(r < 6.39e6)
+    # Equator: a; pole: b^2/a is NOT the formula — the geometric-mean radius
+    # at the pole equals b.
+    assert float(geo.rwgs84(0.0)) == pytest.approx(6378137.0, abs=1e-3)
+    assert float(geo.rwgs84(90.0)) == pytest.approx(6356752.314245, abs=1e-3)
+
+
+def test_qdrdist_matrix_matches_oracle():
+    qdr, dist = geo.qdrdist_matrix(jnp.asarray(LATS), jnp.asarray(LONS),
+                                   jnp.asarray(LATS), jnp.asarray(LONS))
+    qdr_ref, dist_ref = ref.qdrdist_matrix(LATS, LONS, LATS, LONS)
+    # The diagonal self-bearing is atan2(0, +-0) — sign-of-zero noise with no
+    # meaning (CD masks it); compare off-diagonal entries.
+    offdiag = ~np.eye(len(LATS), dtype=bool)
+    np.testing.assert_allclose(np.asarray(qdr)[offdiag], qdr_ref[offdiag],
+                               rtol=0, atol=1e-9)
+    np.testing.assert_allclose(np.asarray(dist), dist_ref, rtol=1e-12, atol=1e-9)
+
+
+def test_qdrdist_scalar_consistent_with_known_distance():
+    # 1 degree of latitude ~ 60 nm on the sphere
+    qdr, d = geo.qdrdist(0.0, 0.0, 1.0, 0.0)
+    assert float(qdr) == pytest.approx(0.0, abs=1e-9)
+    assert float(d) == pytest.approx(60.0, rel=2e-3)
+    # due east at equator
+    qdr, d = geo.qdrdist(0.0, 0.0, 0.0, 1.0)
+    assert float(qdr) == pytest.approx(90.0, abs=1e-9)
+
+
+def test_qdrpos_inverts_qdrdist():
+    lat1 = jnp.asarray(LATS[:8])
+    lon1 = jnp.asarray(LONS[:8])
+    qdr = jnp.asarray(RNG.uniform(0, 360, 8))
+    dist = jnp.asarray(RNG.uniform(1, 300, 8))  # nm
+    lat2, lon2 = geo.qdrpos(lat1, lon1, qdr, dist)
+    qdr2, dist2 = geo.qdrdist(lat1, lon1, lat2, lon2)
+    # bearings modulo 360
+    dq = (np.asarray(qdr2) - np.asarray(qdr) + 180.0) % 360.0 - 180.0
+    np.testing.assert_allclose(dq, 0.0, atol=0.05)
+    np.testing.assert_allclose(np.asarray(dist2), np.asarray(dist), rtol=5e-3)
+
+
+def test_kwik_approximations_close_to_exact_at_short_range():
+    lat1, lon1 = 52.0, 4.0
+    lat2, lon2 = 52.2, 4.3
+    _, d_exact = geo.qdrdist(lat1, lon1, lat2, lon2)
+    d_kwik = geo.kwikdist(lat1, lon1, lat2, lon2)
+    assert float(d_kwik) == pytest.approx(float(d_exact), rel=2e-3)
+    qdr_kwik, d_m = geo.kwikqdrdist(lat1, lon1, lat2, lon2)
+    assert float(d_m) == pytest.approx(float(d_exact) * 1852.0, rel=2e-3)
+
+
+def test_latlondist_metres():
+    d = geo.latlondist(0.0, 0.0, 1.0, 0.0)
+    assert float(d) == pytest.approx(110e3, rel=2e-2)  # metres
+
+
+def test_wgsg_gravity():
+    assert float(geo.wgsg(0.0)) == pytest.approx(9.7803, abs=1e-4)
+    assert float(geo.wgsg(90.0)) > float(geo.wgsg(0.0))
+
+
+def test_kwikpos_roundtrip():
+    lat2, lon2 = geo.kwikpos(52.0, 4.0, 90.0, 60.0)
+    # 60 nm east at 52N: dlon = 1/cos(52)
+    assert float(lat2) == pytest.approx(52.0, abs=1e-6)
+    assert float(lon2) == pytest.approx(4.0 + 1.0 / np.cos(np.radians(52.0)),
+                                        rel=1e-6)
