@@ -1,0 +1,269 @@
+"""Host-side flight-plan (route) management writing dense device tables.
+
+The reference keeps one Python ``Route`` object per aircraft with parallel
+lists of waypoints and does all FMS lookups through it at sim rate
+(route.py:15-1109).  Here the *editing* stays host-side (stack commands are
+host events, arriving between step chunks) but the *data* lives in the dense
+``RouteArrays`` tables of the state pytree that the jitted FMS consumes —
+editing a route is a slot-row write, not an object mutation.
+
+Implemented with reference semantics:
+* waypoint ordering rules of ``Route.addwpt`` (orig at front, dest at end,
+  normal waypoints before dest; route.py:472-614 simplified: navdb fuzzy
+  position text resolution lives in stack/argparser)
+* ``calcfp`` altitude-constraint propagation: for each waypoint, the next
+  altitude constraint at/after it and the along-route distance to that
+  constraint (route.py:983-1041) -> ``wptoalt``/``wpxtoalt``
+* ``direct``: activate a waypoint and aim guidance at it (route.py:635-705)
+* ``findact``: closest-ahead waypoint choice (route.py:1043-1075)
+"""
+from typing import List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..ops import aero
+
+# Waypoint types (reference route.py wptype coding, dumpRoute legend)
+WPT_LATLON, WPT_NAV, WPT_ORIG, WPT_DEST, WPT_CALC, WPT_RWY = range(6)
+
+
+class HostRoute:
+    """Host mirror of one aircraft's flight plan (names + arrays)."""
+
+    def __init__(self):
+        self.name: List[str] = []
+        self.lat: List[float] = []
+        self.lon: List[float] = []
+        self.alt: List[float] = []      # [m], -999 = none
+        self.spd: List[float] = []      # CAS m/s or Mach, -999 = none
+        self.wtype: List[int] = []
+        self.flyby: List[float] = []
+        self.iactwp = -1
+
+    @property
+    def nwp(self):
+        return len(self.name)
+
+
+class RouteManager:
+    """All host routes + synchronisation into the device RouteArrays."""
+
+    def __init__(self, traf, wmax: int):
+        self.traf = traf
+        self.wmax = wmax
+        self.routes = {}   # slot -> HostRoute
+
+    def route(self, idx: int) -> HostRoute:
+        return self.routes.setdefault(idx, HostRoute())
+
+    def clear(self, idx: int):
+        self.routes.pop(idx, None)
+
+    # ------------------------------------------------------------- editing
+    def addwpt(self, idx: int, name: str, lat: float, lon: float,
+               alt: float = -999.0, spd: float = -999.0,
+               wtype: int = WPT_LATLON, flyby: float = 1.0,
+               afterwp: Optional[str] = None) -> int:
+        """Insert a waypoint with the reference's ordering rules.
+
+        Returns the insertion index, or -1 on error (unknown afterwp).
+        """
+        r = self.route(idx)
+        name = name.upper()
+
+        if afterwp is not None:
+            names = [n.upper() for n in r.name]
+            if afterwp.upper() not in names:
+                return -1
+            wpidx = names.index(afterwp.upper()) + 1
+        elif wtype == WPT_ORIG:
+            # Origin goes at the front, replacing an existing origin
+            if r.nwp > 0 and r.wtype[0] == WPT_ORIG:
+                self._pop(r, 0)
+            wpidx = 0
+        elif wtype == WPT_DEST:
+            # Destination goes at the end, replacing an existing dest
+            if r.nwp > 0 and r.wtype[-1] == WPT_DEST:
+                self._pop(r, r.nwp - 1)
+            wpidx = r.nwp
+        else:
+            # Normal waypoints go before the destination if there is one
+            wpidx = r.nwp - 1 if (r.nwp > 0 and r.wtype[-1] == WPT_DEST) \
+                else r.nwp
+
+        if r.nwp >= self.wmax:
+            raise RuntimeError(
+                f"route full for slot {idx} (wmax={self.wmax}); raise wmax")
+
+        r.name.insert(wpidx, name)
+        r.lat.insert(wpidx, float(lat))
+        r.lon.insert(wpidx, float(lon))
+        r.alt.insert(wpidx, float(alt))
+        r.spd.insert(wpidx, float(spd))
+        r.wtype.insert(wpidx, int(wtype))
+        r.flyby.insert(wpidx, float(flyby))
+        if r.iactwp >= wpidx:
+            r.iactwp += 1
+        if r.iactwp < 0:
+            r.iactwp = 0
+        self.sync(idx)
+        return wpidx
+
+    @staticmethod
+    def _pop(r: HostRoute, i: int):
+        for lst in (r.name, r.lat, r.lon, r.alt, r.spd, r.wtype, r.flyby):
+            del lst[i]
+        if r.iactwp > i:
+            r.iactwp -= 1
+
+    def delwpt(self, idx: int, name: str) -> bool:
+        r = self.route(idx)
+        if name == "*":
+            self.routes[idx] = HostRoute()
+            self.sync(idx)
+            return True
+        names = [n.upper() for n in r.name]
+        if name.upper() not in names:
+            return False
+        # reference deletes the LAST matching occurrence (route.py:816-821)
+        i = len(names) - 1 - names[::-1].index(name.upper())
+        self._pop(r, i)
+        r.iactwp = min(r.iactwp, r.nwp - 1)
+        self.sync(idx)
+        return True
+
+    def direct(self, idx: int, name: str) -> bool:
+        """DIRECT: jump the active waypoint to ``name`` and point guidance at
+        it (route.py:635-705, condensed: the VNAV re-trigger happens at the
+        next FMS tick from the synced tables)."""
+        r = self.route(idx)
+        names = [n.upper() for n in r.name]
+        if name.upper() not in names:
+            return False
+        r.iactwp = names.index(name.upper())
+        self.sync(idx, point_active=True)
+        return True
+
+    def findact(self, idx: int) -> int:
+        """Closest-ahead waypoint (route.py:1043-1075)."""
+        r = self.route(idx)
+        if r.nwp <= 0:
+            return -1
+        if r.nwp == 1:
+            return 0
+        st = self.traf.state
+        aclat = float(st.ac.lat[idx])
+        aclon = float(st.ac.lon[idx])
+        coslat = float(st.ac.coslat[idx])
+        trk = float(st.ac.trk[idx])
+        tas = float(st.ac.tas[idx])
+        bank = float(st.ac.bank[idx])
+
+        dy = np.asarray(r.lat) - aclat
+        dx = (np.asarray(r.lon) - aclon) * coslat
+        dist2 = dx * dx + dy * dy
+        iwpnear = max(r.iactwp, int(np.argmin(dist2)))
+        if iwpnear + 1 < r.nwp:
+            qdr = np.degrees(np.arctan2(dx[iwpnear], dy[iwpnear]))
+            delhdg = abs((trk - qdr + 180.0) % 360.0 - 180.0)
+            time_turn = max(0.01, tas) * np.radians(delhdg) \
+                / (aero.g0 * np.tan(bank))
+            time_straight = np.sqrt(dist2[iwpnear]) * 60.0 * aero.nm \
+                / max(0.01, tas)
+            if time_turn > time_straight:
+                iwpnear += 1
+        return iwpnear
+
+    # --------------------------------------------------------------- sync
+    def calcfp(self, r: HostRoute):
+        """Altitude-constraint lookahead tables (route.py:983-1041)."""
+        n = r.nwp
+        wpdistto = np.zeros(n)          # [nm] distance from wp i-1 to i
+        for i in range(n - 1):
+            from ..core.traffic import _np_vatmos  # noqa: F401 (host helpers)
+            wpdistto[i + 1] = _host_qdrdist_nm(r.lat[i], r.lon[i],
+                                               r.lat[i + 1], r.lon[i + 1])
+        wptoalt = np.full(n, -999.0)
+        wpxtoalt = np.ones(n)
+        toalt, xtoalt = -999.0, 0.0
+        for i in range(n - 1, -1, -1):
+            if r.wtype[i] == WPT_DEST:
+                toalt, xtoalt = 0.0, 0.0
+            elif r.alt[i] >= 0:
+                toalt, xtoalt = r.alt[i], 0.0
+            else:
+                xtoalt = xtoalt + wpdistto[i + 1] * aero.nm if i != n - 1 \
+                    else 0.0
+            wptoalt[i] = toalt
+            wpxtoalt[i] = xtoalt
+        return wptoalt, wpxtoalt
+
+    def sync(self, idx: int, point_active: bool = False):
+        """Write one slot's host route into the device tables."""
+        self.traf.flush()
+        r = self.route(idx)
+        st = self.traf.state
+        rt = st.route
+        W = self.wmax
+        n = r.nwp
+
+        def row(vals, fill):
+            out = np.full(W, fill)
+            out[:n] = vals
+            return out
+
+        wptoalt, wpxtoalt = self.calcfp(r)
+        i = idx
+        dt = rt.wplat.dtype
+        rt = rt.replace(
+            wplat=rt.wplat.at[i].set(jnp.asarray(row(r.lat, 89.99), dt)),
+            wplon=rt.wplon.at[i].set(jnp.asarray(row(r.lon, 0.0), dt)),
+            wpalt=rt.wpalt.at[i].set(jnp.asarray(row(r.alt, -999.0), dt)),
+            wpspd=rt.wpspd.at[i].set(jnp.asarray(row(r.spd, -999.0), dt)),
+            wpflyby=rt.wpflyby.at[i].set(jnp.asarray(row(r.flyby, 1.0), dt)),
+            wptoalt=rt.wptoalt.at[i].set(jnp.asarray(row(wptoalt, -999.0), dt)),
+            wpxtoalt=rt.wpxtoalt.at[i].set(jnp.asarray(row(wpxtoalt, 0.0), dt)),
+            nwp=rt.nwp.at[i].set(n),
+            iactwp=rt.iactwp.at[i].set(r.iactwp))
+        st = st.replace(route=rt)
+
+        if point_active and 0 <= r.iactwp < n:
+            k = r.iactwp
+            actwp = st.actwp
+            ac = st.ac
+            st = st.replace(
+                actwp=actwp.replace(
+                    lat=actwp.lat.at[i].set(r.lat[k]),
+                    lon=actwp.lon.at[i].set(r.lon[k]),
+                    nextaltco=actwp.nextaltco.at[i].set(
+                        r.alt[k] if r.alt[k] >= 0 else float(actwp.nextaltco[i])),
+                    spd=actwp.spd.at[i].set(r.spd[k]),
+                    flyby=actwp.flyby.at[i].set(r.flyby[k]),
+                    xtoalt=actwp.xtoalt.at[i].set(float(wpxtoalt[k]))),
+                ac=ac.replace(swlnav=ac.swlnav.at[i].set(True)))
+        self.traf.state = st
+
+
+def _host_qdrdist_nm(lat1, lon1, lat2, lon2):
+    """Host float64 haversine distance [nm] (same math as ops/geo.qdrdist)."""
+    a = 6378137.0
+    b = 6356752.314245
+
+    def rw(latd):
+        la = np.radians(latd)
+        cl, sl = np.cos(la), np.sin(la)
+        an, bn = a * a * cl, b * b * sl
+        ad, bd = a * cl, b * sl
+        return np.sqrt((an * an + bn * bn) / (ad * ad + bd * bd))
+
+    if lat1 * lat2 >= 0:
+        r = rw(0.5 * (lat1 + lat2))
+    else:
+        r = 0.5 * (abs(lat1) * (rw(lat1) + a) + abs(lat2) * (rw(lat2) + a)) \
+            / (abs(lat1) + abs(lat2))
+    f1, f2 = np.radians(lat1), np.radians(lat2)
+    g1, g2 = np.radians(lon1), np.radians(lon2)
+    h = np.sin(0.5 * (f2 - f1)) ** 2 \
+        + np.cos(f1) * np.cos(f2) * np.sin(0.5 * (g2 - g1)) ** 2
+    return 2.0 * r * np.arctan2(np.sqrt(h), np.sqrt(1 - h)) / 1852.0

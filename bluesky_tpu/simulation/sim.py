@@ -1,0 +1,252 @@
+"""The simulation loop: fixed-dt stepping, fast-time control, benchmark.
+
+Parity with the reference ``Simulation`` node (simulation/qtgl/simulation.py:
+18-287): sim states INIT/HOLD/OP/END, wall-clock pacing with fast-forward and
+DTMULT, scenario-command scheduling each step, BENCHMARK timing, and the
+event surface (op/pause/reset/ff/...) the stack binds to.
+
+TPU-first difference: the reference steps once per loop iteration (simdt,
+then checks the stack).  Here the device advances in *chunks* of k steps with
+one ``lax.scan`` program (core/step.run_steps) and the host syncs only at
+chunk edges — stack commands, scenario triggers, loggers and plugin hooks all
+run at chunk boundaries.  With the default chunk of 20 steps (1 s sim time)
+command latency matches the reference's ASAS interval; BENCHMARK/FF runs use
+big chunks for full throughput.
+"""
+import time
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.asas import AsasConfig
+from ..core.noise import NoiseConfig
+from ..core.route import RouteManager
+from ..core.step import SimConfig, run_steps
+from ..core.traffic import Traffic
+
+# Sim states (reference bluesky/__init__.py:12)
+INIT, HOLD, OP, END = range(4)
+
+
+class Screen:
+    """Echo/plot sink — headless stand-in for ScreenIO (screenio.py:11-263).
+
+    Collects echo lines so stack command output is observable; the network
+    node subclass streams instead.
+    """
+
+    def __init__(self):
+        self.echobuf = []
+        self.viewbounds = (-1.0, 1.0, -1.0, 1.0)
+
+    def echo(self, text="", flags=0):
+        self.echobuf.append(text)
+        return True
+
+    def getviewbounds(self):
+        return self.viewbounds
+
+
+class Simulation:
+    """Host simulation driver owning traffic, config and the step loop."""
+
+    # Allowed device-chunk sizes, largest first (each size = one compiled
+    # scan program per SimConfig).
+    CHUNK_LADDER = (1000, 200, 20, 5, 1)
+
+    def __init__(self, nmax: int = 1024, wmax: int = 32, dtype=None,
+                 openap_path: Optional[str] = None, rng_seed: int = 0,
+                 chunk_steps: int = 20):
+        dtype = dtype or jnp.float32
+        self.traf = Traffic(nmax=nmax, wmax=wmax, dtype=dtype,
+                            openap_path=openap_path, rng_seed=rng_seed)
+        self.routes = RouteManager(self.traf, wmax)
+        self.scr = Screen()
+        self.cfg = SimConfig()
+        self.state_flag = INIT
+        self.chunk_steps = chunk_steps
+        self.dtmult = 1.0
+        self.ffmode = False
+        self.ffstop: Optional[float] = None
+        self.syst = -1.0          # wall-clock anchor
+        self.bencht = 0.0
+        self.benchdt = -1.0
+        self._step_count = 0
+        self._wall_t0 = time.perf_counter()
+        # Late import to avoid cycles; stack binds commands to this sim.
+        from ..stack.stack import Stack
+        self.stack = Stack(self)
+        # Periodic loggers (reference traffic.py:86-89 defaults: SNAPLOG/
+        # INSTLOG/SKYLOG) + their auto-registered stack commands.
+        from ..utils import datalog
+        for name, dt in (("SNAPLOG", 30.0), ("INSTLOG", 30.0),
+                         ("SKYLOG", 60.0)):
+            if datalog.getlogger(name) is None:
+                datalog.definePeriodicLogger(name, f"{name} logfile.", dt)
+        datalog.register_stack_commands(self)
+
+    # ----------------------------------------------------------- time/state
+    @property
+    def simt(self) -> float:
+        return float(self.traf.state.simt)
+
+    @property
+    def simdt(self) -> float:
+        return self.cfg.simdt
+
+    def setdt(self, dt: float):
+        self.cfg = self.cfg._replace(simdt=float(dt))
+        return True
+
+    def setdtmult(self, mult: float):
+        self.dtmult = float(mult)
+        return True
+
+    def op(self):
+        """Start/resume (reference simulation.py OP)."""
+        self.state_flag = OP
+        self.syst = -1.0
+        self.ffmode = False
+        return True
+
+    def pause(self):
+        self.state_flag = HOLD
+        return True
+
+    def stop(self):
+        self.state_flag = END
+        from ..utils import datalog
+        datalog.reset()
+        return True
+
+    def reset(self):
+        self.state_flag = INIT
+        self.traf.reset()
+        self.routes = RouteManager(self.traf, self.routes.wmax)
+        self.cfg = SimConfig()
+        self.dtmult = 1.0
+        self.ffmode = False
+        self.stack.reset()
+        from ..utils import datalog
+        datalog.reset()
+        return True
+
+    def fastforward(self, nsec: Optional[float] = None):
+        """FF [sec]: run at full speed [for nsec] (simulation.py:180-185)."""
+        self.ffmode = True
+        self.ffstop = self.simt + nsec if nsec else None
+        return True
+
+    def benchmark(self, fname: str = "IC", tend: float = 60.0):
+        """BENCHMARK [scen, t]: load scenario, FF a span, report wall time
+        (simulation.py:187-190, completion report :72-77)."""
+        ok, msg = self.stack.ic(fname)
+        if not ok:
+            return False, msg
+        self.bencht = 0.0
+        self.benchdt = float(tend)
+        self.fastforward(float(tend))
+        self.op()
+        return True
+
+    # ----------------------------------------------------------------- step
+    def step(self, max_chunk: Optional[int] = None):
+        """One host iteration: scenario triggers + stack + a device chunk.
+
+        Mirrors the per-step order of simulation.py:62-128 at chunk
+        granularity.  Returns False once END is reached.
+        """
+        if self.state_flag == END:
+            return False
+
+        # Scenario commands due at current sim time (stack.checkfile)
+        self.stack.checkfile(self.simt)
+        # Process pending commands (may change state/config/traffic)
+        self.stack.process()
+
+        if self.state_flag == INIT and self.traf.ntraf > 0:
+            self.op()   # auto-start like simulation.py:89-98
+
+        if self.state_flag != OP:
+            return True
+
+        # Benchmark bookkeeping
+        if self.benchdt > 0.0 and self.bencht == 0.0:
+            self.bencht = time.perf_counter()
+
+        self.traf.flush()
+
+        # Determine the chunk: stop exactly at the next scenario trigger.
+        # IMPORTANT: every distinct nsteps compiles a separate scan program,
+        # so the chunk is quantized to a small ladder — at most a handful of
+        # compilations per configuration instead of one per trigger distance.
+        chunk = max_chunk or self.chunk_steps
+        if self.ffmode:
+            chunk = max(chunk, 1000)
+        limit = chunk
+        tnext = self.stack.next_trigger_time()
+        if tnext is not None:
+            steps_to_trigger = int(np.ceil(
+                max(0.0, tnext - self.simt) / self.cfg.simdt + 1e-9))
+            if steps_to_trigger > 0:
+                limit = min(limit, steps_to_trigger)
+        if self.ffstop is not None:
+            steps_to_stop = int(round((self.ffstop - self.simt) / self.cfg.simdt))
+            if steps_to_stop <= 0:
+                self._end_ff()
+                return True
+            limit = min(limit, steps_to_stop)
+        chunk = 1
+        for c in self.CHUNK_LADDER:
+            if c <= limit:
+                chunk = c
+                break
+
+        # Wall-clock pacing (skipped in fast-forward), simulation.py:67-70
+        if not self.ffmode and self.dtmult <= 1.0 and self.syst >= 0:
+            now = time.perf_counter()
+            if now < self.syst:
+                time.sleep(self.syst - now)
+        if self.syst < 0:
+            self.syst = time.perf_counter()
+        self.syst += chunk * self.cfg.simdt / max(self.dtmult, 1e-9)
+
+        self.traf.state = run_steps(self.traf.state, self.cfg, chunk)
+        self._step_count += chunk
+
+        # Periodic loggers sample at chunk edges
+        from ..utils import datalog
+        datalog.postupdate(self)
+
+        if self.ffstop is not None and self.simt >= self.ffstop - 1e-9:
+            self._end_ff()
+        return True
+
+    def _end_ff(self):
+        self.ffmode = False
+        self.ffstop = None
+        if self.benchdt > 0.0:
+            wall = time.perf_counter() - self.bencht
+            self.scr.echo(
+                f"Benchmark complete: {wall:.3f} s wall for "
+                f"{self.benchdt:.1f} s sim ({self.benchdt / max(wall, 1e-9):.1f}x)")
+            self.benchdt = -1.0
+        self.pause()
+
+    def run(self, until_simt: Optional[float] = None, max_iters: int = 10 ** 9):
+        """Drive step() until END/HOLD or a sim-time horizon."""
+        it = 0
+        while it < max_iters:
+            it += 1
+            if until_simt is not None and self.simt >= until_simt - 1e-9:
+                break
+            alive = self.step()
+            if not alive or self.state_flag in (HOLD, END):
+                if self.state_flag == HOLD and until_simt is not None \
+                        and self.simt < until_simt - 1e-9:
+                    break
+                if self.state_flag != OP:
+                    break
+        return self.simt

@@ -1,0 +1,1 @@
+"""The text command stack — the universal user/API surface."""

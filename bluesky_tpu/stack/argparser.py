@@ -1,0 +1,252 @@
+"""Typed command-argument parsing for the stack.
+
+Parity with the reference parser utilities: ``Argparser`` argtype dispatch
+(stack/stack.py:1467-1748) and the text converters in ``tools/misc.py``
+(txt2alt :18, txt2spd :66, txt2lat/lon, cmdsplit :125) — reimplemented as
+small pure functions keyed by argtype name.  Position text resolution
+(``tools/position.py``) consults the navdatabase when one is attached.
+
+Supported argtypes (subset used by the built-in command dict, same names as
+the reference): txt, string, acid, wpinroute, float, int, onoff, alt, spd,
+vspd, hdg, time, latlon, lat, lon, wpt, pandir, color.  A trailing
+``...`` repeats the last group.  Optional args are marked with brackets in
+the usage string and simply absent from the tail.
+"""
+import re
+from typing import Any, List, Optional, Tuple
+
+from ..ops import aero
+
+
+class ArgError(Exception):
+    pass
+
+
+def cmdsplit(cmdline: str) -> List[str]:
+    """Split a command line on commas/spaces, preserving empty slots from
+    adjacent commas (tools/misc.py:125-150)."""
+    cmdline = cmdline.strip()
+    if not cmdline:
+        return []
+    if ',' in cmdline:
+        parts = [p.strip() for p in re.split(',', cmdline)]
+        # allow spaces inside first arg block
+        out = []
+        for p in parts:
+            if out:
+                out.append(p)
+            else:
+                out.extend(p.split())
+        return out
+    return cmdline.split()
+
+
+def txt2alt(txt: str) -> float:
+    """Altitude text -> metres: 'FL200' -> 20000 ft; bare number = feet
+    (tools/misc.py:18-38)."""
+    t = txt.upper().strip()
+    if t.startswith("FL"):
+        return float(t[2:]) * 100.0 * aero.ft
+    return float(t) * aero.ft
+
+
+def txt2spd(txt: str) -> float:
+    """Speed text -> CAS [m/s] or Mach: 'M.8'/'M08'/'.8' -> 0.8 Mach,
+    else knots CAS (tools/misc.py:66-92)."""
+    t = txt.upper().strip()
+    if t.startswith("M"):
+        t = t[1:]
+        m = float(t) if "." in t else float("0." + t.lstrip("0") or "0")
+        return m
+    v = float(t)
+    if 0.1 < v < 1.0:
+        return v          # Mach
+    return v * aero.kts   # knots -> m/s CAS
+
+
+def txt2vspd(txt: str) -> float:
+    """Vertical speed text [fpm] -> m/s."""
+    return float(txt) * aero.fpm
+
+
+def txt2hdg(txt: str) -> float:
+    return float(txt) % 360.0
+
+
+def txt2time(txt: str) -> float:
+    """'[HH:]MM:SS[.hh]' or plain seconds -> seconds."""
+    parts = txt.strip().split(":")
+    if len(parts) == 1:
+        return float(parts[0])
+    sec = float(parts[-1])
+    mins = int(parts[-2]) if len(parts) >= 2 else 0
+    hrs = int(parts[-3]) if len(parts) >= 3 else 0
+    return hrs * 3600.0 + mins * 60.0 + sec
+
+
+def txt2lat(txt: str) -> float:
+    """Latitude text: decimal or N/S prefix/suffix, DMS with ' " separators."""
+    return _txt2deg(txt, "NS")
+
+
+def txt2lon(txt: str) -> float:
+    return _txt2deg(txt, "EW")
+
+
+def _txt2deg(txt: str, hemis: str) -> float:
+    t = txt.upper().strip()
+    sign = 1.0
+    if t and t[0] in hemis:
+        sign = -1.0 if t[0] in "SW" else 1.0
+        t = t[1:]
+    elif t and t[-1] in hemis:
+        sign = -1.0 if t[-1] in "SW" else 1.0
+        t = t[:-1]
+    if "'" in t or '"' in t or "°" in t:
+        parts = re.split(r"[°'\"]+", t)
+        parts = [p for p in parts if p]
+        deg = float(parts[0])
+        minutes = float(parts[1]) if len(parts) > 1 else 0.0
+        seconds = float(parts[2]) if len(parts) > 2 else 0.0
+        return sign * (deg + minutes / 60.0 + seconds / 3600.0)
+    return sign * float(t)
+
+
+_ISLATLON = re.compile(r"^[NSEW]?[-+]?[\d.]+[NSEW]?$")
+
+
+class Argparser:
+    """Parse an argument list against a comma-separated argtype spec."""
+
+    def __init__(self, sim):
+        self.sim = sim   # for acid lookup, navdb, reflat/lon
+
+    def parse(self, argtypes: str, args: List[str]) -> List[Any]:
+        """Returns converted argument values; raises ArgError on mismatch.
+
+        Mirrors Argparser.parse (stack.py:1467-1560): optional args are
+        bracketed in the spec ('[alt]'), a trailing '...' repeats the
+        preceding group for any remaining arguments.  'latlon' consumes two
+        numeric tokens (lat, lon) or one named-position token and yields a
+        (lat, lon) tuple.
+        """
+        # Preprocess the spec: tokens split on commas; '[' opens an optional
+        # region spanning tokens until the matching ']' (reference usage
+        # strings group several optionals in one bracket, e.g.
+        # "acid,latlon,[alt,spd,afterwp]"); '...' marks the rest repeating.
+        tokens: List[Tuple[str, bool]] = []   # (argtype, optional)
+        repeating = False
+        depth = 0
+        for raw in (argtypes.split(",") if argtypes else []):
+            t = raw.strip()
+            opens = t.count("[")
+            closes = t.count("]")
+            t = t.strip("[]").strip()
+            was_optional = depth > 0 or opens > 0
+            depth += opens - closes
+            if t == "...":
+                repeating = True
+                continue
+            if t:
+                tokens.append((t, was_optional))
+
+        out: List[Any] = []
+        ai = 0
+        si = 0
+        while si < len(tokens) or (repeating and ai < len(args)):
+            if si < len(tokens):
+                st2, optional = tokens[si]
+            else:
+                st2, optional = tokens[-1] if tokens else ("string", True)
+            if ai >= len(args) or args[ai] == "":
+                if ai < len(args):    # empty placeholder token, e.g. "A,,B"
+                    out.append(None)
+                    ai += 1
+                    si += 1
+                    continue
+                if optional or si >= len(tokens):
+                    break
+                raise ArgError(f"missing argument <{st2}>")
+            if st2 == "latlon":
+                val, consumed = self._parse_latlon(args, ai)
+                out.append(val)
+                ai += consumed
+            else:
+                out.append(self.parse_arg(st2, args[ai], out))
+                ai += 1
+            si += 1
+        if ai < len(args) and not repeating:
+            raise ArgError(f"too many arguments: {' '.join(args[ai:])}")
+        return out
+
+    def _parse_latlon(self, args: List[str], ai: int):
+        """(lat, lon) from two numeric tokens or one named position."""
+        t = args[ai].strip()
+        if _ISLATLON.match(t.upper()) and any(c.isdigit() for c in t):
+            if ai + 1 >= len(args):
+                raise ArgError("latlon: missing longitude")
+            return (txt2lat(t), txt2lon(args[ai + 1])), 2
+        # Named position: navdb lookup if attached
+        navdb = getattr(self.sim, "navdb", None)
+        if navdb is not None:
+            pos = navdb.txt2pos(t)
+            if pos is not None:
+                return (pos[0], pos[1]), 1
+        raise ArgError(f"{t}: position not found")
+
+    def parse_arg(self, argtype: str, txt: str, sofar: List[Any]):
+        t = txt.strip()
+        try:
+            if argtype in ("txt", "string", "word"):
+                return t.upper() if argtype == "txt" else t
+            if argtype == "acid":
+                idx = self.sim.traf.id2idx(t)
+                if idx < 0:
+                    raise ArgError(f"{t}: aircraft not found")
+                return idx
+            if argtype == "wpinroute":
+                return t.upper()
+            if argtype == "float":
+                return float(t)
+            if argtype == "int":
+                return int(float(t))
+            if argtype == "onoff":
+                u = t.upper()
+                if u in ("ON", "TRUE", "YES", "1"):
+                    return True
+                if u in ("OFF", "FALSE", "NO", "0"):
+                    return False
+                raise ArgError(f"{t}: expected ON/OFF")
+            if argtype == "alt":
+                return txt2alt(t)
+            if argtype == "spd":
+                return txt2spd(t)
+            if argtype == "vspd":
+                return txt2vspd(t)
+            if argtype == "hdg":
+                return txt2hdg(t)
+            if argtype == "time":
+                return txt2time(t)
+            if argtype == "lat":
+                return txt2lat(t)
+            if argtype == "lon":
+                return txt2lon(t)
+            if argtype == "latlon":
+                # Either two numeric tokens (lat lon — caller passes lat here
+                # and we signal to consume the next token), or a named
+                # position resolved via the navdb.
+                raise ArgError("latlon handled by parse()")
+            if argtype == "wpt":
+                return t.upper()
+            if argtype == "pandir":
+                u = t.upper()
+                if u in ("LEFT", "RIGHT", "UP", "DOWN"):
+                    return u
+                raise ArgError(f"{t}: expected LEFT/RIGHT/UP/DOWN")
+            if argtype == "color":
+                return t.upper()
+        except ArgError:
+            raise
+        except Exception as e:
+            raise ArgError(f"{t}: invalid {argtype} ({e})")
+        raise ArgError(f"unknown argtype {argtype}")

@@ -1,0 +1,628 @@
+"""Built-in stack commands: the user/API surface of the simulator.
+
+Mirrors the reference command dictionary (stack/stack.py:180-796) and
+synonym table (stack.py:44-115).  Each entry is
+``NAME: [usage, argtypes, function, helptext]``; functions return
+True/False/None or (ok, echotext) exactly like the reference contract.
+
+Traffic-state mutation happens through small per-slot device writes — these
+run at command cadence (human/scenario rate), not step rate, so .at[].set
+dispatch cost is irrelevant; bulk creation goes through the batched
+``Traffic.flush`` path instead.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ..ops import aero
+from ..core import wind as windmod
+from ..core.asas import AsasConfig
+from ..core.noise import NoiseConfig
+from . import synthetic
+
+
+def register_all(stack):
+    sim = stack.sim
+    traf = sim.traf
+
+    # ------------------------------------------------------------ helpers
+    def st():
+        return traf.state
+
+    def setac(**updates):
+        traf.state = traf.state.replace(ac=traf.state.ac.replace(**updates))
+
+    def setslot(field, idx, value):
+        arr = getattr(traf.state.ac, field)
+        setac(**{field: arr.at[idx].set(value)})
+
+    def acname(idx):
+        return traf.ids[idx] or f"#{idx}"
+
+    # ------------------------------------------------------- a/c commands
+    def cre(acid, actype, pos, hdg=None, alt=None, spd=None):
+        """CRE acid,type,latlon,hdg,alt,spd (traffic.py:192)."""
+        lat, lon = pos
+        ok, msg = traf.create(1, actype or "B744", alt, spd, None,
+                              lat, lon, hdg, acid)
+        if not ok:
+            return False, msg
+        traf.flush()
+        return True
+
+    def mcre(n, actype=None, alt=None, spd=None, dest=None):
+        """MCRE n,[type,alt,spd,dest]: n random aircraft."""
+        traf.area = sim.scr.getviewbounds()
+        ok, msg = traf.create(n, actype or "B744", alt, spd, dest)
+        traf.flush()
+        return ok, msg
+
+    def delete(idx):
+        traf.delete(idx)
+        return True, f"Deleted {acname(idx)}"
+
+    def delall():
+        idxs = [i for i, v in enumerate(traf.ids) if v is not None]
+        if idxs:
+            traf.delete(idxs)
+        return True
+
+    def move(idx, pos, alt=None, hdg=None, spd=None, vspd=None):
+        """MOVE acid,latlon,[alt,hdg,spd,vspd] (traffic.py:517)."""
+        lat, lon = pos
+        setslot("lat", idx, lat)
+        setslot("lon", idx, lon)
+        setslot("coslat", idx, float(np.cos(np.radians(lat))))
+        if alt is not None:
+            setslot("alt", idx, alt)
+            setslot("selalt", idx, alt)
+        if hdg is not None:
+            setslot("hdg", idx, hdg)
+            setslot("trk", idx, hdg)
+        if spd is not None:
+            setslot("selspd", idx, spd)
+        if vspd is not None:
+            setslot("selvs", idx, vspd)
+        return True
+
+    def selalt(idx, alt, vspd=None):
+        """ALT acid,alt,[vspd] (autopilot.py:306-322)."""
+        setslot("selalt", idx, alt)
+        setslot("swvnav", idx, False)
+        if vspd is not None:
+            setslot("selvs", idx, vspd)
+        else:
+            delalt = alt - float(st().ac.alt[idx])
+            cur = float(st().ac.selvs[idx])
+            if cur * delalt < 0 and abs(cur) > 0.01:
+                setslot("selvs", idx, 0.0)
+        return True
+
+    def selvspd(idx, vspd):
+        """VS acid,vspd (autopilot.py:324-328)."""
+        setslot("selvs", idx, vspd)
+        setslot("swvnav", idx, False)
+        return True
+
+    def selhdg(idx, hdg):
+        """HDG acid,hdg: heading select, LNAV off (autopilot.py:330-346)."""
+        # Wind-corrected track happens continuously in the pilot module;
+        # here we set the AP track like the reference's no-wind path.
+        ap = st().ap
+        traf.state = st().replace(ap=ap.replace(trk=ap.trk.at[idx].set(hdg)))
+        setslot("swlnav", idx, False)
+        return True
+
+    def selspd(idx, spd):
+        """SPD acid,spd(CASkt/Mach) (autopilot.py:348-358)."""
+        setslot("selspd", idx, spd)
+        setslot("swvnav", idx, False)
+        return True
+
+    def setvs_direct(idx, vspd):
+        setslot("vs", idx, vspd)
+        return True
+
+    def pos(idx):
+        """POS acid: info text (traffic.py poscommand)."""
+        s = st()
+        i = idx
+        txt = (f"Info on {acname(i)} {traf.types[i]}\n"
+               f"Pos: {float(s.ac.lat[i]):.4f}, {float(s.ac.lon[i]):.4f}\n"
+               f"Hdg: {float(s.ac.hdg[i]):.0f}   Trk: {float(s.ac.trk[i]):.0f}\n"
+               f"Alt: {float(s.ac.alt[i]) / aero.ft:.0f} ft\n"
+               f"CAS: {float(s.ac.cas[i]) / aero.kts:.0f} kts   "
+               f"TAS: {float(s.ac.tas[i]) / aero.kts:.0f} kts   "
+               f"GS: {float(s.ac.gs[i]) / aero.kts:.0f} kts\n"
+               f"VS: {float(s.ac.vs[i]) / aero.fpm:.0f} fpm")
+        return True, txt
+
+    def dist(pos1, pos2):
+        from ..core.route import _host_qdrdist_nm
+        d = _host_qdrdist_nm(pos1[0], pos1[1], pos2[0], pos2[1])
+        return True, f"Dist = {d:.3f} nm"
+
+    def calc(*expr):
+        try:
+            allowed = {"__builtins__": {}, "abs": abs, "min": min, "max": max}
+            value = eval(" ".join(str(e) for e in expr if e is not None),
+                         allowed, {})
+            return True, f"Ans = {value}"
+        except Exception as e:
+            return False, f"CALC error: {e}"
+
+    # --------------------------------------------------------------- route
+    def setlnav(idx, flag=None):
+        """LNAV acid,[on/off] (autopilot.py:444-461)."""
+        if flag is None:
+            on = bool(st().ac.swlnav[idx])
+            return True, f"{acname(idx)}: LNAV is {'ON' if on else 'OFF'}"
+        if flag:
+            r = sim.routes.route(idx)
+            if r.nwp <= 0:
+                return False, f"LNAV {acname(idx)}: no waypoints"
+            if not bool(st().ac.swlnav[idx]):
+                setslot("swlnav", idx, True)
+                iact = sim.routes.findact(idx)
+                if iact >= 0:
+                    sim.routes.direct(idx, sim.routes.route(idx).name[iact])
+        else:
+            setslot("swlnav", idx, False)
+        return True
+
+    def setvnav(idx, flag=None):
+        """VNAV acid,[on/off] (autopilot.py:463-485)."""
+        if flag is None:
+            on = bool(st().ac.swvnav[idx])
+            return True, f"{acname(idx)}: VNAV is {'ON' if on else 'OFF'}"
+        if flag:
+            if not bool(st().ac.swlnav[idx]):
+                return False, f"{acname(idx)}: VNAV ON requires LNAV ON"
+            if sim.routes.route(idx).nwp <= 0:
+                return False, f"VNAV {acname(idx)}: no waypoints"
+            setslot("swvnav", idx, True)
+            sim.routes.sync(idx, point_active=True)
+        else:
+            setslot("swvnav", idx, False)
+        return True
+
+    def addwpt(idx, pos, alt=None, spd=None, afterwp=None):
+        """ADDWPT acid,(wpt/lat,lon),[alt,spd,afterwp] (route.py:472)."""
+        from ..core.route import WPT_LATLON
+        lat, lon = pos
+        name = f"WP{sim.routes.route(idx).nwp + 1:03d}"
+        wpidx = sim.routes.addwpt(idx, name, lat, lon,
+                                  alt if alt is not None else -999.0,
+                                  spd if spd is not None else -999.0,
+                                  WPT_LATLON, 1.0, afterwp)
+        if wpidx < 0:
+            return False, "ADDWPT: afterwp not found"
+        # First waypoint: engage LNAV and aim at it (route.py addwpt behavior)
+        r = sim.routes.route(idx)
+        if r.nwp == 1 or not bool(st().ac.swlnav[idx]):
+            sim.routes.direct(idx, r.name[r.iactwp if r.iactwp >= 0 else 0])
+        return True
+
+    def dest_orig(cmd, idx, pos=None):
+        """DEST/ORIG acid,[apt/lat,lon] (autopilot.py:360-442)."""
+        from ..core.route import WPT_DEST, WPT_ORIG
+        r = sim.routes.route(idx)
+        if pos is None:
+            return True, f"{cmd} {acname(idx)}: (not set)"
+        lat, lon = pos
+        wtype = WPT_DEST if cmd == "DEST" else WPT_ORIG
+        sim.routes.addwpt(idx, cmd, lat, lon, 0.0,
+                          float(st().ac.cas[idx]), wtype)
+        if cmd == "DEST":
+            r = sim.routes.route(idx)
+            if r.nwp == 1 or (r.nwp == 2 and r.wtype[0] == WPT_ORIG):
+                setslot("swlnav", idx, True)
+                setslot("swvnav", idx, True)
+                sim.routes.direct(idx, "DEST")
+        return True
+
+    def delwpt(idx, name):
+        ok = sim.routes.delwpt(idx, name)
+        return (True,) if ok else (False, f"Waypoint {name} not found")
+
+    def direct(idx, name):
+        ok = sim.routes.direct(idx, name)
+        return (True,) if ok else (False, f"Waypoint {name} not in route")
+
+    def listrte(idx):
+        r = sim.routes.route(idx)
+        if r.nwp == 0:
+            return True, f"{acname(idx)}: route is empty"
+        lines = []
+        for w in range(r.nwp):
+            mark = "*" if w == r.iactwp else " "
+            alttxt = f" FL{r.alt[w] / aero.ft / 100:.0f}" if r.alt[w] >= 0 else ""
+            spdtxt = f" {r.spd[w] / aero.kts:.0f}kt" if r.spd[w] >= 0 else ""
+            lines.append(f"{mark}{r.name[w]} ({r.lat[w]:.4f}, {r.lon[w]:.4f})"
+                         f"{alttxt}{spdtxt}")
+        return True, "\n".join(lines)
+
+    # ---------------------------------------------------------------- ASAS
+    def _setasas(**kw):
+        sim.cfg = sim.cfg._replace(asas=sim.cfg.asas._replace(**kw))
+
+    def asas_onoff(flag=None):
+        if flag is None:
+            return True, f"ASAS is {'ON' if sim.cfg.asas.swasas else 'OFF'}"
+        _setasas(swasas=bool(flag))
+        return True
+
+    def reso(method=None):
+        """RESO [method]: MVP/OFF/ON (asas.py CRmethods registry)."""
+        if method is None:
+            on = sim.cfg.asas.reso_on
+            return True, f"RESO {'MVP' if on else 'OFF'}"
+        m = method.upper()
+        if m in ("MVP", "ON"):
+            _setasas(reso_on=True)
+            return True
+        if m in ("OFF", "NONE", "DONOTHING"):
+            _setasas(reso_on=False)
+            return True
+        return False, f"RESO method {method} not available (have: MVP, OFF)"
+
+    def zoner(r=None):
+        if r is None:
+            return True, f"ZONER = {sim.cfg.asas.rpz / aero.nm:.2f} nm"
+        _setasas(rpz=float(r) * aero.nm)
+        return True
+
+    def zonedh(h=None):
+        if h is None:
+            return True, f"ZONEDH = {sim.cfg.asas.hpz / aero.ft:.0f} ft"
+        _setasas(hpz=float(h) * aero.ft)
+        return True
+
+    def rszoner(r=None):
+        if r is None:
+            return True, f"RSZONER = {sim.cfg.asas.rpz * sim.cfg.asas.resofach / aero.nm:.2f} nm"
+        _setasas(resofach=float(r) * aero.nm / sim.cfg.asas.rpz)
+        return True
+
+    def rszonedh(h=None):
+        if h is None:
+            return True, "RSZONEDH"
+        _setasas(resofacv=float(h) * aero.ft / sim.cfg.asas.hpz)
+        return True
+
+    def dtlook(t=None):
+        if t is None:
+            return True, f"DTLOOK = {sim.cfg.asas.dtlookahead:.0f} s"
+        _setasas(dtlookahead=float(t))
+        return True
+
+    def dtnolook(t=None):
+        if t is None:
+            return True, f"DTNOLOOK = {sim.cfg.asas.dtasas:.2f} s"
+        _setasas(dtasas=float(t))
+        return True
+
+    def rmethh(method=None):
+        """RMETHH [SPD/HDG/BOTH/OFF]: horizontal resolution limiting."""
+        if method is None:
+            return True, "RMETHH"
+        m = method.upper()
+        if m in ("BOTH", "ON"):
+            _setasas(swresohoriz=True, swresospd=True, swresohdg=True,
+                     swresovert=False)
+        elif m == "SPD":
+            _setasas(swresohoriz=True, swresospd=True, swresohdg=False,
+                     swresovert=False)
+        elif m == "HDG":
+            _setasas(swresohoriz=True, swresospd=False, swresohdg=True,
+                     swresovert=False)
+        elif m in ("OFF", "NONE"):
+            _setasas(swresohoriz=False, swresospd=False, swresohdg=False)
+        return True
+
+    def rmethv(method=None):
+        if method is None:
+            return True, "RMETHV"
+        m = method.upper()
+        _setasas(swresovert=m in ("V/S", "VS", "ON", "BOTH"),
+                 swresohoriz=False if m in ("V/S", "VS", "ON", "BOTH")
+                 else sim.cfg.asas.swresohoriz)
+        return True
+
+    def noreso(acids=None):
+        """NORESO acid,...: toggle no-avoidance list (asas.py:360-376)."""
+        s = st()
+        if acids is None:
+            traf.state = s.replace(asas=s.asas.replace(
+                noreso=jnp.zeros_like(s.asas.noreso)))
+            return True
+        idx = traf.id2idx(acids)
+        if idx < 0:
+            return False, f"{acids} not found"
+        cur = bool(s.asas.noreso[idx])
+        traf.state = s.replace(asas=s.asas.replace(
+            noreso=s.asas.noreso.at[idx].set(not cur)))
+        return True
+
+    def resooff(acids=None):
+        s = st()
+        if acids is None:
+            traf.state = s.replace(asas=s.asas.replace(
+                resooff=jnp.zeros_like(s.asas.resooff)))
+            return True
+        idx = traf.id2idx(acids)
+        if idx < 0:
+            return False, f"{acids} not found"
+        cur = bool(s.asas.resooff[idx])
+        traf.state = s.replace(asas=s.asas.replace(
+            resooff=s.asas.resooff.at[idx].set(not cur)))
+        return True
+
+    def vlimits(flag=None, spd=None):
+        if flag is None:
+            return True, (f"ASAS limits [{sim.cfg.asas.vmin / aero.kts:.0f};"
+                          f"{sim.cfg.asas.vmax / aero.kts:.0f}] kts")
+        if flag.upper() == "MAX":
+            _setasas(vmax=spd * aero.nm / 3600.0 if spd else sim.cfg.asas.vmax)
+        else:
+            _setasas(vmin=spd * aero.nm / 3600.0 if spd else sim.cfg.asas.vmin)
+        return True
+
+    def confinfo():
+        s = st()
+        nconf = int(s.asas.nconf_cur)
+        nlos = int(s.asas.nlos_cur)
+        from ..ops.cd import pairs_from_mask
+        # inconf flags are device-side; pair extraction on demand
+        return True, f"Current conflicts: {nconf} (LoS: {nlos})"
+
+    # ----------------------------------------------------- sim-control cmds
+    def op():
+        sim.op()
+        return True
+
+    def hold():
+        sim.pause()
+        return True
+
+    def ff(t=None):
+        sim.fastforward(t)
+        return True
+
+    def setdt(dt=None):
+        if dt is None:
+            return True, f"DT = {sim.cfg.simdt}"
+        sim.setdt(dt)
+        return True
+
+    def setdtmult(m=None):
+        if m is None:
+            return True, f"DTMULT = {sim.dtmult}"
+        sim.setdtmult(m)
+        return True
+
+    def reset():
+        sim.reset()
+        return True
+
+    def quitsim():
+        sim.stop()
+        return True
+
+    def echo(*txt):
+        return True, " ".join(str(t) for t in txt if t is not None)
+
+    def seed(value):
+        traf._rng = np.random.default_rng(int(value))
+        s = st()
+        import jax
+        traf.state = s.replace(rng=jax.random.PRNGKey(int(value)))
+        return True
+
+    def noise(flag=None):
+        if flag is None:
+            on = sim.cfg.noise.turb_active
+            return True, f"Noise is {'ON' if on else 'OFF'}"
+        sim.cfg = sim.cfg._replace(noise=sim.cfg.noise._replace(
+            turb_active=bool(flag), adsb_transnoise=bool(flag),
+            adsb_truncated=bool(flag)))
+        return True
+
+    def wind(pos, *args):
+        """WIND lat,lon,dir,spd[,alt,dir,spd...] (windsim.py:8-53).
+
+        Without altitude triples: a constant-profile point.  With them: an
+        altitude-dependent profile point.
+        """
+        lat, lon = pos
+        vals = [a for a in args if a is not None]
+        try:
+            if len(vals) == 2:
+                newwind = windmod.add_point(st().wind, lat, lon,
+                                            float(vals[0]), float(vals[1]) * aero.kts)
+            elif len(vals) >= 3 and len(vals) % 3 == 0:
+                alts, dirs, spds = [], [], []
+                for k in range(0, len(vals), 3):
+                    alts.append(float(vals[k]))
+                    dirs.append(float(vals[k + 1]))
+                    spds.append(float(vals[k + 2]) * aero.kts)
+                newwind = windmod.add_point(st().wind, lat, lon, dirs, spds,
+                                            windalt=alts)
+            else:
+                return False, "WIND: expected dir,spd or alt,dir,spd triples"
+        except ValueError as e:
+            return False, f"WIND: {e}"
+        traf.state = st().replace(wind=newwind)
+        sim.cfg = sim.cfg._replace(use_wind=True)
+        return True
+
+    def creconfs(acid, actype, targetidx, dpsi, cpa, tlosh, dh=None,
+                 tlosv=None, spd=None):
+        traf.creconfs(acid, actype, targetidx, dpsi, cpa, tlosh, dh, tlosv,
+                      spd, pzr_nm=sim.cfg.asas.rpz / aero.nm,
+                      pzh_ft=sim.cfg.asas.hpz / aero.ft)
+        return True
+
+    def benchmark(fname=None, t=None):
+        return sim.benchmark(fname or "IC", t or 60.0)
+
+    def scen(name):
+        return stack.scen(name)
+
+    def pcall(fname, *pargs):
+        args = [str(a) for a in pargs if a is not None]
+        rel = bool(args and args[0].upper() == "REL")
+        if rel:
+            args = args[1:]
+        return stack.openfile(fname, args, mergeWithExisting=True,
+                              t_offset=sim.simt if rel else 0.0)
+
+    def schedule(t, *cmdwords):
+        return stack.sched_cmd(
+            t, " ".join(str(c) for c in cmdwords if c is not None),
+            relative=False)
+
+    def delay(dt, *cmdwords):
+        return stack.sched_cmd(
+            dt, " ".join(str(c) for c in cmdwords if c is not None),
+            relative=True)
+
+    def ic(fname=None):
+        return stack.ic(fname or "")
+
+    def saveic(fname=None):
+        return stack.saveic(fname)
+
+    def bank(idx, angle=None):
+        if angle is None:
+            return True, f"BANK {acname(idx)}: {np.degrees(float(st().ac.bank[idx])):.0f} deg"
+        setslot("bank", idx, float(np.radians(angle)))
+        setslot("aphi", idx, float(np.radians(angle)))
+        return True
+
+    def syn(subcmd=None, *args):
+        return synthetic.process(sim, subcmd, [a for a in args if a is not None])
+
+    def helpcmd(cmd=None):
+        if cmd is None:
+            names = ", ".join(sorted(stack.cmddict.keys()))
+            return True, f"Commands: {names}"
+        c = stack.synonyms.get(cmd.upper(), cmd.upper())
+        if c in stack.cmddict:
+            e = stack.cmddict[c]
+            return True, f"{e[0]}\n{e[3]}"
+        return False, f"Unknown command {cmd}"
+
+    # ----------------------------------------------------------- dictionary
+    stack.append_commands({
+        "ADDWPT": ["ADDWPT acid,(wpname/lat,lon),[alt,spd,afterwp]",
+                   "acid,latlon,[alt,spd,wpinroute]", addwpt,
+                   "Add a waypoint to the route of an aircraft"],
+        "ALT": ["ALT acid,alt,[vspd]", "acid,alt,[vspd]", selalt,
+                "Altitude select command"],
+        "ASAS": ["ASAS [ON/OFF]", "[onoff]", asas_onoff,
+                 "Airborne separation assurance on/off"],
+        "BANK": ["BANK acid,[angle deg]", "acid,[float]", bank,
+                 "Set bank angle limit"],
+        "BENCHMARK": ["BENCHMARK [scenfile,time]", "[txt,time]", benchmark,
+                      "Load a scenario and time a fast-forward run"],
+        "CALC": ["CALC expression", "[string,...]", calc,
+                 "Evaluate a simple expression"],
+        "CRE": ["CRE acid,type,latlon,hdg,alt,spd",
+                "txt,txt,latlon,[hdg,alt,spd]", cre, "Create an aircraft"],
+        "CRECONFS": ["CRECONFS acid,type,targetacid,dpsi,cpa,tlosh,[dH,tlosv,spd]",
+                     "txt,txt,acid,float,float,time,[alt,time,spd]", creconfs,
+                     "Create an aircraft in conflict with target"],
+        "DEL": ["DEL acid", "acid", delete, "Delete an aircraft"],
+        "DELALL": ["DELALL", "", delall, "Delete all aircraft"],
+        "DELAY": ["DELAY dt,COMMAND+ARGS", "time,string,...", delay,
+                  "Schedule a command in dt seconds"],
+        "DELWPT": ["DELWPT acid,wpname", "acid,wpinroute", delwpt,
+                   "Delete a waypoint from the route"],
+        "DEST": ["DEST acid,latlon", "acid,[latlon]",
+                 lambda idx, pos=None: dest_orig("DEST", idx, pos),
+                 "Set destination"],
+        "DIRECT": ["DIRECT acid,wpname", "acid,wpinroute", direct,
+                   "Go direct to a waypoint in the route"],
+        "DIST": ["DIST lat1,lon1,lat2,lon2", "latlon,latlon", dist,
+                 "Distance between positions"],
+        "DT": ["DT [dt]", "[float]", setdt, "Set simulation timestep"],
+        "DTLOOK": ["DTLOOK [time]", "[time]", dtlook,
+                   "Conflict detection lookahead time"],
+        "DTMULT": ["DTMULT [mult]", "[float]", setdtmult,
+                   "Sim speed multiplier"],
+        "DTNOLOOK": ["DTNOLOOK [time]", "[time]", dtnolook,
+                     "Conflict detection interval"],
+        "ECHO": ["ECHO txt", "[string,...]", echo, "Echo text"],
+        "FF": ["FF [time]", "[time]", ff, "Fast-forward [for time]"],
+        "HDG": ["HDG acid,hdg", "acid,hdg", selhdg, "Heading select command"],
+        "HELP": ["HELP [cmd]", "[txt]", helpcmd, "Command help"],
+        "HOLD": ["HOLD", "", hold, "Pause the simulation"],
+        "IC": ["IC [scenfile]", "[txt]", ic, "Load/reload a scenario"],
+        "LISTRTE": ["LISTRTE acid", "acid", listrte, "Show route"],
+        "LNAV": ["LNAV acid,[ON/OFF]", "acid,[onoff]", setlnav,
+                 "Lateral navigation on/off"],
+        "MCRE": ["MCRE n,[type,alt,spd,dest]", "int,[txt,alt,spd,txt]", mcre,
+                 "Create n random aircraft"],
+        "MOVE": ["MOVE acid,latlon,[alt,hdg,spd,vspd]",
+                 "acid,latlon,[alt,hdg,spd,vspd]", move,
+                 "Instantly move an aircraft"],
+        "NOISE": ["NOISE [ON/OFF]", "[onoff]", noise,
+                  "Turbulence/ADS-B noise on/off"],
+        "NORESO": ["NORESO [acid]", "[txt]", noreso,
+                   "Toggle no-avoidance for an aircraft"],
+        "OP": ["OP", "", op, "Start/resume the simulation"],
+        "ORIG": ["ORIG acid,latlon", "acid,[latlon]",
+                 lambda idx, pos=None: dest_orig("ORIG", idx, pos),
+                 "Set origin"],
+        "PCALL": ["PCALL scenfile,[REL,args]", "txt,[string,...]", pcall,
+                  "Merge a scenario file [with %0-%n substitution]"],
+        "POS": ["POS acid", "acid", pos, "Aircraft info"],
+        "QUIT": ["QUIT", "", quitsim, "Stop the simulation"],
+        "RESET": ["RESET", "", reset, "Reset the simulation"],
+        "RESO": ["RESO [method]", "[txt]", reso,
+                 "Conflict resolution method (MVP/OFF)"],
+        "RESOOFF": ["RESOOFF [acid]", "[txt]", resooff,
+                    "Toggle resolution off for an aircraft"],
+        "RMETHH": ["RMETHH [SPD/HDG/BOTH/OFF]", "[txt]", rmethh,
+                   "Horizontal resolution method limiting"],
+        "RMETHV": ["RMETHV [V/S / OFF]", "[txt]", rmethv,
+                   "Vertical resolution method limiting"],
+        "RSZONER": ["RSZONER [radius nm]", "[float]", rszoner,
+                    "Resolution zone radius"],
+        "RSZONEDH": ["RSZONEDH [height ft]", "[float]", rszonedh,
+                     "Resolution zone half-height"],
+        "SAVEIC": ["SAVEIC filename", "[txt]", saveic,
+                   "Record scenario from current state"],
+        "SCEN": ["SCEN name", "txt", scen, "Name the current scenario"],
+        "SCHEDULE": ["SCHEDULE time,COMMAND+ARGS", "time,string,...", schedule,
+                     "Schedule a command at a sim time"],
+        "SEED": ["SEED value", "int", seed, "Set random seed"],
+        "SPD": ["SPD acid,spd", "acid,spd", selspd, "Speed select command"],
+        "SSD": ["SSD [acid]", "[txt]",
+                lambda *a: (False, "SSD visualization requires the GUI"),
+                "Show solution space diagram"],
+        "SYN": ["SYN subcmd,args", "[txt,string,...]", syn,
+                "Synthetic conflict geometries (SUPER/WALL/MATRIX/...)"],
+        "VNAV": ["VNAV acid,[ON/OFF]", "acid,[onoff]", setvnav,
+                 "Vertical navigation on/off"],
+        "VS": ["VS acid,vspd", "acid,vspd", selvspd,
+               "Vertical speed select command"],
+        "WIND": ["WIND lat,lon,dir,spd[,alt,dir,spd...]",
+                 "latlon,float,float,[float,...]", wind,
+                 "Define a wind vector/profile at a position"],
+        "ZONEDH": ["ZONEDH [height ft]", "[float]", zonedh,
+                   "Protected zone half-height"],
+        "ZONER": ["ZONER [radius nm]", "[float]", zoner,
+                  "Protected zone radius"],
+        "CONFINFO": ["CONFINFO", "", confinfo, "Current conflict counts"],
+    })
+
+    # Synonyms (reference stack.py:44-115 subset)
+    stack.append_synonyms({
+        "CREATE": "CRE", "DELETE": "DEL", "DIRECTTO": "DIRECT",
+        "DIRTO": "DIRECT", "DISP": "SWRAD", "END": "QUIT", "EXIT": "QUIT",
+        "FWD": "FF", "PAUSE": "HOLD", "STOP": "QUIT", "RUN": "OP",
+        "RESUME": "OP", "START": "OP", "TURN": "HDG", "?": "HELP",
+        "CONTINUE": "OP", "SAVE": "SAVEIC", "CLOSE": "QUIT",
+        "DELROUTE": "DELRTE", "LOAD": "IC", "OPEN": "IC",
+    })

@@ -1,0 +1,1 @@
+"""Cross-cutting utilities: data logging, area filters, plotting."""

@@ -1,0 +1,150 @@
+"""CSV data logging with periodic scheduling.
+
+Parity with reference ``bluesky/tools/datalog.py``: named loggers with a
+header, an interval, and a selected-variable list; periodic loggers
+(SNAPLOG/INSTLOG/SKYLOG, traffic.py:86-89) sample every dt of sim time into
+``LOG_<name>_<scenario>_<timestamp>.log`` CSVs; every logger auto-registers a
+stack command ``<NAME> ON/OFF [dt] / LISTVARS / SELECTVARS`` (datalog.py:
+106-110, 216-242).
+
+TPU-first: the reference intercepts ``__setattr__`` with a class swap to
+capture variable groups (datalog.py:112-139).  Here variables are plain
+named getters over the state pytree; sampling pulls one device->host
+transfer per logged chunk edge (never inside the jitted step).
+"""
+import os
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+_loggers: Dict[str, "CSVLogger"] = {}
+log_path = "output"
+
+
+class CSVLogger:
+    def __init__(self, name: str, header: str, dt: float = 0.0,
+                 getters: Optional[Dict[str, Callable]] = None):
+        self.name = name.upper()
+        self.header = header
+        self.dt = dt
+        self.tlog = 0.0
+        self.active = False
+        self.file = None
+        self.getters = getters or {}
+        self.selvars = list(self.getters.keys())
+        _loggers[self.name] = self
+
+    # ----------------------------------------------------------- control
+    def start(self, sim, dt: Optional[float] = None):
+        if dt is not None:
+            self.dt = dt
+        os.makedirs(log_path, exist_ok=True)
+        scen = sim.stack.scenname or "untitled"
+        stamp = time.strftime("%Y%m%d_%H-%M-%S")
+        fname = os.path.join(log_path, f"{self.name}_{scen}_{stamp}.log")
+        self.file = open(fname, "w")
+        self.file.write(f"# {self.header}\n")
+        self.file.write("# simt, " + ", ".join(self.selvars) + "\n")
+        self.tlog = float(sim.simt)
+        self.active = True
+        return fname
+
+    def stop(self):
+        if self.file:
+            self.file.close()
+            self.file = None
+        self.active = False
+
+    def log(self, sim, *extra):
+        """Write one sample row set (one line per aircraft for array vars)."""
+        if not self.file:
+            return
+        simt = sim.simt
+        cols = []
+        for v in self.selvars:
+            val = self.getters[v](sim)
+            cols.append(np.atleast_1d(np.asarray(val)))
+        if not cols:
+            return
+        nrows = max(c.shape[0] for c in cols)
+        for r in range(nrows):
+            vals = [f"{simt:.2f}"]
+            for c in cols:
+                x = c[min(r, c.shape[0] - 1)]
+                vals.append(str(x))
+            self.file.write(", ".join(vals) + "\n")
+
+    # -------------------------------------------------------- stack cmd
+    def stackio(self, sim, flag=None, dt=None):
+        if flag is None:
+            return True, f"{self.name} is {'ON' if self.active else 'OFF'}"
+        f = str(flag).upper()
+        if f in ("ON", "TRUE", "1"):
+            fname = self.start(sim, dt)
+            return True, f"{self.name} logging to {fname}"
+        if f in ("OFF", "FALSE", "0"):
+            self.stop()
+            return True
+        if f == "LISTVARS":
+            return True, "Variables: " + ", ".join(self.getters.keys())
+        if f == "SELECTVARS":
+            return False, f"{self.name} SELECTVARS var,... (not yet selected)"
+        return False, f"{self.name}: unknown argument {flag}"
+
+
+def _traf_getters():
+    """Default per-aircraft variable getters (SNAPLOG group,
+    traffic.py:94-125)."""
+    def arr(field):
+        def get(sim):
+            st = sim.traf.state
+            live = np.asarray(st.ac.active)
+            return np.asarray(getattr(st.ac, field))[live]
+        return get
+
+    def ids(sim):
+        return np.asarray([i for i in sim.traf.ids if i is not None])
+
+    g = {"id": ids}
+    for f in ("lat", "lon", "alt", "hdg", "trk", "tas", "gs", "cas", "vs"):
+        g[f] = arr(f)
+    return g
+
+
+def definePeriodicLogger(name: str, header: str, dt: float) -> CSVLogger:
+    return CSVLogger(name, header, dt, _traf_getters())
+
+
+def crelog(name: str, header: str, getters=None) -> CSVLogger:
+    return CSVLogger(name, header, 0.0, getters)
+
+
+def getlogger(name: str) -> Optional[CSVLogger]:
+    return _loggers.get(name.upper())
+
+
+def postupdate(sim):
+    """Sample due periodic loggers (called at chunk edges by the sim)."""
+    simt = sim.simt
+    for lg in _loggers.values():
+        if lg.active and lg.dt > 0 and simt >= lg.tlog:
+            lg.tlog += lg.dt
+            lg.log(sim)
+
+
+def reset():
+    for lg in _loggers.values():
+        lg.stop()
+
+
+def register_stack_commands(sim):
+    """Give every logger its own stack command (datalog.py:106-110)."""
+    cmds = {}
+    for name, lg in _loggers.items():
+        cmds[name] = [
+            f"{name} [ON/OFF/LISTVARS] [dt]", "[txt,float]",
+            (lambda l: lambda flag=None, dt=None:
+             l.stackio(sim, flag, dt))(lg),
+            lg.header]
+    sim.stack.append_commands(cmds)

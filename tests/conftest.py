@@ -12,7 +12,9 @@ import os
 # Force CPU: the outer environment pins JAX_PLATFORMS=axon (the TPU tunnel),
 # which must never be used by the test suite (x64 golden tests + 8-device
 # virtual mesh are CPU-only concerns, and the single TPU is left free for
-# bench runs).
+# bench runs).  The axon sitecustomize hook registers its plugin and pins
+# jax_platforms before conftest runs, so the env var alone is not enough —
+# the config must be overridden after import.
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -21,4 +23,5 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
