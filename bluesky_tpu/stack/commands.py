@@ -523,7 +523,7 @@ def register_all(stack):
                  "Airborne separation assurance on/off"],
         "BANK": ["BANK acid,[angle deg]", "acid,[float]", bank,
                  "Set bank angle limit"],
-        "BENCHMARK": ["BENCHMARK [scenfile,time]", "[txt,time]", benchmark,
+        "BENCHMARK": ["BENCHMARK [scenfile,time]", "[word,time]", benchmark,
                       "Load a scenario and time a fast-forward run"],
         "CALC": ["CALC expression", "[string,...]", calc,
                  "Evaluate a simple expression"],
@@ -557,7 +557,7 @@ def register_all(stack):
         "HDG": ["HDG acid,hdg", "acid,hdg", selhdg, "Heading select command"],
         "HELP": ["HELP [cmd]", "[txt]", helpcmd, "Command help"],
         "HOLD": ["HOLD", "", hold, "Pause the simulation"],
-        "IC": ["IC [scenfile]", "[txt]", ic, "Load/reload a scenario"],
+        "IC": ["IC [scenfile]", "[word]", ic, "Load/reload a scenario"],
         "LISTRTE": ["LISTRTE acid", "acid", listrte, "Show route"],
         "LNAV": ["LNAV acid,[ON/OFF]", "acid,[onoff]", setlnav,
                  "Lateral navigation on/off"],
@@ -574,7 +574,7 @@ def register_all(stack):
         "ORIG": ["ORIG acid,latlon", "acid,[latlon]",
                  lambda idx, pos=None: dest_orig("ORIG", idx, pos),
                  "Set origin"],
-        "PCALL": ["PCALL scenfile,[REL,args]", "txt,[string,...]", pcall,
+        "PCALL": ["PCALL scenfile,[REL,args]", "word,[string,...]", pcall,
                   "Merge a scenario file [with %0-%n substitution]"],
         "POS": ["POS acid", "acid", pos, "Aircraft info"],
         "QUIT": ["QUIT", "", quitsim, "Stop the simulation"],
@@ -591,7 +591,7 @@ def register_all(stack):
                     "Resolution zone radius"],
         "RSZONEDH": ["RSZONEDH [height ft]", "[float]", rszonedh,
                      "Resolution zone half-height"],
-        "SAVEIC": ["SAVEIC filename", "[txt]", saveic,
+        "SAVEIC": ["SAVEIC filename", "[word]", saveic,
                    "Record scenario from current state"],
         "SCEN": ["SCEN name", "txt", scen, "Name the current scenario"],
         "SCHEDULE": ["SCHEDULE time,COMMAND+ARGS", "time,string,...", schedule,

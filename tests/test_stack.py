@@ -1,0 +1,253 @@
+"""Stack tests: command parsing, dispatch, scenario replay, route editing.
+
+Models the reference's TCP end-to-end tests (test/tcp/test_simple.py: send
+command text, assert echoed responses) but in-process against the Simulation
+object — no sockets needed for command semantics.
+"""
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from bluesky_tpu.simulation.sim import Simulation
+from bluesky_tpu.ops import aero
+
+
+@pytest.fixture()
+def sim():
+    return Simulation(nmax=32, dtype=jnp.float64)
+
+
+def do(sim, *lines):
+    for line in lines:
+        sim.stack.stack(line)
+    sim.stack.process()
+    out = "\n".join(sim.scr.echobuf)
+    sim.scr.echobuf.clear()
+    return out
+
+
+def test_cre_and_pos(sim):
+    out = do(sim, "CRE KL204 B744 52 4 90 FL200 250", "POS KL204")
+    assert "KL204" in out and "20000 ft" in out
+    assert sim.traf.ntraf == 1
+    i = sim.traf.id2idx("KL204")
+    assert float(sim.traf.state.ac.alt[i]) == pytest.approx(20000 * aero.ft)
+    assert float(sim.traf.state.ac.cas[i]) == pytest.approx(250 * aero.kts,
+                                                            rel=1e-6)
+
+
+def test_cre_duplicate_and_syntax_error(sim):
+    do(sim, "CRE KL204 B744 52 4 90 FL200 250")
+    out = do(sim, "CRE KL204 B744 52 4 90 FL200 250")
+    assert "exists" in out
+    out = do(sim, "CRE")
+    assert "Usage" in out or "missing" in out
+    out = do(sim, "FOO BAR")
+    assert "Unknown command" in out
+
+
+def test_acid_first_syntax(sim):
+    do(sim, "CRE KL204 B744 52 4 90 FL200 250")
+    do(sim, "KL204 ALT FL300")
+    i = sim.traf.id2idx("KL204")
+    assert float(sim.traf.state.ac.selalt[i]) == pytest.approx(30000 * aero.ft)
+
+
+def test_alt_spd_hdg_vs(sim):
+    do(sim, "CRE KL204 B744 52 4 90 FL200 250")
+    i = sim.traf.id2idx("KL204")
+    do(sim, "ALT KL204 FL300")
+    assert float(sim.traf.state.ac.selalt[i]) == pytest.approx(30000 * aero.ft)
+    do(sim, "SPD KL204 280")
+    assert float(sim.traf.state.ac.selspd[i]) == pytest.approx(280 * aero.kts)
+    do(sim, "SPD KL204 M.82")
+    assert float(sim.traf.state.ac.selspd[i]) == pytest.approx(0.82)
+    do(sim, "HDG KL204 180")
+    assert float(sim.traf.state.ap.trk[i]) == pytest.approx(180.0)
+    assert not bool(sim.traf.state.ac.swlnav[i])
+    do(sim, "VS KL204 1000")
+    assert float(sim.traf.state.ac.selvs[i]) == pytest.approx(1000 * aero.fpm)
+
+
+def test_del_and_delall(sim):
+    do(sim, "CRE A1 B744 52 4 90 FL200 250", "CRE A2 B744 53 4 90 FL200 250")
+    assert sim.traf.ntraf == 2
+    do(sim, "DEL A1")
+    assert sim.traf.ntraf == 1 and sim.traf.id2idx("A1") == -1
+    do(sim, "DELALL")
+    assert sim.traf.ntraf == 0
+
+
+def test_move(sim):
+    do(sim, "CRE KL204 B744 52 4 90 FL200 250")
+    do(sim, "MOVE KL204 30 5 FL100")
+    i = sim.traf.id2idx("KL204")
+    st = sim.traf.state
+    assert float(st.ac.lat[i]) == pytest.approx(30.0)
+    assert float(st.ac.lon[i]) == pytest.approx(5.0)
+    assert float(st.ac.alt[i]) == pytest.approx(10000 * aero.ft)
+
+
+def test_route_editing(sim):
+    do(sim, "CRE KL204 B744 52 4 90 FL200 250",
+       "ADDWPT KL204 52.2 4.5 FL220",
+       "ADDWPT KL204 52.4 5.0")
+    out = do(sim, "LISTRTE KL204")
+    assert "WP001" in out and "WP002" in out
+    i = sim.traf.id2idx("KL204")
+    assert int(sim.traf.state.route.nwp[i]) == 2
+    # delete one
+    do(sim, "DELWPT KL204 WP002")
+    assert int(sim.traf.state.route.nwp[i]) == 1
+    # direct to remaining
+    out = do(sim, "DIRECT KL204 WP001")
+    assert int(sim.traf.state.route.iactwp[i]) == 0
+    assert bool(sim.traf.state.ac.swlnav[i])
+
+
+def test_dest_engages_lnav_vnav(sim):
+    do(sim, "CRE KL204 B744 52 4 90 FL200 250", "DEST KL204 52.5 6.0")
+    i = sim.traf.id2idx("KL204")
+    assert bool(sim.traf.state.ac.swlnav[i])
+    assert bool(sim.traf.state.ac.swvnav[i])
+    r = sim.routes.route(i)
+    assert r.nwp == 1 and r.name[0] == "DEST"
+
+
+def test_asas_settings(sim):
+    do(sim, "ZONER 3")
+    assert sim.cfg.asas.rpz == pytest.approx(3 * aero.nm)
+    do(sim, "ZONEDH 800")
+    assert sim.cfg.asas.hpz == pytest.approx(800 * aero.ft)
+    do(sim, "DTLOOK 120")
+    assert sim.cfg.asas.dtlookahead == pytest.approx(120.0)
+    do(sim, "RESO OFF")
+    assert not sim.cfg.asas.reso_on
+    do(sim, "RESO MVP")
+    assert sim.cfg.asas.reso_on
+    do(sim, "ASAS OFF")
+    assert not sim.cfg.asas.swasas
+    out = do(sim, "ASAS")
+    assert "OFF" in out
+
+
+def test_noreso_resooff_toggle(sim):
+    do(sim, "CRE KL204 B744 52 4 90 FL200 250")
+    i = sim.traf.id2idx("KL204")
+    do(sim, "NORESO KL204")
+    assert bool(sim.traf.state.asas.noreso[i])
+    do(sim, "NORESO KL204")
+    assert not bool(sim.traf.state.asas.noreso[i])
+    do(sim, "RESOOFF KL204")
+    assert bool(sim.traf.state.asas.resooff[i])
+
+
+def test_syn_super_and_matrix(sim):
+    do(sim, "SYN SUPER 8")
+    assert sim.traf.ntraf == 8
+    do(sim, "SYN MATRIX 3")
+    assert sim.traf.ntraf == 12
+    do(sim, "SYN WALL")
+    assert sim.traf.ntraf == 21
+
+
+def test_scenario_file_roundtrip(sim, tmp_path):
+    scn = tmp_path / "test.scn"
+    scn.write_text(
+        "# comment\n"
+        "00:00:00.00>CRE KL204 B744 52 4 90 FL200 250\n"
+        "00:00:05.00>ALT KL204 FL300\n"
+        "00:00:10.00>ECHO scenario done\n")
+    ok, _ = sim.stack.openfile(str(scn))
+    assert ok
+    assert sim.stack.next_trigger_time() == 0.0
+    sim.run(until_simt=12.0, max_iters=300)
+    assert sim.traf.ntraf == 1
+    i = sim.traf.id2idx("KL204")
+    assert float(sim.traf.state.ac.selalt[i]) == pytest.approx(30000 * aero.ft)
+    assert any("scenario done" in e for e in sim.scr.echobuf)
+
+
+def test_pcall_argument_substitution(sim, tmp_path):
+    scn = tmp_path / "param.scn"
+    scn.write_text("00:00:00.00>CRE %0 B744 52 4 90 FL200 250\n")
+    do(sim, f"PCALL {scn} ACX")
+    sim.run(until_simt=1.0, max_iters=50)
+    assert sim.traf.id2idx("ACX") >= 0
+
+
+def test_delay_and_schedule(sim):
+    do(sim, "CRE KL204 B744 52 4 90 FL200 250",
+       "DELAY 2 ECHO later", "SCHEDULE 00:00:04 ECHO at4")
+    sim.run(until_simt=5.0, max_iters=200)
+    joined = "\n".join(sim.scr.echobuf)
+    assert "later" in joined and "at4" in joined
+
+
+def test_saveic_writes_reconstruction(sim, tmp_path):
+    sim.stack.scenario_path = str(tmp_path)
+    do(sim, "CRE KL204 B744 52 4 90 FL200 250",
+       "ADDWPT KL204 52.2 4.5 FL220",
+       "SAVEIC mysave")
+    do(sim, "ALT KL204 FL300")
+    sim.stack.saveclose()
+    content = (tmp_path / "mysave.scn").read_text()
+    assert "CRE KL204" in content
+    assert "ADDWPT KL204" in content
+    assert "ALT KL204" in content
+
+
+def test_wind_command(sim):
+    do(sim, "CRE KL204 B744 52 4 90 FL200 250")
+    do(sim, "WIND 52 4 270 30")
+    assert sim.cfg.use_wind
+    assert int(sim.traf.state.wind.winddim) >= 1
+
+
+def test_dtmult_and_dt(sim):
+    do(sim, "DTMULT 5")
+    assert sim.dtmult == 5.0
+    do(sim, "DT 0.1")
+    assert sim.cfg.simdt == pytest.approx(0.1)
+
+
+def test_calc_and_dist(sim):
+    out = do(sim, "CALC 2 + 3")
+    assert "5" in out
+    out = do(sim, "DIST 0 0 1 0")
+    assert "60" in out.split("=")[-1]  # ~60 nm
+
+
+def test_benchmark_command(sim, tmp_path):
+    sim.stack.scenario_path = str(tmp_path)
+    (tmp_path / "bench.scn").write_text(
+        "00:00:00.00>CRE KL204 B744 52 4 90 FL200 250\n")
+    do(sim, "BENCHMARK bench 5")
+    sim.run(until_simt=6.0, max_iters=500)
+    joined = "\n".join(sim.scr.echobuf)
+    assert "Benchmark complete" in joined
+
+
+def test_snaplog_logger(sim, tmp_path):
+    from bluesky_tpu.utils import datalog
+    datalog.log_path = str(tmp_path)
+    do(sim, "CRE KL204 B744 52 4 90 FL200 250", "SNAPLOG ON 1")
+    sim.run(until_simt=3.0, max_iters=100)
+    do(sim, "SNAPLOG OFF")
+    files = list(tmp_path.glob("SNAPLOG*"))
+    assert files
+    content = files[0].read_text()
+    assert "KL204" in content
+
+
+def test_seed_reproducibility(sim):
+    do(sim, "SEED 42", "MCRE 3")
+    lats1 = np.asarray(sim.traf.state.ac.lat)[:3].copy()
+    sim2 = Simulation(nmax=32, dtype=jnp.float64)
+    sim2.stack.stack("SEED 42")
+    sim2.stack.stack("MCRE 3")
+    sim2.stack.process()
+    lats2 = np.asarray(sim2.traf.state.ac.lat)[:3]
+    np.testing.assert_array_equal(lats1, lats2)
