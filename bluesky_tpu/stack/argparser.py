@@ -7,7 +7,8 @@ small pure functions keyed by argtype name.  Position text resolution
 (``tools/position.py``) consults the navdatabase when one is attached.
 
 Supported argtypes (subset used by the built-in command dict, same names as
-the reference): txt, string, acid, wpinroute, float, int, onoff, alt, spd,
+the reference): txt (uppercased), word (case-preserving — use for
+filenames), string, acid, wpinroute, float, int, onoff, alt, spd,
 vspd, hdg, time, latlon, lat, lon, wpt, pandir, color.  A trailing
 ``...`` repeats the last group.  Optional args are marked with brackets in
 the usage string and simply absent from the tail.

@@ -593,7 +593,7 @@ def register_all(stack):
                      "Resolution zone half-height"],
         "SAVEIC": ["SAVEIC filename", "[word]", saveic,
                    "Record scenario from current state"],
-        "SCEN": ["SCEN name", "txt", scen, "Name the current scenario"],
+        "SCEN": ["SCEN name", "word", scen, "Name the current scenario"],
         "SCHEDULE": ["SCHEDULE time,COMMAND+ARGS", "time,string,...", schedule,
                      "Schedule a command at a sim time"],
         "SEED": ["SEED value", "int", seed, "Set random seed"],

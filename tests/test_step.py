@@ -148,10 +148,11 @@ def test_step_scheduling_fms_and_asas_intervals():
 def test_run_steps_matches_single_steps():
     traf = make_scene(n=2, spacing=0.05)
     cfg = SimConfig(asas=AsasConfig(swasas=False))
-    st_scan = run_steps(traf.state, cfg, 50)
     st_loop = traf.state
     for _ in range(50):
         st_loop = step_jit(st_loop, cfg)
+    # run_steps donates its input, so it must be the last user of traf.state
+    st_scan = run_steps(traf.state, cfg, 50)
     for name in ("lat", "lon", "alt", "hdg", "tas"):
         np.testing.assert_allclose(np.asarray(getattr(st_scan.ac, name)),
                                    np.asarray(getattr(st_loop.ac, name)),
