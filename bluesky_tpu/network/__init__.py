@@ -1,0 +1,16 @@
+"""Process fabric: ZMQ broker + sim nodes + clients (parity: bluesky/network/).
+
+Architecture (same topology as the reference, reimplemented fresh):
+
+  Client (DEALER+SUB) <-> Server broker (ROUTER:event_port / XPUB:stream_port
+  client-facing; ROUTER:wevent_port / XSUB:wstream_port worker-facing)
+  <-> Node workers (DEALER+PUB), each running one TPU Simulation.
+
+Events are source-routed multipart messages ``[*route, name, payload]`` with
+the route rotated one hop per forward; ``b'*'`` broadcasts.  Streams are
+PUB/SUB topics ``name + node_id`` carried XSUB->XPUB through the broker.
+This layer is deliberately host-side Python: per SURVEY.md §5.8 it is the
+control plane; device-side communication is XLA collectives (parallel/).
+"""
+from .common import DEFAULT_PORTS, get_ownip, make_id
+from .npcodec import packb, unpackb

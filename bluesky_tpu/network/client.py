@@ -1,0 +1,127 @@
+"""GUI/script-side network endpoint (parity: bluesky/network/client.py:16-196).
+
+DEALER event socket + SUB stream socket.  ``connect()`` performs the
+REGISTER handshake with a timeout; ``receive()`` pumps both sockets and
+emits ``event_received(name, data, sender_id)`` /
+``stream_received(name, data, sender_id)`` signals.  Tracks the set of sim
+nodes (from NODESCHANGED) and an *active node* that untargeted events
+(stack commands) are routed to.
+"""
+import time
+
+import zmq
+
+from ..utils.signalslot import Signal
+from .common import DEFAULT_PORTS, make_id
+from .discovery import Discovery
+from .node import split_envelope
+from .npcodec import packb, unpackb
+
+
+class Client:
+    def __init__(self):
+        self.client_id = make_id()
+        self.host_id = b""
+        self.nodes = []            # known sim node ids
+        self.act = b""             # active node id
+        self.event_received = Signal("event")
+        self.stream_received = Signal("stream")
+        self.nodes_changed = Signal("nodes")
+        ctx = zmq.Context.instance()
+        self.event_io = ctx.socket(zmq.DEALER)
+        self.event_io.setsockopt(zmq.IDENTITY, self.client_id)
+        self.event_io.setsockopt(zmq.LINGER, 0)
+        self.stream_in = ctx.socket(zmq.SUB)
+        self.stream_in.setsockopt(zmq.LINGER, 0)
+
+    # ----------------------------------------------------------- connection
+    def connect(self, host="127.0.0.1", event_port=DEFAULT_PORTS["event"],
+                stream_port=DEFAULT_PORTS["stream"], timeout=5.0):
+        self.event_io.connect(f"tcp://{host}:{event_port}")
+        self.stream_in.connect(f"tcp://{host}:{stream_port}")
+        self.send_event(b"REGISTER", target=b"")
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < timeout:
+            if self.event_io.poll(100):
+                route, name, payload = split_envelope(
+                    self.event_io.recv_multipart())
+                if name == b"REGISTER":
+                    data = unpackb(payload)
+                    self.host_id = data["host_id"]
+                    self._set_nodes(data["nodes"])
+                    return
+                self._dispatch(route, name, payload)
+        raise TimeoutError("no REGISTER reply from server")
+
+    def close(self):
+        self.event_io.close()
+        self.stream_in.close()
+
+    @staticmethod
+    def discover(timeout=3.0):
+        """Broadcast on the LAN and return the first discovery.Reply."""
+        disc = Discovery(make_id(), is_client=True)
+        try:
+            disc.send_request()
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < timeout:
+                kind, reply = disc.recv_reqreply()
+                if kind == "rep":
+                    return reply
+        finally:
+            disc.close()
+        return None
+
+    # ----------------------------------------------------------------- I/O
+    def send_event(self, name: bytes, data=None, target=None):
+        """target: None -> active node, b'' -> server, b'*' -> all nodes,
+        or an explicit node id."""
+        if target is None:
+            target = self.act or b"*"
+        route = [target] if target else []
+        self.event_io.send_multipart(route + [name, packb(data)])
+
+    def stack(self, cmdline: str, target=None):
+        self.send_event(b"STACKCMD", cmdline, target)
+
+    def subscribe(self, streamname: bytes, node_id: bytes = b""):
+        self.stream_in.setsockopt(zmq.SUBSCRIBE, streamname + node_id)
+
+    def unsubscribe(self, streamname: bytes, node_id: bytes = b""):
+        self.stream_in.setsockopt(zmq.UNSUBSCRIBE, streamname + node_id)
+
+    def actnode(self, node_id: bytes = None) -> bytes:
+        if node_id is not None and node_id in self.nodes:
+            self.act = node_id
+        return self.act
+
+    # ------------------------------------------------------------- receive
+    def receive(self, timeout_ms: int = 0) -> int:
+        """Pump both sockets; returns number of messages handled."""
+        n = 0
+        while self.event_io.poll(timeout_ms if n == 0 else 0):
+            route, name, payload = split_envelope(
+                self.event_io.recv_multipart())
+            self._dispatch(route, name, payload)
+            n += 1
+        while self.stream_in.poll(0):
+            topic, payload = self.stream_in.recv_multipart()
+            name, sender = topic[:-5], topic[-5:]
+            self.stream_received.emit(name, unpackb(payload), sender)
+            n += 1
+        return n
+
+    def _dispatch(self, route, name, payload):
+        data = unpackb(payload) if payload else None
+        if name == b"NODESCHANGED":
+            self.host_id = data["host_id"]
+            self._set_nodes(data["nodes"])
+        else:
+            sender = route[0] if route else b""
+            self.event_received.emit(name, data, sender)
+
+    def _set_nodes(self, nodes):
+        self.nodes = list(nodes)
+        if (not self.act or self.act not in self.nodes) and self.nodes:
+            self.act = self.nodes[0]
+        self.nodes_changed.emit(self.nodes)

@@ -1,0 +1,30 @@
+"""Shared network helpers (parity: bluesky/network/common.py:4-15).
+
+Endpoint ids are 5 random bytes with a leading zero byte so they can never
+collide with single-character control tokens like ``b'*'``.
+"""
+import os
+import socket
+
+# Reference defaults (network/server.py:20-23): client event/stream ports,
+# worker event/stream ports, UDP discovery port.
+DEFAULT_PORTS = dict(event=9000, stream=9001,
+                     wevent=10000, wstream=10001, discovery=11000)
+
+
+def make_id() -> bytes:
+    """A 5-byte endpoint id: zero byte + 4 random bytes (node.py:15)."""
+    return b"\x00" + os.urandom(4)
+
+
+def get_ownip() -> str:
+    """Best-effort non-loopback IPv4 of this host."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"

@@ -1,0 +1,72 @@
+"""LAN server discovery via UDP broadcast (parity: network/discovery.py:14-73).
+
+A client broadcasts a request datagram on the discovery port; every server
+replies with its event/stream ports.  Datagrams are msgpack maps with a
+magic tag so stray packets on the port are ignored.
+"""
+import socket
+from dataclasses import dataclass
+
+from .common import DEFAULT_PORTS, get_ownip
+from .npcodec import packb, unpackb
+
+_MAGIC = "bstpu-disc-1"
+
+
+@dataclass
+class Reply:
+    ip: str
+    event_port: int
+    stream_port: int
+
+
+class Discovery:
+    def __init__(self, own_id: bytes, is_client: bool = True,
+                 port: int = DEFAULT_PORTS["discovery"]):
+        self.own_id = own_id
+        self.is_client = is_client
+        self.port = port
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_BROADCAST, 1)
+        self.sock.bind(("", port))
+        self.sock.settimeout(0.2)
+
+    @property
+    def handle(self):
+        return self.sock
+
+    def close(self):
+        self.sock.close()
+
+    def send_request(self):
+        msg = packb({"magic": _MAGIC, "kind": "req", "id": self.own_id})
+        self.sock.sendto(msg, ("<broadcast>", self.port))
+
+    def send_reply(self, event_port: int, stream_port: int):
+        msg = packb({"magic": _MAGIC, "kind": "rep", "id": self.own_id,
+                     "ip": get_ownip(), "event": event_port,
+                     "stream": stream_port})
+        self.sock.sendto(msg, ("<broadcast>", self.port))
+
+    def recv_reqreply(self):
+        """Receive one datagram; returns ('req', None) | ('rep', Reply) |
+        (None, None) on timeout/foreign traffic/own echo."""
+        try:
+            raw, addr = self.sock.recvfrom(4096)
+        except socket.timeout:
+            return None, None
+        try:
+            msg = unpackb(raw)
+        except Exception:
+            return None, None
+        if not isinstance(msg, dict) or msg.get("magic") != _MAGIC:
+            return None, None
+        if msg.get("id") == self.own_id:
+            return None, None
+        if msg.get("kind") == "req":
+            return "req", None
+        if msg.get("kind") == "rep":
+            return "rep", Reply(msg.get("ip", addr[0]), msg["event"],
+                                msg["stream"])
+        return None, None

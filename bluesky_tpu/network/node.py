@@ -1,0 +1,104 @@
+"""Sim-side network endpoint (parity: bluesky/network/node.py:13-96).
+
+A Node owns a DEALER event socket and a PUB stream socket connected to the
+Server's worker-facing ports.  Wire format for events is source-routed
+multipart: ``[*route, name, payload]`` where route frames are 5-byte ids
+(leading zero byte, common.make_id) or ``b'*'``; the first frame that is
+neither is the event name.  Replies go back along the accumulated return
+route (see server.py for the rotation rule).  Streams are PUB frames
+``[name + node_id, payload]`` so SUB prefix-matching selects by stream name
+(and optionally by node).
+"""
+import zmq
+
+from ..utils.timer import Timer
+from .common import DEFAULT_PORTS, make_id
+from .npcodec import packb, unpackb
+
+
+def split_envelope(frames):
+    """Split multipart frames into (route, name, payload)."""
+    for i, frame in enumerate(frames):
+        if not (frame == b"*" or (frame and frame[0:1] == b"\x00")):
+            return frames[:i], frame, frames[i + 1] if i + 1 < len(frames) \
+                else b""
+    raise ValueError("malformed envelope: no name frame")
+
+
+class Node:
+    """Worker endpoint; subclass and override event()/step()."""
+
+    def __init__(self, event_port: int = DEFAULT_PORTS["wevent"],
+                 stream_port: int = DEFAULT_PORTS["wstream"],
+                 host: str = "127.0.0.1"):
+        self.node_id = make_id()
+        self.host_id = b""        # filled by REGISTER reply
+        self.running = False
+        ctx = zmq.Context.instance()
+        self.event_io = ctx.socket(zmq.DEALER)
+        self.event_io.setsockopt(zmq.IDENTITY, self.node_id)
+        # short linger so the final STATECHANGE(-1) flushes before close()
+        self.event_io.setsockopt(zmq.LINGER, 500)
+        self.stream_out = ctx.socket(zmq.PUB)
+        self.stream_out.setsockopt(zmq.LINGER, 0)
+        self._endpoints = (f"tcp://{host}:{event_port}",
+                           f"tcp://{host}:{stream_port}")
+
+    # ------------------------------------------------------------ lifecycle
+    def connect(self):
+        self.event_io.connect(self._endpoints[0])
+        self.stream_out.connect(self._endpoints[1])
+        self.send_event(b"REGISTER", None)
+
+    def quit(self):
+        self.running = False
+
+    def close(self):
+        self.event_io.close()
+        self.stream_out.close()
+
+    # ------------------------------------------------------------------ I/O
+    def send_event(self, name: bytes, data=None, route=None):
+        frames = list(route or []) + [name, packb(data)]
+        self.event_io.send_multipart(frames)
+
+    def send_stream(self, name: bytes, data):
+        self.stream_out.send_multipart([name + self.node_id, packb(data)])
+
+    # ------------------------------------------------------------ overrides
+    def event(self, name: bytes, data, sender_route):
+        """Handle one event; override in subclasses."""
+
+    def step(self):
+        """One host-loop iteration of work; override in subclasses."""
+
+    # ------------------------------------------------------------ main loop
+    def process_events(self, timeout_ms: int = 0) -> int:
+        """Drain pending events; returns number handled."""
+        n = 0
+        while True:
+            if not self.event_io.poll(timeout_ms if n == 0 else 0):
+                return n
+            route, name, payload = split_envelope(
+                self.event_io.recv_multipart())
+            n += 1
+            data = unpackb(payload) if payload else None
+            if name == b"REGISTER":
+                # handshake ack: payload carries the server id
+                self.host_id = data["host_id"]
+            elif name == b"QUIT":
+                self.quit()
+            else:
+                self.event(name, data, route)
+
+    def run(self):
+        """Blocking loop: events -> step -> wall-clock timers (node.py:55-80)."""
+        self.running = True
+        self.connect()
+        while self.running:
+            self.process_events(timeout_ms=1)
+            self.step()
+            Timer.update_timers()
+        # tell the server we are gone, then tear down
+        self.send_event(b"STATECHANGE", -1)
+        self.close()

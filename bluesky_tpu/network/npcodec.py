@@ -1,0 +1,37 @@
+"""msgpack codec with transparent numpy ndarray support.
+
+Parity: bluesky/network/npcodec.py:3-16 — arrays travel as a tagged map of
+``{dtype, shape, data}`` with raw ``tobytes()`` payload (no pickling, safe to
+decode from untrusted peers).  JAX arrays are converted via ``np.asarray``
+at the call site before packing (device->host copy happens exactly once,
+at the stream boundary).
+"""
+import msgpack
+import numpy as np
+
+_ND = "__nd__"
+
+
+def _encode(obj):
+    if isinstance(obj, np.ndarray):
+        return {_ND: True, "t": obj.dtype.str, "s": list(obj.shape),
+                "d": obj.tobytes()}
+    if isinstance(obj, (np.generic,)):
+        return obj.item()
+    raise TypeError(f"cannot serialize {type(obj)}")
+
+
+def _decode(obj):
+    if isinstance(obj, dict) and obj.get(_ND):
+        arr = np.frombuffer(obj["d"], dtype=np.dtype(obj["t"]))
+        return arr.reshape(obj["s"])
+    return obj
+
+
+def packb(data) -> bytes:
+    return msgpack.packb(data, default=_encode, use_bin_type=True)
+
+
+def unpackb(raw: bytes):
+    return msgpack.unpackb(raw, object_hook=_decode, raw=False,
+                           strict_map_key=False)

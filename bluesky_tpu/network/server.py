@@ -1,0 +1,228 @@
+"""Broker + worker manager (parity: bluesky/network/server.py:26-317).
+
+Four sockets: client-facing ROUTER (events) + XPUB (streams), worker-facing
+ROUTER (events) + XSUB (streams).  Streams pass through XSUB->XPUB;
+subscription messages flow back XPUB->XSUB.  Events are source-routed
+multipart ``[*route, name, payload]`` (see node.split_envelope): on each
+forward the server pops the first route frame as the next-hop destination
+and appends the arrival sender id to the tail, so the frames a receiver
+sees are exactly the return route for its reply.  ``b'*'`` fans out to all
+workers.
+
+Server-directed events (empty route): REGISTER, ADDNODES, BATCH, QUIT,
+STATECHANGE.  BATCH splits a multi-SCEN scenario and farms the pieces out
+to idle workers, spawning more (up to max_nnodes) as needed — the
+reference's scenario-ensemble parallelism (§2.10), which on TPU pairs with
+the device-side ensemble axis in parallel/sharding.py.
+"""
+import os
+import subprocess
+import sys
+import threading
+
+import zmq
+
+from .common import DEFAULT_PORTS, make_id
+from .discovery import Discovery
+from .node import split_envelope
+from .npcodec import packb, unpackb
+
+
+def split_scenarios(scentime, scencmd):
+    """Split a scenario command list into per-SCEN chunks
+    (parity: server.py:26-32)."""
+    starts = [i for i, cmd in enumerate(scencmd)
+              if cmd.strip().upper().startswith("SCEN")]
+    if not starts:
+        return [(list(scentime), list(scencmd))] if scencmd else []
+    # commands before the first SCEN are global setup: prepend to each piece
+    pre_t, pre_c = scentime[:starts[0]], scencmd[:starts[0]]
+    bounds = starts + [len(scencmd)]
+    return [(pre_t + scentime[a:b], pre_c + scencmd[a:b])
+            for a, b in zip(bounds[:-1], bounds[1:])]
+
+
+class Server(threading.Thread):
+    """Runs the broker loop in a thread (reference: Server(Thread))."""
+
+    def __init__(self, headless=False, discoverable=False,
+                 ports=None, max_nnodes=None, spawn_workers=True):
+        super().__init__(daemon=True)
+        self.server_id = make_id()
+        self.headless = headless
+        self.ports = dict(DEFAULT_PORTS, **(ports or {}))
+        self.max_nnodes = max_nnodes or min(os.cpu_count() or 1, 8)
+        self.spawn_workers = spawn_workers
+        self.running = False
+        self._stop_requested = False
+        self.clients = []                  # connected client ids
+        self.workers = {}                  # worker_id -> state int
+        self.avail_workers = []            # idle worker ids (for BATCH)
+        self.scenarios = []                # pending BATCH pieces
+        self.processes = []                # spawned worker Popen handles
+        self._pending_spawns = 0           # spawned but not yet REGISTERed
+        self.discovery = Discovery(self.server_id, is_client=False,
+                                   port=self.ports["discovery"]) \
+            if discoverable else None
+        ctx = zmq.Context.instance()
+        self.fe_event = ctx.socket(zmq.ROUTER)
+        self.fe_stream = ctx.socket(zmq.XPUB)
+        self.be_event = ctx.socket(zmq.ROUTER)
+        self.be_stream = ctx.socket(zmq.XSUB)
+        # event sockets get a short linger so final QUIT/NODESCHANGED sends
+        # flush before close; stream sockets can drop in-flight data
+        self.fe_event.setsockopt(zmq.LINGER, 500)
+        self.be_event.setsockopt(zmq.LINGER, 500)
+        self.fe_stream.setsockopt(zmq.LINGER, 0)
+        self.be_stream.setsockopt(zmq.LINGER, 0)
+
+    # ----------------------------------------------------------- lifecycle
+    def addnodes(self, count=1):
+        """Spawn sim worker processes (parity: server.py:62-67)."""
+        if not self.spawn_workers:
+            return
+        for _ in range(count):
+            self._pending_spawns += 1
+            self.processes.append(subprocess.Popen(
+                [sys.executable, "-m", "bluesky_tpu", "--sim",
+                 "--event-port", str(self.ports["wevent"]),
+                 "--stream-port", str(self.ports["wstream"])]))
+
+    def stop(self):
+        self._stop_requested = True
+        self.running = False
+
+    # ------------------------------------------------------------- routing
+    def _forward(self, sender, route, name, payload):
+        """Pop next hop, append sender to the return tail, send."""
+        if route and route[0] == b"*":
+            for wid in self.workers:
+                self.be_event.send_multipart(
+                    [wid, sender, name, payload])
+            return
+        dest = route[0]
+        tail = list(route[1:]) + [sender]
+        sock = self.be_event if dest in self.workers else self.fe_event
+        sock.send_multipart([dest] + tail + [name, payload])
+
+    def _nodeschanged(self):
+        data = packb({"host_id": self.server_id,
+                      "nodes": list(self.workers)})
+        for cid in self.clients:
+            self.fe_event.send_multipart([cid, b"NODESCHANGED", data])
+
+    def _handle_server_event(self, sock, sender, name, payload):
+        from_worker = sock is self.be_event
+        if name == b"REGISTER":
+            if from_worker:
+                self.workers[sender] = 0
+                self._pending_spawns = max(0, self._pending_spawns - 1)
+                self.avail_workers.append(sender)
+                self._send_pending_scenario()
+                self._nodeschanged()
+            else:
+                self.clients.append(sender)
+            sock.send_multipart(
+                [sender, b"REGISTER",
+                 packb({"host_id": self.server_id,
+                        "nodes": list(self.workers)})])
+        elif name == b"ADDNODES":
+            count = unpackb(payload) if payload else 1
+            self.addnodes(int(count or 1))
+        elif name == b"STATECHANGE":
+            state = unpackb(payload)
+            if state == -1:
+                self.workers.pop(sender, None)
+                if sender in self.avail_workers:
+                    self.avail_workers.remove(sender)
+                self._nodeschanged()
+            else:
+                self.workers[sender] = state
+                # worker dropped out of OP -> available for the next piece;
+                # busy workers must not receive BATCH pieces
+                # (parity: server.py:234-247)
+                if state < 2:
+                    if sender not in self.avail_workers:
+                        self.avail_workers.append(sender)
+                        self._send_pending_scenario()
+                elif sender in self.avail_workers:
+                    self.avail_workers.remove(sender)
+        elif name == b"BATCH":
+            data = unpackb(payload)
+            self.scenarios.extend(
+                split_scenarios(data["scentime"], data["scencmd"]))
+            while self.avail_workers and self.scenarios:
+                self._send_pending_scenario()
+            if self.scenarios:
+                headroom = self.max_nnodes - len(self.workers) \
+                    - self._pending_spawns
+                self.addnodes(max(0, min(len(self.scenarios), headroom)))
+        elif name == b"QUIT":
+            for wid in self.workers:
+                self.be_event.send_multipart([wid, b"QUIT", packb(None)])
+            self.running = False
+
+    def _send_pending_scenario(self):
+        if self.avail_workers and self.scenarios:
+            wid = self.avail_workers.pop(0)
+            scentime, scencmd = self.scenarios.pop(0)
+            self.be_event.send_multipart(
+                [wid, b"BATCH", packb({"scentime": scentime,
+                                       "scencmd": scencmd})])
+
+    # ------------------------------------------------------------ main loop
+    def run(self):
+        self.fe_event.bind(f"tcp://*:{self.ports['event']}")
+        self.fe_stream.bind(f"tcp://*:{self.ports['stream']}")
+        self.be_event.bind(f"tcp://*:{self.ports['wevent']}")
+        self.be_stream.bind(f"tcp://*:{self.ports['wstream']}")
+        poller = zmq.Poller()
+        for sock in (self.fe_event, self.fe_stream, self.be_event,
+                     self.be_stream):
+            poller.register(sock, zmq.POLLIN)
+        if self.discovery:
+            poller.register(self.discovery.handle, zmq.POLLIN)
+        self.running = not self._stop_requested
+        if not self.headless:
+            self.addnodes(1)
+        while self.running:
+            events = dict(poller.poll(100))
+            if self.be_stream in events:
+                self.fe_stream.send_multipart(
+                    self.be_stream.recv_multipart())
+            if self.fe_stream in events:    # subscription propagation
+                self.be_stream.send_multipart(
+                    self.fe_stream.recv_multipart())
+            if self.discovery and (self.discovery.handle in events
+                                   or self.discovery.handle.fileno()
+                                   in events):
+                kind, _ = self.discovery.recv_reqreply()
+                if kind == "req":
+                    self.discovery.send_reply(self.ports["event"],
+                                              self.ports["stream"])
+            for sock in (self.fe_event, self.be_event):
+                if sock not in events:
+                    continue
+                frames = sock.recv_multipart()
+                # a malformed message from one peer must not kill the broker
+                try:
+                    sender, rest = frames[0], frames[1:]
+                    route, name, payload = split_envelope(rest)
+                    if route:
+                        self._forward(sender, route, name, payload)
+                    else:
+                        self._handle_server_event(sock, sender, name,
+                                                  payload)
+                except Exception as exc:
+                    print(f"server: dropped malformed message: {exc!r}")
+        # shutdown: wait for spawned workers (server.py:311-317)
+        for proc in self.processes:
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        for sock in (self.fe_event, self.fe_stream, self.be_event,
+                     self.be_stream):
+            sock.close()
+        if self.discovery:
+            self.discovery.close()

@@ -1,0 +1,157 @@
+"""Distributed-fabric tests without real distribution (reference §4.3
+pattern: real Server thread in-process, real Client + Node over localhost
+ZMQ — no mock transport)."""
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+zmq = pytest.importorskip("zmq")
+
+from bluesky_tpu.network import npcodec
+from bluesky_tpu.network.node import Node, split_envelope
+from bluesky_tpu.network.client import Client
+from bluesky_tpu.network.server import Server, split_scenarios
+
+
+# ----------------------------------------------------------------- helpers
+def free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def wait_for(cond, timeout=5.0, step=0.01):
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(step)
+    return False
+
+
+class EchoNode(Node):
+    """Replies to STACKCMD with an ECHO back to the sender and records it."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.got = []
+
+    def event(self, name, data, sender_route):
+        self.got.append((name, data))
+        if name == b"STACKCMD":
+            self.send_event(b"ECHO", f"ok: {data}",
+                            route=list(sender_route))
+
+
+@pytest.fixture
+def fabric():
+    """A running Server + registered EchoNode + connected Client."""
+    ev, st, wev, wst = free_ports(4)
+    ports = dict(event=ev, stream=st, wevent=wev, wstream=wst)
+    server = Server(headless=True, ports=ports, spawn_workers=False)
+    server.start()
+    time.sleep(0.2)                      # let the binds land
+    node = EchoNode(event_port=wev, stream_port=wst)
+    node_thread = threading.Thread(target=node.run, daemon=True)
+    node_thread.start()
+    client = Client()
+    client.connect(event_port=ev, stream_port=st, timeout=5.0)
+    assert wait_for(lambda: client.receive(10) or len(client.nodes) > 0)
+    yield server, node, client
+    node.quit()
+    node_thread.join(timeout=2)
+    server.stop()
+    server.join(timeout=5)
+    client.close()
+
+
+# ------------------------------------------------------------------- codec
+def test_npcodec_roundtrip():
+    msg = {"a": np.arange(6, dtype=np.float32).reshape(2, 3),
+           "b": [1, "x", np.array([True, False])],
+           "c": None, "d": 2.5}
+    out = npcodec.unpackb(npcodec.packb(msg))
+    np.testing.assert_array_equal(out["a"], msg["a"])
+    assert out["a"].dtype == np.float32
+    np.testing.assert_array_equal(out["b"][2], msg["b"][2])
+    assert out["c"] is None and out["d"] == 2.5
+
+
+def test_split_envelope():
+    rid = b"\x00abcd"
+    route, name, payload = split_envelope([rid, b"*", b"ECHO", b"xyz"])
+    assert route == [rid, b"*"] and name == b"ECHO" and payload == b"xyz"
+    route, name, payload = split_envelope([b"QUIT", b""])
+    assert route == [] and name == b"QUIT"
+
+
+def test_split_scenarios():
+    cmds = ["SCEN one", "CRE A", "SCEN two", "CRE B", "CRE C"]
+    times = [0.0, 1.0, 0.0, 1.0, 2.0]
+    out = split_scenarios(times, cmds)
+    assert len(out) == 2
+    assert out[0] == ([0.0, 1.0], ["SCEN one", "CRE A"])
+    assert out[1] == ([0.0, 1.0, 2.0], ["SCEN two", "CRE B", "CRE C"])
+
+
+# ------------------------------------------------------------------ fabric
+def test_register_and_nodeschanged(fabric):
+    server, node, client = fabric
+    assert client.host_id == server.server_id
+    assert node.node_id in client.nodes
+    assert client.act == node.node_id
+
+
+def test_event_roundtrip_client_node(fabric):
+    server, node, client = fabric
+    echoes = []
+    client.event_received.connect(
+        lambda name, data, sender: echoes.append((name, data, sender)))
+    client.stack("POS KL204")
+    assert wait_for(lambda: (client.receive(10), len(echoes) > 0)[1])
+    name, data, sender = echoes[0]
+    assert name == b"ECHO" and data == "ok: POS KL204"
+    assert sender == node.node_id
+    assert node.got and node.got[0] == (b"STACKCMD", "POS KL204")
+
+
+def test_broadcast_event(fabric):
+    server, node, client = fabric
+    client.send_event(b"STACKCMD", "HOLD", target=b"*")
+    assert wait_for(lambda: (b"STACKCMD", "HOLD") in node.got)
+
+
+def test_stream_pubsub(fabric):
+    server, node, client = fabric
+    got = []
+    client.stream_received.connect(
+        lambda name, data, sender: got.append((name, data, sender)))
+    client.subscribe(b"ACDATA")
+    time.sleep(0.3)                      # subscription must propagate
+    payload = {"lat": np.array([52.0, 51.0]), "id": ["A", "B"]}
+
+    def pump():
+        node.send_stream(b"ACDATA", payload)
+        client.receive(10)
+        return len(got) > 0
+
+    assert wait_for(pump)
+    name, data, sender = got[0]
+    assert name == b"ACDATA" and sender == node.node_id
+    np.testing.assert_array_equal(data["lat"], payload["lat"])
+
+
+def test_quit_fanout(fabric):
+    server, node, client = fabric
+    client.send_event(b"QUIT", target=b"")
+    assert wait_for(lambda: not node.running)
+    assert wait_for(lambda: not server.running)
