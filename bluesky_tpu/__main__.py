@@ -1,0 +1,127 @@
+"""Entry point / mode dispatch (parity: BlueSky.py:28-119).
+
+Modes:
+  (default) / --headless   start a Server broker that spawns sim workers
+  --sim                    run one sim worker node (spawned by the server)
+  --detached               run an embedded sim with no networking
+  --client                 interactive console client (text UI)
+
+Example headless session:
+  python -m bluesky_tpu --headless &
+  python -m bluesky_tpu --client
+  > CRE KL204 B744 52 4 90 FL200 250
+  > OP
+"""
+import argparse
+import sys
+
+from . import settings
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="bluesky_tpu", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    mode = parser.add_mutually_exclusive_group()
+    mode.add_argument("--headless", action="store_true",
+                      help="server + workers, no UI")
+    mode.add_argument("--sim", action="store_true", help="one sim worker")
+    mode.add_argument("--detached", action="store_true",
+                      help="embedded sim, no networking")
+    mode.add_argument("--client", action="store_true",
+                      help="console client")
+    parser.add_argument("--config-file", default="", help="settings file")
+    parser.add_argument("--scenfile", default="", help="startup scenario")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--event-port", type=int, default=None)
+    parser.add_argument("--stream-port", type=int, default=None)
+    parser.add_argument("--discoverable", action="store_true")
+    args = parser.parse_args(argv)
+
+    settings.init(args.config_file)
+
+    if args.sim:
+        return run_sim(args)
+    if args.detached:
+        return run_detached(args)
+    if args.client:
+        return run_client(args)
+    return run_server(args)
+
+
+def run_server(args):
+    from .network.server import Server
+    ports = {}
+    if args.event_port:
+        ports["event"] = args.event_port
+    if args.stream_port:
+        ports["stream"] = args.stream_port
+    server = Server(headless=True, discoverable=args.discoverable,
+                    ports=ports, max_nnodes=settings.max_nnodes)
+    print(f"bluesky_tpu server: clients on "
+          f"{server.ports['event']}/{server.ports['stream']}, workers on "
+          f"{server.ports['wevent']}/{server.ports['wstream']}")
+    server.start()
+    server.addnodes(1)
+    try:
+        server.join()
+    except KeyboardInterrupt:
+        server.stop()
+        server.join(timeout=5)
+    return 0
+
+
+def run_sim(args):
+    from .simulation.simnode import SimNode
+    node = SimNode(event_port=args.event_port,
+                   stream_port=args.stream_port)
+    if args.scenfile:
+        node.sim.stack.ic(args.scenfile)
+    node.run()
+    return 0
+
+
+def run_detached(args):
+    from .simulation.simnode import DetachedSimNode
+    node = DetachedSimNode()
+    if args.scenfile:
+        node.sim.stack.ic(args.scenfile)
+    node.run()
+    return 0
+
+
+def run_client(args):
+    """Minimal text console: lines -> STACKCMD, ECHO/SIMINFO printed."""
+    from .network.client import Client
+    client = Client()
+    client.connect(host=args.host,
+                   event_port=args.event_port or settings.event_port,
+                   stream_port=args.stream_port or settings.stream_port)
+    client.subscribe(b"SIMINFO")
+    client.event_received.connect(
+        lambda name, data, sender: print(
+            data.get("text", data) if isinstance(data, dict) else data)
+        if name == b"ECHO" else None)
+    print(f"connected to {client.host_id.hex()}; "
+          f"{len(client.nodes)} node(s). Ctrl-D to quit.")
+    try:
+        while True:
+            client.receive(10)
+            line = input("> ").strip()
+            if not line:
+                continue
+            if line.upper() in ("QUIT", "EXIT", "BYE"):
+                break
+            client.stack(line)
+            # give the reply a moment to arrive
+            for _ in range(20):
+                if client.receive(25):
+                    break
+    except (EOFError, KeyboardInterrupt):
+        pass
+    client.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,6 +27,7 @@ class Client:
         self.event_received = Signal("event")
         self.stream_received = Signal("stream")
         self.nodes_changed = Signal("nodes")
+        self._pending = []         # node-bound events queued until a node registers
         ctx = zmq.Context.instance()
         self.event_io = ctx.socket(zmq.DEALER)
         self.event_io.setsockopt(zmq.IDENTITY, self.client_id)
@@ -77,6 +78,11 @@ class Client:
         """target: None -> active node, b'' -> server, b'*' -> all nodes,
         or an explicit node id."""
         if target is None:
+            if not self.nodes:
+                # no sim node registered yet (worker still starting up):
+                # queue instead of broadcasting into an empty worker set
+                self._pending.append((name, data))
+                return
             target = self.act or b"*"
         route = [target] if target else []
         self.event_io.send_multipart(route + [name, packb(data)])
@@ -125,3 +131,7 @@ class Client:
         if (not self.act or self.act not in self.nodes) and self.nodes:
             self.act = self.nodes[0]
         self.nodes_changed.emit(self.nodes)
+        if self.nodes and self._pending:
+            pending, self._pending = self._pending, []
+            for name, data in pending:
+                self.send_event(name, data)

@@ -136,6 +136,12 @@ class Server(threading.Thread):
                 if sender in self.avail_workers:
                     self.avail_workers.remove(sender)
                 self._nodeschanged()
+                # keep the batch draining if pieces are still queued
+                if self.scenarios:
+                    headroom = self.max_nnodes - len(self.workers) \
+                        - self._pending_spawns
+                    self.addnodes(max(0, min(len(self.scenarios),
+                                             headroom)))
             else:
                 self.workers[sender] = state
                 # worker dropped out of OP -> available for the next piece;
@@ -161,6 +167,11 @@ class Server(threading.Thread):
             for wid in self.workers:
                 self.be_event.send_multipart([wid, b"QUIT", packb(None)])
             self.running = False
+        elif from_worker:
+            # unaddressed worker output (e.g. scenario-triggered ECHO with
+            # no issuing client): fan out to every connected client
+            for cid in self.clients:
+                self.fe_event.send_multipart([cid, sender, name, payload])
 
     def _send_pending_scenario(self):
         if self.avail_workers and self.scenarios:
@@ -215,7 +226,10 @@ class Server(threading.Thread):
                                                   payload)
                 except Exception as exc:
                     print(f"server: dropped malformed message: {exc!r}")
-        # shutdown: wait for spawned workers (server.py:311-317)
+        # shutdown: tell workers to quit (covers stop() as well as the
+        # client-QUIT path), then wait for them (server.py:311-317)
+        for wid in self.workers:
+            self.be_event.send_multipart([wid, b"QUIT", packb(None)])
         for proc in self.processes:
             try:
                 proc.wait(timeout=5)

@@ -1,0 +1,73 @@
+"""Config/flag system (parity: bluesky/settings.py:8-133, modernized).
+
+Two-level scheme like the reference: a config file plus per-module
+registered defaults (``set_variable_defaults``).  Divergence from the
+reference (SURVEY.md §5.6 build note): the config file is a restricted
+``key = value`` Python file evaluated with ``ast.literal_eval`` per line —
+config is data, not arbitrary code — and unknown keys are kept so modules
+registering defaults later still pick them up.
+
+Data paths default to the read-only reference data mount when present so
+navdata/performance coefficients load out of the box; everything degrades
+gracefully when they are absent.
+"""
+import ast
+import os
+import sys
+
+_REF_DATA = "/root/reference/data"
+
+# ----------------------------------------------------------------- defaults
+simdt = 0.05
+performance_model = "openap"
+prefer_compiled = True            # use the C host extension when built
+data_path = _REF_DATA if os.path.isdir(_REF_DATA) else "data"
+navdata_path = os.path.join(data_path, "navdata")
+perf_path = os.path.join(data_path, "performance")
+cache_path = os.path.join(os.path.expanduser("~"), ".cache", "bluesky_tpu")
+log_path = "output"
+scenario_path = "scenario"
+plugin_path = "plugins"
+enabled_plugins = ["datafeed"]
+event_port = 9000
+stream_port = 9001
+wevent_port = 10000
+wstream_port = 10001
+discovery_port = 11000
+max_nnodes = os.cpu_count() or 1
+sim_detached = False
+telnet_port = 8888
+
+_overrides = {}                   # file/CLI values for late-registered keys
+
+
+def init(cfgfile: str = "") -> bool:
+    """Load ``key = value`` lines from cfgfile into this module."""
+    if not cfgfile or not os.path.isfile(cfgfile):
+        return False
+    mod = sys.modules[__name__]
+    with open(cfgfile) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#") or "=" not in line:
+                continue
+            key, _, raw = line.partition("=")
+            key = key.strip()
+            try:
+                val = ast.literal_eval(raw.strip())
+            except (ValueError, SyntaxError):
+                val = raw.strip()
+            setattr(mod, key, val)
+            _overrides[key] = val
+    return True
+
+
+def set_variable_defaults(**kwargs):
+    """Per-module defaults registered at import time (settings.py:121-133):
+    only set if neither a default nor a config override exists yet."""
+    mod = sys.modules[__name__]
+    for key, value in kwargs.items():
+        if key in _overrides:
+            setattr(mod, key, _overrides[key])
+        elif not hasattr(mod, key):
+            setattr(mod, key, value)

@@ -1,0 +1,103 @@
+"""Sim-side screen proxy: batches GUI state into network streams
+(parity: bluesky/simulation/qtgl/screenio.py:11-263).
+
+Echo text is routed back to the client that issued the command; SIMINFO
+(achieved sim rate, 1 Hz) and ACDATA (aircraft state subset, 5 Hz) are
+published as streams.  Device -> host transfer of the ACDATA arrays happens
+exactly once per tick via ``np.asarray`` on the padded state, sliced by the
+active mask — the only regular host readback in the whole system.
+"""
+import time
+
+import numpy as np
+
+ACDATA_DT = 0.2       # 5 Hz (screenio.py:18-21)
+SIMINFO_DT = 1.0      # 1 Hz
+
+
+class ScreenIO:
+    """Duck-types simulation.sim.Screen; streams instead of buffering."""
+
+    def __init__(self, sim, node):
+        self.sim = sim
+        self.node = node
+        self.current_sender = ""      # set by the stack before echo calls
+        self.echobuf = []             # retained for embedded inspection
+        self.viewbounds = (-90.0, 90.0, -180.0, 180.0)
+        self.samplecount = 0
+        self.prevcount = 0
+        self.prevtime = time.perf_counter()
+        self.prevsimt = 0.0
+        # Stream cadence is tracked locally, NOT via the process-global
+        # Timer registry: with several nodes in one process a global timer
+        # would fire this node's ZMQ sends from another node's thread
+        # (pyzmq sockets are not thread-safe).  update() runs on this
+        # node's own thread each loop iteration.
+        now = time.perf_counter()
+        self._next_siminfo = now + SIMINFO_DT
+        self._next_acdata = now + ACDATA_DT
+
+    def close(self):
+        pass
+
+    # ------------------------------------------------------------- commands
+    def echo(self, text="", flags=0):
+        self.echobuf.append(text)
+        route = [bytes.fromhex(self.current_sender)] \
+            if self.current_sender else None
+        self.node.send_event(b"ECHO", {"text": text, "flags": flags}, route)
+        return True
+
+    def getviewbounds(self):
+        return self.viewbounds
+
+    def update(self):
+        self.samplecount += 1
+        now = time.perf_counter()
+        if now >= self._next_siminfo:
+            self._next_siminfo = now + SIMINFO_DT
+            self.send_siminfo()
+        if now >= self._next_acdata:
+            self._next_acdata = now + ACDATA_DT
+            self.send_aircraft_data()
+
+    # -------------------------------------------------------------- streams
+    def send_siminfo(self):
+        """Achieved sim speed etc at 1 Hz (screenio.py:185-192)."""
+        now = time.perf_counter()
+        simt = self.sim.simt
+        dt = max(now - self.prevtime, 1e-9)
+        speed = (simt - self.prevsimt) / dt
+        self.prevtime, self.prevsimt = now, simt
+        self.node.send_stream(b"SIMINFO", {
+            "speed": speed, "simdt": self.sim.simdt, "simt": simt,
+            "ntraf": self.sim.traf.ntraf, "state": self.sim.state_flag,
+            "scenname": getattr(self.sim.stack, "scenname", "")})
+
+    def send_aircraft_data(self):
+        """ACDATA stream at 5 Hz (screenio.py:194-239)."""
+        traf = self.sim.traf
+        st = traf.state.ac
+        active = np.asarray(st.active)
+        idx = np.flatnonzero(active)
+        data = {"simt": self.sim.simt,
+                "id": [traf.ids[i] for i in idx],
+                "type": [traf.types[i] for i in idx]}
+        for name in ("lat", "lon", "alt", "trk", "tas", "gs", "cas",
+                     "vs", "inconf"):
+            arr = getattr(st, name, None)
+            if arr is not None:
+                data[name] = np.asarray(arr)[idx]
+        self.node.send_stream(b"ACDATA", data)
+
+    def send_route_data(self, acid=""):
+        """ROUTEDATA for the requested aircraft (screenio.py:241-263)."""
+        traf = self.sim.traf
+        i = traf.id2idx(acid)
+        if i < 0:
+            return
+        rte = self.sim.routes.route(i)
+        self.node.send_stream(b"ROUTEDATA", {
+            "acid": acid, "wplat": list(rte.lat), "wplon": list(rte.lon),
+            "wpalt": list(rte.alt), "wpspd": list(rte.spd),
+            "wpname": list(rte.name), "iactwp": rte.iactwp})

@@ -1,0 +1,81 @@
+"""The networked sim worker: Simulation wrapped in a network Node
+(parity: bluesky/simulation/qtgl/simulation.py:204-287 event surface +
+network/node.py loop).
+
+Event surface (same tokens as the reference): STACKCMD, STEP, BATCH, QUIT,
+GETSIMSTATE.  State changes are reported to the server via STATECHANGE so
+the BATCH farm can schedule the next scenario piece on this worker when it
+finishes (server.py:234-247 semantics).
+"""
+from .. import settings
+from ..network import node as netnode
+from ..network import detached
+from .sim import Simulation, INIT, HOLD, OP, END
+from .screenio import ScreenIO
+
+
+def _make_simnode_class(base):
+    class _SimNode(base):
+        def __init__(self, event_port=None, stream_port=None, **simkw):
+            super().__init__(
+                event_port=event_port or settings.wevent_port,
+                stream_port=stream_port or settings.wstream_port)
+            self.sim = Simulation(**simkw)
+            self.sim.scr = ScreenIO(self.sim, self)
+            self.sim.node = self
+            self.prev_state = self.sim.state_flag
+
+        def close(self):
+            self.sim.scr.close()      # deregister stream timers
+            super().close()
+
+        # ------------------------------------------------------------ events
+        def event(self, name, data, sender_route):
+            sim = self.sim
+            if name == b"STACKCMD":
+                cmd = data["cmd"] if isinstance(data, dict) else str(data)
+                sender = sender_route[0].hex() if sender_route else ""
+                sim.stack.stack(cmd, sender)
+            elif name == b"STEP":
+                # lockstep: advance exactly dtmult seconds of sim time
+                # (possibly several quantized chunks), then ack
+                sim.op()
+                t_target = sim.simt + sim.dtmult
+                while sim.state_flag == OP and sim.simt < t_target - 1e-9:
+                    nsteps = max(1, int(round(
+                        (t_target - sim.simt) / sim.simdt)))
+                    sim.step(max_chunk=nsteps)
+                sim.pause()
+                self.send_event(b"STEP", None, list(sender_route) or None)
+            elif name == b"BATCH":
+                sim.reset()
+                sim.stack.set_scendata(data["scentime"], data["scencmd"])
+                sim.op()
+            elif name == b"GETSIMSTATE":
+                self.send_event(b"SIMSTATE", {
+                    "state": sim.state_flag, "simt": sim.simt,
+                    "simdt": sim.simdt, "ntraf": sim.traf.ntraf},
+                    list(sender_route) or None)
+            elif name == b"QUIT":
+                sim.stop()
+                self.quit()
+
+        # -------------------------------------------------------------- step
+        def step(self):
+            import time as _time
+            sim = self.sim
+            sim.scr.update()
+            alive = sim.step()
+            if sim.state_flag != OP:
+                _time.sleep(0.02)   # idle pacing (~50 Hz stack polling)
+            if sim.state_flag != self.prev_state:
+                self.prev_state = sim.state_flag
+                self.send_event(b"STATECHANGE", sim.state_flag)
+            if not alive or sim.state_flag == END:
+                self.quit()
+
+    return _SimNode
+
+
+SimNode = _make_simnode_class(netnode.Node)
+DetachedSimNode = _make_simnode_class(detached.Node)

@@ -60,6 +60,8 @@ class Stack:
         self.cmdstack = []
 
     def _exec_cmdline(self, cmdline: str, sender: str = ""):
+        # let the screen proxy route echo output back to the issuer
+        self.sim.scr.current_sender = sender
         echo = self.sim.scr.echo
         args = cmdsplit(cmdline)
         if not args:
@@ -148,6 +150,12 @@ class Stack:
         else:
             self.scentime, self.scencmd = scentime, scencmd
         return True, None
+
+    def set_scendata(self, scentime, scencmd):
+        """Install a pre-parsed scenario (BATCH farm-out piece,
+        simulation.py:225-230)."""
+        self.scentime = list(scentime)
+        self.scencmd = list(scencmd)
 
     def _find_scn(self, fname: str) -> Optional[str]:
         if not fname.lower().endswith(".scn"):

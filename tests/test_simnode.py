@@ -1,0 +1,86 @@
+"""End-to-end: TPU sim worker on the fabric, driven from a Client
+(reference §4.2/§4.3 style: real processes-in-threads over localhost ZMQ)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+zmq = pytest.importorskip("zmq")
+
+from bluesky_tpu.network.client import Client
+from bluesky_tpu.network.server import Server
+from bluesky_tpu.simulation.simnode import SimNode, DetachedSimNode
+from tests.test_network import free_ports, wait_for
+
+
+@pytest.fixture
+def simfabric():
+    ev, st, wev, wst = free_ports(4)
+    server = Server(headless=True,
+                    ports=dict(event=ev, stream=st, wevent=wev,
+                               wstream=wst),
+                    spawn_workers=False)
+    server.start()
+    time.sleep(0.2)
+    node = SimNode(event_port=wev, stream_port=wst, nmax=32)
+    thread = threading.Thread(target=node.run, daemon=True)
+    thread.start()
+    client = Client()
+    client.connect(event_port=ev, stream_port=st, timeout=5.0)
+    assert wait_for(lambda: (client.receive(10), len(client.nodes) > 0)[1])
+    yield server, node, client
+    node.quit()
+    thread.join(timeout=5)
+    server.stop()
+    server.join(timeout=5)
+    client.close()
+
+
+def test_stackcmd_echo_and_acdata(simfabric):
+    server, node, client = simfabric
+    echoes, acdata = [], []
+    client.event_received.connect(
+        lambda n, d, s: echoes.append(d) if n == b"ECHO" else None)
+    client.stream_received.connect(
+        lambda n, d, s: acdata.append(d) if n == b"ACDATA" else None)
+    client.subscribe(b"ACDATA")
+    time.sleep(0.3)
+
+    client.stack("CRE KL204 B744 52 4 90 FL200 250")
+    client.stack("POS KL204")
+    assert wait_for(lambda: (client.receive(10), len(echoes) >= 1)[1],
+                    timeout=60)
+    assert any("KL204" in e["text"] for e in echoes if e.get("text"))
+
+    client.stack("OP")
+    assert wait_for(
+        lambda: (client.receive(10),
+                 any(f["id"] for f in acdata))[1], timeout=60)
+    frame = next(f for f in reversed(acdata) if f["id"])
+    assert frame["id"] == ["KL204"]
+    assert frame["lat"].shape == (1,)
+    assert abs(frame["lat"][0] - 52.0) < 0.5
+
+
+def test_getsimstate(simfabric):
+    server, node, client = simfabric
+    states = []
+    client.event_received.connect(
+        lambda n, d, s: states.append(d) if n == b"SIMSTATE" else None)
+    client.send_event(b"GETSIMSTATE")
+    assert wait_for(lambda: (client.receive(10), len(states) > 0)[1],
+                    timeout=30)
+    assert states[0]["ntraf"] == 0
+    assert states[0]["simt"] == 0.0
+
+
+def test_detached_simnode_runs():
+    node = DetachedSimNode(nmax=16)
+    node.sim.stack.stack("CRE AB1 B744 52 4 90 FL100 200")
+    node.sim.stack.process()
+    node.sim.op()
+    for _ in range(3):
+        node.step()
+    assert node.sim.traf.ntraf == 1
+    assert node.sim.simt > 0.0
