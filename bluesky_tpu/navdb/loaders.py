@@ -1,0 +1,235 @@
+"""Text-format loaders for the navigation database
+(parity: bluesky/navdatabase/load_navdata_txt.py, loadnavdata.py).
+
+All loaders gate on file presence (this data snapshot has no awy.dat or
+apt.zip, and user setups may lack everything) and return plain dicts of
+numpy arrays / lists.  A pickled cache keyed by source mtimes makes
+subsequent startups instant (parity: tools/cachefile.py).
+
+Formats (x-plane lineage):
+  fix.dat       ``lat lon ident`` per line
+  nav.dat       ``type lat lon elev freq range var ident name...``
+                (type 2 = NDB, 3 = VOR/DME, others ignored like the
+                reference keeps only en-route aids)
+  airports.dat  CSV ``code, name, lat, lon, class, maxrunway_ft, country,
+                elev_ft`` with a # header
+  awy.dat       ``fromwp fromlat fromlon towp tolat tolon ndir lowfl upfl
+                awid[-awid2...]``
+  fir/*.txt     ``Ndd.mm.ss.sss Eddd.mm.ss.sss`` polygon vertper line
+"""
+import os
+import pickle
+
+import numpy as np
+
+CACHE_VERSION = 1
+
+
+def _dms2deg(token: str) -> float:
+    """'N052.16.00.000' -> 52.2667; S/W negative."""
+    sign = -1.0 if token[0] in "SW" else 1.0
+    d, m, s, ms = (token[1:].split(".") + ["0"] * 4)[:4]
+    return sign * (float(d) + float(m) / 60.0 +
+                   float(f"{s}.{ms}") / 3600.0)
+
+
+def load_fix(path):
+    wpid, wplat, wplon = [], [], []
+    with open(path, errors="replace") as f:
+        for line in f:
+            fields = line.split()
+            if len(fields) < 3:
+                continue
+            try:
+                lat, lon = float(fields[0]), float(fields[1])
+            except ValueError:
+                continue
+            wpid.append(fields[2].upper())
+            wplat.append(lat)
+            wplon.append(lon)
+    return dict(wpid=wpid, wplat=np.array(wplat), wplon=np.array(wplon),
+                wptype=["FIX"] * len(wpid))
+
+
+def load_nav(path):
+    """NDB (2) and VOR/DME (3) en-route navaids."""
+    wpid, wplat, wplon, wptype, wpfreq = [], [], [], [], []
+    with open(path, errors="replace") as f:
+        for line in f:
+            fields = line.split()
+            if len(fields) < 9:
+                continue
+            if fields[0] not in ("2", "3"):
+                continue
+            try:
+                lat, lon = float(fields[1]), float(fields[2])
+                freq = float(fields[4])
+            except ValueError:
+                continue
+            wpid.append(fields[7].upper())
+            wplat.append(lat)
+            wplon.append(lon)
+            wptype.append("NDB" if fields[0] == "2" else "VOR")
+            wpfreq.append(freq)
+    return dict(wpid=wpid, wplat=np.array(wplat), wplon=np.array(wplon),
+                wptype=wptype, wpfreq=wpfreq)
+
+
+def load_airports(path):
+    aptid, aptname, aptlat, aptlon = [], [], [], []
+    aptmaxrwy, aptco, aptelev = [], [], []
+    with open(path, errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            fields = [c.strip() for c in line.split(",")]
+            if len(fields) < 7:
+                continue
+            try:
+                lat, lon = float(fields[2]), float(fields[3])
+            except ValueError:
+                continue
+            aptid.append(fields[0].upper())
+            aptname.append(fields[1])
+            aptlat.append(lat)
+            aptlon.append(lon)
+            try:
+                aptmaxrwy.append(float(fields[5]) * 0.3048)   # ft -> m
+            except ValueError:
+                aptmaxrwy.append(0.0)
+            aptco.append(fields[6])
+            try:
+                aptelev.append(float(fields[7]) * 0.3048)
+            except (IndexError, ValueError):
+                aptelev.append(0.0)
+    return dict(aptid=aptid, aptname=aptname, aptlat=np.array(aptlat),
+                aptlon=np.array(aptlon), aptmaxrwy=np.array(aptmaxrwy),
+                aptco=aptco, aptelev=np.array(aptelev))
+
+
+def load_airways(path):
+    awid, awfrom, awto = [], [], []
+    awfromlat, awfromlon, awtolat, awtolon = [], [], [], []
+    awndir, awlowfl, awupfl = [], [], []
+    with open(path, errors="replace") as f:
+        for line in f:
+            fields = line.split()
+            if len(fields) < 10:
+                continue
+            try:
+                flat, flon = float(fields[1]), float(fields[2])
+                tlat, tlon = float(fields[4]), float(fields[5])
+                ndir, lofl, upfl = (int(fields[6]), int(fields[7]),
+                                    int(fields[8]))
+            except ValueError:
+                continue
+            # the id field may chain several airways: 'UL602-UL607'
+            for aid in fields[9].split("-"):
+                awid.append(aid.strip().upper())
+                awfrom.append(fields[0].upper())
+                awto.append(fields[3].upper())
+                awfromlat.append(flat)
+                awfromlon.append(flon)
+                awtolat.append(tlat)
+                awtolon.append(tlon)
+                awndir.append(ndir)
+                awlowfl.append(lofl)
+                awupfl.append(upfl)
+    return dict(awid=awid, awfromwpid=awfrom, awtowpid=awto,
+                awfromlat=np.array(awfromlat), awfromlon=np.array(awfromlon),
+                awtolat=np.array(awtolat), awtolon=np.array(awtolon),
+                awndir=awndir, awlowfl=awlowfl, awupfl=awupfl)
+
+
+def load_firs(dirpath):
+    firs = {}
+    for fname in sorted(os.listdir(dirpath)):
+        if not fname.endswith(".txt"):
+            continue
+        lat, lon = [], []
+        with open(os.path.join(dirpath, fname), errors="replace") as f:
+            for line in f:
+                fields = line.split()
+                if len(fields) < 2:
+                    continue
+                try:
+                    lat.append(_dms2deg(fields[0]))
+                    lon.append(_dms2deg(fields[1]))
+                except (ValueError, IndexError):
+                    continue
+        if lat:
+            firs[fname[:-4].upper()] = np.column_stack([lat, lon])
+    return firs
+
+
+def load_countries(path):
+    """CSV ``name,code,...`` -> {code: name}."""
+    codes = {}
+    with open(path, errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            fields = [c.strip() for c in line.split(",")]
+            if len(fields) >= 2 and 0 < len(fields[1]) <= 2:
+                codes[fields[1].upper()] = fields[0]
+    return codes
+
+
+def load_navdata(navdata_path, cache_path=None):
+    """Load everything available under navdata_path, with pickle caching."""
+    sources = {name: os.path.join(navdata_path, name)
+               for name in ("fix.dat", "nav.dat", "airports.dat", "awy.dat",
+                            "icao-countries.dat")}
+    sources["fir"] = os.path.join(navdata_path, "fir")
+    stamps = {k: os.path.getmtime(p) for k, p in sources.items()
+              if os.path.exists(p)}
+
+    cachefile = None
+    if cache_path:
+        os.makedirs(cache_path, exist_ok=True)
+        cachefile = os.path.join(cache_path, "navdata.p")
+        if os.path.isfile(cachefile):
+            try:
+                with open(cachefile, "rb") as f:
+                    cached = pickle.load(f)
+                if cached.get("version") == CACHE_VERSION \
+                        and cached.get("stamps") == stamps:
+                    return cached["data"]
+            except Exception:
+                pass
+
+    data = dict(wpid=[], wplat=np.zeros(0), wplon=np.zeros(0), wptype=[],
+                aptid=[], aptname=[], aptlat=np.zeros(0),
+                aptlon=np.zeros(0), aptmaxrwy=np.zeros(0), aptco=[],
+                aptelev=np.zeros(0), awid=[], awfromwpid=[], awtowpid=[],
+                awfromlat=np.zeros(0), awfromlon=np.zeros(0),
+                awtolat=np.zeros(0), awtolon=np.zeros(0), awndir=[],
+                awlowfl=[], awupfl=[], firs={}, countries={})
+    if "fix.dat" in stamps:
+        fix = load_fix(sources["fix.dat"])
+        nav = load_nav(sources["nav.dat"]) if "nav.dat" in stamps \
+            else dict(wpid=[], wplat=np.zeros(0), wplon=np.zeros(0),
+                      wptype=[])
+        data["wpid"] = fix["wpid"] + nav["wpid"]
+        data["wplat"] = np.concatenate([fix["wplat"], nav["wplat"]])
+        data["wplon"] = np.concatenate([fix["wplon"], nav["wplon"]])
+        data["wptype"] = fix["wptype"] + nav["wptype"]
+    if "airports.dat" in stamps:
+        data.update(load_airports(sources["airports.dat"]))
+    if "awy.dat" in stamps:
+        data.update(load_airways(sources["awy.dat"]))
+    if "fir" in stamps:
+        data["firs"] = load_firs(sources["fir"])
+    if "icao-countries.dat" in stamps:
+        data["countries"] = load_countries(sources["icao-countries.dat"])
+
+    if cachefile:
+        try:
+            with open(cachefile, "wb") as f:
+                pickle.dump({"version": CACHE_VERSION, "stamps": stamps,
+                             "data": data}, f, protocol=4)
+        except Exception:
+            pass
+    return data

@@ -87,6 +87,13 @@ class Simulation:
                 datalog.definePeriodicLogger(name, f"{name} logfile.", dt)
         datalog.register_stack_commands(self)
 
+    @property
+    def navdb(self):
+        """Lazy shared navigation database (loads on first named-position
+        lookup; pickle-cached after the first process)."""
+        from ..navdb import get_navdb
+        return get_navdb()
+
     # ----------------------------------------------------------- time/state
     @property
     def simt(self) -> float:

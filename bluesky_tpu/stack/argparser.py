@@ -152,6 +152,7 @@ class Argparser:
                 tokens.append((t, was_optional))
 
         out: List[Any] = []
+        self._last_acid = -1       # reference position for named waypoints
         ai = 0
         si = 0
         while si < len(tokens) or (repeating and ai < len(args)):
@@ -187,10 +188,19 @@ class Argparser:
             if ai + 1 >= len(args):
                 raise ArgError("latlon: missing longitude")
             return (txt2lat(t), txt2lon(args[ai + 1])), 2
-        # Named position: navdb lookup if attached
+        # Named position: navdb lookup if attached.  When an aircraft was
+        # parsed earlier in this command its position disambiguates
+        # duplicate waypoint names (reference position.py/getwpidx
+        # semantics).
         navdb = getattr(self.sim, "navdb", None)
         if navdb is not None:
-            pos = navdb.txt2pos(t)
+            reflat = reflon = 999999.0
+            idx = self._last_acid
+            if idx >= 0:
+                ac = self.sim.traf.state.ac
+                reflat = float(ac.lat[idx])    # single-element transfer
+                reflon = float(ac.lon[idx])
+            pos = navdb.txt2pos(t, reflat, reflon)
             if pos is not None:
                 return (pos[0], pos[1]), 1
         raise ArgError(f"{t}: position not found")
@@ -204,6 +214,7 @@ class Argparser:
                 idx = self.sim.traf.id2idx(t)
                 if idx < 0:
                     raise ArgError(f"{t}: aircraft not found")
+                self._last_acid = idx
                 return idx
             if argtype == "wpinroute":
                 return t.upper()

@@ -136,6 +136,26 @@ def register_all(stack):
                f"VS: {float(s.ac.vs[i]) / aero.fpm:.0f} fpm")
         return True, txt
 
+    def defwpt(name, pos, wptype=None):
+        """DEFWPT wpname,lat,lon[,type] (navdatabase.py defwpt)."""
+        sim.navdb.defwpt(name, pos[0], pos[1], wptype or "DEF")
+        return True, f"Waypoint {name.upper()} defined at " \
+                     f"{pos[0]:.4f}, {pos[1]:.4f}"
+
+    def navdbinfo(txt):
+        """WPTINFO name: resolve a named position via the navdb."""
+        ndb = sim.navdb
+        i = ndb.getaptidx(txt)
+        if i >= 0:
+            return True, (f"{txt.upper()}: airport {ndb.aptname[i]} at "
+                          f"{ndb.aptlat[i]:.4f}, {ndb.aptlon[i]:.4f}, "
+                          f"elev {ndb.aptelev[i]:.0f} m")
+        i = ndb.getwpidx(txt)
+        if i >= 0:
+            return True, (f"{txt.upper()}: {ndb.wptype[i]} at "
+                          f"{ndb.wplat[i]:.4f}, {ndb.wplon[i]:.4f}")
+        return False, f"{txt}: not found in navdb"
+
     def dist(pos1, pos2):
         from ..core.route import _host_qdrdist_nm
         d = _host_qdrdist_nm(pos1[0], pos1[1], pos2[0], pos2[1])
@@ -536,6 +556,10 @@ def register_all(stack):
         "DELALL": ["DELALL", "", delall, "Delete all aircraft"],
         "DELAY": ["DELAY dt,COMMAND+ARGS", "time,string,...", delay,
                   "Schedule a command in dt seconds"],
+        "DEFWPT": ["DEFWPT wpname,lat,lon,[type]", "txt,latlon,[txt]",
+                   defwpt, "Define a user waypoint"],
+        "WPTINFO": ["WPTINFO wpname", "txt", navdbinfo,
+                    "Look up a waypoint/airport in the navdb"],
         "DELWPT": ["DELWPT acid,wpname", "acid,wpinroute", delwpt,
                    "Delete a waypoint from the route"],
         "DEST": ["DEST acid,latlon", "acid,[latlon]",

@@ -1,0 +1,113 @@
+"""Navdatabase loader + query tests.
+
+Synthetic-data tests always run; full-data tests are gated on the
+reference navdata snapshot being present (read-only data mount)."""
+import os
+
+import numpy as np
+import pytest
+
+from bluesky_tpu.navdb.loaders import (_dms2deg, load_airways, load_fix,
+                                       load_navdata)
+from bluesky_tpu.navdb.navdatabase import Navdatabase
+
+REF_NAVDATA = "/root/reference/data/navdata"
+has_refdata = os.path.isdir(REF_NAVDATA)
+
+
+@pytest.fixture
+def synth_navdb(tmp_path):
+    (tmp_path / "fix.dat").write_text(
+        " 52.000000  004.000000 SPY\n"
+        " 12.000000  100.000000 SPY\n"
+        " 51.500000  003.500000 RIVER\n"
+        "I\nbad line\n")
+    (tmp_path / "nav.dat").write_text(
+        "2  52.10000000  004.10000000      0   313  50    0.0 SPL "
+        "Schiphol NDB\n"
+        "3  51.90000000  004.30000000      0   11330 100  0.0 PAM "
+        "Pampus VOR\n")
+    (tmp_path / "airports.dat").write_text(
+        "# code,name,lat,lon,class,maxrunway,cc,elev\n"
+        "EHAM, Schiphol, 52.309, 4.764, Large, 12467, NL,-11\n"
+        "EHRD, Rotterdam, 51.957, 4.437, Medium, 7218, NL,-14\n")
+    (tmp_path / "awy.dat").write_text(
+        "SPY 52.0 4.0 RIVER 51.5 3.5 2 45 460 UL602\n"
+        "RIVER 51.5 3.5 PAM 51.9 4.3 2 45 460 UL602-UL607\n")
+    return Navdatabase(navdata_path=str(tmp_path), cache_path="")
+
+
+def test_dms2deg():
+    assert _dms2deg("N052.30.00.000") == pytest.approx(52.5)
+    assert _dms2deg("W006.15.00.000") == pytest.approx(-6.25)
+
+
+def test_synth_queries(synth_navdb):
+    ndb = synth_navdb
+    # airports
+    assert ndb.getaptidx("eham") == 0
+    assert ndb.getaptidx("XXXX") == -1
+    assert ndb.aptmaxrwy[0] == pytest.approx(12467 * 0.3048)
+    # duplicate waypoint: nearest to reference position wins
+    i = ndb.getwpidx("SPY", 51.0, 4.0)
+    assert ndb.wplat[i] == pytest.approx(52.0)
+    i = ndb.getwpidx("SPY", 10.0, 99.0)
+    assert ndb.wplat[i] == pytest.approx(12.0)
+    # navaids merged in
+    assert ndb.getwpidx("PAM") >= 0
+    # nearest queries
+    assert ndb.getapinear(52.3, 4.7) == 0
+    assert ndb.getwpinear(51.5, 3.5) == ndb.getwpidx("RIVER")
+    # box query
+    inside = ndb.getinside(ndb.wplat, ndb.wplon, 51.0, 53.0, 3.0, 5.0)
+    assert ndb.getwpidx("RIVER") in inside
+    # txt2pos: airport first, then waypoint
+    assert ndb.txt2pos("EHRD") == pytest.approx((51.957, 4.437))
+    assert ndb.txt2pos("RIVER") == pytest.approx((51.5, 3.5))
+    assert ndb.txt2pos("NOPE") is None
+
+
+def test_airways(synth_navdb):
+    ndb = synth_navdb
+    chains = ndb.listairway("UL602")
+    assert len(chains) == 1
+    assert set(chains[0]) == {"SPY", "RIVER", "PAM"}
+    assert ndb.listairway("UL607") == [["RIVER", "PAM"]] \
+        or ndb.listairway("UL607") == [["PAM", "RIVER"]]
+    conns = ndb.listconnections("RIVER")
+    assert ("UL602", "SPY") in conns and ("UL602", "PAM") in conns
+
+
+def test_defwpt(synth_navdb):
+    ndb = synth_navdb
+    ndb.defwpt("MYWP", 50.0, 5.0)
+    assert ndb.txt2pos("mywp") == pytest.approx((50.0, 5.0))
+    # redefinition moves the user waypoint instead of shadowing it
+    ndb.defwpt("MYWP", 10.0, 10.0)
+    assert ndb.txt2pos("MYWP") == pytest.approx((10.0, 10.0))
+    assert ndb.wpid.count("MYWP") == 1
+
+
+def test_cache_roundtrip(tmp_path):
+    (tmp_path / "data").mkdir()
+    (tmp_path / "fix.dat").write_text(" 52.0  4.0 AAA\n")
+    d1 = load_navdata(str(tmp_path), str(tmp_path / "cache"))
+    d2 = load_navdata(str(tmp_path), str(tmp_path / "cache"))
+    assert d1["wpid"] == d2["wpid"] == ["AAA"]
+    assert os.path.isfile(tmp_path / "cache" / "navdata.p")
+
+
+@pytest.mark.skipif(not has_refdata, reason="reference navdata not present")
+def test_full_dataset():
+    ndb = Navdatabase(navdata_path=REF_NAVDATA, cache_path="")
+    assert len(ndb.wpid) > 50000          # ~100k fixes + navaids
+    assert len(ndb.aptid) > 5000
+    i = ndb.getaptidx("EHAM")
+    assert i >= 0
+    assert ndb.aptlat[i] == pytest.approx(52.3, abs=0.2)
+    # a known fix, disambiguated by position
+    j = ndb.getwpidx("SPY", 52.5, 4.8)
+    assert j >= 0
+    assert abs(ndb.wplat[j] - 52.5) < 1.5
+    assert len(ndb.firs) > 10
+    assert ndb.countries.get("NL") == "Netherlands"
