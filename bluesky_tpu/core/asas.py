@@ -20,7 +20,7 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
-from ..ops import aero, cd as cdops, cr_mvp
+from ..ops import aero, cd as cdops, cd_tiled, cr_mvp
 from .state import SimState
 
 
@@ -115,3 +115,59 @@ def detect_only(state: SimState, cfg: AsasConfig):
         nconf_cur=jnp.sum(cd.swconfl, dtype=jnp.int32),
         nlos_cur=jnp.sum(cd.swlos, dtype=jnp.int32))
     return state.replace(asas=asas), cd
+
+
+def update_tiled(state: SimState, cfg: AsasConfig,
+                 block: int = 512) -> SimState:
+    """One ASAS interval via the blockwise large-N backend (ops/cd_tiled.py).
+
+    Same pipeline as ``update`` — detect, resolve, bookkeep, resume
+    (reference asas.py:473-504) — but no [N,N] array ever exists: the pair
+    space is streamed in tiles and resume-nav hysteresis lives in the [N,K]
+    partner table instead of the resopairs matrix.
+    """
+    ac, asas = state.ac, state.asas
+    k = asas.partners.shape[1]
+    mvpcfg = cr_mvp.MVPConfig(
+        rpz_m=cfg.rpz_m, hpz_m=cfg.hpz_m, tlookahead=cfg.dtlookahead,
+        swresohoriz=cfg.swresohoriz, swresospd=cfg.swresospd,
+        swresohdg=cfg.swresohdg, swresovert=cfg.swresovert)
+
+    rd = cd_tiled.detect_resolve_tiled(
+        ac.lat, ac.lon, ac.trk, ac.gs, ac.alt, ac.vs,
+        ac.gseast, ac.gsnorth, ac.active, asas.noreso,
+        cfg.rpz, cfg.hpz, cfg.dtlookahead, mvpcfg, block=block,
+        k_partners=k)
+
+    if cfg.reso_on:
+        newtrk, newgs, newvs, newalt, asase, asasn = cr_mvp.resolve_from_sums(
+            rd.sum_dve, rd.sum_dvn, rd.sum_dvv, rd.tsolv,
+            ac.alt, ac.gseast, ac.gsnorth, ac.vs, ac.trk, ac.gs,
+            ac.selalt, state.ap.vs, asas.alt,
+            cfg.vmin, cfg.vmax, cfg.vsmin, cfg.vsmax, mvpcfg,
+            resooff=asas.resooff)
+        upd = rd.inconf
+        asas = asas.replace(
+            trk=jnp.where(upd, newtrk, asas.trk),
+            tas=jnp.where(upd, newgs, asas.tas),
+            vs=jnp.where(upd, newvs, asas.vs),
+            alt=jnp.where(upd, newalt, asas.alt),
+            asase=jnp.where(upd, asase, asas.asase),
+            asasn=jnp.where(upd, asasn, asas.asasn))
+
+    # Resume-nav on the partner table: prune previous partners past CPA
+    # (asas.py:409-471), then merge in this interval's fresh conflicts.
+    keep = cd_tiled.partner_keep(
+        asas.partners, ac.lat, ac.lon, ac.gseast, ac.gsnorth, ac.trk,
+        ac.active, cfg.rpz, cfg.rpz * cfg.resofach)
+    new_idx = cd_tiled.topk_partners(rd, k)
+    partners = cd_tiled.merge_partners(new_idx, asas.partners, keep)
+
+    asas = asas.replace(
+        partners=partners,
+        active=jnp.any(partners >= 0, axis=1) & cfg.reso_on,
+        inconf=rd.inconf,
+        tcpamax=rd.tcpamax,
+        nconf_cur=rd.nconf,
+        nlos_cur=rd.nlos)
+    return state.replace(asas=asas), rd

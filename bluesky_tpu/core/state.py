@@ -119,6 +119,10 @@ class AsasArrays:
     ``resopairs`` is the [N,N] pair matrix replacing the reference's Python
     set of callsign tuples (asas.py:417); ``active`` is the per-aircraft
     "follow ASAS, not AP" flag consumed by the pilot arbitration.
+
+    For the tiled large-N backend (ops/cd_tiled.py) ``resopairs`` is
+    allocated [0,0] (an [N,N] bool is 10 GB at N=100k) and the resume-nav
+    pair memory lives in ``partners``: [N,K] intruder indices, -1 = empty.
     """
     trk: jnp.ndarray        # [deg] resolution track command
     tas: jnp.ndarray        # [m/s] resolution speed command
@@ -128,6 +132,7 @@ class AsasArrays:
     inconf: jnp.ndarray     # [N] bool — in conflict right now
     tcpamax: jnp.ndarray    # [N] max tcpa over own conflicts
     resopairs: jnp.ndarray  # [N,N] bool — pairs still being resolved
+    partners: jnp.ndarray   # [N,K] int32 — tiled-backend partner table
     asasn: jnp.ndarray      # [N] resolution-vector north (display/logs)
     asase: jnp.ndarray      # [N] resolution-vector east
     noreso: jnp.ndarray     # [N] bool — nobody avoids these aircraft
@@ -234,7 +239,8 @@ def _zeros(nmax, dtype):
 
 
 def make_state(nmax: int = 64, wmax: int = 32,
-               dtype=jnp.float32, rng_seed: int = 0) -> SimState:
+               dtype=jnp.float32, rng_seed: int = 0,
+               pair_matrix: bool = True, k_partners: int = 8) -> SimState:
     """Allocate an empty padded simulation state.
 
     Defaults mirror the reference's creation defaults where a slot is
@@ -277,7 +283,9 @@ def make_state(nmax: int = 64, wmax: int = 32,
     asas = AsasArrays(
         trk=f(), tas=f(), vs=f(), alt=f(),
         active=b(), inconf=b(), tcpamax=f(),
-        resopairs=jnp.zeros((nmax, nmax), dtype=bool),
+        resopairs=jnp.zeros((nmax, nmax) if pair_matrix else (0, 0),
+                            dtype=bool),
+        partners=jnp.full((nmax, k_partners), -1, jnp.int32),
         asasn=f(), asase=f(), noreso=b(), resooff=b(),
         nconf_cur=jnp.zeros((), jnp.int32), nlos_cur=jnp.zeros((), jnp.int32),
     )

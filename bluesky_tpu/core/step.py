@@ -37,6 +37,11 @@ class SimConfig(NamedTuple):
     asas: AsasConfig = AsasConfig()
     noise: NoiseConfig = NoiseConfig()
     use_wind: bool = False
+    # CD&R backend: 'dense' materialises [N,N] (exact reference parity,
+    # fine to ~16k AC); 'tiled' streams [cd_block]² tiles with a [N,K]
+    # partner table — required for the 100k north star (ops/cd_tiled.py).
+    cd_backend: str = "dense"
+    cd_block: int = 512
 
 
 def step(state: SimState, cfg: SimConfig) -> SimState:
@@ -65,10 +70,20 @@ def step(state: SimState, cfg: SimConfig) -> SimState:
 
     # ---------- ASAS CD&R (traffic.py:396), gated at dtasas ----------
     if cfg.asas.swasas:
+        if cfg.cd_backend != "tiled" and state.asas.resopairs.size == 0:
+            raise ValueError(
+                "State was allocated with pair_matrix=False (no [N,N] "
+                "resopairs) but SimConfig.cd_backend is "
+                f"'{cfg.cd_backend}'. Use SimConfig(cd_backend='tiled') or "
+                "allocate Traffic(pair_matrix=True).")
         asas_due = simt >= state.asas_tnext
 
         def run_asas(s):
-            s2, _cd = asasmod.update(s, cfg.asas)
+            if cfg.cd_backend == "tiled":
+                s2, _cd = asasmod.update_tiled(s, cfg.asas,
+                                               block=cfg.cd_block)
+            else:
+                s2, _cd = asasmod.update(s, cfg.asas)
             return s2.replace(
                 asas_tnext=s.asas_tnext
                 + jnp.asarray(cfg.asas.dtasas, s.asas_tnext.dtype))

@@ -33,11 +33,15 @@ class Traffic:
 
     def __init__(self, nmax: int = 64, wmax: int = 32, dtype=jnp.float32,
                  openap_path: Optional[str] = None, rng_seed: int = 0,
-                 area=(-1.0, 1.0, -1.0, 1.0)):
+                 area=(-1.0, 1.0, -1.0, 1.0),
+                 pair_matrix: bool = True, k_partners: int = 8):
         self.nmax = nmax
         self.wmax = wmax
         self.dtype = dtype
-        self.state: SimState = make_state(nmax, wmax, dtype, rng_seed)
+        self.pair_matrix = pair_matrix
+        self.k_partners = k_partners
+        self.state: SimState = make_state(nmax, wmax, dtype, rng_seed,
+                                          pair_matrix, k_partners)
         self.coeffdb = perf_coeffs.CoeffDB(openap_path)
         self.area = area  # default creation area (lat0, lat1, lon0, lon1)
         self._rng = np.random.default_rng(rng_seed)
@@ -228,14 +232,21 @@ class Traffic:
         ac = st.ac.replace(active=st.ac.active.at[jidx].set(False))
         # Clear any conflict-pair state involving the slot
         rp = st.asas.resopairs.at[jidx, :].set(False).at[:, jidx].set(False)
-        asas = st.asas.replace(resopairs=rp,
+        # Clear the deleted aircraft's own partner rows AND every reference
+        # to its slots in other rows — a freed slot can be reused by create()
+        # before the next ASAS interval would have purged the stale entry.
+        partners = st.asas.partners.at[jidx, :].set(-1)
+        stale = jnp.isin(partners, jnp.asarray(jidx, jnp.int32))
+        partners = jnp.where(stale, -1, partners)
+        asas = st.asas.replace(resopairs=rp, partners=partners,
                                active=st.asas.active.at[jidx].set(False))
         self.state = st.replace(ac=ac, asas=asas)
         return True
 
     def reset(self):
         seed = int(self._rng.integers(0, 2**31 - 1))
-        self.state = make_state(self.nmax, self.wmax, self.dtype, seed)
+        self.state = make_state(self.nmax, self.wmax, self.dtype, seed,
+                                self.pair_matrix, self.k_partners)
         self.ids = [None] * self.nmax
         self.types = [None] * self.nmax
         self._id2slot = {}

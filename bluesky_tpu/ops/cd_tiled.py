@@ -1,0 +1,276 @@
+"""Blockwise conflict detection + MVP accumulation for large N.
+
+The dense kernel (``ops/cd.py``) materialises [N, N] matrices — fine to
+~16k aircraft, impossible at the 100k north star (10^10 f32 entries).  This
+module computes exactly the same per-ownship *reductions* without ever
+holding an N x N array: the pair space is tiled into [Br, Bc] blocks that are
+streamed through on-chip memory, flash-attention-style (SURVEY.md §5.7 calls
+for precisely this blockwise decomposition of the CPA geometry).
+
+Per ownship row the step needs only (see core/asas.py):
+  * ``inconf``      — any conflict flag            (OR-reduction)
+  * ``tcpamax``     — max of tcpa over conflicts   (MAX-reduction)
+  * MVP sums        — sum of per-pair displacement (SUM-reduction; the tail
+                      of the resolver, ``cr_mvp.resolve_from_sums``, is
+                      per-aircraft and shared with the dense path)
+  * ``tsolv``       — min vertical solve time      (MIN-reduction)
+  * conflict/LoS counts                            (scalar SUMs)
+  * partner candidates for resume-nav hysteresis (below).
+
+Resume-nav (reference asas.py:409-471) keeps a *pair set* alive until past
+CPA.  The dense path stores it as an [N, N] bool; here it becomes a fixed-K
+**partner table** ``[N, K]`` of intruder indices: a running top-K (by
+earliest conflict-entry time) is carried through the column-block scan, so
+each CD interval yields the K genuinely most urgent conflicts per ownship;
+these are merged with the surviving previous partners, and the resume
+predicates are evaluated on gathered partner state (an [N, K] problem,
+linear in N).  K defaults to 8: an ownship tracks at most K simultaneous
+hysteresis partners — conflicts re-detect every interval, so this bounds only
+how many *past* conflicts can hold ASAS engaged at once, which the margin
+analysis of the reference's own ResumeNav already caps in practice.
+
+Semantics match the reference StateBasedCD + MVP summation
+(StateBasedCD.py:7-103, MVP.py:14-143) pair-for-pair; only the reduction
+*order* differs (blockwise f32 reassociation), so golden tests compare to the
+dense path at tolerance (tests/test_cd_tiled.py).
+"""
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from . import cr_mvp, geo
+
+
+class RowConflictData(NamedTuple):
+    """Per-ownship reductions of the pair space — no [N,N] anywhere."""
+    inconf: jnp.ndarray     # [N] bool
+    tcpamax: jnp.ndarray    # [N]
+    sum_dve: jnp.ndarray    # [N]  sum over conflict pairs of MVP east term
+    sum_dvn: jnp.ndarray    # [N]
+    sum_dvv: jnp.ndarray    # [N]
+    tsolv: jnp.ndarray      # [N]  min vertical solve time (1e9 = none)
+    nconf: jnp.ndarray      # scalar int32 — directional conflict pairs
+    nlos: jnp.ndarray       # scalar int32 — LoS pairs
+    topk_idx: jnp.ndarray   # [N, K] int32 — K most urgent intruders,
+    topk_tin: jnp.ndarray   # [N, K]         urgency order (1e9 = empty)
+
+
+def _pad1(a, npad, value):
+    return a if npad == 0 else jnp.concatenate(
+        [a, jnp.full((npad,), value, a.dtype)])
+
+
+def detect_resolve_tiled(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
+                         active, noreso, rpz, hpz, tlookahead, mvpcfg,
+                         block=512, k_partners=8):
+    """One fused pass over all aircraft pairs in [block, block] tiles.
+
+    Args mirror ``ops.cd.detect`` plus the MVP inputs; ``mvpcfg`` is a
+    ``cr_mvp.MVPConfig``.  Returns a ``RowConflictData``.
+    """
+    n = lat.shape[0]
+    block = min(block, max(n, 1))
+    kk = min(k_partners, block)   # per-tile candidates merged into the top-K
+    nb = -(-n // block)
+    npad = nb * block - n
+    dtype = lat.dtype
+
+    packed = {
+        "lat": _pad1(lat, npad, 0.0), "lon": _pad1(lon, npad, 0.0),
+        "trk": _pad1(trk, npad, 0.0), "gs": _pad1(gs, npad, 0.0),
+        "alt": _pad1(alt, npad, 0.0), "vs": _pad1(vs, npad, 0.0),
+        "gse": _pad1(gseast, npad, 0.0), "gsn": _pad1(gsnorth, npad, 0.0),
+    }
+    packed = {k: v.reshape(nb, block) for k, v in packed.items()}
+    act_b = _pad1(active, npad, False).reshape(nb, block)
+    nor_b = _pad1(noreso, npad, False).reshape(nb, block)
+    # East/north velocity components for the CPA math (StateBasedCD.py:31-40
+    # uses trk/gs; gseast/gsnorth are the same numbers assembled in traffic).
+    trkrad = jnp.radians(packed["trk"])
+    packed["u"] = packed["gs"] * jnp.sin(trkrad)
+    packed["v"] = packed["gs"] * jnp.cos(trkrad)
+
+    r2 = rpz * rpz
+    bigval = jnp.asarray(1e9, dtype)
+    col_ids = jnp.arange(nb * block, dtype=jnp.int32).reshape(nb, block)
+
+    def tile(ri, ci, rows_active, carry):
+        """Compute one [block, block] tile and fold it into the row carry."""
+        (inconf, tcpamax, sdve, sdvn, sdvv, tsolv, nconf, nlos,
+         topk_tin, topk_idx) = carry
+        r = {k: v[ri] for k, v in packed.items()}
+        c = {k: v[ci] for k, v in packed.items()}
+        cols_active = act_b[ci]
+        cols_noreso = nor_b[ci]
+
+        # Pair mask: both active, not the same aircraft (generalised
+        # diagonal exclusion, StateBasedCD.py:11,22).
+        same = (ri * block + jnp.arange(block, dtype=jnp.int32))[:, None] \
+            == col_ids[ci][None, :]
+        pairmask = (rows_active[:, None] & cols_active[None, :]) & ~same
+        excl = jnp.where(pairmask, 0.0, bigval)
+
+        # Horizontal geometry — identical ops to cd.detect
+        qdr, distnm = geo.qdrdist_matrix(r["lat"], r["lon"],
+                                         c["lat"], c["lon"])
+        dist = distnm * geo.nm + excl
+        qdrrad = jnp.radians(qdr)
+        dx = dist * jnp.sin(qdrrad)
+        dy = dist * jnp.cos(qdrrad)
+
+        du = c["u"][None, :] - r["u"][:, None]
+        dv = c["v"][None, :] - r["v"][:, None]
+        dv2 = du * du + dv * dv
+        dv2 = jnp.where(jnp.abs(dv2) < 1e-6, 1e-6, dv2)
+        vrel = jnp.sqrt(dv2)
+
+        tcpa = -(du * dx + dv * dy) / dv2 + excl
+        dcpa2 = dist * dist - tcpa * tcpa * dv2
+        swhorconf = dcpa2 < r2
+
+        dtinhor = jnp.sqrt(jnp.maximum(0.0, r2 - dcpa2)) / vrel
+        tinhor = jnp.where(swhorconf, tcpa - dtinhor, 1e8)
+        touthor = jnp.where(swhorconf, tcpa + dtinhor, -1e8)
+
+        # Vertical geometry
+        dalt = c["alt"][None, :] - r["alt"][:, None] + excl
+        dvs = c["vs"][None, :] - r["vs"][:, None]
+        dvs = jnp.where(jnp.abs(dvs) < 1e-6, 1e-6, dvs)
+        tcrosshi = (dalt + hpz) / -dvs
+        tcrosslo = (dalt - hpz) / -dvs
+        tinver = jnp.minimum(tcrosshi, tcrosslo)
+        toutver = jnp.maximum(tcrosshi, tcrosslo)
+
+        tinconf = jnp.maximum(tinver, tinhor)
+        toutconf = jnp.minimum(toutver, touthor)
+        swconfl = (swhorconf & (tinconf <= toutconf) & (toutconf > 0.0)
+                   & (tinconf < tlookahead) & pairmask)
+        swlos = (dist < rpz) & (jnp.abs(dalt) < hpz) & pairmask
+
+        # MVP pair contributions on the tile (shared core, MVP.py:149-231)
+        dve_p, dvn_p, dvv_p, tsolv_p = cr_mvp.pair_contrib_core(
+            qdr, dist, tcpa, tinconf,
+            c["alt"][None, :] - r["alt"][:, None],
+            c["gse"][None, :] - r["gse"][:, None],
+            c["gsn"][None, :] - r["gsn"][:, None],
+            c["vs"][None, :] - r["vs"][:, None],
+            mvpcfg)
+        mvpmask = swconfl & ~cols_noreso[None, :]
+        maskf = mvpmask.astype(dtype)
+
+        # Fold tile reductions into the row carry
+        inconf = inconf | jnp.any(swconfl, axis=1)
+        tcpamax = jnp.maximum(tcpamax, jnp.max(tcpa * swconfl, axis=1))
+        sdve = sdve + jnp.sum(dve_p * maskf, axis=1)
+        sdvn = sdvn + jnp.sum(dvn_p * maskf, axis=1)
+        sdvv = sdvv + jnp.sum(dvv_p * maskf, axis=1)
+        tsolv = jnp.minimum(
+            tsolv, jnp.min(jnp.where(mvpmask, tsolv_p, 1e9), axis=1))
+        nconf = nconf + jnp.sum(swconfl, dtype=jnp.int32)
+        nlos = nlos + jnp.sum(swlos, dtype=jnp.int32)
+
+        # Partner candidates: the kk most urgent (earliest conflict entry)
+        # in this block, merged into the running per-ownship top-K.
+        urg = jnp.where(swconfl, tinconf, bigval)
+        negv, jbest = jax.lax.top_k(-urg, kk)             # [block, kk]
+        cand_tin = -negv
+        cand_idx = (ci * block + jbest).astype(jnp.int32)
+        cat_tin = jnp.concatenate([topk_tin, cand_tin], axis=1)
+        cat_idx = jnp.concatenate([topk_idx, cand_idx], axis=1)
+        negv, sel = jax.lax.top_k(-cat_tin, kk)
+        topk_tin = -negv
+        topk_idx = jnp.take_along_axis(cat_idx, sel, axis=1)
+        return ((inconf, tcpamax, sdve, sdvn, sdvv, tsolv, nconf, nlos,
+                 topk_tin, topk_idx), None)
+
+    def row_block(ri):
+        rows_active = act_b[ri]
+        z = jnp.zeros((block,), dtype)
+        carry0 = (jnp.zeros((block,), bool),              # inconf
+                  jnp.zeros((block,), dtype),             # tcpamax (>=0, see
+                  z, z, z,                                #   cd.detect note)
+                  jnp.full((block,), 1e9, dtype),         # tsolv
+                  jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32),
+                  jnp.full((block, kk), bigval, dtype),   # running top-K tin
+                  jnp.full((block, kk), -1, jnp.int32))   # running top-K idx
+
+        def colstep(carry, ci):
+            return tile(ri, ci, rows_active, carry)
+
+        carry, _ = jax.lax.scan(colstep, carry0, jnp.arange(nb))
+        return carry
+
+    out = jax.lax.map(row_block, jnp.arange(nb))
+    (inconf, tcpamax, sdve, sdvn, sdvv, tsolv, nconf, nlos,
+     topk_tin, topk_idx) = out
+    topk_idx = jnp.where(topk_tin < bigval, topk_idx, -1)
+
+    unb = lambda a: a.reshape(nb * block, *a.shape[2:])[:n]
+    return RowConflictData(
+        inconf=unb(inconf), tcpamax=unb(tcpamax),
+        sum_dve=unb(sdve), sum_dvn=unb(sdvn), sum_dvv=unb(sdvv),
+        tsolv=unb(tsolv),
+        nconf=jnp.sum(nconf, dtype=jnp.int32),
+        nlos=jnp.sum(nlos, dtype=jnp.int32),
+        topk_idx=unb(topk_idx), topk_tin=unb(topk_tin))
+
+
+def topk_partners(rd, k):
+    """The [N, K] partner candidates from a RowConflictData (-1 = empty).
+
+    The running top-K merge in the scan already ordered them by urgency;
+    this just pads/crops to the table width K.
+    """
+    idx = rd.topk_idx[:, :k]
+    pad = k - idx.shape[1]
+    if pad > 0:
+        idx = jnp.pad(idx, ((0, 0), (0, pad)), constant_values=-1)
+    return idx
+
+
+def partner_keep(partners, lat, lon, gseast, gsnorth, trk, active,
+                 rpz, rpz_m):
+    """Resume-nav predicates on the partner table (reference asas.py:426-455).
+
+    Same math as ``cr_mvp.resume_nav`` but on gathered [N, K] partner state
+    instead of the [N, N] matrix.  Returns a bool [N, K] keep mask.
+    """
+    n = lat.shape[0]
+    valid = partners >= 0
+    j = jnp.clip(partners, 0, n - 1)
+
+    re = 6371000.0
+    latj, lonj = lat[j], lon[j]
+    dist_e = re * (jnp.radians(lonj - lon[:, None])
+                   * jnp.cos(0.5 * jnp.radians(latj + lat[:, None])))
+    dist_n = re * jnp.radians(latj - lat[:, None])
+    vrel_e = gseast[j] - gseast[:, None]
+    vrel_n = gsnorth[j] - gsnorth[:, None]
+
+    alive = active[:, None] & active[j]
+    keep = cr_mvp.resume_keep_core(dist_e, dist_n, vrel_e, vrel_n,
+                                   trk[:, None], trk[j], alive, rpz, rpz_m)
+    return keep & valid
+
+
+def merge_partners(new_idx, old_idx, old_keep):
+    """Merge fresh conflict partners with surviving previous partners.
+
+    ``new_idx`` [N, K] (most urgent first, -1 empty) takes precedence; old
+    partners surviving ``old_keep`` fill remaining slots, duplicates dropped.
+    Returns the new [N, K] partner table.
+    """
+    k = new_idx.shape[1]
+    old = jnp.where(old_keep, old_idx, -1)
+    # Drop old entries that reappear among the new ones
+    dup = jnp.any((old[:, :, None] == new_idx[:, None, :])
+                  & (new_idx[:, None, :] >= 0), axis=2)
+    old = jnp.where(dup, -1, old)
+
+    cat = jnp.concatenate([new_idx, old], axis=1)        # [N, 2K]
+    valid = cat >= 0
+    pos = jnp.arange(2 * k, dtype=jnp.int32)[None, :]
+    key = jnp.where(valid, pos, 2 * k + pos)             # valid first, stable
+    order = jnp.argsort(key, axis=1)[:, :k]
+    return jnp.take_along_axis(cat, order, axis=1)

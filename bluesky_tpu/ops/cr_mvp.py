@@ -42,20 +42,27 @@ def pair_contributions(cd, alt, gseast, gsnorth, vs, cfg):
     contribution of pair (i,j) *to ownship i*, and the vertical solve time.
     Entries where ``cd.swconfl`` is False are garbage; callers mask.
     """
-    qdr = jnp.radians(cd.qdr)
-    dist = cd.dist
-    tcpa = cd.tcpa
-    tlos = cd.tinconf
+    return pair_contrib_core(
+        cd.qdr, cd.dist, cd.tcpa, cd.tinconf,
+        alt[None, :] - alt[:, None],
+        gseast[None, :] - gseast[:, None],
+        gsnorth[None, :] - gsnorth[:, None],
+        vs[None, :] - vs[:, None],
+        cfg)
+
+
+def pair_contrib_core(qdr_deg, dist, tcpa, tlos,
+                      drel_v, vrel_e, vrel_n, vrel_v, cfg):
+    """Shape-agnostic MVP pair math (MVP.py:149-231).
+
+    Operands may be full [N,N] matrices (dense path) or [Br,Bc] tiles
+    (ops/cd_tiled.py) — any broadcast-compatible shapes.
+    """
+    qdr = jnp.radians(qdr_deg)
 
     # Relative position of intruder j w.r.t. ownship i (MVP.py:157-159)
     drel_e = jnp.sin(qdr) * dist
     drel_n = jnp.cos(qdr) * dist
-    drel_v = alt[None, :] - alt[:, None]
-
-    # Relative velocity (v2 - v1, MVP.py:162-164)
-    vrel_e = gseast[None, :] - gseast[:, None]
-    vrel_n = gsnorth[None, :] - gsnorth[:, None]
-    vrel_v = vs[None, :] - vs[:, None]
 
     # Horizontal displacement at CPA (MVP.py:170-171)
     dcpa_e = drel_e + vrel_e * tcpa
@@ -136,11 +143,39 @@ def resolve(cd, alt, gseast, gsnorth, vs, trk, gs,
         mask = mask & ~noreso[None, :]
 
     maskf = mask.astype(dve_p.dtype)
+    # Raw pair sums; sign flip + cooperative halving happen in
+    # ``resolve_from_sums`` (shared with the tiled large-N path).
+    sum_dve = jnp.sum(dve_p * maskf, axis=1)
+    sum_dvn = jnp.sum(dvn_p * maskf, axis=1)
+    sum_dvv = jnp.sum(dvv_p * maskf, axis=1)
+
+    # Vertical solve time: min over this ownship's conflicts (MVP.py:41-42)
+    tsolv = jnp.min(jnp.where(mask, tsolv_p, 1e9), axis=1)
+
+    return resolve_from_sums(
+        sum_dve, sum_dvn, sum_dvv, tsolv,
+        alt, gseast, gsnorth, vs, trk, gs,
+        selalt, ap_vs, prev_alt, vmin, vmax, vsmin, vsmax, cfg,
+        resooff=resooff)
+
+
+def resolve_from_sums(sum_dve, sum_dvn, sum_dvv, tsolv,
+                      alt, gseast, gsnorth, vs, trk, gs,
+                      selalt, ap_vs, prev_alt,
+                      vmin, vmax, vsmin, vsmax, cfg,
+                      resooff=None):
+    """Per-aircraft command synthesis from accumulated pair contributions.
+
+    ``sum_dv*`` are the plain sums over conflict pairs of the per-pair MVP
+    displacement (un-negated); ``tsolv`` the per-ownship min vertical solve
+    time.  Shared tail of the dense ``resolve`` and the tiled large-N path
+    (ops/cd_tiled.py), which produce the same sums without the [N,N] matrices.
+    """
     # dv[i] -= sum_j dv_mvp(i,j); vertical component halved because the
     # resolution is cooperative (both aircraft manoeuvre, MVP.py:48-50).
-    dve = -jnp.sum(dve_p * maskf, axis=1)
-    dvn = -jnp.sum(dvn_p * maskf, axis=1)
-    dvv = -0.5 * jnp.sum(dvv_p * maskf, axis=1)
+    dve = -sum_dve
+    dvn = -sum_dvn
+    dvv = -0.5 * sum_dvv
 
     # Resooff aircraft do no resolutions at all (MVP.py:58-61)
     if resooff is not None:
@@ -148,9 +183,6 @@ def resolve(cd, alt, gseast, gsnorth, vs, trk, gs,
         dve = jnp.where(keep, dve, 0.0)
         dvn = jnp.where(keep, dvn, 0.0)
         dvv = jnp.where(keep, dvv, 0.0)
-
-    # Vertical solve time: min over this ownship's conflicts (MVP.py:41-42)
-    tsolv = jnp.min(jnp.where(mask, tsolv_p, 1e9), axis=1)
 
     # New velocity vector (MVP.py:67-76)
     newv_e = dve + gseast
@@ -194,6 +226,22 @@ def resolve(cd, alt, gseast, gsnorth, vs, trk, gs,
     return newtrk, newgs_, newvs, newalt, asase, asasn
 
 
+def resume_keep_core(dist_e, dist_n, vrel_e, vrel_n, trk_i, trk_j,
+                     alive, rpz, rpz_m):
+    """Shape-agnostic resume-nav keep predicate (reference asas.py:426-455).
+
+    A pair stays engaged while not yet past CPA, in horizontal LoS, or in a
+    near-parallel "bouncing" encounter.  Shared by the dense [N,N] path
+    (``resume_nav``) and the gathered [N,K] partner table
+    (``cd_tiled.partner_keep``).
+    """
+    past_cpa = dist_e * vrel_e + dist_n * vrel_n > 0.0
+    hdist = jnp.sqrt(dist_e * dist_e + dist_n * dist_n)
+    hor_los = hdist < rpz
+    is_bouncing = (jnp.abs(trk_i - trk_j) < 30.0) & (hdist < rpz_m)
+    return (~past_cpa | hor_los | is_bouncing) & alive
+
+
 def resume_nav(resopairs, swlos_unused, lat, lon, gseast, gsnorth, trk,
                active_ac, rpz, rpz_m):
     """Vectorized ResumeNav (reference asas.py:409-471).
@@ -215,14 +263,10 @@ def resume_nav(resopairs, swlos_unused, lat, lon, gseast, gsnorth, trk,
     vrel_e = gseast[None, :] - gseast[:, None]
     vrel_n = gsnorth[None, :] - gsnorth[:, None]
 
-    past_cpa = dist_e * vrel_e + dist_n * vrel_n > 0.0
-    hdist = jnp.sqrt(dist_e * dist_e + dist_n * dist_n)
-    hor_los = hdist < rpz
-    is_bouncing = (jnp.abs(trk[:, None] - trk[None, :]) < 30.0) & (hdist < rpz_m)
-
     # Drop pairs whose intruder was deleted (reference asas.py:419-421)
     alive = active_ac[:, None] & active_ac[None, :]
-    keep = (~past_cpa | hor_los | is_bouncing) & alive
+    keep = resume_keep_core(dist_e, dist_n, vrel_e, vrel_n,
+                            trk[:, None], trk[None, :], alive, rpz, rpz_m)
     new_resopairs = resopairs & keep
     asas_active = jnp.any(new_resopairs, axis=1)
     return new_resopairs, asas_active
