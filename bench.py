@@ -23,8 +23,13 @@ def main(n_ac=10000, nsteps=200, reps=5):
     from bluesky_tpu.core.traffic import Traffic
 
     # Beyond ~16k aircraft the dense [N,N] CD stops fitting in HBM; switch
-    # to the blockwise backend (ops/cd_tiled.py) with the [N,K] partner table.
+    # to the blockwise backend with the [N,K] partner table — the Pallas
+    # kernel on TPU (ops/cd_pallas.py), the lax formulation elsewhere.
     tiled = n_ac > 16384
+    # Pallas kernel only on real TPU (axon = the tunnelled TPU platform);
+    # the lax 'tiled' formulation everywhere else.
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    backend = "dense" if not tiled else ("pallas" if on_tpu else "tiled")
     nmax = n_ac
     traf = Traffic(nmax=nmax, dtype=jnp.float32, pair_matrix=not tiled)
     rng = np.random.default_rng(0)
@@ -37,7 +42,7 @@ def main(n_ac=10000, nsteps=200, reps=5):
     traf.flush()
 
     # full pipeline: FMS + ASAS CD&R (1 Hz) + perf + kinematics
-    cfg = SimConfig(cd_backend="tiled" if tiled else "dense")
+    cfg = SimConfig(cd_backend=backend)
     state = traf.state
 
     # warmup/compile
@@ -54,7 +59,7 @@ def main(n_ac=10000, nsteps=200, reps=5):
 
     result = {
         "metric": "aircraft-steps/sec/chip (N=%d, CD+MVP @1Hz, simdt=0.05%s)"
-                  % (n_ac, ", tiled" if tiled else ""),
+                  % (n_ac, ", " + backend if tiled else ""),
         "value": round(best, 1),
         "unit": "aircraft-steps/s",
         "vs_baseline": round(best / BASELINE_AC_STEPS_PER_SEC, 2),

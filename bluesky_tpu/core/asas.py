@@ -118,13 +118,15 @@ def detect_only(state: SimState, cfg: AsasConfig):
 
 
 def update_tiled(state: SimState, cfg: AsasConfig,
-                 block: int = 512) -> SimState:
+                 block: int = 512, impl: str = "lax") -> SimState:
     """One ASAS interval via the blockwise large-N backend (ops/cd_tiled.py).
 
     Same pipeline as ``update`` — detect, resolve, bookkeep, resume
     (reference asas.py:473-504) — but no [N,N] array ever exists: the pair
     space is streamed in tiles and resume-nav hysteresis lives in the [N,K]
-    partner table instead of the resopairs matrix.
+    partner table instead of the resopairs matrix.  ``impl`` selects the
+    lax.scan formulation ('lax', runs everywhere) or the Pallas TPU kernel
+    ('pallas', ops/cd_pallas.py).
     """
     ac, asas = state.ac, state.asas
     k = asas.partners.shape[1]
@@ -133,7 +135,12 @@ def update_tiled(state: SimState, cfg: AsasConfig,
         swresohoriz=cfg.swresohoriz, swresospd=cfg.swresospd,
         swresohdg=cfg.swresohdg, swresovert=cfg.swresovert)
 
-    rd = cd_tiled.detect_resolve_tiled(
+    if impl == "pallas":
+        from ..ops import cd_pallas
+        detect_fn = cd_pallas.detect_resolve_pallas
+    else:
+        detect_fn = cd_tiled.detect_resolve_tiled
+    rd = detect_fn(
         ac.lat, ac.lon, ac.trk, ac.gs, ac.alt, ac.vs,
         ac.gseast, ac.gsnorth, ac.active, asas.noreso,
         cfg.rpz, cfg.hpz, cfg.dtlookahead, mvpcfg, block=block,

@@ -39,7 +39,9 @@ class SimConfig(NamedTuple):
     use_wind: bool = False
     # CD&R backend: 'dense' materialises [N,N] (exact reference parity,
     # fine to ~16k AC); 'tiled' streams [cd_block]² tiles with a [N,K]
-    # partner table — required for the 100k north star (ops/cd_tiled.py).
+    # partner table — required for the 100k north star (ops/cd_tiled.py);
+    # 'pallas' is the tiled scheme as a hand-written TPU kernel
+    # (ops/cd_pallas.py, TPU-only).
     cd_backend: str = "dense"
     cd_block: int = 512
 
@@ -70,7 +72,11 @@ def step(state: SimState, cfg: SimConfig) -> SimState:
 
     # ---------- ASAS CD&R (traffic.py:396), gated at dtasas ----------
     if cfg.asas.swasas:
-        if cfg.cd_backend != "tiled" and state.asas.resopairs.size == 0:
+        if cfg.cd_backend not in ("dense", "tiled", "pallas"):
+            raise ValueError(
+                f"Unknown SimConfig.cd_backend {cfg.cd_backend!r}; "
+                "expected 'dense', 'tiled' or 'pallas'.")
+        if cfg.cd_backend == "dense" and state.asas.resopairs.size == 0:
             raise ValueError(
                 "State was allocated with pair_matrix=False (no [N,N] "
                 "resopairs) but SimConfig.cd_backend is "
@@ -79,9 +85,10 @@ def step(state: SimState, cfg: SimConfig) -> SimState:
         asas_due = simt >= state.asas_tnext
 
         def run_asas(s):
-            if cfg.cd_backend == "tiled":
+            if cfg.cd_backend in ("tiled", "pallas"):
+                impl = "pallas" if cfg.cd_backend == "pallas" else "lax"
                 s2, _cd = asasmod.update_tiled(s, cfg.asas,
-                                               block=cfg.cd_block)
+                                               block=cfg.cd_block, impl=impl)
             else:
                 s2, _cd = asasmod.update(s, cfg.asas)
             return s2.replace(

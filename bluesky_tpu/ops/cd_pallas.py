@@ -1,0 +1,278 @@
+"""Pallas TPU kernel for the fused blockwise CD&R pass.
+
+Same computation as ``ops/cd_tiled.py`` (which is the portable lax.scan
+formulation and the golden-test oracle for this kernel): the N x N pair space
+of the state-based conflict detection (reference
+``bluesky/traffic/asas/StateBasedCD.py``) plus the MVP displacement sums
+(reference ``MVP.py:14-143``) is computed in [block, block] tiles and reduced
+per ownship, never materialising an N² array.
+
+Here the tile loop is a real TPU kernel: the grid is (ownship blocks,
+intruder blocks), each program reads two [_NF, block] slabs of packed
+aircraft state from VMEM, evaluates the CPA geometry + MVP contribution on a
+[block, block] tile with the VPU, and accumulates the per-ownship reductions
+in-place in the output blocks (revisited across the intruder grid dimension
+— the standard Pallas accumulation pattern).  The pair math is the *same
+code* as the lax backend — ``cd_tiled.tile_geometry`` (rank-1-factored
+haversine) and ``cr_mvp.pair_contrib_trig`` are shape-agnostic jnp and trace
+straight into the kernel — so the tiled backends cannot drift apart.  The
+one transcendental Mosaic lacks (atan2, for the arc length) comes from
+``kmath`` (f32 Cephes-style polynomial).
+
+Layout note: the tile is oriented **intruder-major**: intruders vary along
+sublanes (axis 0), ownships along lanes (axis 1).  Per-ownship reductions
+are then axis-0 reduces that land in the natural (1, block) lane layout of
+the accumulator blocks; only the intruder-side operands need a
+(1, block) -> (block, 1) relayout.
+
+Partner candidates for resume-nav hysteresis: a running top-K (by earliest
+conflict-entry time) is accumulated in the candidate output refs across the
+intruder-block grid dimension — K-pass masked index-min extraction per tile,
+skipped entirely for conflict-free tiles — so the kernel yields exactly the
+K most urgent intruders per ownship, same as ``cd_tiled``'s carry-based
+top-K merge.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import cr_mvp, kmath
+from .cd_tiled import RowConflictData, TRIG_FIELDS, precompute_trig, \
+    tile_geometry
+
+# Packed state row order for the [nb, 13, block] slabs: 7 trig/geometry
+# columns (cd_tiled.TRIG_FIELDS), 4 velocity/altitude columns, then the
+# active and noreso masks.
+_FIELDS = TRIG_FIELDS + ("u", "v", "alt", "vs", "gse", "gsn",
+                         "active", "noreso")
+_NF = len(_FIELDS)
+_IDX = {k: i for i, k in enumerate(_FIELDS)}
+_BIG = 1e9
+
+
+def _kernel(own_ref, intr_ref,
+            inconf_ref, tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref,
+            tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref, cidx_ref,
+            *, block, kk, rpz, hpz, tlookahead, mvpcfg):
+    jb = pl.program_id(1)
+    oslab = own_ref[0]                                    # (_NF, block)
+    islab = intr_ref[0]
+
+    def own(k):            # ownship operand, varies along lanes: (1, block)
+        return oslab[_IDX[k]:_IDX[k] + 1, :]
+
+    def intr(k):           # intruder operand, varies along sublanes
+        return islab[_IDX[k]:_IDX[k] + 1, :].T            # (block, 1)
+
+    gid_own = pl.program_id(0) * block + jax.lax.broadcasted_iota(
+        jnp.int32, (block, block), 1)
+    gid_int = jb * block + jax.lax.broadcasted_iota(
+        jnp.int32, (block, block), 0)
+    act_o = own("active") > 0.5                           # (1, block)
+    act_i = intr("active") > 0.5                          # (block, 1)
+    pairmask = (act_o & act_i) & (gid_own != gid_int)
+    excl = jnp.where(pairmask, 0.0, _BIG)
+
+    # Horizontal geometry — the factored haversine (cd_tiled.tile_geometry),
+    # evaluated [intruder, ownship] so per-ownship reductions are axis 0.
+    trig_o = {k: own(k) for k in TRIG_FIELDS}
+    trig_i = {k: intr(k) for k in TRIG_FIELDS}
+    dist0, sinqdr, cosqdr = tile_geometry(trig_o, trig_i, atan2=kmath.atan2)
+    dist = dist0 + excl
+    dx = dist * sinqdr
+    dy = dist * cosqdr
+
+    du = intr("u") - own("u")
+    dv = intr("v") - own("v")
+    dv2 = du * du + dv * dv
+    dv2 = jnp.where(jnp.abs(dv2) < 1e-6, 1e-6, dv2)
+    vrel = jnp.sqrt(dv2)
+
+    tcpa = -(du * dx + dv * dy) / dv2 + excl
+    dcpa2 = dist * dist - tcpa * tcpa * dv2
+    r2 = rpz * rpz
+    swhorconf = dcpa2 < r2
+
+    dtinhor = jnp.sqrt(jnp.maximum(0.0, r2 - dcpa2)) / vrel
+    tinhor = jnp.where(swhorconf, tcpa - dtinhor, 1e8)
+    touthor = jnp.where(swhorconf, tcpa + dtinhor, -1e8)
+
+    dalt = intr("alt") - own("alt") + excl
+    dvs = intr("vs") - own("vs")
+    dvs = jnp.where(jnp.abs(dvs) < 1e-6, 1e-6, dvs)
+    tcrosshi = (dalt + hpz) / -dvs
+    tcrosslo = (dalt - hpz) / -dvs
+    tinver = jnp.minimum(tcrosshi, tcrosslo)
+    toutver = jnp.maximum(tcrosshi, tcrosslo)
+
+    tinconf = jnp.maximum(tinver, tinhor)
+    toutconf = jnp.minimum(toutver, touthor)
+    swconfl = (swhorconf & (tinconf <= toutconf) & (toutconf > 0.0)
+               & (tinconf < tlookahead) & pairmask)
+    swlos = (dist < rpz) & (jnp.abs(dalt) < hpz) & pairmask
+
+    dve_p, dvn_p, dvv_p, tsolv_p = cr_mvp.pair_contrib_trig(
+        sinqdr, cosqdr, dist, tcpa, tinconf,
+        intr("alt") - own("alt"), intr("gse") - own("gse"),
+        intr("gsn") - own("gsn"), intr("vs") - own("vs"), mvpcfg)
+    nor_i = intr("noreso") > 0.5
+    mvpmask = swconfl & ~nor_i
+    maskf = mvpmask.astype(dist.dtype)
+
+    conff = swconfl.astype(dist.dtype)
+    t_inconf = jnp.max(conff, axis=0, keepdims=True)
+    t_tcpamax = jnp.max(tcpa * conff, axis=0, keepdims=True)
+    t_sdve = jnp.sum(dve_p * maskf, axis=0, keepdims=True)
+    t_sdvn = jnp.sum(dvn_p * maskf, axis=0, keepdims=True)
+    t_sdvv = jnp.sum(dvv_p * maskf, axis=0, keepdims=True)
+    t_tsolv = jnp.min(jnp.where(mvpmask, tsolv_p, _BIG),
+                      axis=0, keepdims=True)
+    t_ncnt = jnp.sum(conff, axis=0, keepdims=True)
+    t_lcnt = jnp.sum(swlos.astype(dist.dtype), axis=0, keepdims=True)
+
+    @pl.when(jb == 0)
+    def _():
+        inconf_ref[0] = t_inconf
+        tcpamax_ref[0] = t_tcpamax
+        sdve_ref[0] = t_sdve
+        sdvn_ref[0] = t_sdvn
+        sdvv_ref[0] = t_sdvv
+        tsolv_ref[0] = t_tsolv
+        ncnt_ref[0] = t_ncnt
+        lcnt_ref[0] = t_lcnt
+
+    @pl.when(jb > 0)
+    def _():
+        inconf_ref[0] = jnp.maximum(inconf_ref[0], t_inconf)
+        tcpamax_ref[0] = jnp.maximum(tcpamax_ref[0], t_tcpamax)
+        sdve_ref[0] = sdve_ref[0] + t_sdve
+        sdvn_ref[0] = sdvn_ref[0] + t_sdvn
+        sdvv_ref[0] = sdvv_ref[0] + t_sdvv
+        tsolv_ref[0] = jnp.minimum(tsolv_ref[0], t_tsolv)
+        ncnt_ref[0] = ncnt_ref[0] + t_ncnt
+        lcnt_ref[0] = lcnt_ref[0] + t_lcnt
+
+    # Partner candidates: merge this tile's top-kk most urgent conflicts
+    # into the running per-ownship top-kk held in the candidate refs.
+    # Extraction is kk passes of masked index-min (argmin has no stable
+    # Mosaic lowering); conflict-free tiles skip the whole thing.
+    @pl.when(jb == 0)
+    def _():
+        ctin_ref[0] = jnp.full((kk, block), _BIG, dist.dtype)
+        cidx_ref[0] = jnp.full((kk, block), 2**30, jnp.int32)
+
+    @pl.when(jnp.any(swconfl))
+    def _():
+        urg = jnp.where(swconfl, tinconf, _BIG)
+        tins, idxs = [], []
+        for _s in range(kk):
+            minv = jnp.min(urg, axis=0, keepdims=True)    # (1, block)
+            jloc = jnp.min(jnp.where(urg == minv, gid_int, jnp.int32(2**30)),
+                           axis=0, keepdims=True)
+            tins.append(minv)
+            idxs.append(jloc)
+            urg = jnp.where(gid_int == jloc, _BIG, urg)
+        cat_t = jnp.concatenate([ctin_ref[0]] + tins, axis=0)   # (2kk, block)
+        cat_i = jnp.concatenate([cidx_ref[0]] + idxs, axis=0)
+        rio = jax.lax.broadcasted_iota(jnp.int32, (2 * kk, block), 0)
+        new_t, new_i = [], []
+        for _s in range(kk):
+            minv = jnp.min(cat_t, axis=0, keepdims=True)
+            rloc = jnp.min(jnp.where(cat_t == minv, rio, jnp.int32(2**30)),
+                           axis=0, keepdims=True)
+            sel = jnp.min(jnp.where(rio == rloc, cat_i, jnp.int32(2**30)),
+                          axis=0, keepdims=True)
+            new_t.append(minv)
+            new_i.append(sel)
+            cat_t = jnp.where(rio == rloc, _BIG, cat_t)
+        ctin_ref[0] = jnp.concatenate(new_t, axis=0)
+        cidx_ref[0] = jnp.concatenate(new_i, axis=0)
+
+
+def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
+                          active, noreso, rpz, hpz, tlookahead, mvpcfg,
+                          block=256, k_partners=8, interpret=False):
+    """Pallas-backed equivalent of ``cd_tiled.detect_resolve_tiled``.
+
+    Returns a ``RowConflictData``; reductions match the lax formulation to
+    float tolerance (identical per-tile math, same block iteration order).
+    Always computes in float32 (the TPU-native dtype for this kernel).
+    """
+    n = lat.shape[0]
+    dtype = jnp.float32
+    if n <= 128:
+        block = 128
+    else:
+        block = min(block, 1 << (n - 1).bit_length())
+    nb = -(-n // block)
+    npad = nb * block - n
+
+    def pad(a):
+        a = a.astype(dtype)
+        return a if npad == 0 else jnp.concatenate(
+            [a, jnp.zeros((npad,), dtype)])
+
+    trkrad = jnp.radians(trk.astype(dtype))
+    fields = precompute_trig(pad(lat), pad(lon))
+    fields.update({
+        "u": pad(gs.astype(dtype) * jnp.sin(trkrad)),
+        "v": pad(gs.astype(dtype) * jnp.cos(trkrad)),
+        "alt": pad(alt), "vs": pad(vs), "gse": pad(gseast),
+        "gsn": pad(gsnorth),
+        "active": pad(active.astype(dtype)),
+        "noreso": pad(noreso.astype(dtype)),
+    })
+    # [nb, _NF, block]: per-block slabs of the per-aircraft columns
+    packed = jnp.stack([fields[k] for k in _FIELDS]).reshape(
+        _NF, nb, block).transpose(1, 0, 2)
+
+    kk = k_partners
+    kern = functools.partial(
+        _kernel, block=block, kk=kk, rpz=float(rpz), hpz=float(hpz),
+        tlookahead=float(tlookahead), mvpcfg=mvpcfg)
+
+    acc = lambda: jax.ShapeDtypeStruct((nb, 1, block), dtype)
+    out_shapes = [acc(), acc(), acc(), acc(), acc(), acc(), acc(), acc(),
+                  jax.ShapeDtypeStruct((nb, kk, block), dtype),      # ctin
+                  jax.ShapeDtypeStruct((nb, kk, block), jnp.int32)]  # cidx
+
+    acc_spec = lambda: pl.BlockSpec((1, 1, block), lambda i, j: (i, 0, 0),
+                                    memory_space=pltpu.VMEM)
+    cand_spec = lambda: pl.BlockSpec(
+        (1, kk, block), lambda i, j: (i, 0, 0),
+        memory_space=pltpu.VMEM)
+
+    outs = pl.pallas_call(
+        kern,
+        grid=(nb, nb),
+        in_specs=[
+            pl.BlockSpec((1, _NF, block), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),       # ownship slab
+            pl.BlockSpec((1, _NF, block), lambda i, j: (j, 0, 0),
+                         memory_space=pltpu.VMEM),       # intruder slab
+        ],
+        out_specs=[acc_spec() for _ in range(8)] + [cand_spec(), cand_spec()],
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(packed, packed)
+
+    (inconf, tcpamax, sdve, sdvn, sdvv, tsolv, ncnt, lcnt,
+     ctin, cidx) = outs
+
+    unb = lambda a: a.reshape(nb * block)[:n]
+    # Candidates: [nb, kk, block] -> [N, kk], already urgency-sorted
+    topk_tin = ctin.transpose(0, 2, 1).reshape(nb * block, kk)[:n]
+    topk_idx = cidx.transpose(0, 2, 1).reshape(nb * block, kk)[:n]
+    topk_idx = jnp.where(topk_tin < _BIG, topk_idx, -1)
+
+    return RowConflictData(
+        inconf=unb(inconf) > 0.5,
+        tcpamax=unb(tcpamax),
+        sum_dve=unb(sdve), sum_dvn=unb(sdvn), sum_dvv=unb(sdvv),
+        tsolv=unb(tsolv),
+        nconf=jnp.sum(ncnt, dtype=dtype).astype(jnp.int32),
+        nlos=jnp.sum(lcnt, dtype=dtype).astype(jnp.int32),
+        topk_idx=topk_idx, topk_tin=topk_tin)

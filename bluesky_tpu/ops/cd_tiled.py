@@ -61,6 +61,109 @@ def _pad1(a, npad, value):
         [a, jnp.full((npad,), value, a.dtype)])
 
 
+# --------------------------------------------------------------------------
+# Delta-polynomial pair geometry.
+#
+# The dense path evaluates the haversine + bearing with pairwise sin/cos/
+# atan2 — ~a dozen transcendentals per PAIR.  Here the per-pair trig reduces
+# to odd polynomials of the coordinate DELTAS plus products of per-AIRCRAFT
+# sin/cos columns:
+#   dlat, dlon are formed by direct subtraction (well-conditioned: the
+#     cancellation happens on the raw degree values, keeping absolute error
+#     at f32 eps of the coordinates — NOT on cos(delta) near 1, which would
+#     lose all precision of close pairs),
+#   sin(dlat/2) etc. come from a degree-7 odd Taylor evaluation (exact to
+#     f32 for the |delta| < pi/2 range where precision matters; for far
+#     pairs the small overshoot only pushes distances up, never creating
+#     false conflicts),
+#   the bearing uses sin(qdr) = qy/h, cos(qdr) = qx/h with
+#     qy = sin(dlon)*cl_i,  qx = sin(dlat) + sl_o*cl_i*(2*sin^2(dlon/2))
+#     so the angle itself is never formed,
+#   rwgs84(lat_o+lat_i) (the reference matrix quirk, geo.py:117-128)
+#     expands via the angle-sum identities from the per-aircraft columns.
+# Per pair one atan2 (arc length) and a few sqrt survive.  The dense path
+# keeps the literal reference formulas as the parity anchor.
+# --------------------------------------------------------------------------
+
+#: per-aircraft columns consumed by tile_geometry, in slab order
+TRIG_FIELDS = ("lat", "lon", "sl", "cl", "rloc", "abslat")
+
+
+def precompute_trig(lat, lon):
+    """Per-aircraft trig/radius columns for the factored pair geometry."""
+    rlat = jnp.radians(lat)
+    return {
+        "lat": lat, "lon": lon,
+        "sl": jnp.sin(rlat), "cl": jnp.cos(rlat),
+        "rloc": geo.rwgs84(lat),
+        "abslat": jnp.abs(lat),
+    }
+
+
+def _rwgs84_from_trig(cosphi, sinphi):
+    """geo.rwgs84 evaluated from cos/sin of the latitude angle."""
+    an = geo.A_WGS84 * geo.A_WGS84 * cosphi
+    bn = geo.B_WGS84 * geo.B_WGS84 * sinphi
+    ad = geo.A_WGS84 * cosphi
+    bd = geo.B_WGS84 * sinphi
+    return jnp.sqrt((an * an + bn * bn) / (ad * ad + bd * bd))
+
+
+def _sin_poly(x):
+    """sin(x) as a degree-7 odd Taylor evaluation, |x| <= pi.
+
+    Error < 2e-4 at pi/2, < 1e-7 below 0.5 rad — and conflict geometry only
+    needs precision for deltas far below that.
+    """
+    x2 = x * x
+    return x * (1.0 - x2 / 6.0 * (1.0 - x2 / 20.0 * (1.0 - x2 / 42.0)))
+
+
+def tile_geometry(own, intr, atan2=None):
+    """Pair distance [m] + bearing sin/cos for one tile.
+
+    ``own``/``intr`` are dicts of TRIG_FIELDS columns, broadcast-shaped
+    (ownship vs intruder axes).  Mirrors geo.qdrdist_matrix semantics
+    (including the radius-at-sum-of-latitudes quirk and the 1e-6 epsilon,
+    geo.py:117-128) via the delta-polynomial scheme above.  Returns
+    (dist, sin_qdr, cos_qdr).
+    """
+    atan2 = atan2 or jnp.arctan2
+    sl_o, cl_o = own["sl"], own["cl"]
+    sl_i, cl_i = intr["sl"], intr["cl"]
+
+    # Mean radius (reference matrix quirk: evaluated at lat_o + lat_i)
+    cos_sum = cl_o * cl_i - sl_o * sl_i
+    sin_sum = sl_o * cl_i + cl_o * sl_i
+    res1 = _rwgs84_from_trig(cos_sum, sin_sum)
+    denom = own["abslat"] + intr["abslat"] \
+        + jnp.where(own["lat"] == 0.0, 1e-6, 0.0)
+    res2 = 0.5 * (own["abslat"] * (own["rloc"] + geo.A_WGS84)
+                  + intr["abslat"] * (intr["rloc"] + geo.A_WGS84)) / denom
+    r = jnp.where(own["lat"] * intr["lat"] < 0.0, res2, res1)
+
+    # Coordinate deltas; dlon wrapped into [-180, 180] (the reference's
+    # pairwise sin/cos are periodic — the polynomial needs the wrap).
+    dlat = jnp.radians(intr["lat"] - own["lat"])
+    dlon_deg = intr["lon"] - own["lon"]
+    dlon = jnp.radians(dlon_deg - 360.0 * jnp.round(dlon_deg * (1.0 / 360.0)))
+
+    sh_lat = _sin_poly(0.5 * dlat)
+    sh_lon = _sin_poly(0.5 * dlon)
+    root = sh_lat * sh_lat + cl_o * cl_i * sh_lon * sh_lon
+    root = jnp.clip(root, 0.0, 1.0)
+    dist = 2.0 * r * atan2(jnp.sqrt(root), jnp.sqrt(1.0 - root))
+
+    # Bearing sin/cos as ratios — the angle is never formed.
+    # qx = cl_o*sl_i - sl_o*cl_i*cos(dlon) = sin(dlat) + sl_o*cl_i*(1-cos
+    # dlon), with 1-cos(dlon) = 2*sin^2(dlon/2): all well-conditioned terms.
+    qy = _sin_poly(dlon) * cl_i
+    qx = _sin_poly(dlat) + sl_o * cl_i * (2.0 * sh_lon * sh_lon)
+    h = jnp.sqrt(qx * qx + qy * qy)
+    h = jnp.where(h < 1e-30, 1e-30, h)
+    return dist, qy / h, qx / h
+
+
 def detect_resolve_tiled(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                          active, noreso, rpz, hpz, tlookahead, mvpcfg,
                          block=512, k_partners=8):
@@ -77,19 +180,20 @@ def detect_resolve_tiled(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
     dtype = lat.dtype
 
     packed = {
-        "lat": _pad1(lat, npad, 0.0), "lon": _pad1(lon, npad, 0.0),
-        "trk": _pad1(trk, npad, 0.0), "gs": _pad1(gs, npad, 0.0),
         "alt": _pad1(alt, npad, 0.0), "vs": _pad1(vs, npad, 0.0),
         "gse": _pad1(gseast, npad, 0.0), "gsn": _pad1(gsnorth, npad, 0.0),
     }
+    # Per-aircraft trig columns for the rank-1-factored pair geometry
+    packed.update(precompute_trig(_pad1(lat, npad, 0.0),
+                                  _pad1(lon, npad, 0.0)))
+    # East/north velocity components for the CPA math (StateBasedCD.py:31-40
+    # uses trk/gs; gseast/gsnorth are the same numbers assembled in traffic).
+    trkrad = jnp.radians(_pad1(trk, npad, 0.0))
+    packed["u"] = _pad1(gs, npad, 0.0) * jnp.sin(trkrad)
+    packed["v"] = _pad1(gs, npad, 0.0) * jnp.cos(trkrad)
     packed = {k: v.reshape(nb, block) for k, v in packed.items()}
     act_b = _pad1(active, npad, False).reshape(nb, block)
     nor_b = _pad1(noreso, npad, False).reshape(nb, block)
-    # East/north velocity components for the CPA math (StateBasedCD.py:31-40
-    # uses trk/gs; gseast/gsnorth are the same numbers assembled in traffic).
-    trkrad = jnp.radians(packed["trk"])
-    packed["u"] = packed["gs"] * jnp.sin(trkrad)
-    packed["v"] = packed["gs"] * jnp.cos(trkrad)
 
     r2 = rpz * rpz
     bigval = jnp.asarray(1e9, dtype)
@@ -111,13 +215,13 @@ def detect_resolve_tiled(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         pairmask = (rows_active[:, None] & cols_active[None, :]) & ~same
         excl = jnp.where(pairmask, 0.0, bigval)
 
-        # Horizontal geometry — identical ops to cd.detect
-        qdr, distnm = geo.qdrdist_matrix(r["lat"], r["lon"],
-                                         c["lat"], c["lon"])
-        dist = distnm * geo.nm + excl
-        qdrrad = jnp.radians(qdr)
-        dx = dist * jnp.sin(qdrrad)
-        dy = dist * jnp.cos(qdrrad)
+        # Horizontal geometry — factored haversine (tile_geometry docstring)
+        rT = {k: r[k][:, None] for k in TRIG_FIELDS}
+        cT = {k: c[k][None, :] for k in TRIG_FIELDS}
+        dist0, sinqdr, cosqdr = tile_geometry(rT, cT)
+        dist = dist0 + excl
+        dx = dist * sinqdr
+        dy = dist * cosqdr
 
         du = c["u"][None, :] - r["u"][:, None]
         dv = c["v"][None, :] - r["v"][:, None]
@@ -149,8 +253,8 @@ def detect_resolve_tiled(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         swlos = (dist < rpz) & (jnp.abs(dalt) < hpz) & pairmask
 
         # MVP pair contributions on the tile (shared core, MVP.py:149-231)
-        dve_p, dvn_p, dvv_p, tsolv_p = cr_mvp.pair_contrib_core(
-            qdr, dist, tcpa, tinconf,
+        dve_p, dvn_p, dvv_p, tsolv_p = cr_mvp.pair_contrib_trig(
+            sinqdr, cosqdr, dist, tcpa, tinconf,
             c["alt"][None, :] - r["alt"][:, None],
             c["gse"][None, :] - r["gse"][:, None],
             c["gsn"][None, :] - r["gsn"][:, None],

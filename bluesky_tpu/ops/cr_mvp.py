@@ -52,17 +52,36 @@ def pair_contributions(cd, alt, gseast, gsnorth, vs, cfg):
 
 
 def pair_contrib_core(qdr_deg, dist, tcpa, tlos,
-                      drel_v, vrel_e, vrel_n, vrel_v, cfg):
+                      drel_v, vrel_e, vrel_n, vrel_v, cfg, arcsin=None):
     """Shape-agnostic MVP pair math (MVP.py:149-231).
 
     Operands may be full [N,N] matrices (dense path) or [Br,Bc] tiles
-    (ops/cd_tiled.py) — any broadcast-compatible shapes.
+    (ops/cd_tiled.py) — any broadcast-compatible shapes.  ``arcsin`` is
+    injectable for the Pallas kernel (Mosaic has no asin lowering; it passes
+    ``kmath.asin``).
     """
+    arcsin = arcsin or jnp.arcsin
     qdr = jnp.radians(qdr_deg)
+    return pair_contrib_trig(jnp.sin(qdr), jnp.cos(qdr), dist, tcpa, tlos,
+                             drel_v, vrel_e, vrel_n, vrel_v, cfg,
+                             arcsin=arcsin)
 
+
+def pair_contrib_trig(sin_qdr, cos_qdr, dist, tcpa, tlos,
+                      drel_v, vrel_e, vrel_n, vrel_v, cfg, arcsin=None):
+    """MVP pair math taking the bearing as (sin, cos) directly.
+
+    The tiled backends produce sin/cos of the bearing without ever forming
+    the angle (they come out of the haversine as ratios), so this entry
+    skips the radians/sin/cos round-trip.  With ``arcsin=None`` the
+    non-grazing erratum factor cos(asin r1 - asin r2) is evaluated via the
+    algebraic identity sqrt(1-r1^2)*sqrt(1-r2^2) + r1*r2 — mathematically
+    identical, transcendental-free (the reference formula is MVP.py:190-193;
+    the dense path passes a real arcsin to keep bit-parity with the oracle).
+    """
     # Relative position of intruder j w.r.t. ownship i (MVP.py:157-159)
-    drel_e = jnp.sin(qdr) * dist
-    drel_n = jnp.cos(qdr) * dist
+    drel_e = sin_qdr * dist
+    drel_n = cos_qdr * dist
 
     # Horizontal displacement at CPA (MVP.py:170-171)
     dcpa_e = drel_e + vrel_e * tcpa
@@ -89,7 +108,13 @@ def pair_contrib_core(qdr_deg, dist, tcpa, tlos,
     apply_err = (cfg.rpz_m < dist) & (dabsh < dist)
     ratio1 = jnp.clip(cfg.rpz_m / safe_dist, -1.0, 1.0)
     ratio2 = jnp.clip(dabsh / safe_dist, -1.0, 1.0)
-    erratum = jnp.cos(jnp.arcsin(ratio1) - jnp.arcsin(ratio2))
+    if arcsin is not None:
+        erratum = jnp.cos(arcsin(ratio1) - arcsin(ratio2))
+    else:
+        # cos(asin r1 - asin r2) for r in [-1, 1]
+        erratum = (jnp.sqrt(jnp.maximum(0.0, 1.0 - ratio1 * ratio1))
+                   * jnp.sqrt(jnp.maximum(0.0, 1.0 - ratio2 * ratio2))
+                   + ratio1 * ratio2)
     erratum = jnp.where(apply_err, erratum, 1.0)
     # erratum can be ~0 for extreme geometry; reference divides unguarded, we
     # clamp to keep the kernel NaN-free under padding garbage.
@@ -224,6 +249,17 @@ def resolve_from_sums(sum_dve, sum_dvn, sum_dvv, tsolv,
     if cfg.swresohoriz:
         newalt = selalt
     return newtrk, newgs_, newvs, newalt, asase, asasn
+
+
+def resume_displacement(lat_own, lon_own, lat_other, lon_other):
+    """Flat-earth east/north displacement [m] used by the resume predicates
+    (reference asas.py:426-432).  Shared by the [N,N] matrix path and the
+    gathered [N,K] partner-table path so the geometry cannot diverge."""
+    from . import geo
+    dist_e = geo.REARTH * (jnp.radians(lon_other - lon_own)
+                           * jnp.cos(0.5 * jnp.radians(lat_other + lat_own)))
+    dist_n = geo.REARTH * jnp.radians(lat_other - lat_own)
+    return dist_e, dist_n
 
 
 def resume_keep_core(dist_e, dist_n, vrel_e, vrel_n, trk_i, trk_j,

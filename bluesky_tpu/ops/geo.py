@@ -82,8 +82,14 @@ def _mean_radius_matrix(latd1, latd2):
     return jnp.where(latd1 * latd2 < 0.0, res2, res1)
 
 
-def _haversine_qdr_dist(latd1, lond1, latd2, lond2, r):
-    """Shared haversine core: bearing [deg] and distance [m] given radius r."""
+def _haversine_qdr_dist(latd1, lond1, latd2, lond2, r, atan2=None):
+    """Shared haversine core: bearing [deg] and distance [m] given radius r.
+
+    ``atan2`` is injectable because Mosaic has no atan2 lowering — the
+    Pallas CD kernel passes ``kmath.atan2`` (f32 Cephes evaluation); every
+    other caller gets the exact jnp primitive.
+    """
+    atan2 = atan2 or jnp.arctan2
     lat1 = jnp.radians(latd1)
     lon1 = jnp.radians(lond1)
     lat2 = jnp.radians(latd2)
@@ -97,9 +103,9 @@ def _haversine_qdr_dist(latd1, lond1, latd2, lond2, r):
     root = sin1 * sin1 + coslat1 * coslat2 * sin2 * sin2
     # arctan2 form (not arcsin) matches the reference and is stable near
     # antipodes.
-    d = 2.0 * r * jnp.arctan2(jnp.sqrt(root), jnp.sqrt(1.0 - root))
+    d = 2.0 * r * atan2(jnp.sqrt(root), jnp.sqrt(1.0 - root))
 
-    qdr = jnp.degrees(jnp.arctan2(
+    qdr = jnp.degrees(atan2(
         jnp.sin(lon2 - lon1) * coslat2,
         coslat1 * jnp.sin(lat2) - jnp.sin(lat1) * coslat2 * jnp.cos(lon2 - lon1)))
     return qdr, d

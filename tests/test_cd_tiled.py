@@ -81,14 +81,17 @@ def test_tiled_matches_dense_reductions():
                                   np.asarray(exp["inconf"]))
     assert int(rd.nconf) == int(exp["nconf"]) > 0
     assert int(rd.nlos) == int(exp["nlos"])
-    np.testing.assert_allclose(rd.tcpamax, exp["tcpamax"], rtol=1e-9)
+    # The tiled path evaluates the haversine/bearing through the factored
+    # identities (cd_tiled.tile_geometry) — mathematically identical to the
+    # dense formulas, fp-rounded differently, measured <= ~2e-8 relative.
+    np.testing.assert_allclose(rd.tcpamax, exp["tcpamax"], rtol=1e-8)
     np.testing.assert_allclose(rd.sum_dve, exp["sum_dve"],
-                               rtol=1e-8, atol=1e-10)
+                               rtol=1e-6, atol=1e-4)
     np.testing.assert_allclose(rd.sum_dvn, exp["sum_dvn"],
-                               rtol=1e-8, atol=1e-10)
+                               rtol=1e-6, atol=1e-4)
     np.testing.assert_allclose(rd.sum_dvv, exp["sum_dvv"],
-                               rtol=1e-8, atol=1e-10)
-    np.testing.assert_allclose(rd.tsolv, exp["tsolv"], rtol=1e-9)
+                               rtol=1e-6, atol=1e-4)
+    np.testing.assert_allclose(rd.tsolv, exp["tsolv"], rtol=1e-8)
 
 
 def test_tiled_block_size_invariance():
@@ -177,7 +180,7 @@ def test_update_tiled_matches_dense_asas_update():
     for f in ("trk", "tas", "vs", "alt", "asase", "asasn"):
         np.testing.assert_allclose(
             np.asarray(getattr(s_dense.asas, f)),
-            np.asarray(getattr(s_tiled.asas, f)), rtol=1e-7, atol=1e-9,
+            np.asarray(getattr(s_tiled.asas, f)), rtol=1e-6, atol=1e-6,
             err_msg=f)
     # partner table mirrors the resopairs row membership
     partners = np.asarray(s_tiled.asas.partners)
@@ -225,3 +228,49 @@ def test_backend_allocation_mismatch_raises():
     traf = _conflict_traffic(pair_matrix=False)
     with pytest.raises(ValueError, match="pair_matrix"):
         run_steps(traf.state, SimConfig(cd_backend="dense"), 2)
+
+
+def test_pallas_interpret_matches_tiled():
+    """The Pallas kernel (interpret mode on CPU) against the lax oracle.
+
+    f32 on both sides; kmath.atan2 vs jnp.arctan2 bounds the tolerance.
+    """
+    from bluesky_tpu.ops import cd_pallas
+
+    scene = [jnp.asarray(np.asarray(a), jnp.float32)
+             if np.asarray(a).dtype.kind == "f" else a
+             for a in _random_scene(77, 100, seed=3)]
+    rd_t = cd_tiled.detect_resolve_tiled(*scene, RPZ, HPZ, TLOOK, MVPCFG,
+                                         block=128)
+    rd_p = cd_pallas.detect_resolve_pallas(*scene, RPZ, HPZ, TLOOK, MVPCFG,
+                                           block=128, interpret=True)
+    np.testing.assert_array_equal(np.asarray(rd_p.inconf),
+                                  np.asarray(rd_t.inconf))
+    assert int(rd_p.nconf) == int(rd_t.nconf) > 0
+    assert int(rd_p.nlos) == int(rd_t.nlos)
+    np.testing.assert_allclose(rd_p.tcpamax, rd_t.tcpamax,
+                               rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(rd_p.sum_dve, rd_t.sum_dve,
+                               rtol=1e-3, atol=0.3)
+    np.testing.assert_allclose(rd_p.sum_dvn, rd_t.sum_dvn,
+                               rtol=1e-3, atol=0.3)
+    # top-1 partner (most urgent) identical
+    t1 = np.asarray(cd_tiled.topk_partners(rd_t, 8))[:, 0]
+    p1 = np.asarray(rd_p.topk_idx)[:, 0]
+    np.testing.assert_array_equal(t1, p1)
+
+
+def test_kmath_accuracy():
+    from bluesky_tpu.ops import kmath
+    x = jnp.asarray(np.linspace(-50, 50, 10001), jnp.float32)
+    np.testing.assert_allclose(kmath.atan(x), np.arctan(np.asarray(x)),
+                               rtol=3e-7, atol=3e-7)
+    y = jnp.asarray(np.linspace(-1, 1, 4001), jnp.float32)
+    np.testing.assert_allclose(kmath.asin(y), np.arcsin(np.asarray(y)),
+                               rtol=0, atol=2e-6)
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.normal(size=4096), jnp.float32)
+    b = jnp.asarray(rng.normal(size=4096), jnp.float32)
+    np.testing.assert_allclose(kmath.atan2(a, b),
+                               np.arctan2(np.asarray(a), np.asarray(b)),
+                               rtol=0, atol=3e-6)
