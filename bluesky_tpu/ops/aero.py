@@ -9,6 +9,8 @@ reference (aero.py:178-260) is only used for ground-level utilities; the
 vectorized 2-layer model is what the simulation loop uses, and that is what
 we provide.
 """
+import math
+
 import jax.numpy as jnp
 
 # Constants (reference aero.py:11-29)
@@ -30,7 +32,10 @@ gamma1 = 0.2            # (gamma-1)/2
 gamma2 = 3.5            # gamma/(gamma-1)
 beta = -0.0065          # K/m tropospheric lapse rate
 Rearth = 6371000.0      # m mean earth radius
-a0 = float(jnp.sqrt(gamma * R * T0))  # sea-level speed of sound
+# Host-side math.sqrt, NOT jnp: a module-scope device op would initialise the
+# JAX backend at import time and pin the platform before the caller (tests,
+# multi-chip dryrun) can choose one.
+a0 = math.sqrt(gamma * R * T0)  # sea-level speed of sound
 
 
 def vtemp(h):
