@@ -16,11 +16,13 @@ Resolver selection: MVP is the default (and currently only) device resolver;
 the registry hook mirrors the reference's CDmethods/CRmethods dicts
 (asas.py:41-55) for host-side extension.
 """
-from typing import NamedTuple
+from typing import NamedTuple, Tuple
 
 import jax.numpy as jnp
 
 from ..ops import aero, cd as cdops, cd_tiled, cr_mvp
+from ..ops.cd import ConflictData
+from ..ops.cd_tiled import RowConflictData
 from .state import SimState
 
 
@@ -57,7 +59,8 @@ class AsasConfig(NamedTuple):
         return self.hpz * self.resofacv
 
 
-def update(state: SimState, cfg: AsasConfig) -> SimState:
+def update(state: SimState,
+           cfg: AsasConfig) -> Tuple[SimState, ConflictData]:
     """One ASAS interval: detect, resolve, bookkeep, resume (asas.py:473-504)."""
     ac, asas = state.ac, state.asas
 
@@ -117,8 +120,8 @@ def detect_only(state: SimState, cfg: AsasConfig):
     return state.replace(asas=asas), cd
 
 
-def update_tiled(state: SimState, cfg: AsasConfig,
-                 block: int = 512, impl: str = "lax") -> SimState:
+def update_tiled(state: SimState, cfg: AsasConfig, block: int = 512,
+                 impl: str = "lax") -> Tuple[SimState, RowConflictData]:
     """One ASAS interval via the blockwise large-N backend (ops/cd_tiled.py).
 
     Same pipeline as ``update`` — detect, resolve, bookkeep, resume
@@ -162,13 +165,20 @@ def update_tiled(state: SimState, cfg: AsasConfig,
             asase=jnp.where(upd, asase, asas.asase),
             asasn=jnp.where(upd, asasn, asas.asasn))
 
-    # Resume-nav on the partner table: prune previous partners past CPA
-    # (asas.py:409-471), then merge in this interval's fresh conflicts.
-    keep = cd_tiled.partner_keep(
-        asas.partners, ac.lat, ac.lon, ac.gseast, ac.gsnorth, ac.trk,
+    # Resume-nav on the partner table, matching the dense path's pruning of
+    # (old | new swconfl) through resume_nav (asas.py:409-471) as closely as
+    # the K-wide table allows: prune the old partners first (so stale
+    # past-CPA entries cannot evict still-engaged ones from the K slots),
+    # merge in this interval's fresh conflicts, then prune the merged table
+    # (so a borderline fresh conflict already past CPA releases immediately
+    # instead of staying engaged one interval longer than the dense path).
+    prune = lambda tbl: cd_tiled.partner_keep(
+        tbl, ac.lat, ac.lon, ac.gseast, ac.gsnorth, ac.trk,
         ac.active, cfg.rpz, cfg.rpz * cfg.resofach)
     new_idx = cd_tiled.topk_partners(rd, k)
-    partners = cd_tiled.merge_partners(new_idx, asas.partners, keep)
+    merged = cd_tiled.merge_partners(new_idx, asas.partners,
+                                     prune(asas.partners))
+    partners = jnp.where(prune(merged), merged, -1)
 
     asas = asas.replace(
         partners=partners,

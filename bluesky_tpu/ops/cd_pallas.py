@@ -273,6 +273,8 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         tcpamax=unb(tcpamax),
         sum_dve=unb(sdve), sum_dvn=unb(sdvn), sum_dvv=unb(sdvv),
         tsolv=unb(tsolv),
-        nconf=jnp.sum(ncnt, dtype=dtype).astype(jnp.int32),
-        nlos=jnp.sum(lcnt, dtype=dtype).astype(jnp.int32),
+        # Cast per-block float counts to int32 BEFORE summing: a float32
+        # total silently loses exactness past 2^24 pairs (plausible at 100k).
+        nconf=jnp.sum(ncnt.astype(jnp.int32)),
+        nlos=jnp.sum(lcnt.astype(jnp.int32)),
         topk_idx=topk_idx, topk_tin=topk_tin)

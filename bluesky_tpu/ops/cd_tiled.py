@@ -176,6 +176,13 @@ def detect_resolve_tiled(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
     block = min(block, max(n, 1))
     kk = min(k_partners, block)   # per-tile candidates merged into the top-K
     nb = -(-n // block)
+    # With a single tile the cap kk=block=n is exact (at most n-1 partners
+    # exist); across multiple tiles a sub-K per-tile candidate list would
+    # silently drop hysteresis partners beyond `block`.
+    if nb > 1 and block < k_partners:
+        raise ValueError(
+            f"block ({block}) must be >= k_partners ({k_partners}) "
+            "when the pair space spans multiple tiles")
     npad = nb * block - n
     dtype = lat.dtype
 
@@ -344,11 +351,9 @@ def partner_keep(partners, lat, lon, gseast, gsnorth, trk, active,
     valid = partners >= 0
     j = jnp.clip(partners, 0, n - 1)
 
-    re = 6371000.0
     latj, lonj = lat[j], lon[j]
-    dist_e = re * (jnp.radians(lonj - lon[:, None])
-                   * jnp.cos(0.5 * jnp.radians(latj + lat[:, None])))
-    dist_n = re * jnp.radians(latj - lat[:, None])
+    dist_e, dist_n = cr_mvp.resume_displacement(
+        lat[:, None], lon[:, None], latj, lonj)
     vrel_e = gseast[j] - gseast[:, None]
     vrel_n = gsnorth[j] - gsnorth[:, None]
 

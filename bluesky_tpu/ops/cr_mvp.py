@@ -22,6 +22,8 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
+from . import geo
+
 
 class MVPConfig(NamedTuple):
     """Static-ish resolver configuration (device scalars / small arrays)."""
@@ -255,7 +257,6 @@ def resume_displacement(lat_own, lon_own, lat_other, lon_other):
     """Flat-earth east/north displacement [m] used by the resume predicates
     (reference asas.py:426-432).  Shared by the [N,N] matrix path and the
     gathered [N,K] partner-table path so the geometry cannot diverge."""
-    from . import geo
     dist_e = geo.REARTH * (jnp.radians(lon_other - lon_own)
                            * jnp.cos(0.5 * jnp.radians(lat_other + lat_own)))
     dist_n = geo.REARTH * jnp.radians(lat_other - lat_own)
@@ -291,10 +292,8 @@ def resume_nav(resopairs, swlos_unused, lat, lon, gseast, gsnorth, trk,
     Returns (new_resopairs, asas_active):
       asas_active[i] = any pair (i, j) still demanding resolution.
     """
-    re = 6371000.0
-    dist_e = re * (jnp.radians(lon[None, :] - lon[:, None])
-                   * jnp.cos(0.5 * jnp.radians(lat[None, :] + lat[:, None])))
-    dist_n = re * jnp.radians(lat[None, :] - lat[:, None])
+    dist_e, dist_n = resume_displacement(lat[:, None], lon[:, None],
+                                         lat[None, :], lon[None, :])
 
     vrel_e = gseast[None, :] - gseast[:, None]
     vrel_n = gsnorth[None, :] - gsnorth[:, None]
