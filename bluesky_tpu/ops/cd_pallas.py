@@ -275,6 +275,6 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         tsolv=unb(tsolv),
         # Cast per-block float counts to int32 BEFORE summing: a float32
         # total silently loses exactness past 2^24 pairs (plausible at 100k).
-        nconf=jnp.sum(ncnt.astype(jnp.int32)),
-        nlos=jnp.sum(lcnt.astype(jnp.int32)),
+        nconf=jnp.sum(ncnt.astype(jnp.int32), dtype=jnp.int32),
+        nlos=jnp.sum(lcnt.astype(jnp.int32), dtype=jnp.int32),
         topk_idx=topk_idx, topk_tin=topk_tin)

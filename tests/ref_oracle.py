@@ -1,0 +1,91 @@
+"""Load the ACTUAL reference implementation as a golden oracle.
+
+``ref_numpy.py`` is an independent reimplementation — useful, but it could
+share a misunderstanding with the kernels it validates.  This module imports
+the real reference code from the read-only mount
+(``/root/reference/bluesky/traffic/asas/StateBasedCD.py`` and
+``/root/reference/bluesky/tools/geo.py``) so golden tests fail if the JAX
+kernels diverge from the reference *code*, not merely from our reading of it.
+
+The reference is 2019-era NumPy; two aliases it uses were removed in
+NumPy >= 1.24 / 2.0 (``np.mat``, ``np.bool``).  They are restored here as the
+documented equivalents (``np.asmatrix``, ``np.bool_``) before the modules are
+executed.  The reference package ``__init__`` pulls in settings/zmq/etc., so
+the needed modules are loaded from their file paths under stub ``bluesky`` /
+``bluesky.tools`` packages instead of importing the package for real.
+
+Nothing under /root/reference is modified.
+"""
+import importlib.util
+import sys
+import types
+from types import SimpleNamespace
+
+import numpy as np
+
+REF_ROOT = "/root/reference/bluesky"
+
+# NumPy 1.x aliases the 2019-era reference code relies on.
+if not hasattr(np, "mat"):
+    np.mat = np.asmatrix
+if not hasattr(np, "bool"):
+    np.bool = np.bool_
+
+
+def _load(fullname, path):
+    if fullname in sys.modules:
+        return sys.modules[fullname]
+    spec = importlib.util.spec_from_file_location(fullname, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[fullname] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _ensure_pkg(name):
+    if name not in sys.modules:
+        pkg = types.ModuleType(name)
+        pkg.__path__ = []  # mark as package
+        sys.modules[name] = pkg
+    return sys.modules[name]
+
+
+def load():
+    """Returns (geo, aero, statebasedcd) — the real reference modules."""
+    bs = _ensure_pkg("bluesky")
+    tools = _ensure_pkg("bluesky.tools")
+    geo = _load("bluesky.tools.geo", f"{REF_ROOT}/tools/geo.py")
+    aero = _load("bluesky.tools.aero", f"{REF_ROOT}/tools/aero.py")
+    tools.geo, tools.aero = geo, aero
+    bs.tools = tools
+    sbcd = _load("bluesky.traffic.asas.StateBasedCD",
+                 f"{REF_ROOT}/traffic/asas/StateBasedCD.py")
+    return geo, aero, sbcd
+
+
+def make_ownship(lat, lon, trk, gs, alt, vs, acid=None):
+    """Duck-typed stand-in for the reference Traffic object: the attribute
+    subset ``StateBasedCD.detect`` reads (StateBasedCD.py:11-101)."""
+    lat = np.asarray(lat, np.float64)
+    n = len(lat)
+    return SimpleNamespace(
+        ntraf=n,
+        lat=lat,
+        lon=np.asarray(lon, np.float64),
+        trk=np.asarray(trk, np.float64),
+        gs=np.asarray(gs, np.float64),
+        alt=np.asarray(alt, np.float64),
+        vs=np.asarray(vs, np.float64),
+        id=list(acid) if acid is not None else [f"AC{i:04d}" for i in range(n)],
+    )
+
+
+def detect(lat, lon, trk, gs, alt, vs, rpz, hpz, tlook, acid=None):
+    """Run the REAL reference StateBasedCD.detect on plain arrays.
+
+    Returns the reference's raw tuple:
+    (confpairs, lospairs, inconf, tcpamax, qdr, dist, tcpa, tinconf).
+    """
+    _, _, sbcd = load()
+    own = make_ownship(lat, lon, trk, gs, alt, vs, acid)
+    return sbcd.detect(own, own, rpz, hpz, tlook)

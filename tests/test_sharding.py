@@ -94,7 +94,7 @@ def test_sharded_step_with_resolution_engages(mesh):
     assert_state_close(out, ref)
 
 
-def test_ensemble_replicas_match_individual_runs(mesh_unused=None):
+def test_ensemble_replicas_match_individual_runs():
     """8 replicas stepped as one SPMD program == 8 independent runs.
 
     The device-side analogue of the reference BATCH scenario farm
