@@ -51,6 +51,12 @@ class Traffic:
         self._id2slot = {}
         self._pending = []          # queued creation dicts
         self._autoid = 0
+        # Display trails (reference traffic.py:79 bs.traf.trails)
+        from .trails import Trails
+        self.trails = Trails(self)
+        # Observers notified with slot indices on deletion (conditional
+        # commands, AREA plugin, ... — reference cond.delac wiring)
+        self.delete_hooks = []
 
     # ------------------------------------------------------------------ info
     @property
@@ -214,6 +220,7 @@ class Traffic:
 
         self.state = st.replace(ac=ac, ap=ap, actwp=actwp, asas=asas,
                                 adsb=adsb, perf=perf, route=route)
+        self.trails.create(slots, lat, lon, t=float(st.simt))
 
     # ---------------------------------------------------------------- delete
     def delete(self, idx):
@@ -241,6 +248,8 @@ class Traffic:
         asas = st.asas.replace(resopairs=rp, partners=partners,
                                active=st.asas.active.at[jidx].set(False))
         self.state = st.replace(ac=ac, asas=asas)
+        for hook in self.delete_hooks:
+            hook(idx)
         return True
 
     def reset(self):
@@ -252,6 +261,7 @@ class Traffic:
         self._id2slot = {}
         self._pending = []
         self._autoid = 0
+        self.trails.reset()
 
     # ------------------------------------------------------------- creconfs
     def creconfs(self, acid, actype, targetidx, dpsi, cpa, tlosh,

@@ -1,0 +1,152 @@
+"""Aircraft trails: line-segment position history for display.
+
+Parity with the reference ``bluesky/traffic/trails.py:9-236``: per-aircraft
+last-sample anchors, a growing host buffer of (lat0, lon0, lat1, lon1, time,
+color) line pieces appended every ``dttrail`` seconds while active, per-
+aircraft colors, CLEAR/background handling, and the TRAIL ON/OFF [dt] /
+TRAIL acid color stack command.
+
+TPU-first divergences:
+* Sampling happens at chunk edges from the already-fetched host copy of
+  lat/lon (the ACDATA screen sample), never inside the jitted step, so
+  trails cost nothing on device.
+* Segments for all due aircraft are appended as array blocks (the
+  reference loops per aircraft, trails.py:95-115).
+* Slots are stable; the per-aircraft anchors are fixed-size [nmax] arrays.
+"""
+import numpy as np
+
+COLORLIST = {
+    "BLUE": (0, 0, 255),
+    "CYAN": (0, 255, 255),
+    "RED": (255, 0, 0),
+    "YELLOW": (255, 255, 0),
+}
+
+
+class Trails:
+    def __init__(self, traf, dttrail=10.0):
+        self.traf = traf
+        self.active = False
+        self.dt = dttrail
+        self.tcol0 = 60.0                      # fade-to-old after [s]
+        self.defcolor = COLORLIST["CYAN"]
+        nmax = traf.nmax
+        self.accolor = np.tile(np.asarray(self.defcolor, np.uint8),
+                               (nmax, 1))     # [nmax,3]
+        self.lastlat = np.zeros(nmax)
+        self.lastlon = np.zeros(nmax)
+        self.lasttim = np.zeros(nmax)
+        self._clear_buffers()
+
+    def _clear_buffers(self):
+        # Foreground line pieces (streamed in ACDATA / drawn by a GUI)
+        self.lat0 = np.array([])
+        self.lon0 = np.array([])
+        self.lat1 = np.array([])
+        self.lon1 = np.array([])
+        self.time = np.array([])
+        self.col = np.zeros((0, 3), dtype=np.uint8)
+        # Background copy (frozen picture on CLEAR, trails.py:156-175)
+        self.bglat0 = np.array([])
+        self.bglon0 = np.array([])
+        self.bglat1 = np.array([])
+        self.bglon1 = np.array([])
+        self.bgtime = np.array([])
+        self.bgcol = np.zeros((0, 3), dtype=np.uint8)
+
+    # ------------------------------------------------------------ lifecycle
+    def create(self, idx, lat, lon, t=0.0):
+        """Anchor new aircraft at their spawn position (trails.py:64-69)."""
+        idx = np.atleast_1d(idx)
+        self.accolor[idx] = self.defcolor
+        self.lastlat[idx] = np.atleast_1d(lat)
+        self.lastlon[idx] = np.atleast_1d(lon)
+        self.lasttim[idx] = t
+
+    def delete(self, idx):
+        # Stable slots: nothing to renumber; segments already in the buffer
+        # stay visible like the reference's.
+        pass
+
+    def reset(self):
+        self.active = False
+        self._clear_buffers()
+        self.lasttim[:] = 0.0
+
+    # -------------------------------------------------------------- update
+    def update(self, t, lat=None, lon=None):
+        """Append segments for aircraft whose last anchor is > dt old.
+
+        lat/lon: host samples of the position arrays (fetched once per
+        chunk edge by the caller); fetched here only if not supplied.
+        """
+        active_mask = np.asarray(self.traf.state.ac.active)
+        if lat is None:
+            ac = self.traf.state.ac
+            lat = np.asarray(ac.lat)
+            lon = np.asarray(ac.lon)
+        if not self.active:
+            self.lastlat = np.array(lat, copy=True)
+            self.lastlon = np.array(lon, copy=True)
+            self.lasttim[:] = t
+            return
+        # >= with an fp-slack so chunk edges spaced exactly dt apart (the
+        # Simulation clamps the chunk to the trail resolution) still sample.
+        due = active_mask & ((t - self.lasttim) >= self.dt - 1e-6)
+        idxs = np.where(due)[0]
+        if len(idxs) == 0:
+            return
+        self.lat0 = np.append(self.lat0, self.lastlat[idxs])
+        self.lon0 = np.append(self.lon0, self.lastlon[idxs])
+        self.lat1 = np.append(self.lat1, lat[idxs])
+        self.lon1 = np.append(self.lon1, lon[idxs])
+        self.time = np.append(self.time, np.full(len(idxs), t))
+        self.col = np.concatenate([self.col, self.accolor[idxs]], axis=0)
+        self.lastlat[idxs] = lat[idxs]
+        self.lastlon[idxs] = lon[idxs]
+        self.lasttim[idxs] = t
+
+    # ------------------------------------------------------------- command
+    def setTrails(self, *args):
+        """TRAIL ON/OFF [dt] or TRAIL acid color (stack.py:734-739)."""
+        if not args or args[0] is None:
+            return True, f"TRAIL is {'ON' if self.active else 'OFF'}"
+        a0 = args[0]
+        if isinstance(a0, bool):
+            self.active = a0
+            if len(args) > 1 and args[1] is not None:
+                try:
+                    self.dt = float(args[1])
+                except (TypeError, ValueError):
+                    return False, f"{args[1]}: expected trail dt"
+            return True
+        if a0 == "CLEAR":
+            self.clear()
+            return True
+        # TRAIL acid color
+        try:
+            idx = int(a0)
+        except (TypeError, ValueError):
+            return False, f"{a0}: expected ON/OFF/CLEAR or acid"
+        if len(args) < 2 or str(args[1]).upper() not in COLORLIST:
+            return False, "Usage: TRAIL acid BLUE/RED/CYAN/YELLOW"
+        self.accolor[idx] = COLORLIST[str(args[1]).upper()]
+        return True
+
+    def clear(self):
+        """Move current picture to the background buffer (trails.py CLEAR)."""
+        self.bglat0 = np.append(self.bglat0, self.lat0)
+        self.bglon0 = np.append(self.bglon0, self.lon0)
+        self.bglat1 = np.append(self.bglat1, self.lat1)
+        self.bglon1 = np.append(self.bglon1, self.lon1)
+        self.bgtime = np.append(self.bgtime, self.time)
+        self.bgcol = np.concatenate([self.bgcol, self.col], axis=0)
+        n = len(self.bglat0)
+        self.lat0 = np.array([])
+        self.lon0 = np.array([])
+        self.lat1 = np.array([])
+        self.lon1 = np.array([])
+        self.time = np.array([])
+        self.col = np.zeros((0, 3), dtype=np.uint8)
+        return n

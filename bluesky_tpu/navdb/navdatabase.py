@@ -18,13 +18,10 @@ NM = 1852.0
 
 
 def _kwikdist_nm(lata, lona, latb, lonb):
-    """Fast flat-earth distance [nm] (parity: tools/geo.py kwikdist)."""
-    re = 6371000.0
-    dlat = np.radians(latb - lata)
-    dlon = np.radians(((lonb - lona) + 180.0) % 360.0 - 180.0)
-    cavelat = np.cos(np.radians(lata + latb) * 0.5)
-    dangle = np.sqrt(dlat * dlat + dlon * dlon * cavelat * cavelat)
-    return re * dangle / NM
+    """Fast flat-earth distance [nm] with antimeridian wrap (shared impl,
+    cf. reference tools/geo.py kwikdist)."""
+    from ..ops.geo import kwikdist_wrapped
+    return kwikdist_wrapped(lata, lona, latb, lonb, xp=np)
 
 
 class Navdatabase:

@@ -193,6 +193,23 @@ def kwikdist_matrix(lata, lona, latb, lonb):
                     jnp.asarray(latb)[None, :], jnp.asarray(lonb)[None, :])
 
 
+def kwikdist_wrapped(lata, lona, latb, lonb, xp=jnp):
+    """Flat-earth distance [nm] with the longitude difference wrapped to
+    [-180, 180).
+
+    Deliberate divergence from the reference ``kwikdist`` (geo.py:288-305),
+    which returns nonsense across the antimeridian; the shared host-side
+    consumers (navdb nearest-waypoint lookup, areafilter circles) use this
+    with ``xp=np``.  ``kwikdist`` above stays reference-exact for kernel
+    parity.
+    """
+    dlat = xp.radians(latb - lata)
+    dlon = xp.radians(((lonb - lona) + 180.0) % 360.0 - 180.0)
+    cavelat = xp.cos(xp.radians(lata + latb) * 0.5)
+    dangle = xp.sqrt(dlat * dlat + dlon * dlon * cavelat * cavelat)
+    return REARTH * dangle / nm
+
+
 def kwikqdrdist(lata, lona, latb, lonb):
     """Fast flat-earth bearing [deg, 0..360) and distance [m]!
 

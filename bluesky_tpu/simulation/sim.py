@@ -40,6 +40,7 @@ class Screen:
     def __init__(self):
         self.echobuf = []
         self.viewbounds = (-1.0, 1.0, -1.0, 1.0)
+        self.objdata = {}     # named display shapes (screenio objappend)
 
     def echo(self, text="", flags=0):
         self.echobuf.append(text)
@@ -47,6 +48,15 @@ class Screen:
 
     def getviewbounds(self):
         return self.viewbounds
+
+    def objappend(self, objtype, objname, data):
+        """Mirror a named shape to the display (screenio.py objappend);
+        empty objtype deletes."""
+        if not objtype:
+            self.objdata.pop(objname, None)
+        else:
+            self.objdata[objname] = (objtype, data)
+        return True
 
 
 class Simulation:
@@ -75,6 +85,12 @@ class Simulation:
         self.benchdt = -1.0
         self._step_count = 0
         self._wall_t0 = time.perf_counter()
+        # Named areas + deferred conditional commands (chunk-edge subsystems)
+        from ..utils.areafilter import AreaRegistry
+        from ..core.conditional import ConditionList
+        self.areas = AreaRegistry(self.scr)
+        self.cond = ConditionList(self)
+        self.traf.delete_hooks.append(self.cond.delac)
         # Late import to avoid cycles; stack binds commands to this sim.
         from ..stack.stack import Stack
         self.stack = Stack(self)
@@ -131,6 +147,8 @@ class Simulation:
     def reset(self):
         self.state_flag = INIT
         self.traf.reset()
+        self.areas.reset()
+        self.cond.reset()
         self.routes = RouteManager(self.traf, self.routes.wmax)
         self.cfg = SimConfig()
         self.dtmult = 1.0
@@ -193,6 +211,15 @@ class Simulation:
         if self.ffmode:
             chunk = max(chunk, 1000)
         limit = chunk
+        # Pending conditional commands quantize their fire time to the
+        # chunk edge: clamp to <= 1 s of sim time while any are armed.
+        if self.cond.ncond > 0:
+            limit = min(limit, max(1, int(round(1.0 / self.cfg.simdt))))
+        # Trails sample positions at chunk edges: keep the chunk at or
+        # below the trail resolution so fast-forward doesn't coarsen them.
+        if self.traf.trails.active:
+            limit = min(limit, max(1, int(round(
+                self.traf.trails.dt / self.cfg.simdt))))
         tnext = self.stack.next_trigger_time()
         if tnext is not None:
             steps_to_trigger = int(np.ceil(
@@ -223,7 +250,11 @@ class Simulation:
         self.traf.state = run_steps(self.traf.state, self.cfg, chunk)
         self._step_count += chunk
 
-        # Periodic loggers sample at chunk edges
+        # Chunk-edge subsystems: conditional triggers, trails, loggers
+        # (the reference runs these per 0.05 s step, simulation.py:110-116;
+        # here they sample the chunk-edge state)
+        self.cond.update()
+        self.traf.trails.update(self.simt)
         from ..utils import datalog
         datalog.postupdate(self)
 

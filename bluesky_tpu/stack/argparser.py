@@ -169,7 +169,14 @@ class Argparser:
                 if optional or si >= len(tokens):
                     break
                 raise ArgError(f"missing argument <{st2}>")
-            if st2 == "latlon":
+            if st2 == "string" and not repeating and si == len(tokens) - 1:
+                # Greedy rest-of-line (reference stack.py 'string' argtype)
+                # — only as the FINAL spec token; 'string,...' specs
+                # (DELAY/SYN/PCALL) keep per-token parsing, their handlers
+                # re-join or index the words.
+                out.append(" ".join(a for a in args[ai:] if a != ""))
+                ai = len(args)
+            elif st2 == "latlon":
                 val, consumed = self._parse_latlon(args, ai)
                 out.append(val)
                 ai += consumed

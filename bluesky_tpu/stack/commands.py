@@ -57,8 +57,9 @@ def register_all(stack):
         return ok, msg
 
     def delete(idx):
+        name = acname(idx)
         traf.delete(idx)
-        return True, f"Deleted {acname(idx)}"
+        return True, f"Deleted {name}"
 
     def delall():
         idxs = [i for i, v in enumerate(traf.ids) if v is not None]
@@ -522,6 +523,99 @@ def register_all(stack):
     def syn(subcmd=None, *args):
         return synthetic.process(sim, subcmd, [a for a in args if a is not None])
 
+    # ----------------------------------- areas / conditionals / trails
+    def _flat(*vals):
+        """Flatten (lat, lon) tuples + scalars into the reference's flat
+        coordinate list, dropping empty optionals."""
+        out = []
+        for v in vals:
+            if v is None:
+                continue
+            if isinstance(v, tuple):
+                out.extend(v)
+            else:
+                out.append(v)
+        return out
+
+    def boxcmd(name, p0, p1, top=None, bottom=None):
+        """BOX name,lat,lon,lat,lon,[top,bottom] (stack.py:266-269)."""
+        return sim.areas.defineArea(
+            name, "BOX", _flat(p0, p1),
+            top if top is not None else 1e9,
+            bottom if bottom is not None else -1e9)
+
+    def circlecmd(name, p, radius, top=None, bottom=None):
+        """CIRCLE name,lat,lon,radius[nm],[top,bottom] (stack.py:290-293)."""
+        return sim.areas.defineArea(
+            name, "CIRCLE", _flat(p, radius),
+            top if top is not None else 1e9,
+            bottom if bottom is not None else -1e9)
+
+    def polycmd(name, *pts):
+        """POLY name,lat,lon,lat,lon,... (stack.py:577-580)."""
+        coords = _flat(*pts)
+        if len(coords) < 6:
+            return False, "POLY needs at least 3 points"
+        return sim.areas.defineArea(name, "POLY", coords)
+
+    def polyaltcmd(name, top, bottom, *pts):
+        """POLYALT name,top,bottom,lat,lon,... (stack.py:583-586)."""
+        coords = _flat(*pts)
+        if len(coords) < 6:
+            return False, "POLYALT needs at least 3 points"
+        return sim.areas.defineArea(name, "POLY", coords, top, bottom)
+
+    def linecmd(name, *pts):
+        """LINE/POLYLINE name,lat,lon,lat,lon[,...] (stack.py:469-472,
+        589-592 — POLYLINE is a LINE shape with more points)."""
+        coords = _flat(*pts)
+        if len(coords) < 4:
+            return False, "LINE needs at least 2 points"
+        return sim.areas.defineArea(name, "LINE", coords)
+
+    def delcmd(name):
+        """DEL acid/ALL/WIND/shape (stack.py:321-327)."""
+        u = str(name).upper()
+        if u == "ALL":
+            return delall()
+        if u == "WIND":
+            traf.state = st().replace(wind=windmod.make_windstate(
+                dtype=traf.dtype))
+            return True, "Wind field cleared"
+        i = traf.id2idx(u)
+        if isinstance(i, int) and i >= 0:
+            return delete(i)
+        for nm_ in (name, u):
+            if sim.areas.hasArea(nm_):
+                sim.areas.deleteArea(nm_)
+                return True, f"Deleted area {nm_}"
+        return False, f"{name}: no such aircraft or area"
+
+    def atalt(idx, targalt, cmdtxt):
+        sim.cond.ataltcmd(idx, targalt, cmdtxt)
+        return True, f"ATALT armed for {acname(idx)}"
+
+    def atspd(idx, targspd, cmdtxt):
+        sim.cond.atspdcmd(idx, targspd, cmdtxt)
+        return True, f"ATSPD armed for {acname(idx)}"
+
+    def trailcmd(a0=None, a1=None):
+        """TRAIL ON/OFF [dt] or TRAIL acid color (stack.py:734-739)."""
+        tr = traf.trails
+        if a0 is None:
+            return tr.setTrails()
+        u = str(a0).upper()
+        if u in ("ON", "TRUE", "YES", "1"):
+            return tr.setTrails(True, a1)
+        if u in ("OFF", "FALSE", "NO", "0"):
+            return tr.setTrails(False)
+        if u == "CLEAR":
+            return tr.setTrails("CLEAR")
+        idx = traf.id2idx(u)
+        if isinstance(idx, int) and idx >= 0:
+            return tr.setTrails(idx, a1)
+        return False, "Usage: TRAIL ON/OFF,[dt] or TRAIL acid,color"
+
     def helpcmd(cmd=None):
         if cmd is None:
             names = ", ".join(sorted(stack.cmddict.keys()))
@@ -552,7 +646,27 @@ def register_all(stack):
         "CRECONFS": ["CRECONFS acid,type,targetacid,dpsi,cpa,tlosh,[dH,tlosv,spd]",
                      "txt,txt,acid,float,float,time,[alt,time,spd]", creconfs,
                      "Create an aircraft in conflict with target"],
-        "DEL": ["DEL acid", "acid", delete, "Delete an aircraft"],
+        "ATALT": ["acid ATALT alt cmd", "acid,alt,string", atalt,
+                  "When a/c passes given altitude, execute a command"],
+        "ATSPD": ["acid ATSPD spd cmd", "acid,spd,string", atspd,
+                  "When a/c reaches given speed, execute a command"],
+        "BOX": ["BOX name,lat,lon,lat,lon,[top,bottom]",
+                "txt,latlon,latlon,[alt,alt]", boxcmd,
+                "Define a box-shaped area"],
+        "CIRCLE": ["CIRCLE name,lat,lon,radius,[top,bottom]",
+                   "txt,latlon,float,[alt,alt]", circlecmd,
+                   "Define a circle-shaped area"],
+        "POLY": ["POLY name,lat,lon,lat,lon, ...", "txt,latlon,...",
+                 polycmd, "Define a polygon-shaped area"],
+        "POLYALT": ["POLYALT name,top,bottom,lat,lon, ...",
+                    "txt,alt,alt,latlon,...", polyaltcmd,
+                    "Define a polygon-shaped area in 3D"],
+        "LINE": ["LINE name,lat,lon,lat,lon", "txt,latlon,latlon,...",
+                 linecmd, "Draw a (poly)line between points"],
+        "TRAIL": ["TRAIL ON/OFF,[dt] OR TRAIL acid color",
+                  "[txt],[txt]", trailcmd, "Toggle aircraft trails on/off"],
+        "DEL": ["DEL acid/ALL/WIND/shape", "txt", delcmd,
+                "Delete an aircraft, wind field or area"],
         "DELALL": ["DELALL", "", delall, "Delete all aircraft"],
         "DELAY": ["DELAY dt,COMMAND+ARGS", "time,string,...", delay,
                   "Schedule a command in dt seconds"],
@@ -649,4 +763,6 @@ def register_all(stack):
         "RESUME": "OP", "START": "OP", "TURN": "HDG", "?": "HELP",
         "CONTINUE": "OP", "SAVE": "SAVEIC", "CLOSE": "QUIT",
         "DELROUTE": "DELRTE", "LOAD": "IC", "OPEN": "IC",
+        "TRAILS": "TRAIL", "POLYGON": "POLY", "POLYLINE": "LINE",
+        "POLYLINES": "LINE", "LINES": "LINE",
     })

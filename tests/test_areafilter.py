@@ -1,0 +1,206 @@
+"""Areafilter + conditional commands + trails.
+
+Area semantics mirror the reference ``tools/areafilter.py:15-104`` (BOX /
+CIRCLE / POLY / LINE with altitude bounds, vectorized checkInside); the
+polygon containment test cross-checks against matplotlib.path (the
+reference's own implementation) when available.  Conditional AT-commands
+mirror ``traffic/conditional.py:13-129``; trails mirror
+``traffic/trails.py:9-236``.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from bluesky_tpu.utils.areafilter import AreaRegistry, Box, Circle, Poly, Line
+from bluesky_tpu.ops import aero
+
+
+# ---------------------------------------------------------------- shapes
+class TestShapes:
+    def test_box(self):
+        box = Box("B1", [52.0, 4.0, 51.0, 5.0], top=3000.0, bottom=0.0)
+        lat = np.array([51.5, 51.5, 52.5, 51.5])
+        lon = np.array([4.5, 5.5, 4.5, 4.5])
+        alt = np.array([1000.0, 1000.0, 1000.0, 5000.0])
+        np.testing.assert_array_equal(box.contains(lat, lon, alt),
+                                      [True, False, False, False])
+
+    def test_circle(self):
+        c = Circle("C1", [52.0, 4.0, 10.0])    # 10 nm radius
+        lat = np.array([52.0, 52.0, 52.0])
+        lon = np.array([4.0, 4.2, 5.0])        # ~0, ~7.4, ~37 nm away
+        alt = np.zeros(3)
+        np.testing.assert_array_equal(c.contains(lat, lon, alt),
+                                      [True, True, False])
+
+    def test_poly_triangle(self):
+        p = Poly("P1", [0.0, 0.0, 0.0, 2.0, 2.0, 1.0])
+        lat = np.array([0.5, 1.5, -0.1, 1.9])
+        lon = np.array([1.0, 1.0, 1.0, 1.0])
+        alt = np.zeros(4)
+        np.testing.assert_array_equal(p.contains(lat, lon, alt),
+                                      [True, True, False, True])
+
+    def test_poly_matches_matplotlib_reference_impl(self):
+        mpl = pytest.importorskip("matplotlib.path")
+        rng = np.random.default_rng(3)
+        # A messy (self-intersecting-free) star-ish polygon
+        ang = np.sort(rng.uniform(0, 2 * np.pi, 11))
+        r = rng.uniform(0.5, 1.5, 11)
+        verts_lat = r * np.cos(ang)
+        verts_lon = r * np.sin(ang)
+        coords = np.stack([verts_lat, verts_lon], axis=1).ravel()
+        p = Poly("P2", coords)
+        lat = rng.uniform(-2, 2, 500)
+        lon = rng.uniform(-2, 2, 500)
+        ours = p.contains(lat, lon, np.zeros(500))
+        path = mpl.Path(np.stack([verts_lat, verts_lon], axis=1))
+        ref = path.contains_points(np.stack([lat, lon], axis=1))
+        # Boundary-grazing points may differ; none here with random data
+        np.testing.assert_array_equal(ours, ref)
+
+    def test_poly_contains_on_device(self):
+        """The same containment expression runs with xp=jnp (device mask
+        path for e.g. GEOVECTOR)."""
+        p = Poly("P3", [0.0, 0.0, 0.0, 2.0, 2.0, 1.0])
+        lat = jnp.asarray([0.5, -0.1])
+        lon = jnp.asarray([1.0, 1.0])
+        out = p.contains(lat, lon, jnp.zeros(2), xp=jnp)
+        np.testing.assert_array_equal(np.asarray(out), [True, False])
+
+    def test_line_never_contains(self):
+        l = Line("L1", [0.0, 0.0, 1.0, 1.0])
+        assert not l.contains(np.array([0.5]), np.array([0.5]),
+                              np.array([0.0])).any()
+
+    def test_registry(self):
+        reg = AreaRegistry()
+        assert reg.defineArea("A1", "BOX", [0.0, 0.0, 1.0, 1.0]) is True
+        assert reg.hasArea("A1")
+        inside = reg.checkInside("A1", np.array([0.5]), np.array([0.5]),
+                                 np.array([0.0]))
+        assert inside.all()
+        # unknown area -> all False (areafilter.py:32-33)
+        assert not reg.checkInside("NOPE", np.array([0.5]), np.array([0.5]),
+                                   np.array([0.0])).any()
+        assert reg.deleteArea("A1")
+        assert not reg.hasArea("A1")
+
+
+# ------------------------------------------------------- stack integration
+@pytest.fixture()
+def sim():
+    from bluesky_tpu.simulation.sim import Simulation
+    return Simulation(nmax=16, dtype=jnp.float64)
+
+
+def do(sim, *lines):
+    for line in lines:
+        sim.stack.stack(line)
+    sim.stack.process()
+    out = "\n".join(sim.scr.echobuf)
+    sim.scr.echobuf.clear()
+    return out
+
+
+class TestAreaCommands:
+    def test_box_poly_circle_line_and_del(self, sim):
+        do(sim, "BOX B1 52 4 51 5", "CIRCLE C1 52 4 10",
+           "POLY P1 0 0 0 2 2 1", "LINE L1 0 0 1 1")
+        for name in ("B1", "C1", "P1", "L1"):
+            assert sim.areas.hasArea(name), name
+        # screen mirror (areafilter.py:26-27 objappend)
+        assert "B1" in sim.scr.objdata
+        do(sim, "DEL B1")
+        assert not sim.areas.hasArea("B1")
+        assert "B1" not in sim.scr.objdata
+
+    def test_polyalt_with_bounds(self, sim):
+        do(sim, "POLYALT P2 FL100 0 0 0 0 2 2 1")
+        shape = sim.areas.areas["P2"]
+        assert shape.top == pytest.approx(10000 * aero.ft)
+        inside = sim.areas.checkInside(
+            "P2", np.array([0.5]), np.array([1.0]),
+            np.array([5000 * aero.ft]))
+        assert inside.all()
+        above = sim.areas.checkInside(
+            "P2", np.array([0.5]), np.array([1.0]),
+            np.array([15000 * aero.ft]))
+        assert not above.any()
+
+    def test_del_still_deletes_aircraft(self, sim):
+        do(sim, "CRE KL1 B744 52 4 90 FL200 250")
+        assert sim.traf.ntraf == 1
+        do(sim, "DEL KL1")
+        assert sim.traf.ntraf == 0
+
+
+class TestConditional:
+    def test_atalt_fires_on_crossing(self, sim):
+        do(sim, "CRE KL1 B744 52 4 90 FL200 250",
+           "KL1 ATALT FL250 KL1 HDG 180",
+           "KL1 ALT FL300")
+        assert sim.cond.ncond == 1
+        sim.op()
+        sim.fastforward()
+        sim.run(until_simt=600.0)
+        assert sim.cond.ncond == 0          # fired and removed
+        i = sim.traf.id2idx("KL1")
+        assert float(sim.traf.state.ap.trk[i]) == pytest.approx(180.0)
+
+    def test_atspd_fires_on_deceleration(self, sim):
+        do(sim, "CRE KL1 B744 52 4 90 FL200 300",
+           "KL1 ATSPD 290 KL1 ALT FL100",
+           "KL1 SPD 220")
+        assert sim.cond.ncond == 1
+        sim.op()
+        sim.fastforward()
+        sim.run(until_simt=600.0)
+        assert sim.cond.ncond == 0
+        i = sim.traf.id2idx("KL1")
+        assert float(sim.traf.state.ac.selalt[i]) == pytest.approx(
+            10000 * aero.ft)
+
+    def test_condition_dropped_with_aircraft(self, sim):
+        do(sim, "CRE KL1 B744 52 4 90 FL200 250",
+           "KL1 ATALT FL250 KL1 HDG 180")
+        assert sim.cond.ncond == 1
+        do(sim, "DEL KL1")
+        assert sim.cond.ncond == 0
+
+
+class TestTrails:
+    def test_segments_accumulate(self, sim):
+        do(sim, "CRE KL1 B744 52 4 90 FL200 250", "TRAIL ON")
+        assert sim.traf.trails.active
+        sim.op()
+        sim.fastforward()
+        sim.run(until_simt=60.0)
+        tr = sim.traf.trails
+        assert len(tr.lat0) >= 4             # dt=10 s over 60 s
+        # segments are contiguous: each starts where the previous ended
+        assert np.all(np.diff(tr.time) >= 0)
+        np.testing.assert_allclose(tr.lat1[:-1], tr.lat0[1:], atol=1e-12)
+
+    def test_trail_color_and_clear(self, sim):
+        do(sim, "CRE KL1 B744 52 4 90 FL200 250", "TRAIL ON",
+           "TRAIL KL1 RED")
+        i = sim.traf.id2idx("KL1")
+        np.testing.assert_array_equal(sim.traf.trails.accolor[i],
+                                      [255, 0, 0])
+        sim.op()
+        sim.fastforward()
+        sim.run(until_simt=60.0)
+        n_fg = len(sim.traf.trails.lat0)
+        assert n_fg > 0
+        do(sim, "TRAILS CLEAR")              # synonym TRAILS -> TRAIL
+        tr = sim.traf.trails
+        assert len(tr.lat0) == 0
+        assert len(tr.bglat0) == n_fg
+
+    def test_off_keeps_anchors_fresh(self, sim):
+        do(sim, "CRE KL1 B744 52 4 90 FL200 250")
+        sim.op()
+        sim.fastforward()
+        sim.run(until_simt=30.0)
+        assert len(sim.traf.trails.lat0) == 0
