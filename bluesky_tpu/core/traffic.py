@@ -55,8 +55,10 @@ class Traffic:
         from .trails import Trails
         self.trails = Trails(self)
         # Observers notified with slot indices on deletion (conditional
-        # commands, AREA plugin, ... — reference cond.delac wiring)
+        # commands, AREA plugin, ... — reference cond.delac wiring) and on
+        # creation flush (slot array; reference TrafficArrays.create cascade)
         self.delete_hooks = []
+        self.create_hooks = []
 
     # ------------------------------------------------------------------ info
     @property
@@ -221,6 +223,8 @@ class Traffic:
         self.state = st.replace(ac=ac, ap=ap, actwp=actwp, asas=asas,
                                 adsb=adsb, perf=perf, route=route)
         self.trails.create(slots, lat, lon, t=float(st.simt))
+        for hook in self.create_hooks:
+            hook(slots)
 
     # ---------------------------------------------------------------- delete
     def delete(self, idx):

@@ -94,6 +94,13 @@ class Simulation:
         # Late import to avoid cycles; stack binds commands to this sim.
         from ..stack.stack import Stack
         self.stack = Stack(self)
+        # Plugin system (discovery + hook scheduling at chunk edges);
+        # enabled_plugins from settings are best-effort (plugin.py:103-105).
+        from ..plugins import PluginManager
+        from .. import settings as _settings
+        self.plugins = PluginManager(self)
+        for pname in getattr(_settings, "enabled_plugins", []):
+            self.plugins.load(pname.upper())
         # Periodic loggers (reference traffic.py:86-89 defaults: SNAPLOG/
         # INSTLOG/SKYLOG) + their auto-registered stack commands.
         from ..utils import datalog
@@ -156,6 +163,9 @@ class Simulation:
         self.stack.reset()
         from ..utils import datalog
         datalog.reset()
+        # After stack.reset: plugin reset hooks may stack commands (e.g.
+        # TRAFGEN redraws its spawn circle) that must survive the reset.
+        self.plugins.reset()
         return True
 
     def fastforward(self, nsec: Optional[float] = None):
@@ -220,6 +230,10 @@ class Simulation:
         if self.traf.trails.active:
             limit = min(limit, max(1, int(round(
                 self.traf.trails.dt / self.cfg.simdt))))
+        # Active plugins run at chunk edges: clamp to their smallest dt.
+        plugdt = self.plugins.min_dt()
+        if plugdt is not None:
+            limit = min(limit, max(1, int(round(plugdt / self.cfg.simdt))))
         tnext = self.stack.next_trigger_time()
         if tnext is not None:
             steps_to_trigger = int(np.ceil(
@@ -232,11 +246,17 @@ class Simulation:
                 self._end_ff()
                 return True
             limit = min(limit, steps_to_stop)
-        chunk = 1
-        for c in self.CHUNK_LADDER:
-            if c <= limit:
-                chunk = c
-                break
+        # Quantize to the ladder; small limits (from plugin/trail dt
+        # clamps — a handful of distinct values per config) run exactly,
+        # so a 0.1 s plugin interval gives 2-step chunks, not 1-step.
+        if limit < self.CHUNK_LADDER[-3]:
+            chunk = max(1, limit)
+        else:
+            chunk = 1
+            for c in self.CHUNK_LADDER:
+                if c <= limit:
+                    chunk = c
+                    break
 
         # Wall-clock pacing (skipped in fast-forward), simulation.py:67-70
         if not self.ffmode and self.dtmult <= 1.0 and self.syst >= 0:
@@ -247,12 +267,19 @@ class Simulation:
             self.syst = time.perf_counter()
         self.syst += chunk * self.cfg.simdt / max(self.dtmult, 1e-9)
 
+        # Plugin preupdate hooks fire before the device chunk
+        # (simulation.py:83)
+        self.plugins.preupdate(self.simt)
+        self.traf.flush()   # preupdate hooks may have queued aircraft
+
         self.traf.state = run_steps(self.traf.state, self.cfg, chunk)
         self._step_count += chunk
 
-        # Chunk-edge subsystems: conditional triggers, trails, loggers
-        # (the reference runs these per 0.05 s step, simulation.py:110-116;
-        # here they sample the chunk-edge state)
+        # Chunk-edge subsystems: plugin updates, conditional triggers,
+        # trails, loggers (the reference runs these per 0.05 s step,
+        # simulation.py:110-116; here they sample the chunk-edge state)
+        self.plugins.update(self.simt)
+        self.traf.flush()
         self.cond.update()
         self.traf.trails.update(self.simt)
         from ..utils import datalog

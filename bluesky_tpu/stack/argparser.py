@@ -214,6 +214,16 @@ class Argparser:
 
     def parse_arg(self, argtype: str, txt: str, sofar: List[Any]):
         t = txt.strip()
+        # Union types 'a/b' (reference e.g. 'acid/txt', 'float/txt'):
+        # first alternative that parses wins.
+        if "/" in argtype:
+            err = None
+            for alt in argtype.split("/"):
+                try:
+                    return self.parse_arg(alt.strip(), txt, sofar)
+                except ArgError as e:
+                    err = e
+            raise err
         try:
             if argtype in ("txt", "string", "word"):
                 return t.upper() if argtype == "txt" else t

@@ -753,6 +753,11 @@ def register_all(stack):
         "ZONER": ["ZONER [radius nm]", "[float]", zoner,
                   "Protected zone radius"],
         "CONFINFO": ["CONFINFO", "", confinfo, "Current conflict counts"],
+        "PLUGINS": ["PLUGINS LIST or PLUGINS LOAD/REMOVE plugin",
+                    "[txt,txt]",
+                    lambda cmd=None, name=None: sim.plugins.manage(
+                        cmd or "LIST", name or ""),
+                    "List, load or remove plugins"],
     })
 
     # Synonyms (reference stack.py:44-115 subset)
@@ -764,5 +769,6 @@ def register_all(stack):
         "CONTINUE": "OP", "SAVE": "SAVEIC", "CLOSE": "QUIT",
         "DELROUTE": "DELRTE", "LOAD": "IC", "OPEN": "IC",
         "TRAILS": "TRAIL", "POLYGON": "POLY", "POLYLINE": "LINE",
-        "POLYLINES": "LINE", "LINES": "LINE",
+        "POLYLINES": "LINE", "LINES": "LINE", "PLUGIN": "PLUGINS",
+        "PLUG-INS": "PLUGINS", "PLUG-IN": "PLUGINS",
     })

@@ -46,6 +46,11 @@ class Stack:
     def append_synonyms(self, syns: Dict[str, str]):
         self.synonyms.update({k.upper(): v.upper() for k, v in syns.items()})
 
+    def remove_commands(self, names):
+        """Remove commands (plugin unload, reference stack remove_commands)."""
+        for n in names:
+            self.cmddict.pop(n.upper(), None)
+
     # ------------------------------------------------------------- stacking
     def stack(self, cmdline: str, sender: str = ""):
         """Append commandline(s) to the pending stack (stack.py:997-1003)."""

@@ -93,6 +93,42 @@ class CSVLogger:
         return False, f"{self.name}: unknown argument {flag}"
 
 
+class EventLogger(CSVLogger):
+    """Event-driven logger: rows are passed explicitly to ``log`` instead
+    of sampled through getters (the reference ``datalog.defineLogger``
+    pattern used by the AREA plugin's FLST log, plugins/area.py:99,144)."""
+
+    def __init__(self, name: str, header: str):
+        super().__init__(name, header, dt=0.0, getters={})
+
+    def log(self, sim, *columns):
+        """Write one row per element; columns are arrays/lists of equal
+        length (scalars broadcast)."""
+        if not self.file or not columns:
+            return
+        simt = sim.simt
+        cols = [np.atleast_1d(np.asarray(c)) for c in columns]
+        nrows = max(c.shape[0] for c in cols)
+        for c in cols:
+            if c.shape[0] not in (1, nrows):
+                raise ValueError(
+                    f"{self.name}: column length {c.shape[0]} != {nrows} "
+                    "(only scalars broadcast)")
+        for r in range(nrows):
+            vals = [f"{simt:.2f}"]
+            for c in cols:
+                vals.append(str(c[min(r, c.shape[0] - 1)]))
+            self.file.write(", ".join(vals) + "\n")
+
+
+def defineLogger(name: str, header: str) -> "EventLogger":
+    """Create-or-get an event logger (reference datalog.defineLogger)."""
+    lg = getlogger(name)
+    if lg is None:
+        lg = EventLogger(name, header)
+    return lg
+
+
 def _traf_getters():
     """Default per-aircraft variable getters (SNAPLOG group,
     traffic.py:94-125)."""

@@ -1,0 +1,167 @@
+"""Plugin system + shipped AREA / TRAFGEN plugins.
+
+Mirrors the reference contract (tools/plugin.py:29-190): AST discovery
+without import, load/remove with stack-command append/removal, hook
+scheduling at per-plugin dt, and the two benchmark-workflow plugins —
+AREA (delete-on-exit + FLST flight statistics, plugins/area.py:47-219)
+and TRAFGEN (source/drain flows, plugins/trafgen.py).
+"""
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from bluesky_tpu.plugins import check_plugin, BUILTIN_PATH
+
+
+@pytest.fixture()
+def sim(tmp_path, monkeypatch):
+    from bluesky_tpu.utils import datalog
+    monkeypatch.setattr(datalog, "log_path", str(tmp_path))
+    from bluesky_tpu.simulation.sim import Simulation
+    return Simulation(nmax=64, dtype=jnp.float64)
+
+
+def do(sim, *lines):
+    for line in lines:
+        sim.stack.stack(line)
+    sim.stack.process()
+    out = "\n".join(sim.scr.echobuf)
+    sim.scr.echobuf.clear()
+    return out
+
+
+class TestDiscovery:
+    def test_builtin_plugins_discovered(self, sim):
+        assert "AREA" in sim.plugins.descriptions
+        assert "TRAFGEN" in sim.plugins.descriptions
+
+    def test_ast_check_reads_name_without_import(self):
+        p = check_plugin(os.path.join(BUILTIN_PATH, "area.py"))
+        assert p is not None
+        assert p.plugin_name == "AREA"
+        assert p.plugin_type == "sim"
+        assert ("AREA", "Define experiment area (area of interest)") \
+            in p.plugin_stack
+
+    def test_non_plugin_rejected(self, tmp_path):
+        f = tmp_path / "notaplugin.py"
+        f.write_text("x = 1\n")
+        assert check_plugin(str(f)) is None
+
+
+class TestLoadRemove:
+    def test_load_registers_commands_and_unload_removes(self, sim):
+        assert "AREA" not in sim.stack.cmddict
+        out = do(sim, "PLUGINS LOAD AREA")
+        assert "Successfully loaded" in out
+        assert "AREA" in sim.stack.cmddict
+        assert "TAXI" in sim.stack.cmddict
+        out = do(sim, "PLUGINS REMOVE AREA")
+        assert "AREA" not in sim.stack.cmddict
+
+    def test_list(self, sim):
+        out = do(sim, "PLUGINS LIST")
+        assert "AREA" in out and "TRAFGEN" in out
+        do(sim, "PLUGINS LOAD AREA")
+        out = do(sim, "PLUGINS")
+        assert "running" in out.lower()
+
+    def test_double_load_rejected(self, sim):
+        do(sim, "PLUGINS LOAD AREA")
+        out = do(sim, "PLUGINS LOAD AREA")
+        assert "already" in out
+
+
+class TestAreaPlugin:
+    def test_delete_on_exit_and_flst_log(self, sim, tmp_path):
+        do(sim, "PLUGINS LOAD AREA")
+        # Small box around the spawn point; aircraft flying east exits fast
+        do(sim, "BOX EXPBOX 51.9 3.9 52.1 4.1",
+           "CRE KL1 B744 52 4 90 FL200 250",
+           "AREA EXPBOX")
+        out = do(sim, "AREA")
+        assert "ON" in out
+        sim.op()
+        sim.fastforward()
+        sim.run(until_simt=120.0)
+        # ~0.1 deg lon at 128 m/s TAS -> exits within ~60 s and is deleted
+        assert sim.traf.ntraf == 0
+        from bluesky_tpu.utils import datalog
+        lg = datalog.getlogger("FLSTLOG")
+        lg.stop()
+        logs = [f for f in os.listdir(tmp_path) if f.startswith("FLSTLOG")]
+        assert logs
+        content = open(tmp_path / logs[0]).read()
+        assert "KL1" in content
+
+    def test_aircraft_inside_not_deleted(self, sim):
+        do(sim, "PLUGINS LOAD AREA",
+           "BOX EXPBOX 40 -10 60 20",
+           "CRE KL1 B744 52 4 90 FL200 250",
+           "AREA EXPBOX")
+        sim.op()
+        sim.fastforward()
+        sim.run(until_simt=60.0)
+        assert sim.traf.ntraf == 1
+
+    def test_area_off(self, sim):
+        do(sim, "PLUGINS LOAD AREA", "BOX EXPBOX 40 -10 60 20",
+           "AREA EXPBOX")
+        out = do(sim, "AREA OFF")
+        assert "OFF" in out
+
+
+class TestTrafgenPlugin:
+    def test_source_flow_spawns_aircraft(self, sim):
+        do(sim, "PLUGINS LOAD TRAFGEN",
+           "TRAFGEN CIRCLE 52 4 100",
+           "TRAFGEN SRC SEGM90 FLOW 3600")   # 1 a/c per second
+        sim.op()
+        sim.fastforward()
+        sim.run(until_simt=30.0)
+        # Poisson(30) spawns: extremely unlikely below 10
+        assert sim.traf.ntraf >= 10
+        # spawned on the circle edge east of centre, flying inward (270)
+        ac = sim.traf.state.ac
+        n = sim.traf.ntraf
+        lons = np.asarray(ac.lon)[np.asarray(ac.active)]
+        assert (lons > 4.5).all()
+
+    def test_drain_spawns_toward_drain(self, sim):
+        do(sim, "PLUGINS LOAD TRAFGEN",
+           "TRAFGEN CIRCLE 52 4 100",
+           "TRAFGEN DRN SEGM270 ORIG SEGM90",
+           "TRAFGEN DRN SEGM270 FLOW 1800")
+        sim.op()
+        sim.fastforward()
+        sim.run(until_simt=30.0)
+        assert sim.traf.ntraf >= 3
+        # aircraft head west (~270) from the east segment toward the drain
+        ac = sim.traf.state.ac
+        active = np.asarray(ac.active)
+        hdgs = np.asarray(ac.hdg)[active]
+        err = (hdgs - 270.0 + 180.0) % 360.0 - 180.0
+        assert np.abs(err).max() < 25.0
+
+    def test_gain_scales_flow(self, sim):
+        do(sim, "PLUGINS LOAD TRAFGEN",
+           "TRAFGEN CIRCLE 52 4 100",
+           "TRAFGEN SRC SEGM0 FLOW 3600",
+           "TRAFGEN GAIN 0")
+        sim.op()
+        sim.fastforward()
+        sim.run(until_simt=20.0)
+        assert sim.traf.ntraf == 0
+
+    def test_runway_queue_respects_takeoff_interval(self, sim):
+        do(sim, "PLUGINS LOAD TRAFGEN",
+           "TRAFGEN CIRCLE 52.3 4.7 100",
+           "TRAFGEN SRC EHAM RWY 18C",
+           "TRAFGEN SRC EHAM FLOW 36000")  # 10/s demand, queueing
+        sim.op()
+        sim.fastforward()
+        sim.run(until_simt=200.0)
+        # dtakeoff=90 s -> at most ceil(200/90)+1 = 4 departures possible
+        assert 1 <= sim.traf.ntraf <= 4
