@@ -18,6 +18,7 @@ Writes are *batched*: stack commands queue slot writes and ``flush()``
 applies them in one ``.at[idx].set`` sweep per field before the next step
 chunk, so a 4000-line scenario costs a handful of device calls, not 4000.
 """
+import os
 from typing import List, Optional
 
 import numpy as np
@@ -42,7 +43,17 @@ class Traffic:
         self.k_partners = k_partners
         self.state: SimState = make_state(nmax, wmax, dtype, rng_seed,
                                           pair_matrix, k_partners)
-        self.coeffdb = perf_coeffs.CoeffDB(openap_path)
+        from .. import settings
+        model = getattr(settings, "performance_model", "openap")
+        if openap_path is None and model == "openap":
+            # Default to the real OpenAP coefficient data when present
+            # (settings.perf_path/OpenAP; reference coeff.py:7,16-19),
+            # falling back to the built-in approximate tables.
+            cand = os.path.join(settings.perf_path, "OpenAP")
+            if os.path.isdir(os.path.join(cand, "fixwing")):
+                openap_path = cand
+        self.coeffdb = perf_coeffs.CoeffDB(openap_path, model=model,
+                                           perf_path=settings.perf_path)
         self.area = area  # default creation area (lat0, lat1, lon0, lon1)
         self._rng = np.random.default_rng(rng_seed)
         # Host-side per-slot bookkeeping

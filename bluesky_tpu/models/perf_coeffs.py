@@ -157,8 +157,10 @@ def load_openap_dir(path: str) -> Dict[str, dict]:
                 d['vminer'] = min(float(wrap['cl_v_cas_const']['min']),
                                   float(wrap['cr_v_cas_mean']['min']),
                                   float(wrap['de_v_cas_const']['min']))
-                d['vmaxer'] = max(float(wrap['cl_v_cas_const']['max']),
-                                  float(wrap['cr_v_cas_max']['max']),
+                # NB: the reference takes the MIN of the phase maxima
+                # (coeff.py:91-94) — kept for parity.
+                d['vmaxer'] = min(float(wrap['cl_v_cas_const']['max']),
+                                  float(wrap['cr_v_cas_mean']['max']),
                                   float(wrap['de_v_cas_const']['max']))
                 d['vminap'] = float(wrap['fa_va_avg']['min'])
                 d['vmaxap'] = float(wrap['fa_va_avg']['max'])
@@ -172,7 +174,8 @@ def load_openap_dir(path: str) -> Dict[str, dict]:
                                  float(wrap['de_vz_avg_after_cas']['min']),
                                  float(wrap['de_vz_avg_cas_const']['min']),
                                  float(wrap['de_vz_avg_mach_const']['min']))
-                d['hmax'] = float(wrap['cr_h_max']['max']) * 1000.0
+                d['hmax'] = float(wrap['cr_h_max']['opt']) * 1000.0
+                d['axmax'] = float(wrap['to_acc_tof']['max'])
             except KeyError:
                 pass
         # Fill any missing keys from the generic default
@@ -184,11 +187,37 @@ def load_openap_dir(path: str) -> Dict[str, dict]:
 
 
 class CoeffDB:
-    """Merged coefficient database: BUILTIN overridden by loaded OpenAP data."""
+    """Merged coefficient database: BUILTIN overridden by model data.
 
-    def __init__(self, openap_path: Optional[str] = None):
+    ``model`` selects the source (reference traffic.py:39-52 switch):
+    'openap' loads the OpenAP directory; 'bs'/'legacy' loads the BS
+    conceptual-design XML database mapped onto the generic columns
+    (models/coeff_bs.py bs_to_generic); 'bada' loads proprietary BADA
+    OPF/APF data when present.  Unknown types fall back to 'NA'
+    (the reference's default-B744 behavior, perfbs.py:115-121).
+    """
+
+    def __init__(self, openap_path: Optional[str] = None,
+                 model: str = "openap", perf_path: Optional[str] = None):
         self.table = dict(BUILTIN)
-        if openap_path:
+        self.model = model
+        self.bada_synonyms, self.bada_coeffs = {}, {}
+        if model in ("bs", "legacy") and perf_path:
+            from . import coeff_bs
+            bsdir = os.path.join(perf_path, "BS")
+            self.table.update({t: coeff_bs.bs_to_generic(d)
+                               for t, d in
+                               coeff_bs.load_bs_dir(bsdir).items()})
+        elif model == "bada" and perf_path:
+            from . import coeff_bada
+            syn, coeffs = coeff_bada.load_bada_dir(
+                os.path.join(perf_path, "BADA"))
+            self.bada_synonyms, self.bada_coeffs = syn, coeffs
+            for code in syn:
+                d = coeff_bada.get_coefficients(syn, coeffs, code)
+                if d is not None:
+                    self.table[code.upper()] = coeff_bada.bada_to_generic(d)
+        elif openap_path:
             self.table.update(load_openap_dir(openap_path))
 
     def get(self, actype: str) -> dict:

@@ -1,0 +1,90 @@
+"""BADA 3 thrust and fuel-flow kernels.
+
+Elementwise jnp parity with the physics block of the reference
+``traffic/performance/bada/perfbada.py:390-520`` (BADA User Manual 3.12):
+max-climb thrust by engine type (jet / turboprop / piston), level and
+phase-dependent descent thrust, reduced-climb-power correction, and
+thrust-specific fuel consumption with nominal / minimal / cruise /
+approach regimes.
+
+Inputs are per-aircraft coefficient columns (from models/coeff_bada.py)
+and state arrays; everything is masked select over the padded axis, so
+the whole block fuses into the scanned step.
+"""
+import jax.numpy as jnp
+
+from . import aero
+from .perf_legacy import PHASE_CR, PHASE_AP, PHASE_LD, PHASE_GD
+
+
+def max_climb_thrust(alt, tas, jet, turbo, piston, ctcth1, ctcth2, ctcth3):
+    """Max climb (= max available) thrust in ISA [N]
+    (perfbada.py:404-429; BADA 3.12 p.32)."""
+    h_ft = alt / aero.ft
+    tas_kt = jnp.maximum(1.0, tas / aero.kts)
+    tj = ctcth1 * (1.0 - h_ft / ctcth2 + ctcth3 * h_ft * h_ft)
+    tt = ctcth1 / tas_kt * (1.0 - h_ft / ctcth2) + ctcth3
+    tp = ctcth1 * (1.0 - h_ft / ctcth2) + ctcth3 / tas_kt
+    return jnp.where(jet, tj, jnp.where(turbo, tt, tp * piston))
+
+
+def thrust(phase, climb, descent, lvl, alt, tas, drag, jet, turbo, piston,
+           ctcth1, ctcth2, ctcth3, ctdesl, ctdesh, ctdesa, ctdesld,
+           hpdes):
+    """Thrust by flight condition (perfbada.py:404-458).
+
+    Returns (thr, maxthr).  ``lvl`` = level flight mask.
+    """
+    h_ft = alt / aero.ft
+    tas_kt = jnp.maximum(1.0, tas / aero.kts)
+    tj = ctcth1 * (1.0 - h_ft / ctcth2 + ctcth3 * h_ft * h_ft)
+    tt = ctcth1 / tas_kt * (1.0 - h_ft / ctcth2) + ctcth3
+    tp = ctcth1 * (1.0 - h_ft / ctcth2) + ctcth3 / tas_kt
+    tjc = (climb & jet) * tj
+    ttc = (climb & turbo) * tt
+    tpc = (climb & piston) * tp
+    maxthr = tj * jet + tt * turbo + tp * piston
+
+    tlvl = lvl * drag
+
+    delh = alt - hpdes
+    high = delh > 0.0
+    low = delh < 0.0
+    tdesh = maxthr * ctdesh * (descent & high)
+    tdeslc = maxthr * ctdesl * (descent & low & (phase == PHASE_CR))
+    tdesla = maxthr * ctdesa * (descent & low & (phase == PHASE_AP))
+    tdesll = maxthr * ctdesld * (descent & low & (phase == PHASE_LD))
+    tgd = jnp.minimum(tdesh, tdeslc) * (phase == PHASE_GD)
+
+    thr = jnp.max(jnp.stack([tjc, ttc, tpc, tlvl, tdesh, tdeslc,
+                             tdesla, tdesll, tgd]), axis=0)
+    return thr, maxthr
+
+
+def reduced_climb_power(alt, hmaxact, climb, cred, mass, mmin, mmax):
+    """Reduced-climb-power factor cpred (perfbada.py:462-469)."""
+    clh = (alt < hmaxact * 0.8) & climb
+    c = cred * clh
+    return 1.0 - c * ((mmax - mass) / (mmax - mmin))
+
+
+def fuelflow(phase, alt, tas, thr, jet, turbo, piston, cf1, cf2, cf3, cf4,
+             cf_cruise):
+    """Fuel flow by regime (perfbada.py:483-520).
+
+    Returns (fnom, fmin, fcr, fal): nominal, minimal, cruise, and
+    approach/landing fuel flows [kg/s equivalent of the reference's
+    units]; the caller selects per phase like perfbada.py:523-535.
+    """
+    tas_kt = tas / aero.kts
+    h_ft = alt / aero.ft
+    etaj = cf1 * (1.0 + tas_kt / cf2)
+    etat = cf1 * (1.0 - tas_kt / cf2) * (tas_kt / 1000.0)
+    eta = jnp.maximum(etaj * jet, etat * turbo) / 1000.0
+
+    jt = jet | turbo
+    fnom = eta * thr * jt + cf1 * piston
+    fmin = cf3 * (1.0 - h_ft / cf4) * jt + cf3 * piston
+    fcr = eta * thr * cf_cruise * jt + cf1 * cf_cruise * piston
+    fal = jnp.maximum(fnom, fmin)
+    return fnom, fmin, fcr, fal

@@ -183,6 +183,9 @@ class PluginManager:
                     raise
             config, stackfuns = mod.init_plugin(self.sim)
         except Exception as e:
+            # Strip any traffic hooks a half-initialized plugin attached
+            del traf.create_hooks[n_create_hooks:]
+            del traf.delete_hooks[n_delete_hooks:]
             return False, f"Failed to load {name}: {e}"
         self.active[name] = mod
         self._hooks = getattr(self, "_hooks", {})

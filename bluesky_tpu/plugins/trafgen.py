@@ -75,7 +75,6 @@ class Flowpoint:
             if not self.incircle:
                 # Project to the circle edge segment toward the point
                 # (trafgenclasses.py:58-64)
-                from ..ops.geo import kwikdist_wrapped
                 brg = _bearing(gen.ctrlat, gen.ctrlon, self.lat, self.lon)
                 self.lat, self.lon = gen.segpos(brg)
                 self.hdg = (brg + 180.0) % 360.0

@@ -221,19 +221,23 @@ class Simulation:
         if self.ffmode:
             chunk = max(chunk, 1000)
         limit = chunk
-        # Pending conditional commands quantize their fire time to the
-        # chunk edge: clamp to <= 1 s of sim time while any are armed.
+        # Subsystem dt clamps (conditionals <= 1 s, trail resolution,
+        # smallest plugin interval).  These derive from a handful of
+        # stable per-config dt values, so running them as EXACT step
+        # counts costs a bounded number of extra compilations — tracked
+        # separately from trigger distances, which are arbitrary.
+        dtclamp = None
         if self.cond.ncond > 0:
-            limit = min(limit, max(1, int(round(1.0 / self.cfg.simdt))))
-        # Trails sample positions at chunk edges: keep the chunk at or
-        # below the trail resolution so fast-forward doesn't coarsen them.
+            dtclamp = max(1, int(round(1.0 / self.cfg.simdt)))
         if self.traf.trails.active:
-            limit = min(limit, max(1, int(round(
-                self.traf.trails.dt / self.cfg.simdt))))
-        # Active plugins run at chunk edges: clamp to their smallest dt.
+            c = max(1, int(round(self.traf.trails.dt / self.cfg.simdt)))
+            dtclamp = c if dtclamp is None else min(dtclamp, c)
         plugdt = self.plugins.min_dt()
         if plugdt is not None:
-            limit = min(limit, max(1, int(round(plugdt / self.cfg.simdt))))
+            c = max(1, int(round(plugdt / self.cfg.simdt)))
+            dtclamp = c if dtclamp is None else min(dtclamp, c)
+        if dtclamp is not None:
+            limit = min(limit, dtclamp)
         tnext = self.stack.next_trigger_time()
         if tnext is not None:
             steps_to_trigger = int(np.ceil(
@@ -246,17 +250,19 @@ class Simulation:
                 self._end_ff()
                 return True
             limit = min(limit, steps_to_stop)
-        # Quantize to the ladder; small limits (from plugin/trail dt
-        # clamps — a handful of distinct values per config) run exactly,
-        # so a 0.1 s plugin interval gives 2-step chunks, not 1-step.
-        if limit < self.CHUNK_LADDER[-3]:
-            chunk = max(1, limit)
-        else:
-            chunk = 1
-            for c in self.CHUNK_LADDER:
-                if c <= limit:
-                    chunk = c
-                    break
+        # Quantize to the ladder — EXCEPT when the binding constraint is
+        # a dt clamp, which runs exactly (a 0.1 s plugin interval gives
+        # 2-step chunks, not 1-step).  Arbitrary trigger distances stay
+        # ladder-quantized so scenarios can't force a compile per
+        # distinct distance (run_steps nsteps is a static jit arg).
+        chunk = 1
+        for c in self.CHUNK_LADDER:
+            if c <= limit:
+                chunk = c
+                break
+        if dtclamp is not None and limit == dtclamp \
+                and dtclamp < self.CHUNK_LADDER[-3] and chunk < limit:
+            chunk = limit
 
         # Wall-clock pacing (skipped in fast-forward), simulation.py:67-70
         if not self.ffmode and self.dtmult <= 1.0 and self.syst >= 0:

@@ -63,6 +63,62 @@ def load():
     return geo, aero, sbcd
 
 
+def load_openap_coeff():
+    """Load the real reference OpenAP ``Coefficient`` class
+    (traffic/performance/openap/coeff.py) against the real data directory.
+
+    Requires pandas (present in the image).  The reference reads its data
+    paths from ``bluesky.settings``; the stub settings module provides the
+    ``set_variable_defaults`` contract.
+    """
+    settings = _settings_stub()
+    settings.perf_path_openap = "/root/reference/data/performance/OpenAP"
+    coeff = _load("bluesky.traffic.performance.openap.coeff",
+                  f"{REF_ROOT}/traffic/performance/openap/coeff.py")
+    return coeff.Coefficient()
+
+
+def _settings_stub():
+    bs = _ensure_pkg("bluesky")
+    if "bluesky.settings" not in sys.modules:
+        settings = types.ModuleType("bluesky.settings")
+
+        def set_variable_defaults(**kw):
+            for k, v in kw.items():
+                if not hasattr(settings, k):
+                    setattr(settings, k, v)
+
+        settings.set_variable_defaults = set_variable_defaults
+        sys.modules["bluesky.settings"] = settings
+        bs.settings = settings
+    return sys.modules["bluesky.settings"]
+
+
+def load_legacy_performance():
+    """The real legacy helpers module (phases/esf/calclimits),
+    traffic/performance/legacy/performance.py."""
+    load()   # bluesky.tools.aero must exist first
+    _settings_stub()
+    _ensure_pkg("bluesky.traffic")
+    _ensure_pkg("bluesky.traffic.performance")
+    _ensure_pkg("bluesky.traffic.performance.legacy")
+    return _load("bluesky.traffic.performance.legacy.performance",
+                 f"{REF_ROOT}/traffic/performance/legacy/performance.py")
+
+
+def load_coeff_bs():
+    """The real CoeffBS class parsed over the real BS XML data."""
+    perf = load_legacy_performance()   # noqa: F841  (package sibling)
+    settings = _settings_stub()
+    settings.perf_path = "/root/reference/data/performance"
+    settings.verbose = False
+    mod = _load("bluesky.traffic.performance.legacy.coeff_bs",
+                f"{REF_ROOT}/traffic/performance/legacy/coeff_bs.py")
+    c = mod.CoeffBS()
+    c.coeff()
+    return c
+
+
 def make_ownship(lat, lon, trk, gs, alt, vs, acid=None):
     """Duck-typed stand-in for the reference Traffic object: the attribute
     subset ``StateBasedCD.detect`` reads (StateBasedCD.py:11-101)."""

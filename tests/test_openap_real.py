@@ -1,0 +1,99 @@
+"""Golden tests: OpenAP coefficient loading vs the REAL reference code+data.
+
+``load_openap_dir`` (models/perf_coeffs.py) parses the actual
+``/root/reference/data/performance/OpenAP`` directory; the oracle is the
+reference's own ``Coefficient`` class (openap/coeff.py) run on the same
+data.  Every envelope value must match exactly for every fixwing type the
+reference loads (VERDICT round-1 item 5).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import ref_oracle
+from bluesky_tpu.models.perf_coeffs import CoeffDB, load_openap_dir
+
+OPENAP_DIR = "/root/reference/data/performance/OpenAP"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(os.path.join(OPENAP_DIR, "fixwing")),
+    reason="reference OpenAP data not mounted")
+
+
+@pytest.fixture(scope="module")
+def ref_coeff():
+    return ref_oracle.load_openap_coeff()
+
+
+@pytest.fixture(scope="module")
+def ours():
+    return load_openap_dir(OPENAP_DIR)
+
+
+ENVELOPE_KEYS = ["vminto", "vmaxto", "vminic", "vmaxic", "vminer",
+                 "vmaxer", "vminap", "vmaxap", "vminld", "vmaxld",
+                 "vsmin", "vsmax", "hmax", "axmax"]
+
+
+def test_all_reference_types_loaded(ref_coeff, ours):
+    missing = set(ref_coeff.limits_fixwing) - set(ours)
+    assert not missing, f"types the reference loads but we don't: {missing}"
+    assert len(ours) >= 20
+
+
+def test_envelope_values_match_reference_exactly(ref_coeff, ours):
+    for mdl, lim in ref_coeff.limits_fixwing.items():
+        d = ours[mdl]
+        for key in ENVELOPE_KEYS:
+            assert d[key] == pytest.approx(float(lim[key]), abs=0.0), \
+                f"{mdl}.{key}: ours {d[key]} vs reference {lim[key]}"
+
+
+def test_engine_selection_matches_reference(ref_coeff, ours):
+    """The loader picks the same engine the reference's first-listed-match
+    rule picks (coeff.py:55-61, last row of startswith matches)."""
+    for mdl, ac in ref_coeff.acs_fixwing.items():
+        if mdl not in ours or not ac["engines"]:
+            continue
+        first_engine = next(iter(ac["engines"].values()))
+        d = ours[mdl]
+        assert d["engthr"] == pytest.approx(float(first_engine["thr"])), mdl
+        assert d["engbpr"] == pytest.approx(float(first_engine["bpr"])), mdl
+        for ff in ("ff_to", "ff_co", "ff_app", "ff_idl"):
+            assert d[ff] == pytest.approx(float(first_engine[ff])), \
+                f"{mdl}.{ff}"
+
+
+def test_dragpolar_matches_reference(ref_coeff, ours):
+    for mdl, dp in ref_coeff.dragpolar_fixwing.items():
+        if mdl == "NA" or mdl not in ours:
+            continue
+        for key in ("cd0_clean", "cd0_gd", "cd0_to", "cd0_ic",
+                    "cd0_ap", "cd0_ld", "k"):
+            assert ours[mdl][key] == pytest.approx(float(dp[key])), \
+                f"{mdl}.{key}"
+
+
+def test_airframe_basics_match(ref_coeff, ours):
+    for mdl, ac in ref_coeff.acs_fixwing.items():
+        if mdl not in ours:
+            continue
+        assert ours[mdl]["wa"] == pytest.approx(float(ac["wa"])), mdl
+        assert ours[mdl]["mtow"] == pytest.approx(float(ac["mtow"])), mdl
+        assert ours[mdl]["oew"] == pytest.approx(float(ac["oew"])), mdl
+        assert ours[mdl]["n_engines"] == int(ac["n_engines"]), mdl
+
+
+def test_traffic_defaults_to_real_coefficients():
+    """With the data mounted, a fresh Traffic uses real per-type values
+    (not the approximate builtin) — e.g. the A320's real 145 m/s vmaxer."""
+    import jax.numpy as jnp
+    from bluesky_tpu.core.traffic import Traffic
+    traf = Traffic(nmax=4, dtype=jnp.float64)
+    assert "A320" in traf.coeffdb.table
+    traf.create(1, "A320", 9000.0, 120.0, None, 52.0, 4.0, 90.0, "TST1")
+    traf.flush()
+    i = traf.id2idx("TST1")
+    assert float(traf.state.perf.vmaxer[i]) == pytest.approx(145.0)
+    assert float(traf.state.perf.mass[i]) > 50000.0

@@ -1,0 +1,447 @@
+"""Golden tests: legacy BS + BADA performance machinery vs the REAL
+reference code (and real BS XML data).
+
+- coeff_bs loader vs the reference ``CoeffBS`` parsing the same
+  ``data/performance/BS`` XML files.
+- phases/esf/calclimits kernels (ops/perf_legacy.py) vs the reference
+  ``legacy/performance.py`` functions on randomized state arrays.
+- fwparser + BADA OPF/APF parsing vs the reference ``tools/fwparser.py``
+  + ``ACData`` on synthetic files in the exact BADA fixed-width format
+  (the proprietary BADA data itself is not shipped).
+- BADA thrust/fuelflow kernels vs the reference formulas.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import ref_oracle
+from bluesky_tpu.models import coeff_bs as mbs
+from bluesky_tpu.models import coeff_bada as mbada
+from bluesky_tpu.models.fwparser import FixedWidthParser
+from bluesky_tpu.ops import aero, perf_legacy, perf_bada
+
+BS_DIR = "/root/reference/data/performance/BS"
+
+
+# ------------------------------------------------------------- coeff_bs
+class TestCoeffBS:
+    @pytest.fixture(scope="class")
+    def ref(self):
+        return ref_oracle.load_coeff_bs()
+
+    @pytest.fixture(scope="class")
+    def ours(self):
+        return mbs.load_bs_dir(BS_DIR)
+
+    def test_all_types_loaded(self, ref, ours):
+        assert set(ours) == set(t.upper() for t in ref.atype)
+
+    def test_airframe_values_match(self, ref, ours):
+        for i, atype in enumerate(ref.atype):
+            d = ours[atype.upper()]
+            for ref_name, our_name in [
+                    ("MTOW", "mtow"), ("Sref", "sref"), ("CD0", "cd0"),
+                    ("k", "k"), ("vmto", "vmto"), ("vmld", "vmld"),
+                    ("clmax_cr", "clmax_cr"), ("max_spd", "max_spd"),
+                    ("max_Ma", "max_mach"), ("max_alt", "max_alt"),
+                    ("cr_Ma", "cr_mach"), ("cr_spd", "cr_spd"),
+                    ("gr_acc", "gr_acc"), ("gr_dec", "gr_dec"),
+                    ("n_eng", "n_eng")]:
+                want = float(getattr(ref, ref_name)[i])
+                got = float(d[our_name])
+                assert got == pytest.approx(want, rel=1e-12), \
+                    f"{atype}.{our_name}"
+
+    def test_engine_merge_matches_reference_lists(self, ref, ours):
+        checked = 0
+        for atype, d in ours.items():
+            eng = d.get("engine")
+            if eng is None or eng["eng_type"] != 1:
+                continue
+            # first available engine (coeff_bs.py "first engine is taken")
+            assert eng["name"] == next(
+                e for e in d["engines"] if e in
+                [n for n in ref.enlist])
+            j = ref.jetenlist.index(eng["name"])
+            assert eng["thr"] == pytest.approx(float(ref.rThr[j]))
+            assert eng["sfc"] == pytest.approx(float(ref.SFC[j]))
+            for our_k, ref_arr in [("ff_to", ref.ffto), ("ff_cl", ref.ffcl),
+                                   ("ff_cr", ref.ffcr), ("ff_ap", ref.ffap),
+                                   ("ff_id", ref.ffid)]:
+                assert eng[our_k] == pytest.approx(float(ref_arr[j])), \
+                    f"{atype} {our_k}"
+            checked += 1
+        assert checked >= 5
+
+    def test_drag_scaling_tables_match(self, ref, ours):
+        np.testing.assert_allclose(mbs.D_CD0_JET, ref.d_CD0j)
+        np.testing.assert_allclose(mbs.D_K_JET, ref.d_kj)
+        np.testing.assert_allclose(mbs.D_CD0_TP, ref.d_CD0t)
+        np.testing.assert_allclose(mbs.D_K_TP, ref.d_kt)
+
+
+# --------------------------------------------------- phase/esf/limits
+def _rand_state(n, seed):
+    rng = np.random.default_rng(seed)
+    ft, kts = aero.ft, aero.kts
+    alt = rng.uniform(0.0, 40000.0, n) * ft
+    alt[rng.random(n) < 0.1] = 0.0                      # some on ground
+    gs = rng.uniform(0.0, 260.0, n)
+    delalt = rng.uniform(-3000.0, 3000.0, n) * ft
+    delalt[rng.random(n) < 0.2] = 0.0
+    cas = rng.uniform(50.0, 200.0, n)
+    return alt, gs, delalt, cas
+
+
+class TestLegacyKernels:
+    @pytest.fixture(scope="class")
+    def refperf(self):
+        return ref_oracle.load_legacy_performance()
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_phases_matches_reference(self, refperf, seed):
+        n = 300
+        alt, gs, delalt, cas = _rand_state(n, seed)
+        rng = np.random.default_rng(seed + 100)
+        vm = {k: rng.uniform(40.0, 90.0, n) for k in
+              ("vmto", "vmic", "vmap", "vmcr", "vmld")}
+        bphase = np.radians([15.0, 35.0, 35.0, 35.0, 15.0, 15.0])
+        swhdgsel = rng.random(n) < 0.5
+        for bada in (False, True):
+            bank_ref = np.zeros(n)
+            ph_ref, bank_ref = refperf.phases(
+                alt, gs, delalt, cas, vm["vmto"], vm["vmic"], vm["vmap"],
+                vm["vmcr"], vm["vmld"], bank_ref.copy(), bphase,
+                swhdgsel, bada)
+            ph, bank = perf_legacy.phases(
+                jnp.asarray(alt), jnp.asarray(gs), jnp.asarray(delalt),
+                jnp.asarray(cas), *(jnp.asarray(vm[k]) for k in
+                                    ("vmto", "vmic", "vmap", "vmcr",
+                                     "vmld")),
+                jnp.zeros(n), bphase, jnp.asarray(swhdgsel), bada)
+            np.testing.assert_array_equal(np.asarray(ph), ph_ref,
+                                          err_msg=f"bada={bada}")
+            np.testing.assert_allclose(np.asarray(bank), bank_ref,
+                                       rtol=1e-12)
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_esf_matches_reference(self, refperf, seed):
+        n = 300
+        rng = np.random.default_rng(seed)
+        alt = rng.uniform(0.0, 14000.0, n)
+        mach = rng.uniform(0.2, 0.9, n)
+        abco = rng.random(n) < 0.5
+        belco = ~abco
+        climb = rng.random(n) < 0.4
+        descent = ~climb & (rng.random(n) < 0.5)
+        delspd = rng.choice([-5.0, 0.0, 5.0], n)
+        want = refperf.esf(abco, belco, alt, mach, climb, descent, delspd)
+        got = perf_legacy.esf(jnp.asarray(abco), jnp.asarray(belco),
+                              jnp.asarray(alt), jnp.asarray(mach),
+                              jnp.asarray(climb), jnp.asarray(descent),
+                              jnp.asarray(delspd))
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-12)
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_calclimits_matches_reference(self, refperf, seed):
+        n = 300
+        rng = np.random.default_rng(seed)
+        desspd = rng.uniform(40.0, 220.0, n)
+        gs = rng.uniform(0.0, 250.0, n)
+        to_spd = rng.uniform(60.0, 90.0, n)
+        vmin = rng.uniform(45.0, 80.0, n)
+        vmo = rng.uniform(150.0, 200.0, n)
+        mmo = rng.uniform(0.7, 0.9, n)
+        mach = rng.uniform(0.2, 0.95, n)
+        alt = rng.uniform(0.0, 13000.0, n)
+        hmaxact = rng.uniform(9000.0, 13000.0, n)
+        desalt = rng.uniform(0.0, 14000.0, n)
+        desvs = rng.choice([-5.0, 0.0, 8.0], n)
+        maxthr = rng.uniform(80000.0, 250000.0, n)
+        thr = maxthr * rng.uniform(0.3, 1.2, n)
+        drag = rng.uniform(20000.0, 90000.0, n)
+        tas = rng.uniform(60.0, 250.0, n)
+        mass = rng.uniform(40000.0, 200000.0, n)
+        esf_ = rng.uniform(0.3, 1.7, n)
+        phase = rng.integers(0, 7, n)
+
+        want = refperf.calclimits(desspd, gs, to_spd, vmin, vmo, mmo,
+                                  mach, alt, hmaxact, desalt, desvs,
+                                  maxthr, thr, drag, tas, mass, esf_,
+                                  phase)
+        got = perf_legacy.calclimits(
+            *(jnp.asarray(x) for x in
+              (desspd, gs, to_spd, vmin, vmo, mmo, mach, alt, hmaxact,
+               desalt, desvs, maxthr, thr, drag, tas, mass, esf_, phase)))
+        names = ["limspd", "limspd_flag", "limalt", "limalt_flag",
+                 "limvs", "limvs_flag"]
+        for g, w, name in zip(got, want, names):
+            np.testing.assert_allclose(np.asarray(g, dtype=np.float64),
+                                       np.asarray(w, dtype=np.float64),
+                                       rtol=1e-12, err_msg=name)
+
+
+# ------------------------------------------------------------ BADA OPF
+def _f10(x):
+    return f"{x:10.5G}"
+
+
+def _opf_lines():
+    """A synthetic A320-ish OPF in the exact BADA fixed-width layout."""
+    pad = " "
+    L = []
+    L.append(f"CD {pad:2}A320__{pad:9}2{pad:12}Jet{pad:6}{pad:17}M")
+    L.append("CD  " + "   " + _f10(64.0) + "   " + _f10(39.0) + "   "
+             + _f10(77.0) + "   " + _f10(21.5) + "   " + _f10(0.2))
+    L.append("CD  " + "   " + _f10(350.0) + "   " + _f10(0.82) + "   "
+             + _f10(41000.0) + "   " + _f10(38000.0) + "   "
+             + _f10(-121.0))
+    L.append("CD  " + "   " + _f10(122.6) + "   " + _f10(1.4) + "   "
+             + _f10(13.2) + "   " + _f10(0.0))
+    for vstall, cd0, cd2 in [(145.0, 0.024, 0.0375),   # CR
+                             (117.0, 0.023, 0.0414),   # IC
+                             (114.0, 0.038, 0.0412),   # TO
+                             (108.0, 0.042, 0.0424),   # AP
+                             (101.0, 0.076, 0.0413)]:  # LD
+        L.append("CD" + " " * 15 + "   " + _f10(vstall) + "   "
+                 + _f10(cd0) + "   " + _f10(cd2))
+    L += ["CD" + " " * 50] * 3
+    L.append("CD" + " " * 31 + _f10(0.0288))
+    L += ["CD" + " " * 50] * 2
+    L.append("CD  " + "   " + _f10(136000.0) + "   " + _f10(52238.0)
+             + "   " + _f10(2.67e-11) + "   " + _f10(10.8) + "   "
+             + _f10(0.0107))
+    L.append("CD  " + "   " + _f10(0.0297) + "   " + _f10(0.955) + "   "
+             + _f10(8000.0) + "   " + _f10(0.122) + "   " + _f10(0.288))
+    L.append("CD  " + "   " + _f10(300.0) + "   " + _f10(0.78))
+    L.append("CD  " + "   " + _f10(0.697) + "   " + _f10(1068.0))
+    L.append("CD  " + "   " + _f10(12.9) + "   " + _f10(64430.0))
+    L.append("CD" + " " * 5 + _f10(0.92958))
+    L.append("CD  " + "   " + _f10(2190.0) + "   " + _f10(1440.0)
+             + "   " + _f10(34.1) + "   " + _f10(37.57))
+    return L
+
+
+def _apf_lines():
+    def prof(v1, v2, m):
+        return ("CD" + " " * 25 + f"{v1:3d} {v2:3d} {m:2d}" + " " * 10
+                + f"{v1:3d} {v2:3d} {m:2d}  {m:2d} {v1:3d} {v2:3d}")
+    return [
+        "CD  A32 1 " + " " * 4 + "A320 profile   ",
+        prof(250, 310, 78),
+        prof(250, 310, 78),
+        prof(250, 300, 78),
+    ]
+
+
+class TestBadaParsing:
+    @pytest.fixture(scope="class")
+    def opf_file(self, tmp_path_factory):
+        p = tmp_path_factory.mktemp("bada") / "A320__.OPF"
+        p.write_text("\n".join(_opf_lines()) + "\n")
+        return str(p)
+
+    @pytest.fixture(scope="class")
+    def apf_file(self, opf_file):
+        import os
+        p = opf_file[:-4] + ".APF"
+        with open(p, "w") as f:
+            f.write("\n".join(_apf_lines()) + "\n")
+        return p
+
+    def test_opf_matches_reference_parser(self, opf_file):
+        """Our fwparser + parse_opf vs the reference fwparser + ACData."""
+        ref_fw = ref_oracle._load(
+            "bluesky.tools.fwparser",
+            f"{ref_oracle.REF_ROOT}/tools/fwparser.py")
+        ref_cb = ref_oracle._load(
+            "bluesky.traffic.performance.bada.coeff_bada_oracle",
+            f"{ref_oracle.REF_ROOT}/traffic/performance/bada/coeff_bada.py")
+        ref_data = ref_cb.opf_parser.parse(opf_file)
+        ac = ref_cb.ACData()
+        ac.setOPFData(ref_data)
+
+        d = mbada.parse_opf(opf_file)
+        assert d["actype"] == ac.actype.strip("_")
+        assert d["neng"] == ac.neng
+        assert d["m_ref"] == pytest.approx(ac.m_ref)
+        assert d["m_max"] == pytest.approx(ac.m_max)
+        assert d["vmo"] == pytest.approx(ac.VMO)
+        assert d["mmo"] == pytest.approx(ac.MMO)
+        assert d["S"] == pytest.approx(ac.S)
+        assert d["cd0_cr"] == pytest.approx(ac.CD0_cr)
+        assert d["cd2_ld"] == pytest.approx(ac.CD2_ld)
+        assert d["cd0_gear"] == pytest.approx(ac.CD0_gear)
+        assert d["ctc"] == pytest.approx(list(ac.CTC))
+        assert d["ctdes_low"] == pytest.approx(ac.CTdes_low)
+        assert d["hp_des"] == pytest.approx(ac.Hp_des)
+        assert d["cf1"] == pytest.approx(ac.Cf1)
+        assert d["cf_cruise"] == pytest.approx(ac.Cf_cruise)
+        assert d["tol"] == pytest.approx(ac.TOL)
+        assert d["wingspan"] == pytest.approx(ac.wingspan)
+
+    def test_apf_matches_reference_parser(self, opf_file, apf_file):
+        ref_cb = ref_oracle._load(
+            "bluesky.traffic.performance.bada.coeff_bada_oracle",
+            f"{ref_oracle.REF_ROOT}/traffic/performance/bada/coeff_bada.py")
+        ac = ref_cb.ACData()
+        ac.setAPFData(ref_cb.apf_parser.parse(apf_file))
+        d = mbada.parse_apf(apf_file)
+        assert list(d["cascl1"]) == list(ac.CAScl1)
+        assert list(d["mcl"]) == pytest.approx(list(ac.Mcl))
+        assert list(d["casdes1"]) == list(ac.CASdes1)
+
+    def test_load_dir_with_synonym(self, opf_file, tmp_path_factory):
+        import os
+        import shutil
+        d = tmp_path_factory.mktemp("badadir")
+        shutil.copy(opf_file, d / "A320__.OPF")
+        # SYNONYM.NEW line: CD, 1X, 1S, 1X, 4S, 3X, 18S, 1X, 25S, 1X, 6S, 2X, 1S
+        syn = ("CD - A320   AIRBUS" + " " * 12 + " A-320" + " " * 20
+               + " A320__  Y")
+        (d / "SYNONYM.NEW").write_text(syn + "\n")
+        synonyms, coeffs = mbada.load_bada_dir(str(d))
+        assert "A320" in synonyms
+        assert "A320" in coeffs
+        got = mbada.get_coefficients(synonyms, coeffs, "A320")
+        assert got is not None and got["m_ref"] == pytest.approx(64.0)
+
+    def test_missing_dir_returns_empty(self):
+        syn, coeffs = mbada.load_bada_dir("/nonexistent")
+        assert syn == {} and coeffs == {}
+
+
+class TestModelSelection:
+    def test_bs_model_uses_real_xml_data(self, monkeypatch):
+        """settings.performance_model='bs' flies aircraft on the real
+        BS database values (reference traffic.py:39-52 model switch)."""
+        import jax.numpy as jnp
+        from bluesky_tpu import settings
+        from bluesky_tpu.core.traffic import Traffic
+        monkeypatch.setattr(settings, "performance_model", "bs")
+        traf = Traffic(nmax=4, dtype=jnp.float64)
+        traf.create(1, "A320", 9000.0, 120.0, None, 52.0, 4.0, 90.0, "T1")
+        traf.flush()
+        # legacy flies at MTOW (perfbs.py:128); A320.xml MTOW = 64000 kg
+        assert float(traf.state.perf.mass[0]) == pytest.approx(64000.0)
+        assert float(traf.state.perf.sref[0]) == pytest.approx(122.4)
+        # max_alt 39800 ft -> m
+        assert float(traf.state.perf.hmax[0]) == pytest.approx(
+            39800.0 * aero.ft, rel=1e-6)
+
+    def test_bada_model_flies_on_opf_data(self, monkeypatch,
+                                          tmp_path_factory):
+        """settings.performance_model='bada' + a BADA dir: aircraft get
+        OPF-derived columns (m_ref in tonnes -> kg, VMO kt -> m/s)."""
+        import shutil
+        import jax.numpy as jnp
+        from bluesky_tpu import settings
+        from bluesky_tpu.core.traffic import Traffic
+        d = tmp_path_factory.mktemp("badaperf")
+        (d / "BADA").mkdir()
+        (d / "BADA" / "A320__.OPF").write_text(
+            "\n".join(_opf_lines()) + "\n")
+        syn = ("CD - A320   AIRBUS" + " " * 12 + " A-320" + " " * 20
+               + " A320__  Y")
+        (d / "BADA" / "SYNONYM.NEW").write_text(syn + "\n")
+        monkeypatch.setattr(settings, "performance_model", "bada")
+        monkeypatch.setattr(settings, "perf_path", str(d))
+        traf = Traffic(nmax=4, dtype=jnp.float64)
+        traf.create(1, "A320", 9000.0, 120.0, None, 52.0, 4.0, 90.0, "T1")
+        traf.flush()
+        assert float(traf.state.perf.mass[0]) == pytest.approx(64000.0)
+        assert float(traf.state.perf.vmaxer[0]) == pytest.approx(
+            350.0 * aero.kts)
+        assert float(traf.state.perf.hmax[0]) == pytest.approx(
+            38000.0 * aero.ft)
+
+    def test_openap_remains_default(self):
+        import jax.numpy as jnp
+        from bluesky_tpu.core.traffic import Traffic
+        traf = Traffic(nmax=4, dtype=jnp.float64)
+        assert traf.coeffdb.model == "openap"
+
+
+class TestBadaKernels:
+    def test_thrust_formulas_match_reference_expressions(self):
+        """Re-derive perfbada.py:404-458 in NumPy and compare."""
+        n = 200
+        rng = np.random.default_rng(5)
+        ft, kts = aero.ft, aero.kts
+        alt = rng.uniform(0.0, 12000.0, n)
+        tas = rng.uniform(5.0, 250.0, n)
+        drag = rng.uniform(2e4, 9e4, n)
+        eng = rng.integers(0, 3, n)
+        jet, turbo, piston = eng == 0, eng == 1, eng == 2
+        climb = rng.random(n) < 0.4
+        descent = ~climb & (rng.random(n) < 0.5)
+        lvl = ~climb & ~descent
+        phase = rng.integers(1, 7, n)
+        ctcth1 = rng.uniform(1e5, 3e5, n)
+        ctcth2 = rng.uniform(3e4, 6e4, n)
+        ctcth3 = rng.uniform(1e-11, 1e-10, n)
+        ctdesl = rng.uniform(0.02, 0.05, n)
+        ctdesh = rng.uniform(0.8, 1.0, n)
+        ctdesa = rng.uniform(0.1, 0.2, n)
+        ctdesld = rng.uniform(0.2, 0.4, n)
+        hpdes = rng.uniform(2000.0, 3000.0, n)
+
+        # reference expressions (perfbada.py:404-458), float64 NumPy
+        h_ft = alt / ft
+        tk = np.maximum(1.0, tas / kts)
+        Tj = ctcth1 * (1 - h_ft / ctcth2 + ctcth3 * h_ft * h_ft)
+        Tt = ctcth1 / tk * (1 - h_ft / ctcth2) + ctcth3
+        Tp = ctcth1 * (1 - h_ft / ctcth2) + ctcth3 / tk
+        maxthr = Tj * jet + Tt * turbo + Tp * piston
+        delh = alt - hpdes
+        Tdesh = maxthr * ctdesh * (descent & (delh > 0))
+        Tdeslc = maxthr * ctdesl * (descent & (delh < 0) & (phase == 3))
+        Tdesla = maxthr * ctdesa * (descent & (delh < 0) & (phase == 4))
+        Tdesll = maxthr * ctdesld * (descent & (delh < 0) & (phase == 5))
+        Tgd = np.minimum(Tdesh, Tdeslc) * (phase == 6)
+        want = np.maximum.reduce([
+            (climb & jet) * Tj, (climb & turbo) * Tt,
+            (climb & piston) * Tp, lvl * drag,
+            Tdesh, Tdeslc, Tdesla, Tdesll, Tgd])
+
+        thr, mthr = perf_bada.thrust(
+            *(jnp.asarray(x) for x in
+              (phase, climb, descent, lvl, alt, tas, drag, jet, turbo,
+               piston, ctcth1, ctcth2, ctcth3, ctdesl, ctdesh, ctdesa,
+               ctdesld, hpdes)))
+        np.testing.assert_allclose(np.asarray(thr), want, rtol=1e-12)
+        np.testing.assert_allclose(np.asarray(mthr), maxthr, rtol=1e-12)
+
+    def test_fuelflow_matches_reference_expressions(self):
+        n = 200
+        rng = np.random.default_rng(6)
+        ft, kts = aero.ft, aero.kts
+        alt = rng.uniform(0.0, 12000.0, n)
+        tas = rng.uniform(5.0, 250.0, n)
+        thr = rng.uniform(1e4, 2e5, n)
+        eng = rng.integers(0, 3, n)
+        jet, turbo, piston = eng == 0, eng == 1, eng == 2
+        phase = rng.integers(1, 7, n)
+        cf1 = rng.uniform(0.2, 1.0, n)
+        cf2 = rng.uniform(100.0, 2000.0, n)
+        cf3 = rng.uniform(5.0, 20.0, n)
+        cf4 = rng.uniform(3e4, 9e4, n)
+        cfcr = rng.uniform(0.85, 1.0, n)
+
+        etaj = cf1 * (1.0 + (tas / kts) / cf2)
+        etat = cf1 * (1.0 - (tas / kts) / cf2) * ((tas / kts) / 1000.0)
+        eta = np.maximum(etaj * jet, etat * turbo) / 1000.0
+        jt = jet | turbo
+        fnom = eta * thr * jt + cf1 * piston
+        fmin = cf3 * (1 - (alt / ft) / cf4) * jt + cf3 * piston
+        fcr = eta * thr * cfcr * jt + cf1 * cfcr * piston
+        fal = np.maximum(fnom, fmin)
+
+        got = perf_bada.fuelflow(
+            *(jnp.asarray(x) for x in
+              (phase, alt, tas, thr, jet, turbo, piston, cf1, cf2, cf3,
+               cf4, cfcr)))
+        for g, w, name in zip(got, (fnom, fmin, fcr, fal),
+                              ("fnom", "fmin", "fcr", "fal")):
+            np.testing.assert_allclose(np.asarray(g), w, rtol=1e-12,
+                                       err_msg=name)
