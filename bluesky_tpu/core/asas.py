@@ -45,6 +45,9 @@ class AsasConfig(NamedTuple):
     swresohdg: bool = False
     swresovert: bool = False
     reso_on: bool = True         # conflict resolution enabled (RESO MVP/OFF)
+    reso_method: str = "MVP"     # MVP / EBY / SWARM / SSD (CRmethods
+                                 # registry, asas.py:41-55); static under
+                                 # jit like the rest of the config
     vmin: float = 100.0 * aero.kts   # [m/s] resolution speed caps
     vmax: float = 180.0 * aero.kts   # (reference asas.py setters)
     vsmin: float = -3000.0 * aero.fpm
@@ -72,16 +75,62 @@ def update(state: SimState,
             rpz_m=cfg.rpz_m, hpz_m=cfg.hpz_m, tlookahead=cfg.dtlookahead,
             swresohoriz=cfg.swresohoriz, swresospd=cfg.swresospd,
             swresohdg=cfg.swresohdg, swresovert=cfg.swresovert)
-        newtrk, newgs, newvs, newalt, asase, asasn = cr_mvp.resolve(
-            cd, ac.alt, ac.gseast, ac.gsnorth, ac.vs, ac.trk, ac.gs,
-            ac.selalt, state.ap.vs, asas.alt,
-            cfg.vmin, cfg.vmax, cfg.vsmin, cfg.vsmax, mvpcfg,
-            noreso=asas.noreso, resooff=asas.resooff)
-        # Only aircraft with conflicts get fresh commands; others keep the
-        # previous resolution state (the reference overwrites all, but only
-        # `active` aircraft consume them — keeping them avoids NaN leakage
-        # from padding garbage).
-        upd = cd.inconf
+        method = cfg.reso_method.upper()
+        if method in ("MVP", "SWARM"):
+            newtrk, newgs, newvs, newalt, asase, asasn = cr_mvp.resolve(
+                cd, ac.alt, ac.gseast, ac.gsnorth, ac.vs, ac.trk, ac.gs,
+                ac.selalt, state.ap.vs, asas.alt,
+                cfg.vmin, cfg.vmax, cfg.vsmin, cfg.vsmax, mvpcfg,
+                noreso=asas.noreso, resooff=asas.resooff)
+        if method == "EBY":
+            from ..ops import cr_eby
+            newtrk, newgs, newvs, newalt = cr_eby.resolve(
+                cd, ac.alt, ac.vs, ac.trk, ac.tas,
+                cfg.rpz_m, cfg.vmin, cfg.vmax)
+            asase = newgs * jnp.sin(jnp.radians(newtrk))
+            asasn = newgs * jnp.cos(jnp.radians(newtrk))
+        elif method == "SWARM":
+            from ..ops import cr_swarm
+            # Swarm blends the MVP output computed above with alignment
+            # and flock centering (Swarm.py:68-110).  The CA gate is the
+            # PREVIOUS interval's active flags — the resume-nav
+            # hysteresis output, which is what asas.active holds at
+            # reference resolve time (Swarm.py:70-73).
+            newtrk, newgs, newvs, newalt = cr_swarm.resolve(
+                cd, ac.lat, ac.lon, ac.alt, ac.trk, ac.gs, ac.cas,
+                ac.vs, ac.gseast, ac.gsnorth, ac.active,
+                newtrk, newgs, newvs, asas.active,
+                state.ap.trk, ac.selspd, ac.selvs,
+                cfg.vmin, cfg.vmax)
+            asase = newgs * jnp.sin(jnp.radians(newtrk))
+            asasn = newgs * jnp.cos(jnp.radians(newtrk))
+        elif method == "SSD":
+            from ..ops import cr_ssd
+            ssdcfg = cr_ssd.SSDConfig(rpz_m=cfg.rpz_m,
+                                      tlookahead=cfg.dtlookahead)
+            newtrk, newgs = cr_ssd.resolve(
+                cd, ac.lat, ac.lon, ac.alt, ac.trk, ac.gs, ac.vs,
+                ac.gseast, ac.gsnorth, ac.active,
+                cfg.vmin, cfg.vmax, ssdcfg)
+            # SSD is a horizontal method (SSD.py:99-104)
+            newvs, newalt = asas.vs, asas.alt
+            asase = newgs * jnp.sin(jnp.radians(newtrk))
+            asasn = newgs * jnp.cos(jnp.radians(newtrk))
+        elif method != "MVP":
+            raise ValueError(
+                f"Unknown AsasConfig.reso_method {cfg.reso_method!r}; "
+                "expected MVP, EBY, SWARM or SSD.")
+        # Swarm commands apply to the whole swarm once any conflict
+        # exists (the reference only calls resolve when confpairs is
+        # non-empty, asas.py:487, and Swarm then sets all active);
+        # others gate on inconf.  Non-updated aircraft keep the previous
+        # resolution state (the reference overwrites all, but only
+        # `active` aircraft consume them — keeping them avoids NaN
+        # leakage from padding garbage).
+        if method == "SWARM":
+            upd = ac.active & jnp.any(cd.swconfl)
+        else:
+            upd = cd.inconf
         asas = asas.replace(
             trk=jnp.where(upd, newtrk, asas.trk),
             tas=jnp.where(upd, newgs, asas.tas),
@@ -97,6 +146,12 @@ def update(state: SimState,
     resopairs, active = cr_mvp.resume_nav(
         resopairs, cd.swlos, ac.lat, ac.lon, ac.gseast, ac.gsnorth, ac.trk,
         ac.active, cfg.rpz, cfg.rpz * cfg.resofach)
+
+    if cfg.reso_on and cfg.reso_method.upper() == "SWARM":
+        # The whole swarm follows ASAS, not only conflict pairs — but
+        # only once any conflict triggered a resolve (asas.py:487 gate +
+        # Swarm.py:101-102 active.fill(True))
+        active = jnp.where(jnp.any(cd.swconfl), ac.active, active)
 
     asas = asas.replace(
         resopairs=resopairs,

@@ -82,6 +82,12 @@ def step(state: SimState, cfg: SimConfig) -> SimState:
                 "resopairs) but SimConfig.cd_backend is "
                 f"'{cfg.cd_backend}'. Use SimConfig(cd_backend='tiled') or "
                 "allocate Traffic(pair_matrix=True).")
+        if cfg.cd_backend != "dense" and cfg.asas.reso_on \
+                and cfg.asas.reso_method.upper() != "MVP":
+            raise ValueError(
+                f"Resolver {cfg.asas.reso_method} needs the dense [N,N] "
+                "backend; the tiled/pallas large-N path carries only the "
+                "MVP pair sums. Use RESO MVP or cd_backend='dense'.")
         asas_due = simt >= state.asas_tnext
 
         def run_asas(s):

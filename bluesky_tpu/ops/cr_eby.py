@@ -1,0 +1,111 @@
+"""Eby conflict resolution, vectorized.
+
+Parity with the reference ``traffic/asas/Eby.py:15-138`` (Eby-method
+geometric resolution assuming straight-line motion): for each conflict
+pair, find the time ``tstar`` maximizing intrusion-over-time via the
+quadratic formula, evaluate the relative position there, and displace
+the velocity vector by ``intrusion * drelstar / (dstarabs * tstar)``.
+
+TPU-first redesign: the reference solves each pair in a Python loop over
+the conflict list (Eby.py:26-38); here every [N, N] pair solves in one
+broadcast and the per-aircraft displacement is the masked row sum —
+the same segment-sum treatment as the MVP kernel.  The reference applies
+``dv[id1] -= dv_eby; dv[id2] += dv_eby`` per unique pair; with the
+directional conflict matrix, ``dv_pair(j, i) == -dv_pair(i, j)``, so
+``dv[i] = -sum_j swconfl[i,j] * dv_pair(i,j)`` reproduces both updates.
+
+NB the reference's final assignment stores capped EAS in ``asas.tas``
+(Eby.py:55-61) — a reference quirk kept for parity.
+"""
+import jax.numpy as jnp
+
+from . import aero
+
+
+def resolve(cd, alt, vs, trk, tas, rpz_m, vmin, vmax):
+    """Eby resolution commands.
+
+    Args:
+      cd:       ConflictData (ops/cd.py) — swconfl/qdr/dist matrices
+      alt/vs:   [N] state arrays
+      trk/tas:  [N] track + TRUE AIRSPEED — the reference builds its
+                velocity vectors from tas, not groundspeed (Eby.py:44-46,
+                84-87), so the EAS cap stays wind-independent
+      rpz_m:    resolution zone radius Rm [m] (asas.Rm)
+      vmin/vmax: EAS caps [m/s]
+    Returns (newtrk, newtas, newvs, newalt) per aircraft.
+    """
+    eps = 1e-12
+    mask = cd.swconfl
+    maskf = mask.astype(tas.dtype)
+    trkrad = jnp.radians(trk)
+    ve = tas * jnp.sin(trkrad)
+    vn = tas * jnp.cos(trkrad)
+
+    # Pairwise relative position (Eby.py:73-78)
+    qdrrad = jnp.radians(cd.qdr)
+    dx = cd.dist * jnp.sin(qdrrad)
+    dy = cd.dist * jnp.cos(qdrrad)
+    dz = alt[None, :] - alt[:, None]
+
+    # Relative velocity v = v_j - v_i (Eby.py:85-87)
+    vx = ve[None, :] - ve[:, None]
+    vy = vn[None, :] - vn[:, None]
+    vz = vs[None, :] - vs[:, None]
+
+    r2 = rpz_m * rpz_m
+    d2 = dx * dx + dy * dy + dz * dz
+    v2 = vx * vx + vy * vy + vz * vz
+    dv = dx * vx + dy * vy + dz * vz
+
+    # Quadratic for tstar (Eby.py:104-117)
+    a = r2 * v2 - dv * dv
+    b = 2.0 * dv * (r2 - d2)
+    c = r2 * d2 - d2 * d2
+    discrim = jnp.maximum(b * b - 4.0 * a * c, 0.0)
+    a_safe = jnp.where(jnp.abs(a) < eps, eps, a)
+    sq = jnp.sqrt(discrim)
+    time1 = (-b + sq) / (2.0 * a_safe)
+    time2 = (-b - sq) / (2.0 * a_safe)
+    tstar = jnp.minimum(jnp.abs(time1), jnp.abs(time2))
+
+    # Relative position at tstar (Eby.py:120-122)
+    dsx = dx + vx * tstar
+    dsy = dy + vy * tstar
+    dsz = dz + vz * tstar
+    dstarabs = jnp.sqrt(dsx * dsx + dsy * dsy + dsz * dsz)
+
+    # Exact-collision-course fix (Eby.py:125-131): if passing within
+    # 10 m, push drelstar out sideways to 10 m
+    dif = 10.0 - dstarabs
+    vperp_norm = jnp.sqrt(vy * vy + vx * vx)
+    vp_safe = jnp.where(vperp_norm < eps, eps, vperp_norm)
+    fixmask = dif > 0.0
+    dsx = dsx + fixmask * dif * (-vy) / vp_safe
+    dsy = dsy + fixmask * dif * vx / vp_safe
+    dstarabs = jnp.sqrt(dsx * dsx + dsy * dsy + dsz * dsz)
+
+    # Intrusion and displacement (Eby.py:134-138)
+    intr = rpz_m - dstarabs
+    denom = dstarabs * tstar
+    denom = jnp.where(jnp.abs(denom) < eps, eps, denom)
+    scale = intr / denom
+    dve_p = scale * dsx
+    dvn_p = scale * dsy
+    dvv_p = scale * dsz
+
+    # dv[i] = -sum_j over conflict pairs (see module docstring)
+    dve = -jnp.sum(dve_p * maskf, axis=1)
+    dvn = -jnp.sum(dvn_p * maskf, axis=1)
+    dvv = -jnp.sum(dvv_p * maskf, axis=1)
+
+    # New velocity vector -> polar commands (Eby.py:42-61)
+    newv_e = dve + ve
+    newv_n = dvn + vn
+    newv_v = dvv + vs
+    newtrk = jnp.degrees(jnp.arctan2(newv_e, newv_n)) % 360.0
+    newgs = jnp.sqrt(newv_e * newv_e + newv_n * newv_n)
+    neweas = aero.vtas2eas(newgs, alt)
+    newtas = jnp.clip(neweas, vmin, vmax)
+    newalt = jnp.sign(newv_v) * 1e5
+    return newtrk, newtas, newv_v, newalt

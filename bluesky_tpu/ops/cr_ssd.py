@@ -1,0 +1,103 @@
+"""SSD conflict resolution: solution-space diagram on a velocity grid.
+
+Capability parity with the reference ``traffic/asas/SSD.py:99-625``,
+which builds velocity-obstacle polygons with pyclipper and picks the
+resolution velocity per priority rule.  That construction is inherently
+sequential host geometry; this is a ground-up TPU redesign:
+
+* The solution space is DISCRETIZED: candidate velocities sample a polar
+  grid (``ntrk`` tracks x ``nspd`` speeds spanning [vmin, vmax] —
+  matching the reference's SSD bounded by the speed envelope ring,
+  SSD.py:131-141).
+* Each candidate is tested against every intruder with the same
+  CPA predicate as conflict detection (a candidate lies inside the
+  velocity obstacle of intruder j iff flying it would come within
+  ``rpz_m`` of j inside the lookahead) — an [N, C, N] elementwise mask
+  instead of polygon clipping, which is exactly the shape the VPU eats.
+* Resolution rule RS1 "shortest way out" (the reference default,
+  SSD.py:429-500): among free candidates, take the one closest to the
+  current velocity.  If the whole grid is forbidden, fall back to the
+  candidate whose earliest conflict is farthest away (max min-tin).
+
+Memory: N * C * N floats with C = ntrk*nspd.  With the default 24x6
+grid and N=500 that is ~2 GB transient — SSD is a small-N study tool in
+the reference too (pyclipper per pair per step); for big-N use MVP.
+"""
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class SSDConfig(NamedTuple):
+    ntrk: int = 24        # track samples (15 deg, SSD.py N_angle analogue)
+    nspd: int = 6         # speed ring samples between vmin and vmax
+    rpz_m: float = 9260.0  # resolution zone [m]
+    tlookahead: float = 300.0
+
+
+def resolve(cd, lat, lon, alt, trk, gs, vs, gseast, gsnorth, active,
+            vmin, vmax, cfg: SSDConfig):
+    """RS1 resolution velocities for in-conflict aircraft.
+
+    Returns (newtrk, newgs): per-aircraft track/speed of the chosen free
+    velocity (aircraft not in conflict get their current trk/gs back).
+    """
+    n = lat.shape[0]
+    dtype = gs.dtype
+
+    # Candidate velocity grid [C]: polar product of tracks and speeds
+    trks = jnp.linspace(0.0, 360.0, cfg.ntrk, endpoint=False, dtype=dtype)
+    spds = jnp.linspace(vmin, vmax, cfg.nspd, dtype=dtype)
+    ctrk = jnp.repeat(trks, cfg.nspd)              # [C]
+    cspd = jnp.tile(spds, cfg.ntrk)                # [C]
+    cve = cspd * jnp.sin(jnp.radians(ctrk))        # [C] east
+    cvn = cspd * jnp.cos(jnp.radians(ctrk))        # [C] north
+
+    # Pairwise geometry from the CD output (relative position i->j)
+    qdrrad = jnp.radians(cd.qdr)
+    dxm = cd.dist * jnp.sin(qdrrad)                # [N,N]
+    dym = cd.dist * jnp.cos(qdrrad)
+    eye = jnp.eye(n, dtype=bool)
+    pairok = (active[:, None] & active[None, :]) & ~eye
+
+    # Relative velocity for candidate c of ownship i vs intruder j, in
+    # the CD convention (StateBasedCD.py:39-40 via its (1,N)/(N,1)
+    # broadcast): w = v_j - u_c.  [1,C,N] against [N,1,N] geometry.
+    wve = gseast[None, None, :] - cve[None, :, None]    # [1,C,N]
+    wvn = gsnorth[None, None, :] - cvn[None, :, None]
+    dx = dxm[:, None, :]                                # [N,1,N]
+    dy = dym[:, None, :]
+
+    dv2 = wve * wve + wvn * wvn
+    dv2 = jnp.where(dv2 < 1e-6, 1e-6, dv2)
+    tcpa = -(wve * dx + wvn * dy) / dv2                 # [N,C,N]
+    dcpa2 = dx * dx + dy * dy - tcpa * tcpa * dv2
+    r2 = cfg.rpz_m * cfg.rpz_m
+    # Horizontal-only VO test (the reference SSD is a horizontal method,
+    # SSD.py:99-104): conflict if CPA inside rpz within the lookahead
+    dxinhor = jnp.sqrt(jnp.maximum(0.0, r2 - dcpa2))
+    dtinhor = dxinhor / jnp.sqrt(dv2)
+    tin = tcpa - dtinhor
+    conflict = (dcpa2 < r2) & (tcpa + dtinhor > 0.0) \
+        & (tin < cfg.tlookahead)
+    conflict = conflict & pairok[:, None, :]
+
+    free = ~jnp.any(conflict, axis=2)                   # [N,C]
+
+    # RS1: free candidate closest to the current velocity (SSD.py:429+)
+    dist2 = (cve[None, :] - gseast[:, None]) ** 2 \
+        + (cvn[None, :] - gsnorth[:, None]) ** 2       # [N,C]
+    big = jnp.asarray(1e18, dtype)
+    best_free = jnp.argmin(jnp.where(free, dist2, big), axis=1)
+
+    # Fallback when nothing is free: max earliest-conflict time
+    tin_masked = jnp.where(conflict, jnp.maximum(tin, 0.0), big)
+    min_tin = jnp.min(tin_masked, axis=2)               # [N,C]
+    best_delay = jnp.argmax(jnp.where(jnp.isfinite(min_tin), min_tin,
+                                      0.0), axis=1)
+    any_free = jnp.any(free, axis=1)
+    best = jnp.where(any_free, best_free, best_delay)
+
+    newtrk = jnp.where(cd.inconf, ctrk[best], trk)
+    newgs = jnp.where(cd.inconf, cspd[best], gs)
+    return newtrk, newgs

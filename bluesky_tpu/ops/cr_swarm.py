@@ -1,0 +1,109 @@
+"""Swarm conflict resolution: MVP avoidance + alignment + flock centering.
+
+Parity with the reference ``traffic/asas/Swarm.py:23-103``: neighbors
+within 7.5 nm / 1500 ft flying within 90 deg of the own track form the
+swarm; the commanded velocity blends three parts with weights [10, 3, 1]:
+Collision Avoidance (the MVP resolution, or the autopilot command when
+not in conflict), Velocity Alignment (swarm-weighted averages of speed /
+vertical speed / track difference), and Flock Centering (velocity toward
+the swarm centroid).  All aircraft become ASAS-active (Swarm.py:101-102).
+
+The reference is already matrix-formed NumPy; the port keeps the same
+masked-average algebra in jnp.  The reference's stale ``asas.u``/
+``asas.v`` diagonal terms (the attribute no longer exists upstream —
+bit-rot noted in SURVEY §2.2) are taken as the ownship velocity
+components, which is what the flock-centering geometry calls for.
+"""
+import jax.numpy as jnp
+
+from . import aero
+
+R_SWARM = 7.5 * aero.nm      # [m] swarm neighbourhood (Swarm.py start())
+DH_SWARM = 1500.0 * aero.ft  # [m]
+WEIGHTS = (10.0, 3.0, 1.0)   # CA / alignment / centering
+
+
+def _wavg(x, w):
+    """np.average(x, axis=1, weights=w) with all-zero-row guard."""
+    den = jnp.sum(w, axis=1)
+    den = jnp.where(den == 0.0, 1.0, den)
+    return jnp.sum(x * w, axis=1) / den
+
+
+def resolve(cd, lat, lon, alt, trk, gs, cas, vs, gseast, gsnorth,
+            active,
+            mvp_trk, mvp_tas, mvp_vs, mvp_active,
+            ap_trk, selspd, selvs,
+            vmin, vmax):
+    """Swarm resolution commands.
+
+    Args:
+      cd:          ConflictData (for the qdr/dist matrices)
+      lat..gsnorth: [N] state arrays; ``cas`` the calibrated speed
+      active:      [N] live-aircraft mask (padding exclusion)
+      mvp_*:       the MVP resolution output + its active flags (Swarm
+                   runs MVP first, Swarm.py:68)
+      ap_trk/selspd/selvs: autopilot commands for non-conflict aircraft
+      vmin/vmax:   speed caps
+    Returns (newtrk, newtas, newvs, newalt) for every aircraft.
+    """
+    n = lat.shape[0]
+    eye = jnp.eye(n, dtype=bool)
+
+    # Neighbour matrix (Swarm.py:47-58); the reference subtracts 1e9
+    # from dy to kill the self-pair — here the eye mask does it.
+    qdrrad = jnp.radians(cd.qdr)
+    dx = cd.dist * jnp.sin(qdrrad)
+    dy = cd.dist * jnp.cos(qdrrad)
+    dalt = alt[:, None] - alt[None, :]
+    pairok = (active[:, None] & active[None, :]) & ~eye
+    close = (dx * dx + dy * dy < R_SWARM * R_SWARM) \
+        & (jnp.abs(dalt) < DH_SWARM) & pairok
+
+    trkdif = trk[None, :] - trk[:, None]
+    dtrk = (trkdif + 180.0) % 360.0 - 180.0
+    samedirection = jnp.abs(dtrk) < 90.0
+    swarming = (close & samedirection) | (eye & active[:, None])
+    w = swarming.astype(gs.dtype)
+
+    # Collision avoidance part: MVP output where ASAS-active, else AP
+    # (Swarm.py:70-73)
+    ca_trk = jnp.where(mvp_active, mvp_trk, ap_trk)
+    ca_cas = jnp.where(mvp_active, mvp_tas, selspd)
+    ca_vs = jnp.where(mvp_active, mvp_vs, selvs)
+
+    # Velocity alignment (Swarm.py:75-84)
+    va_cas = _wavg(jnp.broadcast_to(cas[None, :], (n, n)), w)
+    va_vs = _wavg(jnp.broadcast_to(vs[None, :], (n, n)), w)
+    va_trk = trk + _wavg(dtrk, w)
+
+    # Flock centering (Swarm.py:86-97): own velocity/100 on the diagonal
+    dxflock = jnp.where(eye, gseast[:, None] / 100.0, dx)
+    dyflock = jnp.where(eye, gsnorth[:, None] / 100.0, dy)
+    fc_dx = _wavg(dxflock, w)
+    fc_dy = _wavg(dyflock, w)
+    fc_dz = _wavg(jnp.broadcast_to(alt[None, :], (n, n)), w) - alt
+    fc_trk = jnp.degrees(jnp.arctan2(fc_dx, fc_dy))
+    fc_cas = cas
+    cas_safe = jnp.where(cas == 0.0, 1.0, cas)
+    ttoreach = jnp.sqrt(fc_dx * fc_dx + fc_dy * fc_dy) / cas_safe
+    fc_vs = jnp.where(ttoreach == 0.0, 0.0,
+                      fc_dz / jnp.where(ttoreach == 0.0, 1.0, ttoreach))
+
+    # Blend the three parts in cartesian velocity space (Swarm.py:99-110)
+    wsum = sum(WEIGHTS)
+    def blend(a, b, c):
+        return (WEIGHTS[0] * a + WEIGHTS[1] * b + WEIGHTS[2] * c) / wsum
+    trks = [ca_trk, va_trk, fc_trk]
+    cass = [ca_cas, va_cas, fc_cas]
+    vxs = [c * jnp.sin(jnp.radians(t)) for t, c in zip(trks, cass)]
+    vys = [c * jnp.cos(jnp.radians(t)) for t, c in zip(trks, cass)]
+    swarm_vx = blend(*vxs)
+    swarm_vy = blend(*vys)
+    newtrk = jnp.degrees(jnp.arctan2(swarm_vx, swarm_vy)) % 360.0
+    newcas = blend(ca_cas, va_cas, fc_cas)
+    newvs = blend(ca_vs, va_vs, fc_vs)
+
+    newtas = jnp.clip(newcas, vmin, vmax)
+    newalt = jnp.sign(newvs) * 1e5
+    return newtrk, newtas, newvs, newalt

@@ -273,18 +273,28 @@ def register_all(stack):
         return True
 
     def reso(method=None):
-        """RESO [method]: MVP/OFF/ON (asas.py CRmethods registry)."""
+        """RESO [method]: MVP/EBY/SWARM/SSD/OFF/ON (asas.py CRmethods
+        registry, asas.py:41-55)."""
         if method is None:
-            on = sim.cfg.asas.reso_on
-            return True, f"RESO {'MVP' if on else 'OFF'}"
+            cfg = sim.cfg.asas
+            return True, f"RESO {cfg.reso_method if cfg.reso_on else 'OFF'}"
         m = method.upper()
-        if m in ("MVP", "ON"):
+        if m == "ON":
             _setasas(reso_on=True)
+            return True
+        if m in ("MVP", "EBY", "SWARM", "SSD"):
+            if m != "MVP" and sim.cfg.cd_backend != "dense":
+                return False, (f"RESO {m} needs the dense CD backend "
+                               f"(current: {sim.cfg.cd_backend}); only "
+                               "MVP runs on the tiled/pallas large-N "
+                               "path")
+            _setasas(reso_on=True, reso_method=m)
             return True
         if m in ("OFF", "NONE", "DONOTHING"):
             _setasas(reso_on=False)
             return True
-        return False, f"RESO method {method} not available (have: MVP, OFF)"
+        return False, (f"RESO method {method} not available "
+                       "(have: MVP, EBY, SWARM, SSD, OFF)")
 
     def zoner(r=None):
         if r is None:
