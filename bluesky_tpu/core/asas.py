@@ -48,6 +48,8 @@ class AsasConfig(NamedTuple):
     reso_method: str = "MVP"     # MVP / EBY / SWARM / SSD (CRmethods
                                  # registry, asas.py:41-55); static under
                                  # jit like the rest of the config
+    swprio: bool = False         # PRIORULES on/off (asas.py SetPrio)
+    priocode: str = "FF1"        # FF1/FF2/FF3/LAY1/LAY2
     vmin: float = 100.0 * aero.kts   # [m/s] resolution speed caps
     vmax: float = 180.0 * aero.kts   # (reference asas.py setters)
     vsmin: float = -3000.0 * aero.fpm
@@ -74,7 +76,8 @@ def update(state: SimState,
         mvpcfg = cr_mvp.MVPConfig(
             rpz_m=cfg.rpz_m, hpz_m=cfg.hpz_m, tlookahead=cfg.dtlookahead,
             swresohoriz=cfg.swresohoriz, swresospd=cfg.swresospd,
-            swresohdg=cfg.swresohdg, swresovert=cfg.swresovert)
+            swresohdg=cfg.swresohdg, swresovert=cfg.swresovert,
+            swprio=cfg.swprio, priocode=cfg.priocode)
         method = cfg.reso_method.upper()
         if method in ("MVP", "SWARM"):
             newtrk, newgs, newvs, newalt, asase, asasn = cr_mvp.resolve(
@@ -96,11 +99,15 @@ def update(state: SimState,
             # PREVIOUS interval's active flags — the resume-nav
             # hysteresis output, which is what asas.active holds at
             # reference resolve time (Swarm.py:70-73).
+            # selspd may hold a Mach number; resolve to CAS like the
+            # autopilot does (the reference Swarm blends raw selspd,
+            # Swarm.py:72 — a unit bug upstream, fixed here)
+            _, selcas, _ = aero.vcasormach(ac.selspd, ac.alt)
             newtrk, newgs, newvs, newalt = cr_swarm.resolve(
                 cd, ac.lat, ac.lon, ac.alt, ac.trk, ac.gs, ac.cas,
                 ac.vs, ac.gseast, ac.gsnorth, ac.active,
                 newtrk, newgs, newvs, asas.active,
-                state.ap.trk, ac.selspd, ac.selvs,
+                state.ap.trk, selcas, ac.selvs,
                 cfg.vmin, cfg.vmax)
             asase = newgs * jnp.sin(jnp.radians(newtrk))
             asasn = newgs * jnp.cos(jnp.radians(newtrk))

@@ -17,6 +17,7 @@ Implemented with reference semantics:
 * ``direct``: activate a waypoint and aim guidance at it (route.py:635-705)
 * ``findact``: closest-ahead waypoint choice (route.py:1043-1075)
 """
+import os
 from typing import List, Optional
 
 import numpy as np
@@ -116,6 +117,89 @@ class RouteManager:
             del lst[i]
         if r.iactwp > i:
             r.iactwp -= 1
+
+    def delrte(self, idx: int) -> bool:
+        """DELRTE: drop the complete route incl. orig/dest
+        (route.py delrte)."""
+        self.clear(idx)
+        self.sync(idx)
+        return True
+
+    def addwpt_before(self, idx: int, beforewp: str, name: str,
+                      lat: float, lon: float,
+                      alt: float = -999.0, spd: float = -999.0) -> int:
+        """BEFORE beforewp ADDWPT (route.py beforeaddwptStack): insert a
+        waypoint in front of a named one.  Returns index or -1."""
+        r = self.route(idx)
+        names = [n.upper() for n in r.name]
+        if beforewp.upper() not in names:
+            return -1
+        if r.nwp >= self.wmax:
+            raise RuntimeError(
+                f"route full for slot {idx} (wmax={self.wmax}); raise wmax")
+        wpidx = names.index(beforewp.upper())
+        r.name.insert(wpidx, name.upper())
+        r.lat.insert(wpidx, float(lat))
+        r.lon.insert(wpidx, float(lon))
+        r.alt.insert(wpidx, float(alt))
+        r.spd.insert(wpidx, float(spd))
+        r.wtype.insert(wpidx, WPT_LATLON)
+        r.flyby.insert(wpidx, 1.0)
+        if r.iactwp >= wpidx:
+            r.iactwp += 1
+        self.sync(idx)
+        return wpidx
+
+    def atwpt(self, idx: int, wpname: str, what: Optional[str] = None,
+              value=None):
+        """AT wpname [DEL] SPD/ALT [val]: show/edit/delete constraints
+        at a route waypoint (route.py atwptStack).
+
+        Returns (ok, message or None)."""
+        r = self.route(idx)
+        names = [n.upper() for n in r.name]
+        if wpname.upper() not in names:
+            return False, f"{wpname} not in route"
+        i = names.index(wpname.upper())
+        if what is None:
+            alttxt = "-----" if r.alt[i] < 0 else f"{r.alt[i]:.0f} m"
+            spdtxt = "-----" if r.spd[i] < 0 else f"{r.spd[i]:.2f}"
+            return True, f"{wpname}: alt {alttxt}, spd {spdtxt}"
+        w = what.upper()
+        if w == "DEL":
+            which = (str(value).upper() if value is not None else "BOTH")
+            if which in ("ALT", "BOTH"):
+                r.alt[i] = -999.0
+            if which in ("SPD", "BOTH"):
+                r.spd[i] = -999.0
+        elif w == "ALT":
+            if value is None:
+                return False, "AT wpname ALT value"
+            r.alt[i] = float(value)
+        elif w == "SPD":
+            if value is None:
+                return False, "AT wpname SPD value"
+            r.spd[i] = float(value)
+        else:
+            return False, f"AT: unknown argument {what}"
+        self.sync(idx)   # sync recomputes calcfp's constraint tables
+        return True, None
+
+    def dumproute(self, idx: int, acid: str, path: str = "output") -> str:
+        """DUMPRTE: append the route table to output/routelog.txt
+        (route.py dumpRoute)."""
+        os.makedirs(path, exist_ok=True)
+        fname = os.path.join(path, "routelog.txt")
+        r = self.route(idx)
+        with open(fname, "a") as f:
+            f.write(f"\nRoute {acid}:\n")
+            f.write("(name, lat, lon, alt, spd, active)\n")
+            for i in range(r.nwp):
+                f.write(f"{r.name[i]}, {r.lat[i]:.6f}, {r.lon[i]:.6f}, "
+                        f"{r.alt[i]:.1f}, {r.spd[i]:.2f}, "
+                        f"{i == r.iactwp}\n")
+            f.write("***\n")
+        return fname
 
     def delwpt(self, idx: int, name: str) -> bool:
         r = self.route(idx)

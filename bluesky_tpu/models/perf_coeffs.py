@@ -113,14 +113,18 @@ def load_openap_dir(path: str) -> Dict[str, dict]:
     for mdl, ac in acs.items():
         mdl = mdl.upper()
         # First engine listed that matches the engines table (the reference
-        # also uses the first engine, perfoap.py:74-76).
+        # also uses the first engine, perfoap.py:74-76); all matches are
+        # kept for the ENG acid,[engine] change command (perfbase
+        # engchange contract).
         eng = None
+        avail = {}
         for ename in ac.get('engines', []):
             ename = ename.strip().upper()
             matches = [e for n, e in engines.items() if n.startswith(ename)]
             if matches:
-                eng = matches[-1]
-                break
+                avail[matches[-1]['name'].upper()] = matches[-1]
+                if eng is None:
+                    eng = matches[-1]
         if eng is None:
             continue
 
@@ -130,6 +134,13 @@ def load_openap_dir(path: str) -> Dict[str, dict]:
             engthr=float(eng['thr']), engbpr=float(eng['bpr']),
             ff_idl=float(eng['ff_idl']), ff_app=float(eng['ff_app']),
             ff_co=float(eng['ff_co']), ff_to=float(eng['ff_to']),
+            engines_avail={n: dict(thr=float(e['thr']),
+                                   bpr=float(e['bpr']),
+                                   ff_idl=float(e['ff_idl']),
+                                   ff_app=float(e['ff_app']),
+                                   ff_co=float(e['ff_co']),
+                                   ff_to=float(e['ff_to']))
+                           for n, e in avail.items()},
         )
         dp = dragpolar.get(mdl) or dragpolar.get('NA')
         if dp is None and dragpolar:

@@ -34,6 +34,8 @@ class MVPConfig(NamedTuple):
     swresospd: bool = False     # ... with speed changes only
     swresohdg: bool = False     # ... with heading changes only
     swresovert: bool = False    # resolve vertically only
+    swprio: bool = False        # priority rules on (PRIORULES cmd)
+    priocode: str = "FF1"       # FF1/FF2/FF3/LAY1/LAY2 (MVP.py:235-300)
 
 
 def pair_contributions(cd, alt, gseast, gsnorth, vs, cfg):
@@ -170,11 +172,45 @@ def resolve(cd, alt, gseast, gsnorth, vs, trk, gs,
         mask = mask & ~noreso[None, :]
 
     maskf = mask.astype(dve_p.dtype)
+    vmaskf = maskf
+    if cfg.swprio and cfg.priocode != "FF1":
+        # Priority rules (MVP.py:235-300), as per-directional-pair apply
+        # masks: the reference updates dv1/dv2 per unique pair; with the
+        # antisymmetric pair function, "aircraft k solves" means row k
+        # keeps its contribution.  Cruising = |vs| < 0.1 m/s.
+        cruise = jnp.abs(vs) < 0.1
+        ci = cruise[:, None]
+        cj = cruise[None, :]
+        mixed = ci ^ cj
+        if cfg.priocode == "FF2":
+            # cruiser has priority: the climbing/descending one solves
+            apply = jnp.where(mixed, ~ci, True)
+            vapply = apply
+        elif cfg.priocode == "FF3":
+            # climber/descender has priority: cruiser solves, and in
+            # mixed pairs horizontally only (dv_mvp[2] = 0)
+            apply = jnp.where(mixed, ci, True)
+            vapply = apply & ~mixed
+        elif cfg.priocode == "LAY1":
+            # all horizontal; climbing/descending solves in mixed pairs
+            apply = jnp.where(mixed, ~ci, True)
+            vapply = jnp.zeros_like(mixed)
+        elif cfg.priocode == "LAY2":
+            # all horizontal; cruiser solves in mixed pairs
+            apply = jnp.where(mixed, ci, True)
+            vapply = jnp.zeros_like(mixed)
+        else:
+            raise ValueError(
+                f"Unknown priocode {cfg.priocode!r}; expected "
+                "FF1/FF2/FF3/LAY1/LAY2")
+        maskf = maskf * apply
+        vmaskf = maskf * vapply
+
     # Raw pair sums; sign flip + cooperative halving happen in
     # ``resolve_from_sums`` (shared with the tiled large-N path).
     sum_dve = jnp.sum(dve_p * maskf, axis=1)
     sum_dvn = jnp.sum(dvn_p * maskf, axis=1)
-    sum_dvv = jnp.sum(dvv_p * maskf, axis=1)
+    sum_dvv = jnp.sum(dvv_p * vmaskf, axis=1)
 
     # Vertical solve time: min over this ownship's conflicts (MVP.py:41-42)
     tsolv = jnp.min(jnp.where(mask, tsolv_p, 1e9), axis=1)

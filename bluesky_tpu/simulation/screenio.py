@@ -15,15 +15,21 @@ ACDATA_DT = 0.2       # 5 Hz (screenio.py:18-21)
 SIMINFO_DT = 1.0      # 1 Hz
 
 
-class ScreenIO:
-    """Duck-types simulation.sim.Screen; streams instead of buffering."""
+from .sim import DisplayState
+
+
+class ScreenIO(DisplayState):
+    """Duck-types simulation.sim.Screen; streams instead of buffering.
+
+    Inherits the DisplayState surface (pan/zoom/feature/objappend/...)
+    so every display stack command works in node mode too."""
 
     def __init__(self, sim, node):
         self.sim = sim
         self.node = node
         self.current_sender = ""      # set by the stack before echo calls
         self.echobuf = []             # retained for embedded inspection
-        self.viewbounds = (-90.0, 90.0, -180.0, 180.0)
+        self._init_display()
         self.samplecount = 0
         self.prevcount = 0
         self.prevtime = time.perf_counter()
@@ -47,9 +53,6 @@ class ScreenIO:
             if self.current_sender else None
         self.node.send_event(b"ECHO", {"text": text, "flags": flags}, route)
         return True
-
-    def getviewbounds(self):
-        return self.viewbounds
 
     def update(self):
         self.samplecount += 1

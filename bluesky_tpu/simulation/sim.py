@@ -30,24 +30,29 @@ from ..core.traffic import Traffic
 INIT, HOLD, OP, END = range(4)
 
 
-class Screen:
-    """Echo/plot sink — headless stand-in for ScreenIO (screenio.py:11-263).
+class DisplayState:
+    """Display state shared by the headless Screen and the node-mode
+    ScreenIO (screenio.py duck-types this surface): shape registry, pan
+    centre, zoom, feature switches, altitude filter, symbol toggle,
+    editline inserts, ND selection.  Every display command in the stack
+    works against this mixin in both modes."""
 
-    Collects echo lines so stack command output is observable; the network
-    node subclass streams instead.
-    """
-
-    def __init__(self):
-        self.echobuf = []
-        self.viewbounds = (-1.0, 1.0, -1.0, 1.0)
+    def _init_display(self):
         self.objdata = {}     # named display shapes (screenio objappend)
-
-    def echo(self, text="", flags=0):
-        self.echobuf.append(text)
-        return True
+        self.ctrlat = 0.0
+        self.ctrlon = 0.0
+        self.scrzoom = 1.0
+        self.features = {}
+        self.altfilter = None       # (bottom, top) in meters or None
+        self.swsymbol = True
+        self.editline = ""
+        self.nd_acid = None
 
     def getviewbounds(self):
-        return self.viewbounds
+        """Lat/lon box currently in view (screenio pan/zoom state)."""
+        half = 1.0 / max(self.scrzoom, 1e-9)
+        return (self.ctrlat - half, self.ctrlat + half,
+                self.ctrlon - half, self.ctrlon + half)
 
     def objappend(self, objtype, objname, data):
         """Mirror a named shape to the display (screenio.py objappend);
@@ -56,6 +61,55 @@ class Screen:
             self.objdata.pop(objname, None)
         else:
             self.objdata[objname] = (objtype, data)
+        return True
+
+    def pan(self, lat, lon):
+        self.ctrlat = float(lat)
+        self.ctrlon = float(lon)
+        return True
+
+    def zoom(self, factor, absolute=False):
+        self.scrzoom = float(factor) if absolute \
+            else self.scrzoom * float(factor)
+        return True
+
+    def feature(self, sw, arg=None):
+        """SWRAD switches (screenio.feature): toggle/record per name."""
+        self.features[sw.upper()] = arg if arg is not None \
+            else not self.features.get(sw.upper(), False)
+        return True
+
+    def filteralt(self, flag, bottom=None, top=None):
+        self.altfilter = (bottom, top) if flag else None
+        return True
+
+    def symbol(self):
+        self.swsymbol = not self.swsymbol
+        return True
+
+    def cmdline(self, text):
+        """INSEDIT: text inserted on the console edit line."""
+        self.editline = text
+        return True
+
+    def shownd(self, acid=None):
+        self.nd_acid = acid
+        return True
+
+
+class Screen(DisplayState):
+    """Echo/plot sink — headless stand-in for ScreenIO (screenio.py:11-263).
+
+    Collects echo lines so stack command output is observable; the network
+    node subclass streams instead.
+    """
+
+    def __init__(self):
+        self.echobuf = []
+        self._init_display()
+
+    def echo(self, text="", flags=0):
+        self.echobuf.append(text)
         return True
 
 
@@ -85,11 +139,16 @@ class Simulation:
         self.benchdt = -1.0
         self._step_count = 0
         self._wall_t0 = time.perf_counter()
+        import datetime
+        self._utc0 = datetime.datetime.combine(datetime.date.today(),
+                                               datetime.time())
         # Named areas + deferred conditional commands (chunk-edge subsystems)
         from ..utils.areafilter import AreaRegistry
         from ..core.conditional import ConditionList
+        from ..utils.plotter import Plotter
         self.areas = AreaRegistry(self.scr)
         self.cond = ConditionList(self)
+        self.plotter = Plotter(self)
         self.traf.delete_hooks.append(self.cond.delac)
         # Late import to avoid cycles; stack binds commands to this sim.
         from ..stack.stack import Stack
@@ -130,6 +189,54 @@ class Simulation:
         self.cfg = self.cfg._replace(simdt=float(dt))
         return True
 
+    @property
+    def utc(self):
+        """Simulated UTC clock = epoch + simt (simulation.py setutc)."""
+        import datetime
+        return self._utc0 + datetime.timedelta(seconds=self.simt)
+
+    def setutc(self, *args):
+        """TIME/DATE: RUN / REAL/UTC / HH:MM:SS.hh / day,month,year,time
+        (reference simulation.py setutc)."""
+        import datetime
+        if not args or args[0] is None or str(args[0]).upper() == "RUN":
+            self._utc0 = datetime.datetime.combine(
+                datetime.date.today(), datetime.time()) \
+                - datetime.timedelta(seconds=self.simt)
+            return True
+        a0 = str(args[0]).upper()
+        if a0 in ("REAL", "UTC"):
+            now = datetime.datetime.now(datetime.timezone.utc) \
+                .replace(tzinfo=None) if a0 == "UTC" \
+                else datetime.datetime.now()
+            self._utc0 = now - datetime.timedelta(seconds=self.simt)
+            return True
+        try:
+            if len(args) >= 4:   # DATE day, month, year, HH:MM:SS
+                day, month, year = int(args[0]), int(args[1]), int(args[2])
+                t = datetime.datetime.strptime(
+                    str(args[3]).split(".")[0], "%H:%M:%S").time()
+                base = datetime.datetime.combine(
+                    datetime.date(year, month, day), t)
+            else:                # TIME HH:MM:SS[.hh]
+                t = datetime.datetime.strptime(
+                    a0.split(".")[0], "%H:%M:%S").time()
+                base = datetime.datetime.combine(self.utc.date(), t)
+        except ValueError as e:
+            return False, f"TIME/DATE: {e}"
+        self._utc0 = base - datetime.timedelta(seconds=self.simt)
+        return True
+
+    def setFixdt(self, flag, tend=None):
+        """FIXDT ON/OFF [tend]: fixed-dt stepping — equivalent to
+        fast-forward pacing in this architecture (simulation.py
+        setFixdt)."""
+        if flag:
+            self.fastforward(tend)
+        else:
+            self.ffmode = False
+        return True
+
     def setdtmult(self, mult: float):
         self.dtmult = float(mult)
         return True
@@ -166,6 +273,7 @@ class Simulation:
         # After stack.reset: plugin reset hooks may stack commands (e.g.
         # TRAFGEN redraws its spawn circle) that must survive the reset.
         self.plugins.reset()
+        self.plotter.reset()
         return True
 
     def fastforward(self, nsec: Optional[float] = None):
@@ -217,9 +325,12 @@ class Simulation:
         # IMPORTANT: every distinct nsteps compiles a separate scan program,
         # so the chunk is quantized to a small ladder — at most a handful of
         # compilations per configuration instead of one per trigger distance.
-        chunk = max_chunk or self.chunk_steps
-        if self.ffmode:
-            chunk = max(chunk, 1000)
+        if max_chunk is not None:
+            chunk = max_chunk        # explicit caller bound (run horizon)
+        else:
+            chunk = self.chunk_steps
+            if self.ffmode:
+                chunk = max(chunk, 1000)
         limit = chunk
         # Subsystem dt clamps (conditionals <= 1 s, trail resolution,
         # smallest plugin interval).  These derive from a handful of
@@ -235,6 +346,10 @@ class Simulation:
         plugdt = self.plugins.min_dt()
         if plugdt is not None:
             c = max(1, int(round(plugdt / self.cfg.simdt)))
+            dtclamp = c if dtclamp is None else min(dtclamp, c)
+        if self.plotter.plots:
+            pdt = min(p.dt for p in self.plotter.plots)
+            c = max(1, int(round(pdt / self.cfg.simdt)))
             dtclamp = c if dtclamp is None else min(dtclamp, c)
         if dtclamp is not None:
             limit = min(limit, dtclamp)
@@ -287,6 +402,7 @@ class Simulation:
         self.plugins.update(self.simt)
         self.traf.flush()
         self.cond.update()
+        self.plotter.update(self.simt)
         self.traf.trails.update(self.simt)
         from ..utils import datalog
         datalog.postupdate(self)
@@ -311,9 +427,14 @@ class Simulation:
         it = 0
         while it < max_iters:
             it += 1
-            if until_simt is not None and self.simt >= until_simt - 1e-9:
-                break
-            alive = self.step()
+            mc = None
+            if until_simt is not None:
+                remaining = until_simt - self.simt
+                if remaining <= 1e-9:
+                    break
+                # stop exactly at the horizon (ladder-quantized downstream)
+                mc = max(1, int(round(remaining / self.cfg.simdt)))
+            alive = self.step(max_chunk=mc)
             if not alive or self.state_flag in (HOLD, END):
                 if self.state_flag == HOLD and until_simt is not None \
                         and self.simt < until_simt - 1e-9:

@@ -19,6 +19,11 @@ from typing import Any, List, Optional, Tuple
 from ..ops import aero
 
 
+class NamedPos(tuple):
+    """(lat, lon) that remembers the resolved position's name."""
+    name = None
+
+
 class ArgError(Exception):
     pass
 
@@ -189,7 +194,11 @@ class Argparser:
         return out
 
     def _parse_latlon(self, args: List[str], ai: int):
-        """(lat, lon) from two numeric tokens or one named position."""
+        """(lat, lon) from two numeric tokens or one named position.
+
+        Named positions come back as a NamedPos (a (lat, lon) tuple that
+        also carries .name) so route commands can keep the waypoint name
+        (reference wpt argtype keeps names, stack.py Argparser)."""
         t = args[ai].strip()
         if _ISLATLON.match(t.upper()) and any(c.isdigit() for c in t):
             if ai + 1 >= len(args):
@@ -209,7 +218,9 @@ class Argparser:
                 reflon = float(ac.lon[idx])
             pos = navdb.txt2pos(t, reflat, reflon)
             if pos is not None:
-                return (pos[0], pos[1]), 1
+                np_ = NamedPos((pos[0], pos[1]))
+                np_.name = t.upper()
+                return np_, 1
         raise ArgError(f"{t}: position not found")
 
     def parse_arg(self, argtype: str, txt: str, sofar: List[Any]):

@@ -18,6 +18,7 @@ from ..core import wind as windmod
 from ..core.asas import AsasConfig
 from ..core.noise import NoiseConfig
 from . import synthetic
+from .argparser import txt2alt, txt2spd
 
 
 def register_all(stack):
@@ -210,7 +211,9 @@ def register_all(stack):
         """ADDWPT acid,(wpt/lat,lon),[alt,spd,afterwp] (route.py:472)."""
         from ..core.route import WPT_LATLON
         lat, lon = pos
-        name = f"WP{sim.routes.route(idx).nwp + 1:03d}"
+        # navdb-resolved positions carry their name (NamedPos)
+        name = getattr(pos, "name", None) \
+            or f"WP{sim.routes.route(idx).nwp + 1:03d}"
         wpidx = sim.routes.addwpt(idx, name, lat, lon,
                                   alt if alt is not None else -999.0,
                                   spd if spd is not None else -999.0,
@@ -626,6 +629,329 @@ def register_all(stack):
             return tr.setTrails(idx, a1)
         return False, "Usage: TRAIL ON/OFF,[dt] or TRAIL acid,color"
 
+    # -------------------------------------------- route editing (FMS)
+    def _resolve_wpt(token, idx):
+        """wpt token -> (name, lat, lon): the 'latlon' argtype always
+        yields a tuple — plain for numeric pairs, NamedPos (carrying the
+        waypoint name) for navdb-resolved positions."""
+        lat, lon = token
+        name = getattr(token, "name", None) \
+            or f"WP{sim.routes.route(idx).nwp + 1:03d}"
+        return name, lat, lon
+
+    def after(idx, afterwp, sub, wpt, alt=None, spd=None):
+        """acid AFTER afterwp ADDWPT wpt,[alt,spd] (route.py
+        afteraddwptStack)."""
+        if str(sub).upper() != "ADDWPT":
+            return False, "Syntax: acid AFTER wpname ADDWPT wpname"
+        from ..core.route import WPT_LATLON
+        name, lat, lon = _resolve_wpt(wpt, idx)
+        wpidx = sim.routes.addwpt(idx, name, lat, lon,
+                                  alt if alt is not None else -999.0,
+                                  spd if spd is not None else -999.0,
+                                  WPT_LATLON, 1.0, afterwp)
+        if wpidx < 0:
+            return False, f"AFTER: {afterwp} not in route"
+        return True
+
+    def before(idx, beforewp, sub, wpt, alt=None, spd=None):
+        """acid BEFORE beforewp ADDWPT wpt,[alt,spd] (route.py
+        beforeaddwptStack)."""
+        if str(sub).upper() != "ADDWPT":
+            return False, "Syntax: acid BEFORE wpname ADDWPT wpname"
+        name, lat, lon = _resolve_wpt(wpt, idx)
+        wpidx = sim.routes.addwpt_before(
+            idx, beforewp, name, lat, lon,
+            alt if alt is not None else -999.0,
+            spd if spd is not None else -999.0)
+        if wpidx < 0:
+            return False, f"BEFORE: {beforewp} not in route"
+        return True
+
+    def atwpt(idx, wpname, what=None, value=None):
+        """acid AT wpname [DEL] SPD/ALT [val] (route.py atwptStack)."""
+        if what is not None and str(what).upper() == "ALT" \
+                and value is not None:
+            value = txt2alt(str(value))
+        elif what is not None and str(what).upper() == "SPD" \
+                and value is not None:
+            value = txt2spd(str(value))
+        return sim.routes.atwpt(idx, wpname, what, value)
+
+    def delrte(idx):
+        sim.routes.delrte(idx)
+        setslot("swlnav", idx, False)
+        setslot("swvnav", idx, False)
+        return True
+
+    def dumprte(idx):
+        fname = sim.routes.dumproute(idx, acname(idx))
+        return True, f"Route written to {fname}"
+
+    # ---------------------------------------------------- info / misc
+    def airway(wp):
+        """AIRWAY wp/airway (traffic.py airwaycmd)."""
+        navdb = sim.navdb
+        awid = wp.upper()
+        segs = navdb.listairway(awid)
+        if segs:
+            txt = f"Airway {awid}: " + " - ".join(
+                " ".join(leg) for leg in segs)
+            return True, txt
+        conns = navdb.listconnections(awid)
+        if conns:
+            return True, f"Connections of {awid}: " + ", ".join(
+                f"{aw}>{wpto}" for aw, wpto in conns)
+        return False, f"{wp}: no airway or connections found"
+
+    def listac():
+        ids = [i for i in traf.ids if i is not None]
+        return True, "Aircraft: " + (", ".join(ids) if ids else "(none)")
+
+    def getwind(pos, alt=None):
+        lat, lon = pos
+        vn, ve = windmod.getdata(st().wind, jnp.asarray([lat]),
+                                 jnp.asarray([lon]),
+                                 jnp.asarray([alt or 0.0]))
+        vn, ve = float(vn[0]), float(ve[0])
+        spd = float(np.hypot(vn, ve))
+        direc = float(np.degrees(np.arctan2(ve, vn)) % 360.0)
+        # wind FROM direction (meteo convention, windsim.py get)
+        return True, (f"Wind at ({lat:.4f}, {lon:.4f}): "
+                      f"{(direc + 180.0) % 360.0:03.0f} deg, "
+                      f"{spd / aero.kts:.1f} kts")
+
+    def engcmd(idx, engid=None):
+        """ENG acid,[engine_id] (perfbase engchange contract)."""
+        actype = traf.types[idx] or "NA"
+        avail = traf.coeffdb.get(actype).get("engines_avail", {})
+        if engid is None:
+            names = ", ".join(avail) if avail else "(no data)"
+            return True, f"{acname(idx)} ({actype}) engines: {names}"
+        e = avail.get(engid.upper())
+        if e is None:
+            return False, f"{engid}: not an engine of {actype}"
+        from ..models.perf_coeffs import _ff_quadratic
+        ffa, ffb, ffc = _ff_quadratic(e["ff_idl"], e["ff_app"],
+                                      e["ff_co"], e["ff_to"])
+        perf = st().perf
+        traf.state = st().replace(perf=perf.replace(
+            engthrust=perf.engthrust.at[idx].set(e["thr"]),
+            engbpr=perf.engbpr.at[idx].set(e["bpr"]),
+            ff_a=perf.ff_a.at[idx].set(ffa),
+            ff_b=perf.ff_b.at[idx].set(ffb),
+            ff_c=perf.ff_c.at[idx].set(ffc)))
+        return True, f"{acname(idx)}: engine set to {engid.upper()}"
+
+    def nom(idx):
+        """NOM acid: reset to nominal performance accel (traffic.nom)."""
+        setslot("ax", idx, aero.kts)
+        return True
+
+    def cdcmd(path=None):
+        """CD [path]: change the scenario folder (stack.py setscenpath)."""
+        if path is None:
+            return True, f"Scenario path: {stack.scenario_path}"
+        import os as _os
+        if not _os.path.isdir(path):
+            return False, f"{path}: not a directory"
+        stack.scenario_path = path
+        return True
+
+    def cdmethod(method=None):
+        """CDMETHOD [method] (asas.SetCDmethod); detection backends map
+        to SimConfig.cd_backend."""
+        if method is None:
+            return True, f"CDMETHOD {sim.cfg.cd_backend.upper()}"
+        m = method.upper()
+        table = {"STATEBASED": "dense", "DENSE": "dense",
+                 "TILED": "tiled", "PALLAS": "pallas"}
+        if m not in table:
+            return False, (f"CDMETHOD {method} not available "
+                           "(have: STATEBASED/DENSE, TILED, PALLAS)")
+        sim.cfg = sim.cfg._replace(cd_backend=table[m])
+        return True
+
+    def asasv(minmax=None, spd=None):
+        """ASASV MAX/MIN SPD (asas.SetVLimits; TAS in kts)."""
+        if minmax is None:
+            c = sim.cfg.asas
+            return True, (f"ASAS speed limits: {c.vmin / aero.kts:.0f}"
+                          f"-{c.vmax / aero.kts:.0f} kts")
+        mm = minmax.upper()
+        if spd is None or mm not in ("MIN", "MAX"):
+            return False, "Usage: ASASV MAX/MIN spd (kts)"
+        if mm == "MIN":
+            _setasas(vmin=float(spd) * aero.kts)
+        else:
+            _setasas(vmax=float(spd) * aero.kts)
+        return True
+
+    def priorules(flag=None, priocode=None):
+        """PRIORULES [ON/OFF PRIOCODE] (asas.SetPrio + MVP.py:235-300)."""
+        if flag is None:
+            c = sim.cfg.asas
+            return True, (f"PRIORULES {'ON' if c.swprio else 'OFF'} "
+                          f"{c.priocode}")
+        if sim.cfg.cd_backend != "dense" and flag:
+            return False, ("PRIORULES needs the dense CD backend "
+                           "(per-pair priority masks)")
+        kw = dict(swprio=bool(flag))
+        if priocode is not None:
+            pc = priocode.upper()
+            if pc not in ("FF1", "FF2", "FF3", "LAY1", "LAY2"):
+                return False, (f"Priority code {priocode} not understood;"
+                               " use FF1/FF2/FF3/LAY1/LAY2")
+            kw["priocode"] = pc
+        _setasas(**kw)
+        return True
+
+    def rfach(factor=None):
+        if factor is None:
+            return True, f"RFACH {sim.cfg.asas.resofach}"
+        _setasas(resofach=float(factor))
+        return True
+
+    def rfacv(factor=None):
+        if factor is None:
+            return True, f"RFACV {sim.cfg.asas.resofacv}"
+        _setasas(resofacv=float(factor))
+        return True
+
+    # ------------------------------------------------- time / sim ctrl
+    def timecmd(arg=None):
+        return sim.setutc(arg) if arg is not None else (
+            True, f"Simulation time: {sim.utc.isoformat(' ')}")
+
+    def datecmd(*args):
+        args = [a for a in args if a is not None]
+        if not args:
+            return True, f"Date: {sim.utc.date().isoformat()}"
+        return sim.setutc(*args)
+
+    def fixdt(flag, tend=None):
+        return sim.setFixdt(flag, tend)
+
+    def addnodes(n):
+        """ADDNODES n (server worker spawn; sim.addnodes on nodes)."""
+        fn = getattr(sim, "addnodes", None)
+        if fn is None:
+            # informative no-op, not a syntax error
+            return True, "ADDNODES: no server attached (headless sim)"
+        fn(int(n))
+        return True
+
+    def batchcmd(fname):
+        """BATCH scenario (sim.batch on nodes; server farm-out)."""
+        fn = getattr(sim, "batch", None)
+        if fn is None:
+            return True, "BATCH: no server attached (headless sim)"
+        return fn(fname)
+
+    # ------------------------------------------------- display state
+    def pan(arg, lon=None):
+        """PAN lat lon / acid / waypoint / LEFT/RIGHT/UP/DOWN
+        (scr.pan; raw tokens, resolved here like the reference's
+        pandir/latlon union)."""
+        a = str(arg).upper()
+        if lon is not None:
+            try:
+                return sim.scr.pan(float(a), float(lon))
+            except ValueError:
+                pass
+        step = 0.5
+        moves = {"LEFT": (0.0, -step), "RIGHT": (0.0, step),
+                 "UP": (step, 0.0), "ABOVE": (step, 0.0),
+                 "DOWN": (-step, 0.0)}
+        if a in moves:
+            dlat, dlon = moves[a]
+            return sim.scr.pan(sim.scr.ctrlat + dlat,
+                               sim.scr.ctrlon + dlon)
+        i = traf.id2idx(a)
+        if isinstance(i, int) and i >= 0:
+            return sim.scr.pan(float(st().ac.lat[i]),
+                               float(st().ac.lon[i]))
+        pos = sim.navdb.txt2pos(a, sim.scr.ctrlat, sim.scr.ctrlon)
+        if pos is not None:
+            return sim.scr.pan(pos[0], pos[1])
+        return False, f"PAN: {arg} not found"
+
+    def zoom(factor):
+        f = str(factor).upper()
+        if f == "IN":
+            return sim.scr.zoom(1.4142135623730951)
+        if f == "OUT":
+            return sim.scr.zoom(0.7071067811865475)
+        try:
+            return sim.scr.zoom(float(factor), True)
+        except (TypeError, ValueError):
+            return False, "Usage: ZOOM IN/OUT or factor"
+
+    def swrad(sw, dt=None):
+        return sim.scr.feature(sw, dt)
+
+    def filteralt(flag, bottom=None, top=None):
+        return sim.scr.filteralt(flag, bottom, top)
+
+    def insedit(txt=""):
+        return sim.scr.cmdline(txt)
+
+    def nd(acid_txt=None):
+        return sim.scr.shownd(acid_txt)
+
+    def symbol():
+        return sim.scr.symbol()
+
+    def tmx():
+        return True, "TMX command not (yet?) implemented."
+
+    def ssdcmd(acid_txt=None):
+        """SSD [acid]: report the solution-space occupancy for an
+        aircraft (headless stand-in for the GUI's SSD view — the same
+        velocity-grid mask ops/cr_ssd.py resolves on)."""
+        if acid_txt is None:
+            return True, "SSD acid: show solution-space occupancy"
+        i = traf.id2idx(acid_txt.upper())
+        if not isinstance(i, int) or i < 0:
+            return False, f"{acid_txt}: aircraft not found"
+        if sim.cfg.cd_backend != "dense" or traf.nmax > 2000:
+            # the [N, C, N] velocity-obstacle tensor is a small-N tool
+            return False, ("SSD view needs the dense backend and "
+                           "nmax <= 2000")
+        from ..ops import cd as cdops, cr_ssd
+        ac = st().ac
+        c = sim.cfg.asas
+        cdout = cdops.detect(ac.lat, ac.lon, ac.trk, ac.gs, ac.alt,
+                             ac.vs, ac.active, c.rpz, c.hpz,
+                             c.dtlookahead)
+        ssdcfg = cr_ssd.SSDConfig(rpz_m=c.rpz_m,
+                                  tlookahead=c.dtlookahead)
+        newtrk, newgs = cr_ssd.resolve(
+            cdout, ac.lat, ac.lon, ac.alt, ac.trk, ac.gs, ac.vs,
+            ac.gseast, ac.gsnorth, ac.active,
+            c.vmin, c.vmax, ssdcfg)
+        inconf = bool(cdout.inconf[i])
+        txt = (f"{acname(i)}: {'IN CONFLICT' if inconf else 'clear'}; "
+               f"SSD resolution trk {float(newtrk[i]):.0f} deg, "
+               f"spd {float(newgs[i]) / aero.kts:.0f} kts")
+        return True, txt
+
+    def doccmd(cmd=None):
+        """DOC [command]: extended help (scr.show_cmd_doc)."""
+        return helpcmd(cmd)
+
+    def makedoc():
+        """MAKEDOC: write command reference markdown (stack.py makedoc)."""
+        import os as _os
+        _os.makedirs("output", exist_ok=True)
+        fname = _os.path.join("output", "commands.md")
+        with open(fname, "w") as f:
+            f.write("# Stack command reference\n\n")
+            for name in sorted(stack.cmddict):
+                usage, _, _, helptxt = stack.cmddict[name]
+                f.write(f"## {name}\n\n    {usage}\n\n{helptxt}\n\n")
+        return True, f"Command reference written to {fname}"
+
     def helpcmd(cmd=None):
         if cmd is None:
             names = ", ".join(sorted(stack.cmddict.keys()))
@@ -746,8 +1072,7 @@ def register_all(stack):
                      "Schedule a command at a sim time"],
         "SEED": ["SEED value", "int", seed, "Set random seed"],
         "SPD": ["SPD acid,spd", "acid,spd", selspd, "Speed select command"],
-        "SSD": ["SSD [acid]", "[txt]",
-                lambda *a: (False, "SSD visualization requires the GUI"),
+        "SSD": ["SSD [acid]", "[txt]", ssdcmd,
                 "Show solution space diagram"],
         "SYN": ["SYN subcmd,args", "[txt,string,...]", syn,
                 "Synthetic conflict geometries (SUPER/WALL/MATRIX/...)"],
@@ -768,6 +1093,77 @@ def register_all(stack):
                     lambda cmd=None, name=None: sim.plugins.manage(
                         cmd or "LIST", name or ""),
                     "List, load or remove plugins"],
+        "ADDNODES": ["ADDNODES number", "int", addnodes,
+                     "Add a simulation instance/node"],
+        "AFTER": ["acid AFTER afterwp ADDWPT (wpname/lat,lon),[alt,spd]",
+                  "acid,wpinroute,txt,latlon,[alt,spd]", after,
+                  "After waypoint, add a waypoint to route of aircraft"],
+        "AIRWAY": ["AIRWAY wp/airway", "txt", airway,
+                   "Get info on airway or connections of a waypoint"],
+        "ASASV": ["ASASV MAX/MIN SPD (TAS in kts)", "[txt,float]", asasv,
+                  "Airborne Separation Assurance System Speed limits"],
+        "AT": ["acid AT wpname [DEL] SPD/ALT [spd/alt]",
+               "acid,wpinroute,[txt,txt]", atwpt,
+               "Edit, delete or show spd/alt constraints at a waypoint"],
+        "BATCH": ["BATCH filename", "string", batchcmd,
+                  "Start a scenario file as batch simulation"],
+        "BEFORE": ["acid BEFORE beforewp ADDWPT (wpname/lat,lon),[alt,spd]",
+                   "acid,wpinroute,txt,latlon,[alt,spd]", before,
+                   "Before waypoint, add a waypoint to route of aircraft"],
+        "CD": ["CD [path]", "[txt]", cdcmd,
+               "Change to a different scenario folder"],
+        "CDMETHOD": ["CDMETHOD [method]", "[txt]", cdmethod,
+                     "Set conflict detection method"],
+        "DATE": ["DATE [day,month,year,HH:MM:SS.hh]", "[int,int,int,txt]",
+                 datecmd, "Set simulation date"],
+        "DELRTE": ["DELRTE acid", "acid", delrte,
+                   "Delete the complete route/dest/orig of an aircraft"],
+        "DOC": ["DOC [command]", "[txt]", doccmd,
+                "Show extended help for a command"],
+        "DUMPRTE": ["DUMPRTE acid", "acid", dumprte,
+                    "Write route to output/routelog.txt"],
+        "ENG": ["ENG acid,[engine_id]", "acid,[txt]", engcmd,
+                "Specify a different engine type"],
+        "FILTERALT": ["FILTERALT ON/OFF,[bottom,top]", "onoff,[alt,alt]",
+                      filteralt,
+                      "Display aircraft only in an altitude range"],
+        "FIXDT": ["FIXDT ON/OFF [tend]", "onoff,[time]", fixdt,
+                  "Fix the time step"],
+        "GETWIND": ["GETWIND lat,lon,[alt]", "latlon,[alt]", getwind,
+                    "Get wind at a specified position"],
+        "INSEDIT": ["INSEDIT txt", "string", insedit,
+                    "Insert text on the edit line in command window"],
+        "LISTAC": ["LISTAC", "", listac,
+                   "List all aircraft identifiers in the simulation"],
+        "MAKEDOC": ["MAKEDOC", "", makedoc,
+                    "Write the stack command reference to output/"],
+        "ND": ["ND acid", "[txt]", nd,
+               "Show navigation display with CDTI"],
+        "NOM": ["NOM acid", "acid", nom,
+                "Set nominal acceleration for this aircraft"],
+        "PAN": ["PAN latlon/acid/airport/waypoint/LEFT/RIGHT/UP/DOWN",
+                "txt,[txt]", pan,
+                "Pan screen (move view) to a position or aircraft"],
+        "PRIORULES": ["PRIORULES [ON/OFF PRIOCODE]", "[onoff,txt]",
+                      priorules,
+                      "Define priority rules (right of way) for "
+                      "conflict resolution"],
+        "RFACH": ["RFACH [factor]", "[float]", rfach,
+                  "Set resolution factor horizontal (margin)"],
+        "RFACV": ["RFACV [factor]", "[float]", rfacv,
+                  "Set resolution factor vertical (margin)"],
+        "SWRAD": ["SWRAD GEO/GRID/APT/VOR/WPT/LABEL/TRAIL/POLY [value]",
+                  "txt,[float]", swrad,
+                  "Switch on/off elements of the radar view"],
+        "SYMBOL": ["SYMBOL", "", symbol, "Toggle aircraft symbol"],
+        "TIME": ["TIME RUN(default)/HH:MM:SS.hh/REAL/UTC", "[txt]",
+                 timecmd, "Set simulated clock time"],
+        "TMX": ["TMX", "", tmx, "Stub for not-implemented TMX commands"],
+        "PLOT": ["PLOT [x],y,[dt],[color]", "[txt,txt,float,txt]",
+                 sim.plotter.plot,
+                 "Create a plot of variables x versus y"],
+        "ZOOM": ["ZOOM IN/OUT or factor", "txt", zoom,
+                 "Zoom display in/out"],
     })
 
     # Synonyms (reference stack.py:44-115 subset)
@@ -781,4 +1177,19 @@ def register_all(stack):
         "TRAILS": "TRAIL", "POLYGON": "POLY", "POLYLINE": "LINE",
         "POLYLINES": "LINE", "LINES": "LINE", "PLUGIN": "PLUGINS",
         "PLUG-INS": "PLUGINS", "PLUG-IN": "PLUGINS",
+        # Full reference synonym table (stack.py:44-115)
+        "AWY": "POS", "AIRPORT": "POS", "AIRWAYS": "AIRWAY",
+        "CALL": "PCALL", "CHDIR": "CD", "DEBUG": "CALC",
+        "DELWP": "DELWPT", "HEADING": "HDG", "HMETH": "RMETHH",
+        "HRESOM": "RMETHH", "HRESOMETH": "RMETHH", "PRINT": "ECHO",
+        "Q": "QUIT", "RTF": "DTMULT", "RUNWAYS": "POS",
+        "RESOFACH": "RFACH", "RESOFACV": "RFACV", "SPEED": "SPD",
+        "VMETH": "RMETHV", "VRESOM": "RMETHV", "VRESOMETH": "RMETHV",
+        # Unimplemented TMX commands route to the TMX stub
+        "BGPASAS": "TMX", "DFFLEVEL": "TMX", "FFLEVEL": "TMX",
+        "FILTCONF": "TMX", "FILTTRED": "TMX", "FILTTAMB": "TMX",
+        "GRAB": "TMX", "HDGREF": "TMX", "MOVIE": "TMX",
+        "NAVDB": "TMX", "PREDASAS": "TMX", "RENAME": "TMX",
+        "RETYPE": "TMX", "SWNLRPASAS": "TMX", "TRAFRECDT": "TMX",
+        "TRAFLOGDT": "TMX", "TREACT": "TMX", "WINDGRID": "TMX",
     })

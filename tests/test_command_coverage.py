@@ -1,0 +1,216 @@
+"""Command-surface coverage: every reference stack command must exist
+here (or be explicitly waived with a reason).
+
+The reference command dictionary and synonym table are parsed from the
+actual ``/root/reference/bluesky/stack/stack.py`` source, so this test
+fails when the reference surface and ours drift apart (VERDICT round-1
+item 7's acceptance criterion).
+"""
+import re
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+REF_STACK = "/root/reference/bluesky/stack/stack.py"
+
+# Commands deliberately not implemented, with the reason on record.
+WAIVED = {
+    # (currently none — every reference command exists)
+}
+
+# Synonyms whose target differs by design.
+SYNONYM_WAIVERS = {
+    "POLYLINE": "LINE",    # POLYLINE is a LINE shape with more points
+    "POLYLINES": "LINE",
+    "LINES": "LINE",
+    "ADDAIRWAY": None,     # maps to ADDAWY, which the reference itself
+    "AIRWAY": None,        # ...does not define (dead synonym upstream)
+}
+
+
+def _reference_surface():
+    src = open(REF_STACK).read()
+    cmds = set(re.findall(r'^\s{8}"([A-Z0-9_/?+-]+)":\s*\[', src, re.M))
+    syns = dict(re.findall(r'"([A-Z0-9_/?+-]+)"\s*:\s*"([A-Z0-9_/?+-]+)"',
+                           src.split("cmdsynon")[1].split("}")[0]))
+    return cmds, syns
+
+
+@pytest.fixture(scope="module")
+def sim():
+    from bluesky_tpu.simulation.sim import Simulation
+    return Simulation(nmax=8, dtype=jnp.float64)
+
+
+def test_every_reference_command_exists(sim):
+    ref_cmds, _ = _reference_surface()
+    ours = set(sim.stack.cmddict) | set(sim.stack.synonyms)
+    missing = ref_cmds - ours - set(WAIVED)
+    assert not missing, (
+        f"reference commands without an implementation or waiver: "
+        f"{sorted(missing)}")
+
+
+def test_every_reference_synonym_resolves(sim):
+    _, ref_syns = _reference_surface()
+    ours = set(sim.stack.cmddict)
+    for syn, target in ref_syns.items():
+        if syn in SYNONYM_WAIVERS:
+            continue
+        got = sim.stack.synonyms.get(syn, syn)
+        assert got in ours, f"synonym {syn} -> {got} has no command"
+
+
+def test_surface_size_at_reference_scale(sim):
+    ref_cmds, ref_syns = _reference_surface()
+    assert len(sim.stack.cmddict) >= len(ref_cmds) - len(WAIVED)
+    assert len(sim.stack.synonyms) >= 40
+
+
+def test_all_commands_have_usage_and_help(sim):
+    for name, entry in sim.stack.cmddict.items():
+        usage, argtypes, fn, helptxt = entry
+        assert callable(fn), name
+        assert isinstance(usage, str) and usage, name
+        assert isinstance(helptxt, str) and helptxt, name
+
+
+SMOKE = [
+    ("LISTAC", "(none)"),
+    ("TIME", "Simulation time"),
+    ("DATE", "Date:"),
+    ("ZOOM IN", None),
+    ("PAN 52 4", None),
+    ("PAN LEFT", None),
+    ("SWRAD GEO", None),
+    ("SYMBOL", None),
+    ("FILTERALT ON FL100 FL300", None),
+    ("FILTERALT OFF", None),
+    ("CD", "Scenario path"),
+    ("CDMETHOD", "CDMETHOD"),
+    ("ASASV MAX 350", None),
+    ("ASASV", "limits"),
+    ("RFACH 1.1", None),
+    ("RFACH", "1.1"),
+    ("RFACV 1.2", None),
+    ("PRIORULES ON FF2", None),
+    ("PRIORULES", "FF2"),
+    ("PRIORULES OFF", None),
+    ("GETWIND 52 4", "Wind at"),
+    ("TMX", "TMX"),
+    ("MOVIE", "TMX"),          # TMX synonym routing
+    ("INSEDIT CRE KL", None),
+    ("ND KL204", None),
+    ("MAKEDOC", "commands.md"),
+    ("DOC CRE", "CRE"),
+    ("ADDNODES 2", "no server"),
+    ("BATCH foo.scn", "no server"),
+]
+
+
+@pytest.mark.parametrize("cmdline,expect", SMOKE,
+                         ids=[c for c, _ in SMOKE])
+def test_command_smoke(sim, cmdline, expect):
+    sim.scr.echobuf.clear()
+    sim.stack.stack(cmdline)
+    sim.stack.process()
+    out = "\n".join(sim.scr.echobuf)
+    assert "Unknown command" not in out, out
+    assert "Usage" not in out or expect == "Usage", out
+    if expect:
+        assert expect in out, f"{cmdline}: expected {expect!r} in {out!r}"
+
+
+class TestPlotter:
+    def test_plot_samples_series(self):
+        from bluesky_tpu.simulation.sim import Simulation
+        s = Simulation(nmax=8, dtype=jnp.float64)
+        s.stack.stack("CRE KL1 B744 52 4 90 FL200 250")
+        s.stack.process()
+        s.scr.echobuf.clear()
+        s.stack.stack("PLOT simt lat 1")       # lat vs simt at 1 s
+        s.stack.process()
+        assert "Unknown" not in "\n".join(s.scr.echobuf)
+        s.op()
+        s.fastforward()
+        s.run(until_simt=10.0)
+        plots = s.plotter.plots
+        assert plots, "no plots registered"
+        p = plots[-1]
+        assert len(p.series[1]) >= 9           # ~1 Hz over 10 s
+        # lat of the eastbound aircraft stays ~52
+        lastlat = np.asarray(p.series[1][-1])
+        assert abs(float(np.ravel(lastlat)[0]) - 52.0) < 0.1
+
+    def test_unknown_variable_rejected(self):
+        from bluesky_tpu.simulation.sim import Simulation
+        s = Simulation(nmax=8, dtype=jnp.float64)
+        s.stack.stack("PLOT nosuchvar")
+        s.stack.process()
+        out = "\n".join(s.scr.echobuf)
+        assert "not found" in out
+
+
+class TestRouteEditing:
+    @pytest.fixture()
+    def rsim(self):
+        from bluesky_tpu.simulation.sim import Simulation
+        s = Simulation(nmax=8, dtype=jnp.float64)
+        s.stack.stack("CRE KL1 B744 52 4 90 FL200 250")
+        s.stack.stack("ADDWPT KL1 52.0 5.0")
+        s.stack.stack("ADDWPT KL1 52.0 6.0")
+        s.stack.process()
+        return s
+
+    def _do(self, s, *lines):
+        for line in lines:
+            s.stack.stack(line)
+        s.stack.process()
+        out = "\n".join(s.scr.echobuf)
+        s.scr.echobuf.clear()
+        return out
+
+    def test_after_before_insert(self, rsim):
+        i = rsim.traf.id2idx("KL1")
+        r = rsim.routes.route(i)
+        assert r.nwp == 2
+        first = r.name[0]
+        self._do(rsim, f"KL1 AFTER {first} ADDWPT 52.0 5.5")
+        assert rsim.routes.route(i).nwp == 3
+        assert rsim.routes.route(i).lon[1] == pytest.approx(5.5)
+        self._do(rsim, f"KL1 BEFORE {first} ADDWPT 52.0 4.5")
+        assert rsim.routes.route(i).nwp == 4
+        assert rsim.routes.route(i).lon[0] == pytest.approx(4.5)
+
+    def test_at_constraints(self, rsim):
+        i = rsim.traf.id2idx("KL1")
+        wp = rsim.routes.route(i).name[1]
+        out = self._do(rsim, f"KL1 AT {wp} ALT FL300")
+        assert "Usage" not in out
+        from bluesky_tpu.ops import aero
+        assert rsim.routes.route(i).alt[1] == pytest.approx(
+            30000 * aero.ft)
+        out = self._do(rsim, f"KL1 AT {wp}")
+        assert "alt" in out
+        self._do(rsim, f"KL1 AT {wp} DEL ALT")
+        assert rsim.routes.route(i).alt[1] == -999.0
+
+    def test_delrte_and_dumprte(self, rsim, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        i = rsim.traf.id2idx("KL1")
+        out = self._do(rsim, "DUMPRTE KL1")
+        assert "routelog" in out
+        assert (tmp_path / "output" / "routelog.txt").exists()
+        self._do(rsim, "DELRTE KL1")
+        assert rsim.routes.route(i).nwp == 0
+
+    def test_eng_command(self, rsim):
+        out = self._do(rsim, "ENG KL1")
+        assert "engines" in out
+        # change to a listed engine if the OpenAP data gave options
+        avail = rsim.traf.coeffdb.get("B744").get("engines_avail", {})
+        if avail:
+            name = next(iter(avail))
+            out = self._do(rsim, f"ENG KL1 {name}")
+            assert "engine set" in out
