@@ -71,10 +71,26 @@ def run_server(args):
     return 0
 
 
+def _start_telnet(sim):
+    """Raw-TCP stack bridge on settings.telnet_port (the reference's
+    StackTelnetServer, enabled for sim nodes; tools/network.py:151-184)."""
+    if not settings.telnet_port:
+        return
+    from .network.tcpserver import StackTelnetServer
+    try:
+        sim.telnet = StackTelnetServer(sim, port=settings.telnet_port)
+        sim.telnet.start()
+        print(f"Telnet stack bridge on port {sim.telnet.port}")
+    except OSError as e:
+        print(f"Telnet bridge not started: {e}")
+        sim.telnet = None
+
+
 def run_sim(args):
     from .simulation.simnode import SimNode
     node = SimNode(event_port=args.event_port,
                    stream_port=args.stream_port)
+    _start_telnet(node.sim)
     if args.scenfile:
         node.sim.stack.ic(args.scenfile)
     node.run()
@@ -84,6 +100,7 @@ def run_sim(args):
 def run_detached(args):
     from .simulation.simnode import DetachedSimNode
     node = DetachedSimNode()
+    _start_telnet(node.sim)
     if args.scenfile:
         node.sim.stack.ic(args.scenfile)
     node.run()

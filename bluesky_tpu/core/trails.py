@@ -54,6 +54,15 @@ class Trails:
         self.bglon1 = np.array([])
         self.bgtime = np.array([])
         self.bgcol = np.zeros((0, 3), dtype=np.uint8)
+        # Segments added since the last ACDATA send (the stream sends
+        # only deltas: screenio.py:216-222 newlat0.../clearnew)
+        self.clearnew()
+
+    def clearnew(self):
+        self.newlat0 = np.array([])
+        self.newlon0 = np.array([])
+        self.newlat1 = np.array([])
+        self.newlon1 = np.array([])
 
     # ------------------------------------------------------------ lifecycle
     def create(self, idx, lat, lon, t=0.0):
@@ -103,6 +112,14 @@ class Trails:
         self.lon1 = np.append(self.lon1, lon[idxs])
         self.time = np.append(self.time, np.full(len(idxs), t))
         self.col = np.concatenate([self.col, self.accolor[idxs]], axis=0)
+        self.newlat0 = np.append(self.newlat0, self.lastlat[idxs])
+        self.newlon0 = np.append(self.newlon0, self.lastlon[idxs])
+        self.newlat1 = np.append(self.newlat1, lat[idxs])
+        self.newlon1 = np.append(self.newlon1, lon[idxs])
+        if len(self.newlat0) > 10000:
+            # No consumer draining the deltas (headless run, or a GUI
+            # stalled >10k segments behind): drop the backlog
+            self.clearnew()
         self.lastlat[idxs] = lat[idxs]
         self.lastlon[idxs] = lon[idxs]
         self.lasttim[idxs] = t

@@ -28,8 +28,12 @@ class ScreenIO(DisplayState):
         self.sim = sim
         self.node = node
         self.current_sender = ""      # set by the stack before echo calls
-        self.echobuf = []             # retained for embedded inspection
+        self.echobuf = []             # bounded echo history
         self._init_display()
+        self._nconf_prev = 0
+        self._nconf_tot = 0
+        self._nlos_prev = 0
+        self._nlos_tot = 0
         self.samplecount = 0
         self.prevcount = 0
         self.prevtime = time.perf_counter()
@@ -47,10 +51,24 @@ class ScreenIO(DisplayState):
         pass
 
     # ------------------------------------------------------------- commands
+    def reset(self):
+        """Sim RESET: clear display state + cumulative counters."""
+        self._init_display()
+        self._nconf_prev = self._nconf_tot = 0
+        self._nlos_prev = self._nlos_tot = 0
+
     def echo(self, text="", flags=0):
         self.echobuf.append(text)
-        route = [bytes.fromhex(self.current_sender)] \
-            if self.current_sender else None
+        if len(self.echobuf) > 1000:      # bounded history
+            del self.echobuf[:-500]
+        # ZMQ senders are hex route ids; non-hex senders (the TCP/telnet
+        # bridge uses 'tcpN') get their reply from the bridge's own
+        # echobuf capture, so the event is broadcast instead of routed.
+        try:
+            route = [bytes.fromhex(self.current_sender)] \
+                if self.current_sender else None
+        except ValueError:
+            route = None
         self.node.send_event(b"ECHO", {"text": text, "flags": flags}, route)
         return True
 
@@ -63,6 +81,8 @@ class ScreenIO(DisplayState):
         if now >= self._next_acdata:
             self._next_acdata = now + ACDATA_DT
             self.send_aircraft_data()
+            if self.route_acid:
+                self.send_route_data()
 
     # -------------------------------------------------------------- streams
     def send_siminfo(self):
@@ -78,29 +98,79 @@ class ScreenIO(DisplayState):
             "scenname": getattr(self.sim.stack, "scenname", "")})
 
     def send_aircraft_data(self):
-        """ACDATA stream at 5 Hz (screenio.py:194-239)."""
-        traf = self.sim.traf
-        st = traf.state.ac
+        """ACDATA stream at 5 Hz, shaped to what the reference Qt
+        GuiClient consumes (screenio.py:194-239 producer,
+        guiclient.py:93-296 consumer): per-aircraft state arrays,
+        conflict flags/counters, ASAS resolution vectors and speed caps,
+        and delta-encoded trail segments.
+
+        Counter semantics divergence: the reference counts its host-side
+        unique/cumulative pair SETS; here the current counts come from
+        the device scalars (directional, halved) and the totals from a
+        host accumulator of count increases — same monotonic meaning
+        without an [N,N] transfer at 5 Hz.
+        """
+        sim = self.sim
+        traf = sim.traf
+        state = traf.state
+        st = state.ac
         active = np.asarray(st.active)
         idx = np.flatnonzero(active)
-        data = {"simt": self.sim.simt,
+        data = {"simt": sim.simt,
                 "id": [traf.ids[i] for i in idx],
-                "type": [traf.types[i] for i in idx]}
+                "actype": [traf.types[i] for i in idx]}
         for name in ("lat", "lon", "alt", "trk", "tas", "gs", "cas",
-                     "vs", "inconf"):
-            arr = getattr(st, name, None)
-            if arr is not None:
-                data[name] = np.asarray(arr)[idx]
+                     "vs"):
+            data[name] = np.asarray(getattr(st, name))[idx]
+        asas = state.asas
+        data["inconf"] = np.asarray(asas.inconf)[idx]
+        data["tcpamax"] = np.asarray(asas.tcpamax)[idx]
+        data["asasn"] = np.asarray(asas.asasn)[idx]
+        data["asase"] = np.asarray(asas.asase)[idx]
+        nconf = int(asas.nconf_cur) // 2      # directional -> pairs
+        nlos = int(asas.nlos_cur) // 2
+        self._nconf_tot += max(0, nconf - self._nconf_prev)
+        self._nlos_tot += max(0, nlos - self._nlos_prev)
+        self._nconf_prev, self._nlos_prev = nconf, nlos
+        data["nconf_cur"] = nconf
+        data["nconf_tot"] = self._nconf_tot
+        data["nlos_cur"] = nlos
+        data["nlos_tot"] = self._nlos_tot
+        data["vmin"] = sim.cfg.asas.vmin
+        data["vmax"] = sim.cfg.asas.vmax
+        # Trails: only the segments added since the last send
+        # (screenio.py:216-227)
+        trails = traf.trails
+        data["swtrails"] = trails.active
+        data["traillat0"] = trails.newlat0
+        data["traillon0"] = trails.newlon0
+        data["traillat1"] = trails.newlat1
+        data["traillon1"] = trails.newlon1
+        trails.clearnew()
+        data["traillastlat"] = trails.lastlat[idx]
+        data["traillastlon"] = trails.lastlon[idx]
+        data["translvl"] = getattr(traf, "translvl", 0.0)
         self.node.send_stream(b"ACDATA", data)
 
     def send_route_data(self, acid=""):
         """ROUTEDATA for the requested aircraft (screenio.py:241-263)."""
         traf = self.sim.traf
+        acid = acid or self.route_acid
+        if not acid:
+            return
         i = traf.id2idx(acid)
         if i < 0:
+            # Aircraft gone: acid-only frame clears the GUI's route
+            # display (reference sends data with just 'acid' when idx<0)
+            self.node.send_stream(b"ROUTEDATA", {"acid": acid})
+            self.route_acid = ""
             return
         rte = self.sim.routes.route(i)
+        st = traf.state.ac
         self.node.send_stream(b"ROUTEDATA", {
-            "acid": acid, "wplat": list(rte.lat), "wplon": list(rte.lon),
+            "acid": acid,
+            "aclat": float(st.lat[i]), "aclon": float(st.lon[i]),
+            "wplat": list(rte.lat), "wplon": list(rte.lon),
             "wpalt": list(rte.alt), "wpspd": list(rte.spd),
             "wpname": list(rte.name), "iactwp": rte.iactwp})
+

@@ -47,6 +47,17 @@ class DisplayState:
         self.swsymbol = True
         self.editline = ""
         self.nd_acid = None
+        self.route_acid = ""        # ROUTEDATA selection (showroute)
+
+    def showroute(self, acid=""):
+        """Select the aircraft whose route streams in ROUTEDATA
+        (reference scr.showroute, called from POS)."""
+        self.route_acid = acid
+        return True
+
+    def reset(self):
+        """Clear display state on sim RESET (reference ScreenIO.reset)."""
+        self._init_display()
 
     def getviewbounds(self):
         """Lat/lon box currently in view (screenio pan/zoom state)."""
@@ -149,6 +160,7 @@ class Simulation:
         self.areas = AreaRegistry(self.scr)
         self.cond = ConditionList(self)
         self.plotter = Plotter(self)
+        self.telnet = None            # StackTelnetServer when enabled
         self.traf.delete_hooks.append(self.cond.delac)
         # Late import to avoid cycles; stack binds commands to this sim.
         from ..stack.stack import Stack
@@ -270,6 +282,7 @@ class Simulation:
         self.stack.reset()
         from ..utils import datalog
         datalog.reset()
+        self.scr.reset()
         # After stack.reset: plugin reset hooks may stack commands (e.g.
         # TRAFGEN redraws its spawn circle) that must survive the reset.
         self.plugins.reset()
@@ -304,6 +317,9 @@ class Simulation:
         if self.state_flag == END:
             return False
 
+        # External TCP/telnet command lines (tools/network.py bridge)
+        if self.telnet is not None:
+            self.telnet.pump()
         # Scenario commands due at current sim time (stack.checkfile)
         self.stack.checkfile(self.simt)
         # Process pending commands (may change state/config/traffic)

@@ -136,6 +136,9 @@ def register_all(stack):
                f"TAS: {float(s.ac.tas[i]) / aero.kts:.0f} kts   "
                f"GS: {float(s.ac.gs[i]) / aero.kts:.0f} kts\n"
                f"VS: {float(s.ac.vs[i]) / aero.fpm:.0f} fpm")
+        # POS also selects this aircraft's route for the ROUTEDATA
+        # stream (reference traffic.py:587 poscommand -> scr.showroute)
+        sim.scr.showroute(acname(i))
         return True, txt
 
     def defwpt(name, pos, wptype=None):

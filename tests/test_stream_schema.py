@@ -1,0 +1,129 @@
+"""GuiClient protocol conformance: ACDATA / ROUTEDATA / SIMINFO schema.
+
+The required field set is parsed from the REAL reference producer
+(``simulation/qtgl/screenio.py`` send_aircraft_data/send_route_data) so
+this test fails if the reference contract and our streams drift apart —
+the reference Qt GuiClient (guiclient.py:93-296) consumes exactly these
+keys.  Transport check runs over real localhost ZMQ via the sim fabric.
+"""
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+zmq = pytest.importorskip("zmq")
+
+from bluesky_tpu.network.client import Client
+from bluesky_tpu.network.server import Server
+from bluesky_tpu.simulation.simnode import SimNode
+from tests.test_network import free_ports, wait_for
+
+REF_SCREENIO = "/root/reference/bluesky/simulation/qtgl/screenio.py"
+
+
+def _ref_keys(funcname):
+    src = open(REF_SCREENIO).read()
+    body = src.split(f"def {funcname}")[1].split("\n    def ")[0]
+    return set(re.findall(r"data\['(\w+)'\]", body))
+
+
+@pytest.fixture
+def simfabric():
+    ev, st, wev, wst = free_ports(4)
+    server = Server(headless=True,
+                    ports=dict(event=ev, stream=st, wevent=wev,
+                               wstream=wst),
+                    spawn_workers=False)
+    server.start()
+    time.sleep(0.2)
+    node = SimNode(event_port=wev, stream_port=wst, nmax=32)
+    thread = threading.Thread(target=node.run, daemon=True)
+    thread.start()
+    client = Client()
+    client.connect(event_port=ev, stream_port=st, timeout=5.0)
+    assert wait_for(lambda: (client.receive(10), len(client.nodes) > 0)[1])
+    yield server, node, client
+    node.quit()
+    thread.join(timeout=5)
+    server.stop()
+    server.join(timeout=5)
+    client.close()
+
+
+def test_acdata_covers_reference_schema(simfabric):
+    server, node, client = simfabric
+    frames = []
+    client.stream_received.connect(
+        lambda n, d, s: frames.append(d) if n == b"ACDATA" else None)
+    client.subscribe(b"ACDATA")
+    time.sleep(0.3)
+    client.stack("CRE KL204 B744 52 4 90 FL200 250")
+    client.stack("TRAIL ON")
+    client.stack("OP")
+    assert wait_for(
+        lambda: (client.receive(10),
+                 any(f.get("id") for f in frames))[1], timeout=60)
+    frame = next(f for f in reversed(frames) if f.get("id"))
+
+    want = _ref_keys("send_aircraft_data")
+    got = set(frame)
+    missing = want - got
+    assert not missing, f"ACDATA missing GuiClient fields: {missing}"
+
+    # Types/shapes the radar widget relies on (guiclient.py setacdata)
+    n = len(frame["id"])
+    for key in ("lat", "lon", "alt", "tas", "cas", "gs", "trk", "vs",
+                "inconf", "tcpamax", "asasn", "asase"):
+        assert np.asarray(frame[key]).shape == (n,), key
+    assert isinstance(frame["actype"], list)
+    for key in ("nconf_cur", "nconf_tot", "nlos_cur", "nlos_tot"):
+        assert int(frame[key]) >= 0
+    assert isinstance(frame["swtrails"], (bool, np.bool_))
+
+
+def test_routedata_covers_reference_schema(simfabric):
+    server, node, client = simfabric
+    frames = []
+    client.stream_received.connect(
+        lambda n, d, s: frames.append(d) if n == b"ROUTEDATA" else None)
+    client.subscribe(b"ROUTEDATA")
+    time.sleep(0.3)
+    client.stack("CRE KL204 B744 52 4 90 FL200 250")
+    client.stack("ADDWPT KL204 52.5 5.0")
+    client.stack("ADDWPT KL204 53.0 6.0")
+    client.stack("LISTRTE KL204")
+    # showroute selection happens sim-side
+    node.sim.scr.showroute("KL204")
+    client.stack("OP")
+    assert wait_for(
+        lambda: (client.receive(10), len(frames) > 0)[1], timeout=60)
+    frame = frames[-1]
+    want = _ref_keys("send_route_data")
+    missing = want - set(frame)
+    assert not missing, f"ROUTEDATA missing GuiClient fields: {missing}"
+    assert frame["acid"] == "KL204"
+    assert len(frame["wplat"]) == len(frame["wpname"]) == 2
+    assert isinstance(frame["iactwp"], int)
+
+
+def test_trail_segments_stream_as_deltas(simfabric):
+    server, node, client = simfabric
+    frames = []
+    client.stream_received.connect(
+        lambda n, d, s: frames.append(d) if n == b"ACDATA" else None)
+    client.subscribe(b"ACDATA")
+    time.sleep(0.3)
+    client.stack("CRE KL204 B744 52 4 90 FL200 250")
+    client.stack("TRAIL ON 1")      # 1 s resolution
+    client.stack("FF")
+    client.stack("OP")
+    assert wait_for(
+        lambda: (client.receive(10),
+                 sum(len(np.atleast_1d(f.get("traillat0", [])))
+                     for f in frames) >= 3)[1], timeout=60)
+    # Deltas: total streamed segments ~ number appended, not resent
+    total = sum(len(np.atleast_1d(f.get("traillat0", [])))
+                for f in frames)
+    assert total <= len(node.sim.traf.trails.lat0) + 4

@@ -1,0 +1,86 @@
+"""TCP/telnet stack bridge: raw-socket command lines + echoed replies.
+
+Models the reference's end-to-end TCP tests (test/tcp/test_simple.py:
+send stack commands as text over a plain socket, assert on the echoed
+responses) against the in-process Simulation + StackTelnetServer.
+"""
+import socket
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from bluesky_tpu.network.tcpserver import StackTelnetServer
+
+
+@pytest.fixture()
+def simtcp():
+    from bluesky_tpu.simulation.sim import Simulation
+    sim = Simulation(nmax=16, dtype=jnp.float64)
+    srv = StackTelnetServer(sim, port=0)     # ephemeral port
+    port = srv.start()
+    sim.telnet = srv
+    yield sim, srv, port
+    srv.stop()
+
+
+def _send_and_pump(sim, sock, line, timeout=5.0):
+    sock.sendall(line.encode() + b"\n")
+    deadline = time.time() + timeout
+    sock.settimeout(0.1)
+    reply = b""
+    while time.time() < deadline:
+        sim.step()       # the sim loop pumps the bridge
+        try:
+            reply += sock.recv(65536)
+            if reply.endswith(b"\n"):
+                break
+        except socket.timeout:
+            continue
+    return reply.decode(errors="ignore")
+
+
+def test_cre_pos_over_tcp(simtcp):
+    sim, srv, port = simtcp
+    with socket.create_connection(("127.0.0.1", port), timeout=5) as sock:
+        time.sleep(0.1)
+        _send_and_pump(sim, sock, "CRE KL204 B744 52 4 90 FL200 250")
+        out = _send_and_pump(sim, sock, "POS KL204")
+        assert "KL204" in out and "20000 ft" in out
+        assert sim.traf.ntraf == 1
+
+
+def test_syntax_error_reply(simtcp):
+    sim, srv, port = simtcp
+    with socket.create_connection(("127.0.0.1", port), timeout=5) as sock:
+        time.sleep(0.1)
+        out = _send_and_pump(sim, sock, "CRE")
+        assert "Usage" in out or "missing" in out
+        out = _send_and_pump(sim, sock, "NOSUCHCMD FOO")
+        assert "Unknown command" in out
+
+
+def test_two_clients_get_their_own_replies(simtcp):
+    sim, srv, port = simtcp
+    with socket.create_connection(("127.0.0.1", port), timeout=5) as s1, \
+            socket.create_connection(("127.0.0.1", port), timeout=5) as s2:
+        time.sleep(0.1)
+        out1 = _send_and_pump(sim, s1, "ECHO client one")
+        out2 = _send_and_pump(sim, s2, "ECHO client two")
+        assert "client one" in out1 and "client two" not in out1
+        assert "client two" in out2
+        assert srv.numConnections() == 2
+
+
+def test_drives_running_simulation(simtcp):
+    sim, srv, port = simtcp
+    with socket.create_connection(("127.0.0.1", port), timeout=5) as sock:
+        time.sleep(0.1)
+        _send_and_pump(sim, sock, "CRE KL204 B744 52 4 90 FL200 250")
+        _send_and_pump(sim, sock, "FF")
+        _send_and_pump(sim, sock, "OP")
+        sim.run(until_simt=30.0)
+        out = _send_and_pump(sim, sock, "POS KL204")
+        assert "KL204" in out
+        i = sim.traf.id2idx("KL204")
+        assert float(sim.traf.state.ac.lon[i]) > 4.01   # flew east
