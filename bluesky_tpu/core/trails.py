@@ -117,9 +117,13 @@ class Trails:
         self.newlat1 = np.append(self.newlat1, lat[idxs])
         self.newlon1 = np.append(self.newlon1, lon[idxs])
         if len(self.newlat0) > 10000:
-            # No consumer draining the deltas (headless run, or a GUI
-            # stalled >10k segments behind): drop the backlog
-            self.clearnew()
+            # Backlog bound (headless run with no consumer, or a GUI
+            # stalled behind): drop the OLDEST deltas, keeping the
+            # just-appended batch so an active consumer still renders
+            self.newlat0 = self.newlat0[-10000:]
+            self.newlon0 = self.newlon0[-10000:]
+            self.newlat1 = self.newlat1[-10000:]
+            self.newlon1 = self.newlon1[-10000:]
         self.lastlat[idxs] = lat[idxs]
         self.lastlon[idxs] = lon[idxs]
         self.lasttim[idxs] = t

@@ -68,6 +68,9 @@ class StackTelnetServer:
                 break
             cid = self._nextid
             self._nextid += 1
+            # Bounded sends: a stalled client must not block the sim
+            # thread in pump() (socket.timeout is an OSError there)
+            conn.settimeout(2.0)
             self._conns[cid] = conn
             threading.Thread(target=self._read_loop, args=(cid, conn),
                              daemon=True).start()
@@ -77,6 +80,8 @@ class StackTelnetServer:
         while self.running:
             try:
                 data = conn.recv(4096)
+            except socket.timeout:
+                continue           # idle connection; keep listening
             except OSError:
                 break
             if not data:

@@ -160,6 +160,8 @@ class Simulation:
         self.areas = AreaRegistry(self.scr)
         self.cond = ConditionList(self)
         self.plotter = Plotter(self)
+        from ..core.metrics import Metrics
+        self.metrics = Metrics(self)
         self.telnet = None            # StackTelnetServer when enabled
         self.traf.delete_hooks.append(self.cond.delac)
         # Late import to avoid cycles; stack binds commands to this sim.
@@ -283,6 +285,7 @@ class Simulation:
         from ..utils import datalog
         datalog.reset()
         self.scr.reset()
+        self.metrics.reset()
         # After stack.reset: plugin reset hooks may stack commands (e.g.
         # TRAFGEN redraws its spawn circle) that must survive the reset.
         self.plugins.reset()
@@ -367,6 +370,9 @@ class Simulation:
             pdt = min(p.dt for p in self.plotter.plots)
             c = max(1, int(round(pdt / self.cfg.simdt)))
             dtclamp = c if dtclamp is None else min(dtclamp, c)
+        if self.metrics.metric_number >= 0:
+            c = max(1, int(round(self.metrics.dt / self.cfg.simdt)))
+            dtclamp = c if dtclamp is None else min(dtclamp, c)
         if dtclamp is not None:
             limit = min(limit, dtclamp)
         tnext = self.stack.next_trigger_time()
@@ -419,6 +425,7 @@ class Simulation:
         self.traf.flush()
         self.cond.update()
         self.plotter.update(self.simt)
+        self.metrics.update()
         self.traf.trails.update(self.simt)
         from ..utils import datalog
         datalog.postupdate(self)

@@ -1,0 +1,91 @@
+"""Binary state snapshots: save/restore the full device pytree + host
+bookkeeping.
+
+The reference has NO binary checkpointing — its mechanism is command-log
+record/replay (SAVEIC/IC, stack.py:1185-1321), which this framework also
+implements.  SURVEY §5.4 flags the true device-state snapshot as the
+cheap win the reference lacks: with the whole simulation state in one
+pytree, a checkpoint is one host transfer + one pickle.
+
+Saved: every SimState array (as NumPy), the host slot tables (ids,
+types), per-slot routes, and enough sim config to resume (simdt, ASAS
+config, cd backend).  Restore requires a Traffic with the same nmax/wmax
+(stated in the file header and checked).
+"""
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+FORMAT = 2
+
+
+def save(sim, fname):
+    """Write a snapshot of the complete simulation state."""
+    traf = sim.traf
+    traf.flush()
+    state_np = jax.tree.map(lambda a: np.asarray(a), traf.state)
+    routes = {i: dict(name=list(r.name), lat=list(r.lat),
+                      lon=list(r.lon), alt=list(r.alt),
+                      spd=list(r.spd), wtype=list(r.wtype),
+                      flyby=list(r.flyby), iactwp=r.iactwp)
+              for i, r in sim.routes.routes.items()}
+    blob = dict(
+        format=FORMAT,
+        nmax=traf.nmax, wmax=traf.wmax,
+        state=state_np,
+        ids=list(traf.ids), types=list(traf.types),
+        autoid=traf._autoid,
+        cfg=dict(simdt=sim.cfg.simdt, cd_backend=sim.cfg.cd_backend,
+                 asas=sim.cfg.asas._asdict()),
+        dtmult=sim.dtmult,
+        routes=routes,
+    )
+    with open(fname, "wb") as f:
+        pickle.dump(blob, f, protocol=pickle.HIGHEST_PROTOCOL)
+    return fname
+
+
+def load(sim, fname):
+    """Restore a snapshot into the running simulation."""
+    with open(fname, "rb") as f:
+        blob = pickle.load(f)
+    if blob.get("format") != FORMAT:
+        return False, f"{fname}: unsupported snapshot format"
+    traf = sim.traf
+    if blob["nmax"] != traf.nmax or blob["wmax"] != traf.wmax:
+        return False, (f"snapshot is nmax={blob['nmax']}/"
+                       f"wmax={blob['wmax']}; this sim is "
+                       f"nmax={traf.nmax}/wmax={traf.wmax}")
+    sim.reset()
+    traf = sim.traf
+    # Device state: same treedef, arrays re-uploaded with current dtypes
+    traf.state = jax.tree.map(
+        lambda old, new: jnp.asarray(new, old.dtype),
+        traf.state, blob["state"])
+    traf.ids = list(blob["ids"])
+    traf.types = list(blob["types"])
+    traf._id2slot = {acid: i for i, acid in enumerate(traf.ids)
+                     if acid is not None}
+    traf._autoid = blob["autoid"]
+    # Host route tables
+    for i, r in blob.get("routes", {}).items():
+        hr = sim.routes.route(int(i))
+        hr.name = list(r["name"])
+        hr.lat = list(r["lat"])
+        hr.lon = list(r["lon"])
+        hr.alt = list(r["alt"])
+        hr.spd = list(r["spd"])
+        hr.wtype = list(r["wtype"])
+        hr.flyby = list(r["flyby"])
+        hr.iactwp = r["iactwp"]
+    # Config
+    from ..core.asas import AsasConfig
+    cfg = blob["cfg"]
+    sim.cfg = sim.cfg._replace(simdt=cfg["simdt"],
+                               cd_backend=cfg["cd_backend"],
+                               asas=AsasConfig(**cfg["asas"]))
+    sim.dtmult = blob["dtmult"]
+    return True, (f"Snapshot {fname} restored: {traf.ntraf} aircraft "
+                  f"at simt={sim.simt:.2f}")

@@ -908,6 +908,46 @@ def register_all(stack):
     def tmx():
         return True, "TMX command not (yet?) implemented."
 
+    def metricscmd(flag=None, dt=None):
+        return sim.metrics.toggle(flag, dt)
+
+    def profile(sub=None, arg=None):
+        """PROFILE START [dir] / STOP / KERNELS [nsteps]
+        (jax.profiler trace + per-kernel timing report)."""
+        from ..utils import profiler
+        s = (sub or "KERNELS").upper()
+        if s == "START":
+            logdir = profiler.start_trace(arg or "output/jax-trace")
+            return True, f"JAX trace capturing to {logdir}"
+        if s == "STOP":
+            profiler.stop_trace()
+            return True, "JAX trace stopped"
+        if s == "KERNELS":
+            if traf.ntraf == 0:
+                return False, "PROFILE KERNELS: no traffic"
+            nsteps = int(float(arg)) if arg else 50
+            return True, profiler.report(sim, nsteps)
+        return False, "PROFILE START [dir] / STOP / KERNELS [nsteps]"
+
+    def snapshot(sub, fname=None):
+        """SNAPSHOT SAVE/LOAD fname: binary pytree state checkpoint
+        (device-state snapshot the reference lacks, SURVEY 5.4)."""
+        from ..simulation import snapshot as snap
+        s = str(sub).upper()
+        if fname is None:
+            return False, "SNAPSHOT SAVE/LOAD filename"
+        if not fname.lower().endswith(".snap"):
+            fname += ".snap"
+        if s == "SAVE":
+            out = snap.save(sim, fname)
+            return True, f"Snapshot written to {out}"
+        if s == "LOAD":
+            import os as _os
+            if not _os.path.isfile(fname):
+                return False, f"{fname}: not found"
+            return snap.load(sim, fname)
+        return False, "SNAPSHOT SAVE/LOAD filename"
+
     def ssdcmd(acid_txt=None):
         """SSD [acid]: report the solution-space occupancy for an
         aircraft (headless stand-in for the GUI's SSD view — the same
@@ -1165,6 +1205,14 @@ def register_all(stack):
         "PLOT": ["PLOT [x],y,[dt],[color]", "[txt,txt,float,txt]",
                  sim.plotter.plot,
                  "Create a plot of variables x versus y"],
+        "METRICS": ["METRICS OFF/1/2 [dt]", "[txt,float]", metricscmd,
+                    "Sector metrics: 1=CoCa cell occupancy, "
+                    "2=HB conflict-geometry complexity"],
+        "PROFILE": ["PROFILE START [dir]/STOP/KERNELS [nsteps]",
+                    "[txt,word]", profile,
+                    "JAX trace capture and per-kernel timings"],
+        "SNAPSHOT": ["SNAPSHOT SAVE/LOAD fname", "txt,[word]", snapshot,
+                     "Save/restore a binary state snapshot"],
         "ZOOM": ["ZOOM IN/OUT or factor", "txt", zoom,
                  "Zoom display in/out"],
     })
@@ -1195,4 +1243,5 @@ def register_all(stack):
         "NAVDB": "TMX", "PREDASAS": "TMX", "RENAME": "TMX",
         "RETYPE": "TMX", "SWNLRPASAS": "TMX", "TRAFRECDT": "TMX",
         "TRAFLOGDT": "TMX", "TREACT": "TMX", "WINDGRID": "TMX",
+        "METRIC": "METRICS",
     })

@@ -100,6 +100,11 @@ class Stack:
             echo(f"{cmd}: {e}")
             echo(f"Usage: {usage}")
             return
+        except Exception as e:  # noqa: BLE001 — a command bug/bad input
+            # must never kill the sim node (stack lines arrive from
+            # remote clients); echo the failure instead.
+            echo(f"{cmd} failed: {type(e).__name__}: {e}")
+            return
         # Result protocol like the reference: True/False/None or
         # (success, echotext)
         if isinstance(result, tuple):

@@ -1,0 +1,113 @@
+"""Profiling hooks: JAX trace capture + per-kernel wall timings.
+
+SURVEY §5.1's build note: the reference only has BENCHMARK wall totals
+and the SIMINFO rate stream; here the PROFILE stack command adds
+``jax.profiler`` trace capture (viewable in TensorBoard/Perfetto) and a
+per-kernel timing report that times the pipeline pieces separately —
+the scanned step chunk, the CD kernel, and the MVP resolution — so the
+benchmark number can be decomposed.
+"""
+import time
+
+import numpy as np
+
+
+def start_trace(logdir="output/jax-trace"):
+    import jax
+    jax.profiler.start_trace(logdir)
+    return logdir
+
+
+def stop_trace():
+    import jax
+    jax.profiler.stop_trace()
+
+
+def kernel_timings(sim, nsteps=50, reps=3):
+    """Per-kernel wall timings [ms] at the current traffic state.
+
+    Times: one scanned step chunk (nsteps), the CD kernel alone, and
+    CD + MVP resolve — each best-of-reps with block_until_ready.
+    """
+    import jax
+    import jax.numpy as jnp
+    from ..core.step import run_steps
+    from ..ops import cd as cdops, cr_mvp
+
+    sim.traf.flush()
+    state = sim.traf.state
+    cfg = sim.cfg
+    ac = state.ac
+    acfg = cfg.asas
+
+    timings = {}
+
+    def best(fn, *args):
+        out = fn(*args)                      # compile
+        jax.block_until_ready(out)
+        t = np.inf
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = fn(*args)
+            jax.block_until_ready(out)
+            t = min(t, time.perf_counter() - t0)
+        return t * 1000.0, out
+
+    # Full chunk (not donated here: state is reused below)
+    stepfn = jax.jit(lambda s: run_steps(s, cfg, nsteps))
+    ms, _ = best(stepfn, state)
+    timings[f"step_chunk[{nsteps}]"] = ms
+    timings["per_sim_step"] = ms / nsteps
+
+    if cfg.cd_backend == "dense":
+        detect = jax.jit(lambda a: cdops.detect(
+            a.lat, a.lon, a.trk, a.gs, a.alt, a.vs, a.active,
+            acfg.rpz, acfg.hpz, acfg.dtlookahead))
+        ms, cdout = best(detect, ac)
+        timings["cd_detect"] = ms
+
+        mvpcfg = cr_mvp.MVPConfig(
+            rpz_m=acfg.rpz_m, hpz_m=acfg.hpz_m,
+            tlookahead=acfg.dtlookahead)
+        resolve = jax.jit(lambda c, a, ap: cr_mvp.resolve(
+            c, a.alt, a.gseast, a.gsnorth, a.vs, a.trk, a.gs,
+            a.selalt, ap.vs, state.asas.alt,
+            acfg.vmin, acfg.vmax, acfg.vsmin, acfg.vsmax, mvpcfg))
+        ms, _ = best(resolve, cdout, ac, state.ap)
+        timings["mvp_resolve"] = ms
+    elif cfg.cd_backend == "tiled":
+        from ..ops import cd_tiled
+        mvpcfg = cr_mvp.MVPConfig(
+            rpz_m=acfg.rpz_m, hpz_m=acfg.hpz_m,
+            tlookahead=acfg.dtlookahead)
+        tiled = jax.jit(lambda a, nr: cd_tiled.detect_resolve_tiled(
+            a.lat, a.lon, a.trk, a.gs, a.alt, a.vs, a.gseast, a.gsnorth,
+            a.active, nr, acfg.rpz, acfg.hpz, acfg.dtlookahead, mvpcfg,
+            block=cfg.cd_block))
+        ms, _ = best(tiled, ac, state.asas.noreso)
+        timings["cd_tiled"] = ms
+    else:
+        from ..ops import cd_pallas
+        mvpcfg = cr_mvp.MVPConfig(
+            rpz_m=acfg.rpz_m, hpz_m=acfg.hpz_m,
+            tlookahead=acfg.dtlookahead)
+        pal = jax.jit(lambda a, nr: cd_pallas.detect_resolve_pallas(
+            a.lat, a.lon, a.trk, a.gs, a.alt, a.vs, a.gseast, a.gsnorth,
+            a.active, nr, acfg.rpz, acfg.hpz, acfg.dtlookahead, mvpcfg,
+            block=cfg.cd_block))
+        ms, _ = best(pal, ac, state.asas.noreso)
+        timings["cd_pallas"] = ms
+
+    return timings
+
+
+def report(sim, nsteps=50):
+    t = kernel_timings(sim, nsteps)
+    n = sim.traf.ntraf
+    lines = [f"Kernel timings at N={n} ({sim.cfg.cd_backend} backend):"]
+    for name, ms in t.items():
+        lines.append(f"  {name}: {ms:.3f} ms")
+    if "per_sim_step" in t and t["per_sim_step"] > 0:
+        rate = n * 1000.0 / t["per_sim_step"]
+        lines.append(f"  -> {rate:,.0f} aircraft-steps/s")
+    return "\n".join(lines)

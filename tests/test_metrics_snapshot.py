@@ -1,0 +1,160 @@
+"""Metrics module (CoCa / HB), profiler report, binary snapshots."""
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from bluesky_tpu.core import metrics
+from bluesky_tpu.ops import aero
+
+
+@pytest.fixture()
+def sim(tmp_path, monkeypatch):
+    from bluesky_tpu.utils import datalog
+    monkeypatch.setattr(datalog, "log_path", str(tmp_path))
+    from bluesky_tpu.simulation.sim import Simulation
+    return Simulation(nmax=16, dtype=jnp.float64)
+
+
+def do(sim, *lines):
+    for line in lines:
+        sim.stack.stack(line)
+    sim.stack.process()
+    out = "\n".join(sim.scr.echobuf)
+    sim.scr.echobuf.clear()
+    return out
+
+
+class TestCoCa:
+    def test_counts_land_in_expected_cells(self):
+        area = metrics.MetricsArea()
+        # One aircraft at the grid anchor cell, one outside
+        lat = np.array([area.lat0 + 0.5 * area.dlat,
+                        area.lat0 + 10.0])
+        lon = np.array([area.lon0 + 0.5 * area.dlon,
+                        area.lon0 - 10.0])
+        alt = np.array([20000 * aero.ft, 20000 * aero.ft])
+        counts = metrics.coca_counts(area, lat, lon, alt,
+                                     np.array([True, True]))
+        assert counts.sum() == 1
+        i, j, k, inside = area.cell_indices(lat, lon, alt)
+        assert inside[0] and not inside[1]
+        assert counts[i[0], j[0], k[0]] == 1
+
+    def test_altitude_outside_levels_excluded(self):
+        area = metrics.MetricsArea()
+        lat = np.array([area.lat0 + 0.5 * area.dlat])
+        lon = np.array([area.lon0 + 0.5 * area.dlon])
+        counts = metrics.coca_counts(area, lat, lon,
+                                     np.array([1000 * aero.ft]),
+                                     np.array([True]))
+        assert counts.sum() == 0   # below FL85
+
+
+class TestHB:
+    def test_headon_pair_counts_one_encounter(self):
+        # Head-on pair inside the FIR circle
+        lat = np.array([52.6, 52.6])
+        lon = np.array([5.0, 5.8])
+        alt = np.array([9000.0, 9000.0])
+        tas = np.array([150.0, 150.0])
+        trk = np.array([90.0, 270.0])
+        cx, n, cac = metrics.hb_complexity(
+            lat, lon, alt, tas, trk, np.array([True, True]),
+            52.6, 5.4, 230.0)
+        assert (cx, n, cac) == (1, 2, 2)
+
+    def test_vertically_separated_pair_not_counted(self):
+        lat = np.array([52.6, 52.6])
+        lon = np.array([5.0, 5.8])
+        alt = np.array([9000.0, 9000.0 + 2000 * aero.ft])
+        tas = np.array([150.0, 150.0])
+        trk = np.array([90.0, 270.0])
+        cx, n, cac = metrics.hb_complexity(
+            lat, lon, alt, tas, trk, np.array([True, True]),
+            52.6, 5.4, 230.0)
+        assert cx == 0 and n == 2
+
+    def test_outside_fir_excluded(self):
+        lat = np.array([10.0, 10.0])
+        lon = np.array([5.0, 5.8])
+        alt = np.array([9000.0, 9000.0])
+        tas = np.array([150.0, 150.0])
+        trk = np.array([90.0, 270.0])
+        cx, n, cac = metrics.hb_complexity(
+            lat, lon, alt, tas, trk, np.array([True, True]),
+            52.6, 5.4, 230.0)
+        assert n == 0 and cx == 0
+
+
+class TestMetricsCommand:
+    def test_toggle_and_log(self, sim, tmp_path):
+        out = do(sim, "METRICS")
+        assert "OFF" in out
+        do(sim, "CRE KL1 B744 52.6 5.0 90 FL300 250",
+           "CRE KL2 B744 52.6 5.8 270 FL300 250")
+        out = do(sim, "METRICS 2 5")
+        assert "HB" in out
+        sim.op()
+        sim.fastforward()
+        sim.run(until_simt=20.0)
+        assert sim.metrics.last_hb[0] >= 1     # head-on encounter seen
+        sim.metrics.logger.stop()
+        logs = [f for f in os.listdir(tmp_path) if f.startswith("METLOG")]
+        assert logs
+        content = open(tmp_path / logs[0]).read()
+        assert "HB" in content
+        out = do(sim, "METRIC OFF")            # synonym
+        assert "OFF" in out
+
+
+class TestSnapshot:
+    def test_roundtrip_restores_state_bitwise(self, sim, tmp_path):
+        do(sim, "CRE KL1 B744 52 4 90 FL200 250",
+           "CRE KL2 A320 52.5 4 180 FL300 300",
+           "ADDWPT KL1 52.0 6.0")
+        sim.op()
+        sim.fastforward()
+        sim.run(until_simt=30.0)
+        fname = str(tmp_path / "mid.snap")
+        out = do(sim, f"SNAPSHOT SAVE {fname}")
+        assert "written" in out
+        lat_at_save = float(sim.traf.state.ac.lat[0])
+        simt_at_save = sim.simt
+
+        # keep flying, then restore
+        sim.run(until_simt=60.0)
+        assert float(sim.traf.state.ac.lon[0]) != pytest.approx(
+            lat_at_save)
+        out = do(sim, f"SNAPSHOT LOAD {fname}")
+        assert "restored" in out
+        assert sim.simt == pytest.approx(simt_at_save)
+        assert sim.traf.ntraf == 2
+        assert float(sim.traf.state.ac.lat[0]) == lat_at_save
+        assert sim.traf.id2idx("KL2") == 1
+        # route survived
+        assert sim.routes.route(0).nwp == 1
+        # and the sim continues stepping from the restored state
+        sim.op()
+        sim.fastforward()
+        sim.run(until_simt=simt_at_save + 10.0)
+        assert sim.simt > simt_at_save
+
+    def test_nmax_mismatch_rejected(self, sim, tmp_path):
+        from bluesky_tpu.simulation import snapshot as snap
+        from bluesky_tpu.simulation.sim import Simulation
+        do(sim, "CRE KL1 B744 52 4 90 FL200 250")
+        fname = str(tmp_path / "a.snap")
+        snap.save(sim, fname)
+        other = Simulation(nmax=8, dtype=jnp.float64)
+        ok, msg = snap.load(other, fname)
+        assert not ok and "nmax" in msg
+
+
+class TestProfiler:
+    def test_kernel_report(self, sim):
+        do(sim, "CRE KL1 B744 52 4 90 FL200 250")
+        out = do(sim, "PROFILE KERNELS 5")
+        assert "step_chunk" in out and "cd_detect" in out
+        assert "aircraft-steps/s" in out
