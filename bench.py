@@ -1,11 +1,21 @@
-"""Benchmark: aircraft-steps/sec on one chip with full CD&R pipeline.
+"""Benchmark: the north-star configuration on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Default run (the driver's): N=100,000 aircraft, full CD&R pipeline
+(FMS + state-based CD + MVP resolution @1 Hz + perf + kinematics,
+simdt=0.05), Pallas blockwise backend with the exact spatial prefilter,
+over a continental-scale airspace (35-60N, -10..30E — EU-sized; 100k
+concurrent aircraft over a 230 nm circle would be ~25x the density of
+the busiest real airspace).  Prints ONE JSON line
+{"metric", "value", "unit", "vs_baseline"}.
 
-Baseline: the reference runs 600-800 aircraft in real time on a desktop CPU
-(BlueSky ICRAT-2016 paper §IX; see BASELINE.md) at simdt=0.05 s =>
-~700 * 20 = 14,000 aircraft-steps/sec with the full pipeline.  vs_baseline is
-our aircraft-steps/sec divided by that.
+Baseline: the reference runs 600-800 aircraft in real time on a desktop
+CPU (BlueSky ICRAT-2016 paper §IX; BASELINE.md) at simdt=0.05 =>
+~700 * 20 = 14,000 aircraft-steps/sec with the full pipeline.
+
+``python bench.py N`` benches another size (backend picked by size);
+``python bench.py --detail`` additionally sweeps backends/sizes and
+writes the dense-vs-tiled-vs-pallas crossover table to
+BENCH_DETAIL.json.
 """
 import json
 import sys
@@ -16,39 +26,48 @@ import numpy as np
 BASELINE_AC_STEPS_PER_SEC = 700 * 20.0
 
 
-def main(n_ac=10000, nsteps=200, reps=5):
-    import jax
-    import jax.numpy as jnp
-    from bluesky_tpu.core.step import SimConfig, run_steps
+def _make_traffic(n_ac, geometry, pair_matrix, dtype):
     from bluesky_tpu.core.traffic import Traffic
-
-    # Beyond ~16k aircraft the dense [N,N] CD stops fitting in HBM; switch
-    # to the blockwise backend with the [N,K] partner table — the Pallas
-    # kernel on TPU (ops/cd_pallas.py), the lax formulation elsewhere.
-    tiled = n_ac > 16384
-    # Pallas kernel only on real TPU (axon = the tunnelled TPU platform);
-    # the lax 'tiled' formulation everywhere else.
-    on_tpu = jax.default_backend() in ("tpu", "axon")
-    backend = "dense" if not tiled else ("pallas" if on_tpu else "tiled")
-    nmax = n_ac
-    traf = Traffic(nmax=nmax, dtype=jnp.float32, pair_matrix=not tiled)
     rng = np.random.default_rng(0)
+    if geometry == "continental":
+        lat = rng.uniform(35.0, 60.0, n_ac)
+        lon = rng.uniform(-10.0, 30.0, n_ac)
+    else:   # regional: the trafgen 230 nm spawn circle footprint
+        ang = rng.uniform(0, 2 * np.pi, n_ac)
+        r = 3.8 * np.sqrt(rng.random(n_ac))
+        lat = 52.6 + r * np.cos(ang)
+        lon = 5.4 + r * np.sin(ang) / 0.6
+    traf = Traffic(nmax=n_ac, dtype=dtype, pair_matrix=pair_matrix)
     traf.create(n_ac, "B744",
                 rng.uniform(3000.0, 11000.0, n_ac),
                 rng.uniform(130.0, 240.0, n_ac), None,
-                rng.uniform(51.0, 53.0, n_ac),
-                rng.uniform(3.0, 6.0, n_ac),
-                rng.uniform(0.0, 360.0, n_ac))
+                lat, lon, rng.uniform(0.0, 360.0, n_ac))
     traf.flush()
+    return traf
 
-    # full pipeline: FMS + ASAS CD&R (1 Hz) + perf + kinematics
+
+def _pick_backend(n_ac):
+    import jax
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    if n_ac <= 8192:
+        return "dense"
+    return "pallas" if on_tpu else "tiled"
+
+
+def run_one(n_ac, backend=None, geometry=None, nsteps=200, reps=3):
+    """Full-pipeline aircraft-steps/s for one configuration."""
+    import jax
+    import jax.numpy as jnp
+    from bluesky_tpu.core.step import SimConfig, run_steps
+
+    backend = backend or _pick_backend(n_ac)
+    geometry = geometry or ("continental" if n_ac > 16384 else "regional")
+    traf = _make_traffic(n_ac, geometry, backend == "dense", jnp.float32)
     cfg = SimConfig(cd_backend=backend)
     state = traf.state
 
-    # warmup/compile
-    state = run_steps(state, cfg, nsteps)
+    state = run_steps(state, cfg, nsteps)     # warmup/compile
     jax.block_until_ready(state)
-
     best = 0.0
     for _ in range(reps):
         t0 = time.perf_counter()
@@ -56,11 +75,57 @@ def main(n_ac=10000, nsteps=200, reps=5):
         jax.block_until_ready(state)
         dt = time.perf_counter() - t0
         best = max(best, n_ac * nsteps / dt)
+    # sim-seconds advanced per wall-second
+    x_realtime = best * cfg.simdt / n_ac
+    return dict(n=n_ac, backend=backend, geometry=geometry,
+                ac_steps_per_s=round(best, 1),
+                x_realtime=round(x_realtime, 1))
 
+
+def cd_pairs_per_s(n_ac, backend, geometry, reps=3):
+    """CD&R kernel alone: effective pair rate."""
+    import jax
+    import jax.numpy as jnp
+    from bluesky_tpu.ops import cd_pallas, cd_tiled, cr_mvp
+
+    traf = _make_traffic(n_ac, geometry, False, jnp.float32)
+    ac = traf.state.ac
+    NM, FT = 1852.0, 0.3048
+    cfg = cr_mvp.MVPConfig(rpz_m=5 * NM * 1.05, hpz_m=1000 * FT * 1.05,
+                           tlookahead=300.0)
+    if backend == "dense":
+        from bluesky_tpu.ops import cd
+        fn = jax.jit(lambda: cd.detect(
+            ac.lat, ac.lon, ac.trk, ac.gs, ac.alt, ac.vs, ac.active,
+            5 * NM, 1000 * FT, 300.0).swconfl)
+    else:
+        kern = cd_pallas.detect_resolve_pallas if backend == "pallas" \
+            else cd_tiled.detect_resolve_tiled
+        fn = jax.jit(lambda: kern(
+            ac.lat, ac.lon, ac.trk, ac.gs, ac.alt, ac.vs, ac.gseast,
+            ac.gsnorth, ac.active, traf.state.asas.noreso,
+            5 * NM, 1000 * FT, 300.0, cfg))
+    jax.block_until_ready(fn())
+    t = 1e9
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        t = min(t, time.perf_counter() - t0)
+    return n_ac * n_ac / t
+
+
+def main(n_ac=100_000):
+    result_cfg = run_one(n_ac)
+    gpairs = cd_pairs_per_s(n_ac, result_cfg["backend"],
+                            result_cfg["geometry"]) / 1e9
+    best = result_cfg["ac_steps_per_s"]
     result = {
-        "metric": "aircraft-steps/sec/chip (N=%d, CD+MVP @1Hz, simdt=0.05%s)"
-                  % (n_ac, ", " + backend if tiled else ""),
-        "value": round(best, 1),
+        "metric": (f"aircraft-steps/sec/chip (N={n_ac}, CD+MVP @1Hz, "
+                   f"simdt=0.05, {result_cfg['backend']}, "
+                   f"{result_cfg['geometry']}, "
+                   f"CD {gpairs:.1f} Gpairs/s, "
+                   f"{result_cfg['x_realtime']:.0f}x realtime)"),
+        "value": best,
         "unit": "aircraft-steps/s",
         "vs_baseline": round(best / BASELINE_AC_STEPS_PER_SEC, 2),
     }
@@ -68,6 +133,29 @@ def main(n_ac=10000, nsteps=200, reps=5):
     return result
 
 
+def detail():
+    """Crossover table: backend x N x geometry -> BENCH_DETAIL.json."""
+    rows = []
+    for n in (1000, 4000, 8192, 16384, 50_000, 100_000):
+        for backend in ("dense", "tiled", "pallas"):
+            if backend == "dense" and n > 16384:
+                continue        # [N,N] f32 stops fitting comfortably
+            for geometry in ("regional", "continental"):
+                try:
+                    r = run_one(n, backend, geometry, nsteps=100, reps=2)
+                    rows.append(r)
+                    print(json.dumps(r))
+                except Exception as e:  # noqa: BLE001 (sweep keeps going)
+                    print(f"# {backend} N={n} {geometry}: "
+                          f"{type(e).__name__}: {str(e)[:120]}")
+    with open("BENCH_DETAIL.json", "w") as f:
+        json.dump(rows, f, indent=1)
+    return rows
+
+
 if __name__ == "__main__":
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 10000
-    main(n_ac=n)
+    if "--detail" in sys.argv:
+        detail()
+    else:
+        n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+        main(n_ac=n)

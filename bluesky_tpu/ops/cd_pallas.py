@@ -39,9 +39,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from . import cr_mvp, kmath
-from .cd_tiled import RowConflictData, TRIG_FIELDS, precompute_trig, \
-    tile_geometry
+from . import cd_tiled, cr_mvp, kmath
+from .cd_tiled import RowConflictData, TRIG_FIELDS, block_reachability, \
+    precompute_trig, tile_geometry
 
 # Packed state row order for the [nb, 13, block] slabs: 7 trig/geometry
 # columns (cd_tiled.TRIG_FIELDS), 4 velocity/altitude columns, then the
@@ -53,11 +53,47 @@ _IDX = {k: i for i, k in enumerate(_FIELDS)}
 _BIG = 1e9
 
 
-def _kernel(own_ref, intr_ref,
+def _kernel(reach_ref, own_ref, intr_ref,
             inconf_ref, tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref,
             tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref, cidx_ref,
             *, block, kk, rpz, hpz, tlookahead, mvpcfg):
+    ib = pl.program_id(0)
     jb = pl.program_id(1)
+
+    # Initialise the accumulators on the first intruder block; the tile
+    # compute below is skipped entirely for unreachable tiles, so the
+    # init must not depend on it.  Accumulating t >= 0 maxima into 0 /
+    # minima into BIG reproduces the former set-at-jb==0 semantics.
+    @pl.when(jb == 0)
+    def _():
+        zero = jnp.zeros((1, block), jnp.float32)
+        inconf_ref[0] = zero
+        tcpamax_ref[0] = zero
+        sdve_ref[0] = zero
+        sdvn_ref[0] = zero
+        sdvv_ref[0] = zero
+        tsolv_ref[0] = jnp.full((1, block), _BIG, jnp.float32)
+        ncnt_ref[0] = zero
+        lcnt_ref[0] = zero
+        ctin_ref[0] = jnp.full((kk, block), _BIG, jnp.float32)
+        cidx_ref[0] = jnp.full((kk, block), 2**30, jnp.int32)
+
+    # Exact block-level reachability skip (cd_tiled.block_reachability):
+    # a scalar-predicated branch in Mosaic, so unreachable tiles cost no
+    # VPU work.
+    @pl.when(reach_ref[ib, jb] > 0)
+    def _compute():
+        _tile_body(ib, jb, own_ref, intr_ref, inconf_ref, tcpamax_ref,
+                   sdve_ref, sdvn_ref, sdvv_ref, tsolv_ref, ncnt_ref,
+                   lcnt_ref, ctin_ref, cidx_ref, block=block, kk=kk,
+                   rpz=rpz, hpz=hpz, tlookahead=tlookahead,
+                   mvpcfg=mvpcfg)
+
+
+def _tile_body(ib, jb, own_ref, intr_ref,
+               inconf_ref, tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref,
+               tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref, cidx_ref,
+               *, block, kk, rpz, hpz, tlookahead, mvpcfg):
     oslab = own_ref[0]                                    # (_NF, block)
     islab = intr_ref[0]
 
@@ -67,7 +103,7 @@ def _kernel(own_ref, intr_ref,
     def intr(k):           # intruder operand, varies along sublanes
         return islab[_IDX[k]:_IDX[k] + 1, :].T            # (block, 1)
 
-    gid_own = pl.program_id(0) * block + jax.lax.broadcasted_iota(
+    gid_own = ib * block + jax.lax.broadcasted_iota(
         jnp.int32, (block, block), 1)
     gid_int = jb * block + jax.lax.broadcasted_iota(
         jnp.int32, (block, block), 0)
@@ -133,37 +169,19 @@ def _kernel(own_ref, intr_ref,
     t_ncnt = jnp.sum(conff, axis=0, keepdims=True)
     t_lcnt = jnp.sum(swlos.astype(dist.dtype), axis=0, keepdims=True)
 
-    @pl.when(jb == 0)
-    def _():
-        inconf_ref[0] = t_inconf
-        tcpamax_ref[0] = t_tcpamax
-        sdve_ref[0] = t_sdve
-        sdvn_ref[0] = t_sdvn
-        sdvv_ref[0] = t_sdvv
-        tsolv_ref[0] = t_tsolv
-        ncnt_ref[0] = t_ncnt
-        lcnt_ref[0] = t_lcnt
-
-    @pl.when(jb > 0)
-    def _():
-        inconf_ref[0] = jnp.maximum(inconf_ref[0], t_inconf)
-        tcpamax_ref[0] = jnp.maximum(tcpamax_ref[0], t_tcpamax)
-        sdve_ref[0] = sdve_ref[0] + t_sdve
-        sdvn_ref[0] = sdvn_ref[0] + t_sdvn
-        sdvv_ref[0] = sdvv_ref[0] + t_sdvv
-        tsolv_ref[0] = jnp.minimum(tsolv_ref[0], t_tsolv)
-        ncnt_ref[0] = ncnt_ref[0] + t_ncnt
-        lcnt_ref[0] = lcnt_ref[0] + t_lcnt
+    inconf_ref[0] = jnp.maximum(inconf_ref[0], t_inconf)
+    tcpamax_ref[0] = jnp.maximum(tcpamax_ref[0], t_tcpamax)
+    sdve_ref[0] = sdve_ref[0] + t_sdve
+    sdvn_ref[0] = sdvn_ref[0] + t_sdvn
+    sdvv_ref[0] = sdvv_ref[0] + t_sdvv
+    tsolv_ref[0] = jnp.minimum(tsolv_ref[0], t_tsolv)
+    ncnt_ref[0] = ncnt_ref[0] + t_ncnt
+    lcnt_ref[0] = lcnt_ref[0] + t_lcnt
 
     # Partner candidates: merge this tile's top-kk most urgent conflicts
     # into the running per-ownship top-kk held in the candidate refs.
     # Extraction is kk passes of masked index-min (argmin has no stable
     # Mosaic lowering); conflict-free tiles skip the whole thing.
-    @pl.when(jb == 0)
-    def _():
-        ctin_ref[0] = jnp.full((kk, block), _BIG, dist.dtype)
-        cidx_ref[0] = jnp.full((kk, block), 2**30, jnp.int32)
-
     @pl.when(jnp.any(swconfl))
     def _():
         urg = jnp.where(swconfl, tinconf, _BIG)
@@ -194,7 +212,8 @@ def _kernel(own_ref, intr_ref,
 
 def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                           active, noreso, rpz, hpz, tlookahead, mvpcfg,
-                          block=256, k_partners=8, interpret=False):
+                          block=256, k_partners=8, interpret=False,
+                          spatial_sort=True):
     """Pallas-backed equivalent of ``cd_tiled.detect_resolve_tiled``.
 
     Returns a ``RowConflictData``; reductions match the lax formulation to
@@ -202,7 +221,19 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
     Always computes in float32 (the TPU-native dtype for this kernel).
     """
     n = lat.shape[0]
+    if spatial_sort and n > block:
+        # Morton-order the slots (cd_tiled.run_spatially_sorted) so the
+        # in-kernel reachability skip has tight blocks to work with.
+        return cd_tiled.run_spatially_sorted(
+            functools.partial(detect_resolve_pallas, block=block,
+                              k_partners=k_partners, interpret=interpret,
+                              spatial_sort=False),
+            lat, lon, trk, gs, alt, vs, gseast, gsnorth, active, noreso,
+            rpz, hpz, tlookahead, mvpcfg)
     dtype = jnp.float32
+    # Scoped-VMEM budget: the tile temporaries exceed the 16 MiB stack
+    # limit above block=256 on v5e (measured 18-21 MiB at block=512).
+    block = min(block, 256)
     if n <= 128:
         block = 128
     else:
@@ -229,6 +260,11 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
     packed = jnp.stack([fields[k] for k in _FIELDS]).reshape(
         _NF, nb, block).transpose(1, 0, 2)
 
+    # Exact tile-skip flags (shared bound with the lax backend)
+    reach = block_reachability(
+        pad(lat), pad(lon), pad(gs), fields["active"] > 0.5,
+        nb, block, float(rpz), float(tlookahead)).astype(jnp.int32)
+
     kk = k_partners
     kern = functools.partial(
         _kernel, block=block, kk=kk, rpz=float(rpz), hpz=float(hpz),
@@ -249,6 +285,7 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         kern,
         grid=(nb, nb),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),       # reach flags
             pl.BlockSpec((1, _NF, block), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),       # ownship slab
             pl.BlockSpec((1, _NF, block), lambda i, j: (j, 0, 0),
@@ -257,7 +294,7 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         out_specs=[acc_spec() for _ in range(8)] + [cand_spec(), cand_spec()],
         out_shape=out_shapes,
         interpret=interpret,
-    )(packed, packed)
+    )(reach, packed, packed)
 
     (inconf, tcpamax, sdve, sdvn, sdvv, tsolv, ncnt, lcnt,
      ctin, cidx) = outs

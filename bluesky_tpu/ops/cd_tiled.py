@@ -34,6 +34,7 @@ Semantics match the reference StateBasedCD + MVP summation
 *order* differs (blockwise f32 reassociation), so golden tests compare to the
 dense path at tolerance (tests/test_cd_tiled.py).
 """
+import functools
 from typing import NamedTuple
 
 import jax
@@ -164,15 +165,159 @@ def tile_geometry(own, intr, atan2=None):
     return dist, qy / h, qx / h
 
 
+def spatial_permutation(lat, lon, active):
+    """[N] permutation ordering aircraft along a Morton (Z-order) curve.
+
+    Blocks of the tiled pair space are contiguous SLOT ranges; slots are
+    assigned in creation order, so without sorting every block's
+    bounding box spans the whole airspace and the reachability skip
+    never fires.  Sorting by interleaved 16-bit quantized lat/lon makes
+    blocks spatially tight, which is what turns the O(N^2) pair sweep
+    into ~O(N * local density) for spread-out traffic.  Inactive slots
+    sort last (their block is skipped entirely).
+    """
+    def spread16(x):
+        # 16 -> 32 bit Morton spread (standard bit tricks)
+        x = x.astype(jnp.uint32)
+        x = (x | (x << 8)) & jnp.uint32(0x00FF00FF)
+        x = (x | (x << 4)) & jnp.uint32(0x0F0F0F0F)
+        x = (x | (x << 2)) & jnp.uint32(0x33333333)
+        x = (x | (x << 1)) & jnp.uint32(0x55555555)
+        return x
+
+    # 15-bit quantization -> 30-bit code, so the inactive sentinel fits
+    # in int32 without x64
+    qlat = jnp.clip((lat + 90.0) / 180.0 * 32767.0, 0, 32767)
+    qlon = jnp.clip((lon + 180.0) / 360.0 * 32767.0, 0, 32767)
+    code = spread16(qlat.astype(jnp.uint32)) \
+        | (spread16(qlon.astype(jnp.uint32)) << 1)
+    # inactive last: force their code above every active one
+    key = jnp.where(active, code.astype(jnp.int32),
+                    jnp.int32(0x7FFFFFFF))
+    return jnp.argsort(key)
+
+
+def run_spatially_sorted(kernel, lat, lon, trk, gs, alt, vs, gseast,
+                         gsnorth, active, noreso, *args, **kw):
+    """Run a tiled CD&R kernel in Morton-sorted slot space and map the
+    results back to the caller's slot order.
+
+    Shared by the lax and Pallas backends: permutes every per-aircraft
+    input, invokes ``kernel`` (which must accept the same leading
+    arguments plus *args/**kw and return a RowConflictData), then
+    inverse-permutes the row outputs and maps the partner indices
+    through the permutation (they are sorted-space positions).
+    """
+    perm = spatial_permutation(lat, lon, active)
+    inv = jnp.argsort(perm)
+    g = lambda a: a[perm]
+    rd = kernel(g(lat), g(lon), g(trk), g(gs), g(alt), g(vs),
+                g(gseast), g(gsnorth), g(active), g(noreso),
+                *args, **kw)
+    back = lambda a: a[inv]
+    topk_idx = jnp.where(
+        rd.topk_idx >= 0,
+        perm[jnp.maximum(rd.topk_idx, 0)].astype(jnp.int32), -1)
+    return RowConflictData(
+        inconf=back(rd.inconf), tcpamax=back(rd.tcpamax),
+        sum_dve=back(rd.sum_dve), sum_dvn=back(rd.sum_dvn),
+        sum_dvv=back(rd.sum_dvv), tsolv=back(rd.tsolv),
+        nconf=rd.nconf, nlos=rd.nlos,
+        topk_idx=back(topk_idx), topk_tin=back(rd.topk_tin))
+
+
+def block_reachability(lat, lon, gs, active, nb, block, rpz, tlookahead):
+    """[nb, nb] bool: which block pairs can possibly contain a conflict
+    or LoS.
+
+    EXACT skip predicate (shared by the lax and Pallas tiled backends):
+    a pair farther apart than ``rpz + tlookahead * (gsmax_r + gsmax_c)``
+    has horizontal conflict-entry time >= (dist - rpz)/vrel > tlookahead
+    and dist > rpz, so neither swconfl nor swlos can hold.
+
+    Distance lower bounds between the blocks' active-aircraft bounding
+    boxes, valid on the whole sphere:
+    * meridional: the central angle of any pair is >= its latitude
+      difference, and the reference radius is >= 6,335 km, so
+      ``dlat_gap * 110,000 m/deg`` under-estimates every pair distance;
+    * zonal: the minimum distance between two meridians ``dlon`` apart
+      for points with |lat| <= L is ``2 R asin(cos L * sin(dlon/2))``
+      (attained at +/-L) — correct at the poles (cos L -> 0: no skip
+      from longitude alone) unlike a naive ``dlon * cos L`` scaling;
+    * the longitude gap is CIRCULAR: min of the linear gap and the
+      wrap-around gap, so clusters on both sides of the antimeridian
+      are never falsely skipped.
+    Empty blocks get +/-inf bounds -> infinite gap -> always skipped.
+    """
+    shape = (nb, block)
+    blat = lat.reshape(shape)
+    blon = lon.reshape(shape)
+    bgs = gs.reshape(shape)
+    act = active.reshape(shape)
+    inf = jnp.asarray(jnp.inf, lat.dtype)
+    latmin = jnp.min(jnp.where(act, blat, inf), axis=1)
+    latmax = jnp.max(jnp.where(act, blat, -inf), axis=1)
+    lonmin = jnp.min(jnp.where(act, blon, inf), axis=1)
+    lonmax = jnp.max(jnp.where(act, blon, -inf), axis=1)
+    gsmax = jnp.max(jnp.where(act, bgs, 0.0), axis=1)
+    maxabslat = jnp.maximum(jnp.abs(latmin), jnp.abs(latmax))
+
+    dlat_gap = jnp.maximum(0.0, jnp.maximum(
+        latmin[:, None] - latmax[None, :],
+        latmin[None, :] - latmax[:, None]))
+    # Circular longitude gap between the two [min, max] intervals:
+    # linear gap, or around the back of the sphere, whichever is smaller
+    lin_gap = jnp.maximum(0.0, jnp.maximum(
+        lonmin[:, None] - lonmax[None, :],
+        lonmin[None, :] - lonmax[:, None]))
+    wrap_gap = jnp.maximum(0.0, 360.0 - (
+        jnp.maximum(lonmax[:, None], lonmax[None, :])
+        - jnp.minimum(lonmin[:, None], lonmin[None, :])))
+    dlon_gap = jnp.minimum(lin_gap, wrap_gap)
+
+    cos_lb = jnp.cos(jnp.radians(jnp.minimum(
+        90.0, jnp.maximum(maxabslat[:, None], maxabslat[None, :]))))
+    r_min = 6335000.0
+    zonal = 2.0 * r_min * jnp.arcsin(jnp.clip(
+        cos_lb * jnp.sin(jnp.radians(0.5 * jnp.minimum(dlon_gap, 360.0))),
+        0.0, 1.0))
+    merid = dlat_gap * 110000.0
+    dist_lb = jnp.maximum(merid, zonal)
+    thresh = rpz + tlookahead * (gsmax[:, None] + gsmax[None, :])
+    return dist_lb <= thresh * 1.05
+
+
 def detect_resolve_tiled(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                          active, noreso, rpz, hpz, tlookahead, mvpcfg,
-                         block=512, k_partners=8):
+                         block=512, k_partners=8, prefilter=True,
+                         spatial_sort=True):
     """One fused pass over all aircraft pairs in [block, block] tiles.
 
     Args mirror ``ops.cd.detect`` plus the MVP inputs; ``mvpcfg`` is a
     ``cr_mvp.MVPConfig``.  Returns a ``RowConflictData``.
+
+    ``prefilter=True`` adds an EXACT block-level reachability skip — the
+    TPU analogue of the reference C++ prefilter (asas.hpp:24-27): a tile
+    whose two blocks' bounding boxes are farther apart than
+    ``rpz + tlookahead * (gsmax_r + gsmax_c)`` cannot contain a conflict
+    (horizontal entry time >= (dist - rpz)/vrel > tlookahead) or LoS
+    (dist > rpz), so the column scan skips its work entirely via
+    ``lax.cond`` — sequential scan iterations on TPU really do elide the
+    untaken branch.  Distance lower bounds are conservative
+    (meridional/zonal components at <110 km/deg, cos at the highest
+    |lat| of either block; antimeridian-spanning blocks degrade to
+    "never skip").  Computed tiles are bit-identical with/without.
     """
     n = lat.shape[0]
+    if spatial_sort and n > block:
+        # Morton-order the slots so blocks are spatially tight (the
+        # reachability skip is useless on creation-ordered slots)
+        return run_spatially_sorted(
+            functools.partial(detect_resolve_tiled, block=block,
+                              k_partners=k_partners, prefilter=prefilter,
+                              spatial_sort=False),
+            lat, lon, trk, gs, alt, vs, gseast, gsnorth, active, noreso,
+            rpz, hpz, tlookahead, mvpcfg)
     block = min(block, max(n, 1))
     kk = min(k_partners, block)   # per-tile candidates merged into the top-K
     nb = -(-n // block)
@@ -205,6 +350,12 @@ def detect_resolve_tiled(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
     r2 = rpz * rpz
     bigval = jnp.asarray(1e9, dtype)
     col_ids = jnp.arange(nb * block, dtype=jnp.int32).reshape(nb, block)
+
+    # Reachability flags for the exact tile skip (see docstring)
+    reach = block_reachability(_pad1(lat, npad, 0.0),
+                               _pad1(lon, npad, 0.0),
+                               _pad1(gs, npad, 0.0), act_b.reshape(-1),
+                               nb, block, rpz, tlookahead)
 
     def tile(ri, ci, rows_active, carry):
         """Compute one [block, block] tile and fold it into the row carry."""
@@ -307,7 +458,12 @@ def detect_resolve_tiled(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                   jnp.full((block, kk), -1, jnp.int32))   # running top-K idx
 
         def colstep(carry, ci):
-            return tile(ri, ci, rows_active, carry)
+            if not prefilter:
+                return tile(ri, ci, rows_active, carry)
+            return jax.lax.cond(
+                reach[ri, ci],
+                lambda c: tile(ri, ci, rows_active, c)[0],
+                lambda c: c, carry), None
 
         carry, _ = jax.lax.scan(colstep, carry0, jnp.arange(nb))
         return carry

@@ -7,6 +7,7 @@ partner-table resume-nav behaving like the resopairs matrix whenever the
 number of simultaneous hysteresis partners stays within K.
 """
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 
@@ -274,3 +275,112 @@ def test_kmath_accuracy():
     np.testing.assert_allclose(kmath.atan2(a, b),
                                np.arctan2(np.asarray(a), np.asarray(b)),
                                rtol=0, atol=3e-6)
+
+
+def test_prefilter_and_spatial_sort_exact():
+    """The block-reachability skip + Morton spatial sort are EXACT: flags
+    and counts identical, sums bitwise vs the unfiltered unsorted kernel
+    when the sort is identity-free, and to tolerance when sorted
+    (reduction reassociation only)."""
+    rng = np.random.default_rng(7)
+    n = 900
+    # clusters far apart -> most tiles skippable after sorting
+    centers = rng.uniform(-20, 60, (5, 2))
+    ci = rng.integers(0, 5, n)
+    lat = jnp.asarray(centers[ci, 0] + rng.uniform(-0.4, 0.4, n))
+    lon = jnp.asarray(centers[ci, 1] + rng.uniform(-0.4, 0.4, n))
+    trk = jnp.asarray(rng.uniform(0, 360, n))
+    gs = jnp.asarray(rng.uniform(130, 240, n))
+    alt = jnp.asarray(rng.uniform(3000, 11000, n))
+    vs = jnp.asarray(rng.choice([0.0, 5.0, -5.0], n))
+    active = jnp.asarray(rng.random(n) < 0.9)
+    noreso = jnp.zeros(n, bool)
+    ge = gs * jnp.sin(jnp.radians(trk))
+    gn = gs * jnp.cos(jnp.radians(trk))
+    args = (lat, lon, trk, gs, alt, vs, ge, gn, active, noreso,
+            RPZ, HPZ, TLOOK, MVPCFG)
+
+    base = cd_tiled.detect_resolve_tiled(
+        *args, block=128, prefilter=False, spatial_sort=False)
+    filt = cd_tiled.detect_resolve_tiled(
+        *args, block=128, prefilter=True, spatial_sort=False)
+    both = cd_tiled.detect_resolve_tiled(
+        *args, block=128, prefilter=True, spatial_sort=True)
+
+    # prefilter alone: bitwise identical
+    for name in ("inconf", "tcpamax", "sum_dve", "sum_dvn", "sum_dvv",
+                 "tsolv", "topk_idx", "topk_tin"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(base, name)),
+            np.asarray(getattr(filt, name)), err_msg=name)
+    assert int(base.nconf) == int(filt.nconf) == int(both.nconf)
+    assert int(base.nlos) == int(filt.nlos) == int(both.nlos)
+
+    # + spatial sort: flags identical, sums to fp tolerance
+    np.testing.assert_array_equal(np.asarray(base.inconf),
+                                  np.asarray(both.inconf))
+    np.testing.assert_allclose(np.asarray(both.sum_dve),
+                               np.asarray(base.sum_dve),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(both.tsolv),
+                               np.asarray(base.tsolv), rtol=1e-6)
+    # top-1 partner agrees wherever a partner exists
+    t_base = np.asarray(base.topk_idx)[:, 0]
+    t_both = np.asarray(both.topk_idx)[:, 0]
+    np.testing.assert_array_equal(t_base, t_both)
+
+
+def test_spatial_permutation_groups_and_inactive_last():
+    rng = np.random.default_rng(3)
+    n = 64
+    lat = jnp.asarray(np.where(rng.random(n) < 0.5, 10.0, 50.0)
+                      + rng.uniform(-1, 1, n))
+    lon = jnp.asarray(np.where(rng.random(n) < 0.5, -5.0, 25.0)
+                      + rng.uniform(-1, 1, n))
+    active = jnp.asarray(rng.random(n) < 0.8)
+    perm = np.asarray(cd_tiled.spatial_permutation(lat, lon, active))
+    assert sorted(perm.tolist()) == list(range(n))
+    act_sorted = np.asarray(active)[perm]
+    # all active slots come before all inactive ones
+    first_inactive = np.argmin(act_sorted) if not act_sorted.all() else n
+    assert act_sorted[:first_inactive].all()
+    assert not act_sorted[first_inactive:].any()
+
+
+@pytest.mark.parametrize("where", ["antimeridian", "polar"])
+def test_prefilter_never_skips_edge_geometries(where):
+    """Regression: clusters straddling the antimeridian (circular lon
+    gap) and near-polar traffic (asin zonal bound) must not be skipped
+    by the block-reachability predicate."""
+    rng = np.random.default_rng(11)
+    half = 160
+    if where == "antimeridian":
+        lat = np.full(2 * half, 10.0) + rng.uniform(-0.01, 0.01, 2 * half)
+        lon = np.concatenate([np.full(half, 179.97),
+                              np.full(half, -179.97)]) \
+            + rng.uniform(-0.005, 0.005, 2 * half)
+    else:
+        lat = np.full(2 * half, 89.9) + rng.uniform(-0.01, 0.01, 2 * half)
+        lon = np.concatenate([np.full(half, 0.0), np.full(half, 180.0)]) \
+            + rng.uniform(-0.5, 0.5, 2 * half)
+    n = len(lat)
+    f = jnp.asarray
+    trk = f(rng.uniform(0, 360, n))
+    gs = f(rng.uniform(130, 240, n))
+    alt = f(np.full(n, 9000.0))
+    vs = f(np.zeros(n))
+    active = jnp.ones(n, bool)
+    noreso = jnp.zeros(n, bool)
+    ge = gs * jnp.sin(jnp.radians(trk))
+    gn = gs * jnp.cos(jnp.radians(trk))
+    args = (f(lat), f(lon), trk, gs, alt, vs, ge, gn, active, noreso,
+            RPZ, HPZ, TLOOK, MVPCFG)
+    filt = cd_tiled.detect_resolve_tiled(*args, block=128)
+    base = cd_tiled.detect_resolve_tiled(
+        *args, block=128, prefilter=False, spatial_sort=False)
+    # Cross-cluster pairs are within a few nm: LoS must be detected
+    assert int(base.nlos) > 0, "geometry should contain LoS pairs"
+    assert int(filt.nlos) == int(base.nlos)
+    assert int(filt.nconf) == int(base.nconf)
+    np.testing.assert_array_equal(np.asarray(filt.inconf),
+                                  np.asarray(base.inconf))
