@@ -59,10 +59,16 @@ class Stack:
                 self.cmdstack.append((line.strip(), sender))
 
     def process(self):
-        """Drain and execute all pending commands (stack.py:1359-1464)."""
-        for cmdline, sender in self.cmdstack:
-            self._exec_cmdline(cmdline, sender)
-        self.cmdstack = []
+        """Drain and execute all pending commands (stack.py:1359-1464).
+
+        Reentrancy-safe: the pending list is detached BEFORE execution,
+        so a command that stacks and processes further commands (plugins
+        like STACKCHECK do) cannot re-execute the lines already being
+        drained."""
+        while self.cmdstack:
+            pending, self.cmdstack = self.cmdstack, []
+            for cmdline, sender in pending:
+                self._exec_cmdline(cmdline, sender)
 
     def _exec_cmdline(self, cmdline: str, sender: str = ""):
         # let the screen proxy route echo output back to the issuer

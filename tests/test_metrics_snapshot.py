@@ -121,12 +121,12 @@ class TestSnapshot:
         out = do(sim, f"SNAPSHOT SAVE {fname}")
         assert "written" in out
         lat_at_save = float(sim.traf.state.ac.lat[0])
+        lon_at_save = float(sim.traf.state.ac.lon[0])
         simt_at_save = sim.simt
 
-        # keep flying, then restore
+        # keep flying (KL1 heads east), then restore
         sim.run(until_simt=60.0)
-        assert float(sim.traf.state.ac.lat[0]) != lat_at_save \
-            or sim.simt > simt_at_save
+        assert float(sim.traf.state.ac.lon[0]) != lon_at_save
         out = do(sim, f"SNAPSHOT LOAD {fname}")
         assert "restored" in out
         assert sim.simt == pytest.approx(simt_at_save)
