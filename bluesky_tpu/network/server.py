@@ -96,9 +96,16 @@ class Server(threading.Thread):
     def _forward(self, sender, route, name, payload):
         """Pop next hop, append sender to the return tail, send."""
         if route and route[0] == b"*":
+            # Fan out to every endpoint except the sender (stack.py's
+            # b'*' semantics, server.py:302-307): workers AND clients.
             for wid in self.workers:
-                self.be_event.send_multipart(
-                    [wid, sender, name, payload])
+                if wid != sender:
+                    self.be_event.send_multipart(
+                        [wid, sender, name, payload])
+            for cid in self.clients:
+                if cid != sender:
+                    self.fe_event.send_multipart(
+                        [cid, sender, name, payload])
             return
         dest = route[0]
         tail = list(route[1:]) + [sender]

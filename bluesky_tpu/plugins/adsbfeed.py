@@ -71,6 +71,11 @@ class AdsbFeed:
                 host, port = host.rsplit(":", 1)
                 self.port = int(port)
             self.host = host
+        # Stop any existing reader before (re)connecting so a repeat ON
+        # or a host switch never leaves two connections streaming
+        if self._thread is not None and self._thread.is_alive():
+            self.running = False
+            self._thread.join(timeout=3)
         self.running = True
         self._thread = threading.Thread(target=self._reader, daemon=True)
         self._thread.start()

@@ -69,8 +69,7 @@ class GeoVector:
                 if vec[0] == area.upper():
                     return True, f"GEOVECTOR {area}: {vec[1:]}"
             return False, f"No geovector found for {area}"
-        if not self.sim.areas.hasArea(area.upper()) \
-                and not self.sim.areas.hasArea(area):
+        if not self.sim.areas.hasArea(area.upper()):
             return False, f"Area {area} not found"
         self.delgeovec(area)
         self.geovecs.append([area.upper(), spdmin, spdmax, trkmin,

@@ -91,7 +91,9 @@ class OpenSkyListener:
 
         lat, lon, alt = f(lat), f(lon), f(baro_alt)
         hdg, vspd, spd = f(hdg), f(vspd), f(spd)
-        acid = np.array([str(i).strip() or str(h) for i, h in
+        # null callsigns fall back to the icao24 hex id (str(None) is
+        # truthy — guard on the raw value)
+        acid = np.array([(i or "").strip() or str(h) for i, h in
                          zip(acid, icao24)])
         valid = ~np.logical_or.reduce(
             [np.isnan(x) for x in (lat, lon, alt, hdg, vspd, spd)])

@@ -48,8 +48,7 @@ class SectorCount:
                 return True, "No sectors registered"
             return True, "Registered sectors: " + ", ".join(self.sectors)
         if sw == "ADD":
-            if not self.sim.areas.hasArea(name.upper()) \
-                    and not self.sim.areas.hasArea(name):
+            if not self.sim.areas.hasArea(name.upper()):
                 return False, f"Area {name} not found"
             if name.upper() in self.sectors:
                 return True, f"Sector {name} already registered"

@@ -15,6 +15,9 @@ SKIP = {
     "QUIT", "RESET", "IC", "BATCH", "ADDNODES", "SAVEIC", "SCEN",
     "PCALL", "BENCHMARK", "STACKCHECK", "MAKEDOC", "SNAPSHOT",
     "PROFILE", "CD", "HOLD", "OP", "FF", "DELALL", "PLUGINS",
+    # filesystem side effects (snapshots/logs/renders)
+    "SCREENSHOT", "DUMPRTE", "SNAPLOG", "INSTLOG", "SKYLOG",
+    "FLSTLOG", "OCCUPANCYLOG", "METLOG",
 }
 
 SAMPLE_ARGS = {

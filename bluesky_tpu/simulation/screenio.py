@@ -57,6 +57,16 @@ class ScreenIO(DisplayState):
         self._nconf_prev = self._nconf_tot = 0
         self._nlos_prev = self._nlos_tot = 0
 
+    def objappend(self, objtype, objname, data):
+        """Shape registry + broadcast to GUI clients (the reference
+        mirrors shapes through events, guiclient nodeData.update)."""
+        super().objappend(objtype, objname, data)
+        self.node.send_event(b"SHAPE", {
+            "name": objname, "kind": objtype,
+            "coords": list(data) if data is not None else None},
+            [b"*"])
+        return True
+
     def echo(self, text="", flags=0):
         self.echobuf.append(text)
         if len(self.echobuf) > 1000:      # bounded history

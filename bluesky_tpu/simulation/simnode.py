@@ -23,6 +23,9 @@ def _make_simnode_class(base):
             self.sim = Simulation(**simkw)
             self.sim.scr = ScreenIO(self.sim, self)
             self.sim.node = self
+            # Subsystems constructed before the swap hold the headless
+            # Screen; repoint them at the streaming ScreenIO
+            self.sim.areas.scr = self.sim.scr
             self.prev_state = self.sim.state_flag
 
         def close(self):

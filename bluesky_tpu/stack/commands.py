@@ -908,6 +908,18 @@ def register_all(stack):
     def tmx():
         return True, "TMX command not (yet?) implemented."
 
+    def screenshot(fname=None):
+        """SCREENSHOT [fname]: SVG radar render of the current state
+        (ui/radar.py — the headless RadarWidget)."""
+        import os as _os
+        from ..ui import radar
+        if fname is None:
+            _os.makedirs("output", exist_ok=True)
+            fname = _os.path.join("output",
+                                  f"radar_{sim.simt:08.1f}.svg")
+        radar.render_sim(sim, fname)
+        return True, f"Radar snapshot written to {fname}"
+
     def metricscmd(flag=None, dt=None):
         return sim.metrics.toggle(flag, dt)
 
@@ -1213,6 +1225,8 @@ def register_all(stack):
                     "JAX trace capture and per-kernel timings"],
         "SNAPSHOT": ["SNAPSHOT SAVE/LOAD fname", "txt,[word]", snapshot,
                      "Save/restore a binary state snapshot"],
+        "SCREENSHOT": ["SCREENSHOT [fname.svg]", "[word]", screenshot,
+                       "Render the radar picture to an SVG file"],
         "ZOOM": ["ZOOM IN/OUT or factor", "txt", zoom,
                  "Zoom display in/out"],
     })

@@ -1,0 +1,11 @@
+"""UI layer: headless radar rendering + the GUI client data mirror.
+
+The reference ships a Qt-OpenGL radar (ui/qtgl/, ~3k LoC of GL state)
+and a legacy pygame screen.  This framework is headless-first: the
+equivalent surface is (a) the GuiClient-compatible ACDATA/ROUTEDATA
+streams (simulation/screenio.py), (b) the client-side nodeData mirror
+(network/guiclient.py), and (c) an SVG radar renderer (ui/radar.py)
+that draws the same picture the RadarWidget draws — aircraft symbols
+with labels, trails, area shapes, the selected route — into a file any
+browser displays.  SCREENSHOT renders it sim-side.
+"""

@@ -1,0 +1,199 @@
+"""SVG radar renderer: the headless stand-in for the Qt RadarWidget.
+
+Draws the same picture ``ui/qtgl/radarwidget.py`` draws from the ACDATA
+stream — aircraft chevrons rotated to track with callsign/FL labels,
+trail segments, named area shapes (BOX/CIRCLE/POLY/LINE), and the
+selected route polyline — as a standalone SVG string/file.
+
+Pure host-side: input is plain dicts/arrays (an ACDATA frame, the
+objdata shape registry, a ROUTEDATA frame), so both the sim process
+(SCREENSHOT command) and a connected GuiClient (its nodeData mirror)
+render through this one code path.
+"""
+import numpy as np
+
+W, H = 1000, 800
+BG = "#10141c"
+COLORS = {
+    "ac": "#37c837", "ac_conf": "#e8463c", "label": "#9fd49f",
+    "trail": "#2b8cbe", "shape": "#b08d2f", "route": "#b05fd0",
+    "grid": "#223",
+}
+
+
+def _extent(acdata, shapes):
+    lats, lons = [], []
+    if acdata and len(acdata.get("lat", [])):
+        lats += list(np.atleast_1d(acdata["lat"]))
+        lons += list(np.atleast_1d(acdata["lon"]))
+    for _name, (kind, coords) in (shapes or {}).items():
+        if coords is None:
+            continue
+        c = list(coords)
+        if kind.upper() == "CIRCLE":
+            clat, clon, r_nm = c[:3]
+            dlat = r_nm / 60.0
+            lats += [clat - dlat, clat + dlat]
+            lons += [clon - 2 * dlat, clon + 2 * dlat]
+        else:
+            lats += c[0::2]
+            lons += c[1::2]
+    if not lats:
+        return (-1.0, 1.0, -1.0, 1.0)
+    lat0, lat1 = min(lats), max(lats)
+    lon0, lon1 = min(lons), max(lons)
+    padlat = max(0.05, 0.08 * (lat1 - lat0))
+    padlon = max(0.05, 0.08 * (lon1 - lon0))
+    return (lat0 - padlat, lat1 + padlat, lon0 - padlon, lon1 + padlon)
+
+
+class _Proj:
+    def __init__(self, extent):
+        self.lat0, self.lat1, self.lon0, self.lon1 = extent
+
+    def xy(self, lat, lon):
+        x = (lon - self.lon0) / max(1e-9, self.lon1 - self.lon0) * W
+        y = H - (lat - self.lat0) / max(1e-9, self.lat1 - self.lat0) * H
+        return x, y
+
+
+def render_svg(acdata=None, shapes=None, routedata=None, title=""):
+    """SVG text for one radar frame.
+
+    acdata: dict with id/lat/lon/trk/alt (+ optional inconf,
+    traillat0..) — the ACDATA schema; shapes: {name: (kind, coords)}
+    — the objdata registry; routedata: the ROUTEDATA schema.
+    """
+    proj = _Proj(_extent(acdata, shapes))
+    parts = [
+        f'<svg xmlns="http://www.w3.org/2000/svg" width="{W}" '
+        f'height="{H}" viewBox="0 0 {W} {H}">',
+        f'<rect width="{W}" height="{H}" fill="{BG}"/>',
+    ]
+    # Graticule each whole degree
+    for latg in range(int(np.floor(proj.lat0)), int(np.ceil(proj.lat1)) + 1):
+        _, y = proj.xy(latg, proj.lon0)
+        parts.append(f'<line x1="0" y1="{y:.1f}" x2="{W}" y2="{y:.1f}" '
+                     f'stroke="{COLORS["grid"]}" stroke-width="1"/>')
+    for long in range(int(np.floor(proj.lon0)), int(np.ceil(proj.lon1)) + 1):
+        x, _ = proj.xy(proj.lat0, long)
+        parts.append(f'<line x1="{x:.1f}" y1="0" x2="{x:.1f}" y2="{H}" '
+                     f'stroke="{COLORS["grid"]}" stroke-width="1"/>')
+
+    # Area shapes
+    for name, (kind, coords) in (shapes or {}).items():
+        if coords is None:
+            continue
+        k = kind.upper()
+        c = list(coords)
+        if k == "CIRCLE":
+            x, y = proj.xy(c[0], c[1])
+            _, y2 = proj.xy(c[0] + c[2] / 60.0, c[1])
+            parts.append(
+                f'<circle cx="{x:.1f}" cy="{y:.1f}" r="{abs(y - y2):.1f}" '
+                f'fill="none" stroke="{COLORS["shape"]}"/>')
+        else:
+            pts = " ".join(f"{proj.xy(la, lo)[0]:.1f},"
+                           f"{proj.xy(la, lo)[1]:.1f}"
+                           for la, lo in zip(c[0::2], c[1::2]))
+            closed = "polygon" if k in ("POLY", "BOX") else "polyline"
+            parts.append(f'<{closed} points="{pts}" fill="none" '
+                         f'stroke="{COLORS["shape"]}"/>')
+        la0, lo0 = c[0], c[1]
+        x, y = proj.xy(la0, lo0)
+        parts.append(f'<text x="{x + 4:.1f}" y="{y - 4:.1f}" '
+                     f'fill="{COLORS["shape"]}" font-size="10">'
+                     f'{name}</text>')
+
+    # Selected route
+    if routedata and routedata.get("wplat"):
+        pts = " ".join(
+            f"{proj.xy(la, lo)[0]:.1f},{proj.xy(la, lo)[1]:.1f}"
+            for la, lo in zip(routedata["wplat"], routedata["wplon"]))
+        parts.append(f'<polyline points="{pts}" fill="none" '
+                     f'stroke="{COLORS["route"]}" stroke-dasharray="6 4"/>')
+        for la, lo, nm_ in zip(routedata["wplat"], routedata["wplon"],
+                               routedata.get("wpname", [])):
+            x, y = proj.xy(la, lo)
+            parts.append(f'<circle cx="{x:.1f}" cy="{y:.1f}" r="3" '
+                         f'fill="{COLORS["route"]}"/>')
+            parts.append(f'<text x="{x + 4:.1f}" y="{y + 10:.1f}" '
+                         f'fill="{COLORS["route"]}" font-size="9">'
+                         f'{nm_}</text>')
+
+    if acdata:
+        # Trails
+        t0 = np.atleast_1d(acdata.get("traillat0", []))
+        if len(t0):
+            for la0, lo0, la1, lo1 in zip(
+                    t0, np.atleast_1d(acdata["traillon0"]),
+                    np.atleast_1d(acdata["traillat1"]),
+                    np.atleast_1d(acdata["traillon1"])):
+                x0, y0 = proj.xy(la0, lo0)
+                x1, y1 = proj.xy(la1, lo1)
+                parts.append(
+                    f'<line x1="{x0:.1f}" y1="{y0:.1f}" x2="{x1:.1f}" '
+                    f'y2="{y1:.1f}" stroke="{COLORS["trail"]}"/>')
+        # Aircraft chevrons + labels
+        ids = acdata.get("id", [])
+        lat = np.atleast_1d(acdata.get("lat", []))
+        lon = np.atleast_1d(acdata.get("lon", []))
+        trk = np.atleast_1d(acdata.get("trk", np.zeros(len(lat))))
+        alt = np.atleast_1d(acdata.get("alt", np.zeros(len(lat))))
+        inconf = np.atleast_1d(acdata.get("inconf",
+                                          np.zeros(len(lat), bool)))
+        for i in range(len(lat)):
+            x, y = proj.xy(lat[i], lon[i])
+            color = COLORS["ac_conf"] if (len(inconf) > i
+                                          and inconf[i]) \
+                else COLORS["ac"]
+            parts.append(
+                f'<g transform="translate({x:.1f},{y:.1f}) '
+                f'rotate({float(trk[i]):.0f})">'
+                f'<path d="M0,-6 L4,6 L0,3 L-4,6 Z" fill="{color}"/></g>')
+            label = ids[i] if i < len(ids) else ""
+            fl = int(round(float(alt[i]) / 0.3048 / 100.0))
+            parts.append(f'<text x="{x + 6:.1f}" y="{y:.1f}" '
+                         f'fill="{COLORS["label"]}" font-size="10">'
+                         f'{label} FL{fl:03d}</text>')
+
+    if title:
+        parts.append(f'<text x="10" y="20" fill="#ccc" font-size="13">'
+                     f'{title}</text>')
+    parts.append("</svg>")
+    return "\n".join(parts)
+
+
+def render_sim(sim, fname=None):
+    """Render the current state of an embedded Simulation (the
+    SCREENSHOT command path): builds an ACDATA-shaped frame from the
+    state arrays + the screen's shape registry + the selected route."""
+    traf = sim.traf
+    st = traf.state.ac
+    active = np.asarray(st.active)
+    idx = np.flatnonzero(active)
+    acdata = {
+        "id": [traf.ids[i] for i in idx],
+        "lat": np.asarray(st.lat)[idx],
+        "lon": np.asarray(st.lon)[idx],
+        "trk": np.asarray(st.trk)[idx],
+        "alt": np.asarray(st.alt)[idx],
+        "inconf": np.asarray(traf.state.asas.inconf)[idx],
+        "traillat0": traf.trails.lat0, "traillon0": traf.trails.lon0,
+        "traillat1": traf.trails.lat1, "traillon1": traf.trails.lon1,
+    }
+    routedata = None
+    acid = getattr(sim.scr, "route_acid", "")
+    if acid:
+        i = traf.id2idx(acid)
+        if isinstance(i, int) and i >= 0:
+            r = sim.routes.route(i)
+            routedata = {"wplat": list(r.lat), "wplon": list(r.lon),
+                         "wpname": list(r.name)}
+    svg = render_svg(acdata, sim.scr.objdata, routedata,
+                     title=f"simt {sim.simt:.1f} s — "
+                           f"{len(idx)} aircraft")
+    if fname:
+        with open(fname, "w") as f:
+            f.write(svg)
+    return svg

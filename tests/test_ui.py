@@ -1,0 +1,112 @@
+"""UI layer: SVG radar renderer + GuiClient nodeData mirror.
+
+The renderer is checked for structural content (aircraft symbols,
+labels, shapes, route, trails present in the SVG); the GuiClient is
+driven over the real localhost ZMQ fabric like the reference's
+GuiClient consumes a live node (guiclient.py:19-296 contract).
+"""
+import threading
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from bluesky_tpu.ui import radar
+
+
+class TestRenderer:
+    def test_svg_contains_aircraft_shapes_route_trails(self):
+        acdata = {
+            "id": ["KL1", "KL2"],
+            "lat": np.array([52.0, 52.3]),
+            "lon": np.array([4.0, 4.4]),
+            "trk": np.array([90.0, 270.0]),
+            "alt": np.array([6096.0, 9144.0]),
+            "inconf": np.array([False, True]),
+            "traillat0": np.array([51.9]), "traillon0": np.array([3.9]),
+            "traillat1": np.array([52.0]), "traillon1": np.array([4.0]),
+        }
+        shapes = {"SECT": ("POLY", [51.5, 3.5, 52.5, 3.5, 52.5, 4.5]),
+                  "CTR": ("CIRCLE", [52.0, 4.0, 10.0]),
+                  "RWY": ("LINE", [52.0, 4.0, 52.1, 4.1])}
+        routedata = {"wplat": [52.0, 52.5], "wplon": [4.5, 5.0],
+                     "wpname": ["WPA", "WPB"]}
+        svg = radar.render_svg(acdata, shapes, routedata, title="test")
+        assert svg.startswith("<svg")
+        assert "KL1 FL200" in svg and "KL2 FL300" in svg
+        assert svg.count("<path") == 2          # two chevrons
+        assert "SECT" in svg and "<circle" in svg
+        assert "WPA" in svg and "stroke-dasharray" in svg
+        assert "#e8463c" in svg                 # conflict color for KL2
+
+    def test_empty_frame_renders(self):
+        svg = radar.render_svg({}, {}, None)
+        assert svg.startswith("<svg") and svg.endswith("</svg>")
+
+    def test_screenshot_command(self, tmp_path):
+        from bluesky_tpu.simulation.sim import Simulation
+        sim = Simulation(nmax=8, dtype=jnp.float64)
+        for line in ("CRE KL1 B744 52 4 90 FL200 250",
+                     "BOX SECT 51 3 53 5"):
+            sim.stack.stack(line)
+        sim.stack.process()
+        fname = str(tmp_path / "radar.svg")
+        sim.stack.stack(f"SCREENSHOT {fname}")
+        sim.stack.process()
+        content = open(fname).read()
+        assert "KL1" in content and "SECT" in content
+
+
+zmq = pytest.importorskip("zmq")
+
+
+class TestGuiClient:
+    def test_nodedata_mirror_over_fabric(self):
+        from bluesky_tpu.network.guiclient import GuiClient
+        from bluesky_tpu.network.server import Server
+        from bluesky_tpu.simulation.simnode import SimNode
+        from tests.test_network import free_ports, wait_for
+
+        ev, st, wev, wst = free_ports(4)
+        server = Server(headless=True,
+                        ports=dict(event=ev, stream=st, wevent=wev,
+                                   wstream=wst),
+                        spawn_workers=False)
+        server.start()
+        time.sleep(0.2)
+        node = SimNode(event_port=wev, stream_port=wst, nmax=32)
+        thread = threading.Thread(target=node.run, daemon=True)
+        thread.start()
+        client = GuiClient()
+        try:
+            client.connect(event_port=ev, stream_port=st, timeout=5.0)
+            assert wait_for(lambda: (client.receive(10),
+                                     len(client.nodes) > 0)[1])
+            client.stack("CRE KL204 B744 52 4 90 FL200 250")
+            client.stack("BOX SECT 51 3 53 5")
+            client.stack("TRAIL ON 1")
+            client.stack("POS KL204")
+            client.stack("OP")
+            assert wait_for(
+                lambda: (client.receive(10),
+                         bool(client.get_nodedata(
+                             list(client.nodes)[0]).acdata.get("id"))
+                         )[1], timeout=60)
+            nd = client.get_nodedata(list(client.nodes)[0])
+            assert nd.acdata["id"] == ["KL204"]
+            assert "SECT" in nd.shapes
+            assert nd.siminfo.get("ntraf", 0) >= 0
+            # echo from POS routed back
+            assert wait_for(
+                lambda: (client.receive(10),
+                         any("KL204" in t for t in nd.echo_text))[1],
+                timeout=30)
+            svg = client.render_svg()
+            assert "KL204" in svg and "SECT" in svg
+        finally:
+            node.quit()
+            thread.join(timeout=5)
+            server.stop()
+            server.join(timeout=5)
+            client.close()
