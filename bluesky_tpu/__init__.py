@@ -9,16 +9,20 @@ aircraft axis shards over a `jax.sharding.Mesh` for large N, and Monte-Carlo
 ensembles vmap over a replica axis.
 
 Package layout:
-  ops/        pure jitted math: geodesy, atmosphere, conflict detection,
-              conflict resolution kernels (jnp + Pallas variants)
-  core/       simulation state pytree, traffic facade, kinematics, autopilot,
-              pilot arbitration, performance model, step function
+  ops/        pure jitted math: geodesy, atmosphere, conflict detection
+              (dense / lax-tiled / Pallas), MVP/Eby/Swarm/SSD resolvers,
+              legacy+BADA performance kernels
+  core/       simulation state pytree, traffic facade, kinematics,
+              autopilot, pilot arbitration, ASAS coordinator, perf,
+              wind, noise, routes, trails, conditionals, metrics, step
   parallel/   device-mesh sharding of the aircraft axis, ensemble axis
   stack/      the text-command stack (the universal user/API surface)
-  simulation/ the fixed-dt simulation loop + node
-  network/    zmq server/client/node process fabric
-  models/     aircraft performance coefficient tables
-  utils/      datalog, areafilter, timers, misc parsing
+  simulation/ the fixed-dt simulation loop + node, streams, snapshots
+  network/    zmq server/client/node fabric, GuiClient, telnet bridge
+  plugins/    plugin system + the nine shipped plugins
+  models/     OpenAP / BADA / BS coefficient databases, fwparser
+  ui/         SVG radar renderer
+  utils/      datalog, areafilter, plotter, profiler, timers
 """
 
 __version__ = "0.1.0"
