@@ -27,6 +27,10 @@ perf_path = os.path.join(data_path, "performance")
 cache_path = os.path.join(os.path.expanduser("~"), ".cache", "bluesky_tpu")
 log_path = "output"
 scenario_path = "scenario"
+# the reference's ~90-file scenario library, searched after the local
+# dir (like the navdata/performance mounts above)
+_REF_SCN = "/root/reference/scenario"
+ref_scenario_path = _REF_SCN if os.path.isdir(_REF_SCN) else ""
 plugin_path = "plugins"
 enabled_plugins = ["datafeed"]
 event_port = 9000

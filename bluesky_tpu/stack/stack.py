@@ -34,7 +34,8 @@ class Stack:
         # SAVEIC recording
         self.savefile = None
         self.saveict0 = 0.0
-        self.scenario_path = "scenario"
+        from .. import settings
+        self.scenario_path = settings.scenario_path
         from . import commands
         commands.register_all(self)
 
@@ -176,10 +177,23 @@ class Stack:
     def _find_scn(self, fname: str) -> Optional[str]:
         if not fname.lower().endswith(".scn"):
             fname += ".scn"
+        from .. import settings
         cands = [fname, os.path.join(self.scenario_path, fname)]
+        # the reference scenario library ships ~90 .scn files; search it
+        # after the local dir (settings defaults it when mounted)
+        if settings.ref_scenario_path:
+            cands.append(os.path.join(settings.ref_scenario_path, fname))
         for c in cands:
             if os.path.isfile(c):
                 return c
+        # case-insensitive fallback (the library mixes .scn and .SCN)
+        for d in (self.scenario_path, settings.ref_scenario_path):
+            if d and os.path.isdir(d):
+                low = fname.lower()
+                for entry in os.listdir(d):
+                    p = os.path.join(d, entry)
+                    if entry.lower() == low and os.path.isfile(p):
+                        return p
         return None
 
     def checkfile(self, simt: float):
