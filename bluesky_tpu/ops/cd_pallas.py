@@ -56,15 +56,15 @@ _BIG = 1e9
 def _kernel(reach_ref, own_ref, intr_ref,
             inconf_ref, tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref,
             tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref, cidx_ref,
-            *, block, kk, rpz, hpz, tlookahead, mvpcfg):
+            *, block, kk, cpp, rpz, hpz, tlookahead, mvpcfg):
     ib = pl.program_id(0)
-    jb = pl.program_id(1)
+    jp = pl.program_id(1)      # program handles cpp column tiles
 
-    # Initialise the accumulators on the first intruder block; the tile
-    # compute below is skipped entirely for unreachable tiles, so the
-    # init must not depend on it.  Accumulating t >= 0 maxima into 0 /
-    # minima into BIG reproduces the former set-at-jb==0 semantics.
-    @pl.when(jb == 0)
+    # Initialise the accumulators on the first intruder program; the
+    # tile compute below is skipped entirely for unreachable tiles, so
+    # the init must not depend on it.  Accumulating t >= 0 maxima into
+    # 0 / minima into BIG reproduces the former set-at-jb==0 semantics.
+    @pl.when(jp == 0)
     def _():
         zero = jnp.zeros((1, block), jnp.float32)
         inconf_ref[0] = zero
@@ -80,22 +80,26 @@ def _kernel(reach_ref, own_ref, intr_ref,
 
     # Exact block-level reachability skip (cd_tiled.block_reachability):
     # a scalar-predicated branch in Mosaic, so unreachable tiles cost no
-    # VPU work.
-    @pl.when(reach_ref[ib, jb] > 0)
-    def _compute():
-        _tile_body(ib, jb, own_ref, intr_ref, inconf_ref, tcpamax_ref,
-                   sdve_ref, sdvn_ref, sdvv_ref, tsolv_ref, ncnt_ref,
-                   lcnt_ref, ctin_ref, cidx_ref, block=block, kk=kk,
-                   rpz=rpz, hpz=hpz, tlookahead=tlookahead,
-                   mvpcfg=mvpcfg)
+    # VPU work.  The cpp sub-tiles run sequentially in one program,
+    # amortizing grid/DMA overhead (skipped sub-tiles still skip).
+    for k in range(cpp):
+        jb = jp * cpp + k
+
+        @pl.when(reach_ref[ib, jb] > 0)
+        def _compute(k=k, jb=jb):
+            _tile_body(ib, jb, k, own_ref, intr_ref, inconf_ref,
+                       tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref,
+                       tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref,
+                       cidx_ref, block=block, kk=kk, rpz=rpz, hpz=hpz,
+                       tlookahead=tlookahead, mvpcfg=mvpcfg)
 
 
-def _tile_body(ib, jb, own_ref, intr_ref,
+def _tile_body(ib, jb, ksub, own_ref, intr_ref,
                inconf_ref, tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref,
                tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref, cidx_ref,
                *, block, kk, rpz, hpz, tlookahead, mvpcfg):
     oslab = own_ref[0]                                    # (_NF, block)
-    islab = intr_ref[0]
+    islab = intr_ref[ksub]
 
     def own(k):            # ownship operand, varies along lanes: (1, block)
         return oslab[_IDX[k]:_IDX[k] + 1, :]
@@ -213,7 +217,7 @@ def _tile_body(ib, jb, own_ref, intr_ref,
 def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                           active, noreso, rpz, hpz, tlookahead, mvpcfg,
                           block=256, k_partners=8, interpret=False,
-                          spatial_sort=True):
+                          spatial_sort=True, cols_per_prog=4):
     """Pallas-backed equivalent of ``cd_tiled.detect_resolve_tiled``.
 
     Returns a ``RowConflictData``; reductions match the lax formulation to
@@ -227,7 +231,8 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         return cd_tiled.run_spatially_sorted(
             functools.partial(detect_resolve_pallas, block=block,
                               k_partners=k_partners, interpret=interpret,
-                              spatial_sort=False),
+                              spatial_sort=False,
+                              cols_per_prog=cols_per_prog),
             lat, lon, trk, gs, alt, vs, gseast, gsnorth, active, noreso,
             rpz, hpz, tlookahead, mvpcfg)
     dtype = jnp.float32
@@ -266,9 +271,23 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         nb, block, float(rpz), float(tlookahead)).astype(jnp.int32)
 
     kk = k_partners
+    # Several column tiles per grid program amortize the per-program
+    # overhead (grid steps + slab DMA), which dominates once the
+    # reachability skip elides most tiles' compute at large nb.
+    cpp = min(cols_per_prog, nb)
+    nbp = -(-nb // cpp) * cpp
+    if nbp != nb:
+        padslabs = jnp.zeros((nbp - nb, _NF, block), dtype)
+        # One padded buffer serves BOTH inputs (the ownship grid
+        # dimension stays nb, so its padded rows are never read)
+        packed = jnp.concatenate([packed, padslabs], axis=0)
+        reach = jnp.concatenate(
+            [reach, jnp.zeros((nb, nbp - nb), jnp.int32)], axis=1)
+    packed_cols = packed
+
     kern = functools.partial(
-        _kernel, block=block, kk=kk, rpz=float(rpz), hpz=float(hpz),
-        tlookahead=float(tlookahead), mvpcfg=mvpcfg)
+        _kernel, block=block, kk=kk, cpp=cpp, rpz=float(rpz),
+        hpz=float(hpz), tlookahead=float(tlookahead), mvpcfg=mvpcfg)
 
     acc = lambda: jax.ShapeDtypeStruct((nb, 1, block), dtype)
     out_shapes = [acc(), acc(), acc(), acc(), acc(), acc(), acc(), acc(),
@@ -283,18 +302,18 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
 
     outs = pl.pallas_call(
         kern,
-        grid=(nb, nb),
+        grid=(nb, nbp // cpp),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),       # reach flags
             pl.BlockSpec((1, _NF, block), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),       # ownship slab
-            pl.BlockSpec((1, _NF, block), lambda i, j: (j, 0, 0),
-                         memory_space=pltpu.VMEM),       # intruder slab
+            pl.BlockSpec((cpp, _NF, block), lambda i, j: (j, 0, 0),
+                         memory_space=pltpu.VMEM),       # intruder slabs
         ],
         out_specs=[acc_spec() for _ in range(8)] + [cand_spec(), cand_spec()],
         out_shape=out_shapes,
         interpret=interpret,
-    )(reach, packed, packed)
+    )(reach, packed, packed_cols)
 
     (inconf, tcpamax, sdve, sdvn, sdvv, tsolv, ncnt, lcnt,
      ctin, cidx) = outs

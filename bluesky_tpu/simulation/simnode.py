@@ -26,7 +26,21 @@ def _make_simnode_class(base):
             # Subsystems constructed before the swap hold the headless
             # Screen; repoint them at the streaming ScreenIO
             self.sim.areas.scr = self.sim.scr
+            # BATCH stack command: upload the multi-SCEN scenario to
+            # the server for farm-out (simulation.py:195-202)
+            self.sim.batch = self.batch
             self.prev_state = self.sim.state_flag
+
+        def batch(self, fname):
+            ok, msg = self.sim.stack.openfile(fname)
+            if not ok:
+                return False, msg
+            scentime = self.sim.stack.scentime
+            scencmd = self.sim.stack.scencmd
+            self.sim.stack.scentime, self.sim.stack.scencmd = [], []
+            self.send_event(b"BATCH", {"scentime": scentime,
+                                       "scencmd": scencmd})
+            return True, "BATCH uploaded to the server"
 
         def close(self):
             self.sim.scr.close()      # deregister stream timers

@@ -1,0 +1,80 @@
+"""BATCH Monte-Carlo farm-out over the real fabric (SURVEY §3.4).
+
+A client stacks ``BATCH file``; the sim node uploads the parsed
+scenario to the server; the server splits it at SCEN markers and
+assigns one piece per idle worker (server.py:269-287 semantics).  Two
+in-process worker nodes each end up running a different piece.
+"""
+import threading
+import time
+
+import pytest
+
+zmq = pytest.importorskip("zmq")
+
+from bluesky_tpu.network.client import Client
+from bluesky_tpu.network.server import Server
+from bluesky_tpu.network.server import split_scenarios
+from bluesky_tpu.simulation.simnode import SimNode
+from tests.test_network import free_ports, wait_for
+
+
+def test_split_scenarios_prepends_setup():
+    t = [0.0, 0.0, 0.0, 0.0, 0.0]
+    c = ["ASAS ON", "SCEN A", "CRE A1 B744 52 4 90 FL200 250",
+         "SCEN B", "CRE B1 B744 53 4 90 FL200 250"]
+    pieces = split_scenarios(t, c)
+    assert len(pieces) == 2
+    assert pieces[0][1] == ["ASAS ON", "SCEN A",
+                            "CRE A1 B744 52 4 90 FL200 250"]
+    assert pieces[1][1] == ["ASAS ON", "SCEN B",
+                            "CRE B1 B744 53 4 90 FL200 250"]
+
+
+def test_batch_farms_out_to_two_workers(tmp_path):
+    scn = tmp_path / "mc.scn"
+    scn.write_text(
+        "00:00:00.00>SCEN CASE_A\n"
+        "00:00:00.00>CRE AAA1 B744 52 4 90 FL200 250\n"
+        "00:00:00.00>SCEN CASE_B\n"
+        "00:00:00.00>CRE BBB1 B744 53 5 90 FL300 250\n")
+
+    ev, st, wev, wst = free_ports(4)
+    server = Server(headless=True,
+                    ports=dict(event=ev, stream=st, wevent=wev,
+                               wstream=wst),
+                    spawn_workers=False)
+    server.start()
+    time.sleep(0.2)
+    nodes = [SimNode(event_port=wev, stream_port=wst, nmax=16)
+             for _ in range(2)]
+    threads = [threading.Thread(target=n.run, daemon=True)
+               for n in nodes]
+    for t in threads:
+        t.start()
+    client = Client()
+    try:
+        client.connect(event_port=ev, stream_port=st, timeout=5.0)
+        assert wait_for(lambda: (client.receive(10),
+                                 len(client.nodes) >= 2)[1])
+        client.stack(f"BATCH {scn}")
+        # both pieces land: each node flies exactly its own aircraft
+        def pieces_assigned():
+            client.receive(10)
+            ids = [set(i for i in n.sim.traf.ids if i) for n in nodes]
+            return ids[0] | ids[1] == {"AAA1", "BBB1"} \
+                and len(ids[0]) == len(ids[1]) == 1
+        assert wait_for(pieces_assigned, timeout=60)
+        # each worker auto-started its scenario (BATCH -> reset + op)
+        OP = 2
+        assert all(n.sim.state_flag == OP for n in nodes)
+        names = {n.sim.stack.scenname for n in nodes}
+        assert names == {"CASE_A", "CASE_B"}
+    finally:
+        for n in nodes:
+            n.quit()
+        for t in threads:
+            t.join(timeout=5)
+        server.stop()
+        server.join(timeout=5)
+        client.close()

@@ -384,3 +384,29 @@ def test_prefilter_never_skips_edge_geometries(where):
     assert int(filt.nconf) == int(base.nconf)
     np.testing.assert_array_equal(np.asarray(filt.inconf),
                                   np.asarray(base.inconf))
+
+
+@pytest.mark.parametrize("cpp", [1, 2, 4])
+def test_pallas_multiblock_cols_per_prog(cpp):
+    """The multi-column-tile kernel path (cols_per_prog > 1, with column
+    padding when cpp does not divide nb) against the lax oracle — in
+    interpret mode so the exact TPU code path runs on CPU."""
+    from bluesky_tpu.ops import cd_pallas
+
+    scene = [jnp.asarray(np.asarray(a), jnp.float32)
+             if np.asarray(a).dtype.kind == "f" else a
+             for a in _random_scene(700, 768, seed=5)]
+    rd_t = cd_tiled.detect_resolve_tiled(*scene, RPZ, HPZ, TLOOK, MVPCFG,
+                                         block=128)
+    rd_p = cd_pallas.detect_resolve_pallas(
+        *scene, RPZ, HPZ, TLOOK, MVPCFG, block=128, interpret=True,
+        cols_per_prog=cpp)      # nb=6 -> nbp=8 at cpp=4 (padding path)
+    np.testing.assert_array_equal(np.asarray(rd_p.inconf),
+                                  np.asarray(rd_t.inconf))
+    assert int(rd_p.nconf) == int(rd_t.nconf) > 0
+    assert int(rd_p.nlos) == int(rd_t.nlos)
+    np.testing.assert_allclose(rd_p.sum_dve, rd_t.sum_dve,
+                               rtol=1e-3, atol=0.3)
+    t1 = np.asarray(cd_tiled.topk_partners(rd_t, 8))[:, 0]
+    p1 = np.asarray(rd_p.topk_idx)[:, 0]
+    np.testing.assert_array_equal(t1, p1)

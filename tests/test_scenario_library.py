@@ -10,6 +10,12 @@ import numpy as np
 import jax.numpy as jnp
 import pytest
 
+from bluesky_tpu import settings
+
+pytestmark = pytest.mark.skipif(
+    not settings.ref_scenario_path,
+    reason="reference scenario library not mounted")
+
 
 @pytest.fixture()
 def sim():
