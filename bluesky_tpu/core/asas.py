@@ -18,6 +18,7 @@ the registry hook mirrors the reference's CDmethods/CRmethods dicts
 """
 from typing import NamedTuple, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from ..ops import aero, cd as cdops, cd_tiled, cr_mvp
@@ -50,6 +51,9 @@ class AsasConfig(NamedTuple):
                                  # jit like the rest of the config
     swprio: bool = False         # PRIORULES on/off (asas.py SetPrio)
     priocode: str = "FF1"        # FF1/FF2/FF3/LAY1/LAY2
+    sort_every: int = 30         # tiled backends: CD intervals between
+                                 # Morton re-sorts (any staleness is exact —
+                                 # see AsasArrays.sort_perm)
     vmin: float = 100.0 * aero.kts   # [m/s] resolution speed caps
     vmax: float = 180.0 * aero.kts   # (reference asas.py setters)
     vsmin: float = -3000.0 * aero.fpm
@@ -205,11 +209,26 @@ def update_tiled(state: SimState, cfg: AsasConfig, block: int = 512,
         detect_fn = cd_pallas.detect_resolve_pallas
     else:
         detect_fn = cd_tiled.detect_resolve_tiled
+
+    # Cached Morton permutation: sorting 100k keys costs more than the CD
+    # kernel, and any permutation is exact (reachability is recomputed from
+    # true positions) — so refresh only every cfg.sort_every intervals.
+    refresh = asas.sort_age >= cfg.sort_every
+    perm = jax.lax.cond(
+        refresh,
+        lambda: cd_tiled.spatial_permutation(
+            ac.lat, ac.lon, ac.active).astype(jnp.int32),
+        lambda: asas.sort_perm)
+    asas = asas.replace(
+        sort_perm=perm,
+        sort_age=jnp.where(refresh, 1, asas.sort_age + 1))
+    state = state.replace(asas=asas)
+
     rd = detect_fn(
         ac.lat, ac.lon, ac.trk, ac.gs, ac.alt, ac.vs,
         ac.gseast, ac.gsnorth, ac.active, asas.noreso,
         cfg.rpz, cfg.hpz, cfg.dtlookahead, mvpcfg, block=block,
-        k_partners=k)
+        k_partners=k, perm=perm)
 
     if cfg.reso_on:
         newtrk, newgs, newvs, newalt, asase, asasn = cr_mvp.resolve_from_sums(

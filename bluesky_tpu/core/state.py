@@ -140,6 +140,13 @@ class AsasArrays:
     # Cumulative counts (device-side; unique-pair sets stay host-side)
     nconf_cur: jnp.ndarray  # scalar int — current directional conflict pairs
     nlos_cur: jnp.ndarray   # scalar int — current LoS pairs
+    # Cached Morton slot permutation for the tiled backends.  Sorting 100k
+    # keys on TPU costs more than the CD kernel itself, and ANY permutation
+    # is exact (results are mapped back; tile reachability is recomputed
+    # from true positions every interval) — so the sort is refreshed only
+    # every AsasConfig.sort_every CD intervals and carried here.
+    sort_perm: jnp.ndarray  # [N] int32 — slot permutation (sorted order)
+    sort_age: jnp.ndarray   # scalar int32 — CD intervals since refresh
 
 
 @struct.dataclass
@@ -288,6 +295,8 @@ def make_state(nmax: int = 64, wmax: int = 32,
         partners=jnp.full((nmax, k_partners), -1, jnp.int32),
         asasn=f(), asase=f(), noreso=b(), resooff=b(),
         nconf_cur=jnp.zeros((), jnp.int32), nlos_cur=jnp.zeros((), jnp.int32),
+        sort_perm=jnp.arange(nmax, dtype=jnp.int32),
+        sort_age=jnp.asarray(1 << 30, jnp.int32),   # refresh at first CD
     )
     route = RouteArrays(
         wplat=jnp.full((nmax, wmax), 89.99, dtype),

@@ -14,10 +14,9 @@ aircraft state from VMEM, evaluates the CPA geometry + MVP contribution on a
 in-place in the output blocks (revisited across the intruder grid dimension
 — the standard Pallas accumulation pattern).  The pair math is the *same
 code* as the lax backend — ``cd_tiled.tile_geometry`` (rank-1-factored
-haversine) and ``cr_mvp.pair_contrib_trig`` are shape-agnostic jnp and trace
-straight into the kernel — so the tiled backends cannot drift apart.  The
-one transcendental Mosaic lacks (atan2, for the arc length) comes from
-``kmath`` (f32 Cephes-style polynomial).
+haversine, VPU-lean: rsqrt bearings + odd-Taylor arcsin arc length from
+``kmath``) and ``cr_mvp.pair_contrib_trig`` are shape-agnostic jnp and trace
+straight into the kernel — so the tiled backends cannot drift apart.
 
 Layout note: the tile is oriented **intruder-major**: intruders vary along
 sublanes (axis 0), ownships along lanes (axis 1).  Per-ownship reductions
@@ -39,7 +38,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from . import cd_tiled, cr_mvp, kmath
+from . import cd_tiled, cr_mvp
 from .cd_tiled import RowConflictData, TRIG_FIELDS, block_reachability, \
     precompute_trig, tile_geometry
 
@@ -51,6 +50,21 @@ _FIELDS = TRIG_FIELDS + ("u", "v", "alt", "vs", "gse", "gsn",
 _NF = len(_FIELDS)
 _IDX = {k: i for i, k in enumerate(_FIELDS)}
 _BIG = 1e9
+
+#: Identity elements of the 10 accumulator outputs, in output-tuple order:
+#: inconf, tcpamax, sdve, sdvn, sdvv, tsolv, ncnt, lcnt, ctin, cidx.
+#: Single source of truth for both kernels' init blocks and the
+#: never-visited-row neutralisation in run_compact.
+_ACC_NEUTRAL = (0.0, 0.0, 0.0, 0.0, 0.0, _BIG, 0.0, 0.0, _BIG, 2**30)
+
+
+def _init_accumulators(refs, block, kk):
+    """Write the identity element into each accumulator ref (10 refs in
+    output order)."""
+    for ref, v in zip(refs[:8], _ACC_NEUTRAL[:8]):
+        ref[0] = jnp.full((1, block), v, jnp.float32)
+    refs[8][0] = jnp.full((kk, block), _ACC_NEUTRAL[8], jnp.float32)
+    refs[9][0] = jnp.full((kk, block), _ACC_NEUTRAL[9], jnp.int32)
 
 
 def _kernel(reach_ref, own_ref, intr_ref,
@@ -66,17 +80,9 @@ def _kernel(reach_ref, own_ref, intr_ref,
     # 0 / minima into BIG reproduces the former set-at-jb==0 semantics.
     @pl.when(jp == 0)
     def _():
-        zero = jnp.zeros((1, block), jnp.float32)
-        inconf_ref[0] = zero
-        tcpamax_ref[0] = zero
-        sdve_ref[0] = zero
-        sdvn_ref[0] = zero
-        sdvv_ref[0] = zero
-        tsolv_ref[0] = jnp.full((1, block), _BIG, jnp.float32)
-        ncnt_ref[0] = zero
-        lcnt_ref[0] = zero
-        ctin_ref[0] = jnp.full((kk, block), _BIG, jnp.float32)
-        cidx_ref[0] = jnp.full((kk, block), 2**30, jnp.int32)
+        _init_accumulators((inconf_ref, tcpamax_ref, sdve_ref, sdvn_ref,
+                            sdvv_ref, tsolv_ref, ncnt_ref, lcnt_ref,
+                            ctin_ref, cidx_ref), block, kk)
 
     # Exact block-level reachability skip (cd_tiled.block_reachability):
     # a scalar-predicated branch in Mosaic, so unreachable tiles cost no
@@ -99,13 +105,14 @@ def _tile_body(ib, jb, ksub, own_ref, intr_ref,
                tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref, cidx_ref,
                *, block, kk, rpz, hpz, tlookahead, mvpcfg):
     oslab = own_ref[0]                                    # (_NF, block)
-    islab = intr_ref[ksub]
+    islab_t = intr_ref[ksub].T                            # (block, _NF): ONE
+    # lane->sublane relayout shared by all intruder columns
 
     def own(k):            # ownship operand, varies along lanes: (1, block)
         return oslab[_IDX[k]:_IDX[k] + 1, :]
 
     def intr(k):           # intruder operand, varies along sublanes
-        return islab[_IDX[k]:_IDX[k] + 1, :].T            # (block, 1)
+        return islab_t[:, _IDX[k]:_IDX[k] + 1]            # (block, 1)
 
     gid_own = ib * block + jax.lax.broadcasted_iota(
         jnp.int32, (block, block), 1)
@@ -114,13 +121,30 @@ def _tile_body(ib, jb, ksub, own_ref, intr_ref,
     act_o = own("active") > 0.5                           # (1, block)
     act_i = intr("active") > 0.5                          # (block, 1)
     pairmask = (act_o & act_i) & (gid_own != gid_int)
+
+    # All-inactive tiles (sentinel/padding worklist entries, empty blocks)
+    # contribute nothing — skip the whole geometry for the cost of one
+    # OR-reduce.
+    @pl.when(jnp.any(pairmask))
+    def _live_tile():
+        _tile_pairs(pairmask, gid_int, own, intr, inconf_ref, tcpamax_ref,
+                    sdve_ref, sdvn_ref, sdvv_ref, tsolv_ref, ncnt_ref,
+                    lcnt_ref, ctin_ref, cidx_ref, kk=kk, rpz=rpz, hpz=hpz,
+                    tlookahead=tlookahead, mvpcfg=mvpcfg)
+
+
+def _tile_pairs(pairmask, gid_int, own, intr,
+                inconf_ref, tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref,
+                tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref, cidx_ref,
+                *, kk, rpz, hpz, tlookahead, mvpcfg):
+    block = pairmask.shape[1]
     excl = jnp.where(pairmask, 0.0, _BIG)
 
     # Horizontal geometry — the factored haversine (cd_tiled.tile_geometry),
     # evaluated [intruder, ownship] so per-ownship reductions are axis 0.
     trig_o = {k: own(k) for k in TRIG_FIELDS}
     trig_i = {k: intr(k) for k in TRIG_FIELDS}
-    dist0, sinqdr, cosqdr = tile_geometry(trig_o, trig_i, atan2=kmath.atan2)
+    dist0, sinqdr, cosqdr = tile_geometry(trig_o, trig_i)
     dist = dist0 + excl
     dx = dist * sinqdr
     dy = dist * cosqdr
@@ -129,22 +153,24 @@ def _tile_body(ib, jb, ksub, own_ref, intr_ref,
     dv = intr("v") - own("v")
     dv2 = du * du + dv * dv
     dv2 = jnp.where(jnp.abs(dv2) < 1e-6, 1e-6, dv2)
-    vrel = jnp.sqrt(dv2)
+    # Same rsqrt-based CPA math as cd_tiled.tile — kept in lockstep
+    rvrel = jax.lax.rsqrt(dv2)
 
-    tcpa = -(du * dx + dv * dy) / dv2 + excl
+    tcpa = -(du * dx + dv * dy) * (rvrel * rvrel) + excl
     dcpa2 = dist * dist - tcpa * tcpa * dv2
     r2 = rpz * rpz
     swhorconf = dcpa2 < r2
 
-    dtinhor = jnp.sqrt(jnp.maximum(0.0, r2 - dcpa2)) / vrel
+    dtinhor = jnp.sqrt(jnp.maximum(0.0, r2 - dcpa2)) * rvrel
     tinhor = jnp.where(swhorconf, tcpa - dtinhor, 1e8)
     touthor = jnp.where(swhorconf, tcpa + dtinhor, -1e8)
 
     dalt = intr("alt") - own("alt") + excl
     dvs = intr("vs") - own("vs")
     dvs = jnp.where(jnp.abs(dvs) < 1e-6, 1e-6, dvs)
-    tcrosshi = (dalt + hpz) / -dvs
-    tcrosslo = (dalt - hpz) / -dvs
+    nrdvs = -1.0 / dvs
+    tcrosshi = (dalt + hpz) * nrdvs
+    tcrosslo = (dalt - hpz) * nrdvs
     tinver = jnp.minimum(tcrosshi, tcrosslo)
     toutver = jnp.maximum(tcrosshi, tcrosslo)
 
@@ -154,33 +180,40 @@ def _tile_body(ib, jb, ksub, own_ref, intr_ref,
                & (tinconf < tlookahead) & pairmask)
     swlos = (dist < rpz) & (jnp.abs(dalt) < hpz) & pairmask
 
-    dve_p, dvn_p, dvv_p, tsolv_p = cr_mvp.pair_contrib_trig(
-        sinqdr, cosqdr, dist, tcpa, tinconf,
-        intr("alt") - own("alt"), intr("gse") - own("gse"),
-        intr("gsn") - own("gsn"), intr("vs") - own("vs"), mvpcfg)
-    nor_i = intr("noreso") > 0.5
-    mvpmask = swconfl & ~nor_i
-    maskf = mvpmask.astype(dist.dtype)
+    # Everything past the flags only matters when the tile has at least one
+    # conflict or LoS pair: every accumulator update below is then a no-op
+    # (max with 0, sum with 0, min with BIG).  Conflicts are rare even in
+    # *reachable* tiles, so predicating the whole MVP + reduction tail on a
+    # single any-hit flag cuts the common tile to the core CPA geometry.
+    @pl.when(jnp.any(swconfl | swlos))
+    def _accumulate():
+        dve_p, dvn_p, dvv_p, tsolv_p = cr_mvp.pair_contrib_trig(
+            sinqdr, cosqdr, dist, tcpa, tinconf,
+            intr("alt") - own("alt"), intr("gse") - own("gse"),
+            intr("gsn") - own("gsn"), intr("vs") - own("vs"), mvpcfg)
+        nor_i = intr("noreso") > 0.5
+        mvpmask = swconfl & ~nor_i
+        maskf = mvpmask.astype(dist.dtype)
 
-    conff = swconfl.astype(dist.dtype)
-    t_inconf = jnp.max(conff, axis=0, keepdims=True)
-    t_tcpamax = jnp.max(tcpa * conff, axis=0, keepdims=True)
-    t_sdve = jnp.sum(dve_p * maskf, axis=0, keepdims=True)
-    t_sdvn = jnp.sum(dvn_p * maskf, axis=0, keepdims=True)
-    t_sdvv = jnp.sum(dvv_p * maskf, axis=0, keepdims=True)
-    t_tsolv = jnp.min(jnp.where(mvpmask, tsolv_p, _BIG),
-                      axis=0, keepdims=True)
-    t_ncnt = jnp.sum(conff, axis=0, keepdims=True)
-    t_lcnt = jnp.sum(swlos.astype(dist.dtype), axis=0, keepdims=True)
+        conff = swconfl.astype(dist.dtype)
+        t_inconf = jnp.max(conff, axis=0, keepdims=True)
+        t_tcpamax = jnp.max(tcpa * conff, axis=0, keepdims=True)
+        t_sdve = jnp.sum(dve_p * maskf, axis=0, keepdims=True)
+        t_sdvn = jnp.sum(dvn_p * maskf, axis=0, keepdims=True)
+        t_sdvv = jnp.sum(dvv_p * maskf, axis=0, keepdims=True)
+        t_tsolv = jnp.min(jnp.where(mvpmask, tsolv_p, _BIG),
+                          axis=0, keepdims=True)
+        t_ncnt = jnp.sum(conff, axis=0, keepdims=True)
+        t_lcnt = jnp.sum(swlos.astype(dist.dtype), axis=0, keepdims=True)
 
-    inconf_ref[0] = jnp.maximum(inconf_ref[0], t_inconf)
-    tcpamax_ref[0] = jnp.maximum(tcpamax_ref[0], t_tcpamax)
-    sdve_ref[0] = sdve_ref[0] + t_sdve
-    sdvn_ref[0] = sdvn_ref[0] + t_sdvn
-    sdvv_ref[0] = sdvv_ref[0] + t_sdvv
-    tsolv_ref[0] = jnp.minimum(tsolv_ref[0], t_tsolv)
-    ncnt_ref[0] = ncnt_ref[0] + t_ncnt
-    lcnt_ref[0] = lcnt_ref[0] + t_lcnt
+        inconf_ref[0] = jnp.maximum(inconf_ref[0], t_inconf)
+        tcpamax_ref[0] = jnp.maximum(tcpamax_ref[0], t_tcpamax)
+        sdve_ref[0] = sdve_ref[0] + t_sdve
+        sdvn_ref[0] = sdvn_ref[0] + t_sdvn
+        sdvv_ref[0] = sdvv_ref[0] + t_sdvv
+        tsolv_ref[0] = jnp.minimum(tsolv_ref[0], t_tsolv)
+        ncnt_ref[0] = ncnt_ref[0] + t_ncnt
+        lcnt_ref[0] = lcnt_ref[0] + t_lcnt
 
     # Partner candidates: merge this tile's top-kk most urgent conflicts
     # into the running per-ownship top-kk held in the candidate refs.
@@ -214,10 +247,41 @@ def _tile_body(ib, jb, ksub, own_ref, intr_ref,
         cidx_ref[0] = jnp.concatenate(new_i, axis=0)
 
 
+def _kernel_compact(ilist_ref, jlist_ref, own_ref, intr_ref,
+                    inconf_ref, tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref,
+                    tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref, cidx_ref,
+                    *, block, kk, rpz, hpz, tlookahead, mvpcfg):
+    """Tile worklist variant: program t computes reachable tile
+    (ilist[t], jlist[t]) — no grid step is ever spent on a skipped tile.
+
+    The worklist is row-major sorted, so all programs of one ownship block
+    are consecutive: accumulators are initialised on the first program of
+    each ownship block (detected by comparing with the previous list entry)
+    and stay VMEM-resident until the block changes.  Padding entries beyond
+    the real worklist point both slabs at the all-inactive sentinel block,
+    whose pair mask is empty — they accumulate nothing.
+    """
+    t = pl.program_id(0)
+    ib = ilist_ref[t]
+    prev = ilist_ref[jnp.maximum(t - 1, 0)]
+
+    @pl.when((t == 0) | (ib != prev))
+    def _():
+        _init_accumulators((inconf_ref, tcpamax_ref, sdve_ref, sdvn_ref,
+                            sdvv_ref, tsolv_ref, ncnt_ref, lcnt_ref,
+                            ctin_ref, cidx_ref), block, kk)
+
+    _tile_body(ib, jlist_ref[t], 0, own_ref, intr_ref, inconf_ref,
+               tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref, tsolv_ref,
+               ncnt_ref, lcnt_ref, ctin_ref, cidx_ref, block=block, kk=kk,
+               rpz=rpz, hpz=hpz, tlookahead=tlookahead, mvpcfg=mvpcfg)
+
+
 def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                           active, noreso, rpz, hpz, tlookahead, mvpcfg,
                           block=256, k_partners=8, interpret=False,
-                          spatial_sort=True, cols_per_prog=4):
+                          spatial_sort=True, cols_per_prog=4,
+                          compact_cap=None, perm=None):
     """Pallas-backed equivalent of ``cd_tiled.detect_resolve_tiled``.
 
     Returns a ``RowConflictData``; reductions match the lax formulation to
@@ -232,9 +296,10 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
             functools.partial(detect_resolve_pallas, block=block,
                               k_partners=k_partners, interpret=interpret,
                               spatial_sort=False,
-                              cols_per_prog=cols_per_prog),
+                              cols_per_prog=cols_per_prog,
+                              compact_cap=compact_cap),
             lat, lon, trk, gs, alt, vs, gseast, gsnorth, active, noreso,
-            rpz, hpz, tlookahead, mvpcfg)
+            rpz, hpz, tlookahead, mvpcfg, perm=perm)
     dtype = jnp.float32
     # Scoped-VMEM budget: the tile temporaries exceed the 16 MiB stack
     # limit above block=256 on v5e (measured 18-21 MiB at block=512).
@@ -268,52 +333,118 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
     # Exact tile-skip flags (shared bound with the lax backend)
     reach = block_reachability(
         pad(lat), pad(lon), pad(gs), fields["active"] > 0.5,
-        nb, block, float(rpz), float(tlookahead)).astype(jnp.int32)
+        nb, block, float(rpz), float(tlookahead))
 
     kk = k_partners
-    # Several column tiles per grid program amortize the per-program
-    # overhead (grid steps + slab DMA), which dominates once the
-    # reachability skip elides most tiles' compute at large nb.
-    cpp = min(cols_per_prog, nb)
-    nbp = -(-nb // cpp) * cpp
-    if nbp != nb:
-        padslabs = jnp.zeros((nbp - nb, _NF, block), dtype)
-        # One padded buffer serves BOTH inputs (the ownship grid
-        # dimension stays nb, so its padded rows are never read)
-        packed = jnp.concatenate([packed, padslabs], axis=0)
-        reach = jnp.concatenate(
-            [reach, jnp.zeros((nb, nbp - nb), jnp.int32)], axis=1)
-    packed_cols = packed
+    kern_kw = dict(block=block, kk=kk, rpz=float(rpz), hpz=float(hpz),
+                   tlookahead=float(tlookahead), mvpcfg=mvpcfg)
 
-    kern = functools.partial(
-        _kernel, block=block, kk=kk, cpp=cpp, rpz=float(rpz),
-        hpz=float(hpz), tlookahead=float(tlookahead), mvpcfg=mvpcfg)
+    acc = lambda m: [jax.ShapeDtypeStruct((m, 1, block), dtype)] * 8 + [
+        jax.ShapeDtypeStruct((m, kk, block), dtype),       # ctin
+        jax.ShapeDtypeStruct((m, kk, block), jnp.int32)]   # cidx
 
-    acc = lambda: jax.ShapeDtypeStruct((nb, 1, block), dtype)
-    out_shapes = [acc(), acc(), acc(), acc(), acc(), acc(), acc(), acc(),
-                  jax.ShapeDtypeStruct((nb, kk, block), dtype),      # ctin
-                  jax.ShapeDtypeStruct((nb, kk, block), jnp.int32)]  # cidx
+    def run_full(_):
+        """Grid over ALL tile pairs; unreachable ones branch past the body.
 
-    acc_spec = lambda: pl.BlockSpec((1, 1, block), lambda i, j: (i, 0, 0),
-                                    memory_space=pltpu.VMEM)
-    cand_spec = lambda: pl.BlockSpec(
-        (1, kk, block), lambda i, j: (i, 0, 0),
-        memory_space=pltpu.VMEM)
+        Several column tiles per grid program amortize the per-program
+        overhead (grid steps + slab DMA) across the skipped tiles."""
+        cpp = min(cols_per_prog, nb)
+        nbp = -(-nb // cpp) * cpp
+        reach_i = reach.astype(jnp.int32)
+        packed_f = packed
+        if nbp != nb:
+            # One padded buffer serves BOTH inputs (the ownship grid
+            # dimension stays nb, so its padded rows are never read)
+            packed_f = jnp.concatenate(
+                [packed, jnp.zeros((nbp - nb, _NF, block), dtype)], axis=0)
+            reach_i = jnp.concatenate(
+                [reach_i, jnp.zeros((nb, nbp - nb), jnp.int32)], axis=1)
 
-    outs = pl.pallas_call(
-        kern,
-        grid=(nb, nbp // cpp),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),       # reach flags
-            pl.BlockSpec((1, _NF, block), lambda i, j: (i, 0, 0),
-                         memory_space=pltpu.VMEM),       # ownship slab
-            pl.BlockSpec((cpp, _NF, block), lambda i, j: (j, 0, 0),
-                         memory_space=pltpu.VMEM),       # intruder slabs
-        ],
-        out_specs=[acc_spec() for _ in range(8)] + [cand_spec(), cand_spec()],
-        out_shape=out_shapes,
-        interpret=interpret,
-    )(reach, packed, packed_cols)
+        kern = functools.partial(_kernel, cpp=cpp, **kern_kw)
+        acc_spec = lambda: pl.BlockSpec(
+            (1, 1, block), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM)
+        cand_spec = lambda: pl.BlockSpec(
+            (1, kk, block), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM)
+        return list(pl.pallas_call(
+            kern,
+            grid=(nb, nbp // cpp),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),       # reach flags
+                pl.BlockSpec((1, _NF, block), lambda i, j: (i, 0, 0),
+                             memory_space=pltpu.VMEM),       # ownship slab
+                pl.BlockSpec((cpp, _NF, block), lambda i, j: (j, 0, 0),
+                             memory_space=pltpu.VMEM),       # intruder slabs
+            ],
+            out_specs=[acc_spec() for _ in range(8)]
+            + [cand_spec(), cand_spec()],
+            out_shape=acc(nb),
+            interpret=interpret,
+        )(reach_i, packed_f, packed_f))
+
+    def run_compact(operand):
+        """Grid over the compacted worklist of reachable tiles only.
+
+        Per-program cost is all real work, so the grid shrinks from nb^2
+        tile visits to ~(reachable fraction) * nb^2 — the win that makes
+        spread-out 100k-aircraft geometries CD-bound rather than
+        grid-overhead-bound.  Ownship blocks with no reachable tile are
+        never visited; their (uninitialised) output rows are neutralised
+        after the call."""
+        ilist, jlist = operand
+        # Sentinel slab nb: all-inactive (zeros) — padding worklist entries
+        # and never-visited output rows both resolve to it.
+        packed_c = jnp.concatenate(
+            [packed, jnp.zeros((1, _NF, block), dtype)], axis=0)
+        kern = functools.partial(_kernel_compact, **kern_kw)
+        own_map = lambda t, il, jl: (il[t], 0, 0)
+        intr_map = lambda t, il, jl: (jl[t], 0, 0)
+        acc_spec = lambda: pl.BlockSpec((1, 1, block), own_map,
+                                        memory_space=pltpu.VMEM)
+        cand_spec = lambda: pl.BlockSpec((1, kk, block), own_map,
+                                         memory_space=pltpu.VMEM)
+        outs = pl.pallas_call(
+            kern,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(ilist.shape[0],),
+                in_specs=[
+                    pl.BlockSpec((1, _NF, block), own_map,
+                                 memory_space=pltpu.VMEM),   # ownship slab
+                    pl.BlockSpec((1, _NF, block), intr_map,
+                                 memory_space=pltpu.VMEM),   # intruder slab
+                ],
+                out_specs=[acc_spec() for _ in range(8)]
+                + [cand_spec(), cand_spec()],
+            ),
+            out_shape=acc(nb + 1),
+            interpret=interpret,
+        )(ilist, jlist, packed_c, packed_c)
+        # Neutralise rows whose ownship block was never visited (no
+        # reachable tiles -> uninitialised memory), and drop the sentinel.
+        visited = jnp.any(reach, axis=1)[:, None, None]
+        return [jnp.where(visited, o[:nb], jnp.asarray(v, o.dtype))
+                for o, v in zip(outs, _ACC_NEUTRAL)]
+
+    # Worklist capacity: static. Geometries whose reachable set overflows it
+    # (dense regional traffic) take the full-grid path — bit-identical
+    # results, the worklist is purely a scheduling optimization.
+    if compact_cap is None:
+        compact_cap = max(512, (nb * nb) // 8)
+    compact_cap = min(compact_cap, nb * nb)
+    if nb >= 8 and compact_cap > 0:
+        flat = reach.reshape(-1)
+        count = jnp.sum(flat.astype(jnp.int32))
+        # Stable argsort keeps the reachable tiles in row-major order, so
+        # each ownship block's programs are consecutive in the worklist.
+        order = jnp.argsort(jnp.where(flat, jnp.int32(0), jnp.int32(1)),
+                            stable=True)[:compact_cap]
+        valid = jnp.arange(compact_cap, dtype=jnp.int32) < count
+        ilist = jnp.where(valid, (order // nb).astype(jnp.int32), nb)
+        jlist = jnp.where(valid, (order % nb).astype(jnp.int32), nb)
+        outs = jax.lax.cond(count <= compact_cap, run_compact, run_full,
+                            (ilist, jlist))
+    else:
+        outs = run_full(None)
 
     (inconf, tcpamax, sdve, sdvn, sdvv, tsolv, ncnt, lcnt,
      ctin, cidx) = outs

@@ -46,7 +46,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from . import cr_mvp, geo
+from . import cr_mvp, geo, kmath
 
 
 class RowConflictData(NamedTuple):
@@ -108,12 +108,15 @@ def precompute_trig(lat, lon):
 
 
 def _rwgs84_from_trig(cosphi, sinphi):
-    """geo.rwgs84 evaluated from cos/sin of the latitude angle."""
+    """geo.rwgs84 evaluated from cos/sin of the latitude angle.
+
+    sqrt(num)*rsqrt(den) instead of sqrt(num/den): one fewer multi-cycle
+    VPU op per pair, ~1 ulp difference."""
     an = geo.A_WGS84 * geo.A_WGS84 * cosphi
     bn = geo.B_WGS84 * geo.B_WGS84 * sinphi
     ad = geo.A_WGS84 * cosphi
     bd = geo.B_WGS84 * sinphi
-    return jnp.sqrt((an * an + bn * bn) / (ad * ad + bd * bd))
+    return jnp.sqrt(an * an + bn * bn) * jax.lax.rsqrt(ad * ad + bd * bd)
 
 
 def _sin_poly(x):
@@ -126,7 +129,7 @@ def _sin_poly(x):
     return x * (1.0 - x2 / 6.0 * (1.0 - x2 / 20.0 * (1.0 - x2 / 42.0)))
 
 
-def tile_geometry(own, intr, atan2=None):
+def tile_geometry(own, intr):
     """Pair distance [m] + bearing sin/cos for one tile.
 
     ``own``/``intr`` are dicts of TRIG_FIELDS columns, broadcast-shaped
@@ -134,8 +137,13 @@ def tile_geometry(own, intr, atan2=None):
     (including the radius-at-sum-of-latitudes quirk and the 1e-6 epsilon,
     geo.py:117-128) via the delta-polynomial scheme above.  Returns
     (dist, sin_qdr, cos_qdr).
+
+    VPU-lean transcendentals (shared verbatim by the lax and Pallas
+    backends, so they cannot drift): the arc length uses the odd-Taylor
+    arcsin (kmath.asin_taylor — f32-exact for every distance that can
+    flip a conflict/LoS flag, conservative beyond) and the bearing
+    normalization uses one rsqrt instead of sqrt + two divides.
     """
-    atan2 = atan2 or jnp.arctan2
     sl_o, cl_o = own["sl"], own["cl"]
     sl_i, cl_i = intr["sl"], intr["cl"]
 
@@ -159,16 +167,19 @@ def tile_geometry(own, intr, atan2=None):
     sh_lon = _sin_poly(0.5 * dlon)
     root = sh_lat * sh_lat + cl_o * cl_i * sh_lon * sh_lon
     root = jnp.clip(root, 0.0, 1.0)
-    dist = 2.0 * r * atan2(jnp.sqrt(root), jnp.sqrt(1.0 - root))
+    dist = 2.0 * r * kmath.asin_taylor(jnp.sqrt(root))
 
     # Bearing sin/cos as ratios — the angle is never formed.
     # qx = cl_o*sl_i - sl_o*cl_i*cos(dlon) = sin(dlat) + sl_o*cl_i*(1-cos
     # dlon), with 1-cos(dlon) = 2*sin^2(dlon/2): all well-conditioned terms.
     qy = _sin_poly(dlon) * cl_i
     qx = _sin_poly(dlat) + sl_o * cl_i * (2.0 * sh_lon * sh_lon)
-    h = jnp.sqrt(qx * qx + qy * qy)
-    h = jnp.where(h < 1e-30, 1e-30, h)
-    return dist, qy / h, qx / h
+    # Clamp must stay f32-NORMAL (1e-60 underflows to 0 -> rsqrt=inf ->
+    # NaN bearings for co-located pairs, silently dropping their
+    # conflicts); 1e-37 keeps rsqrt finite and 0*rsqrt = 0 like the
+    # 0/h of the division form.
+    rh = jax.lax.rsqrt(jnp.maximum(qx * qx + qy * qy, 1e-37))
+    return dist, qy * rh, qx * rh
 
 
 def spatial_permutation(lat, lon, active):
@@ -204,7 +215,7 @@ def spatial_permutation(lat, lon, active):
 
 
 def run_spatially_sorted(kernel, lat, lon, trk, gs, alt, vs, gseast,
-                         gsnorth, active, noreso, *args, **kw):
+                         gsnorth, active, noreso, *args, perm=None, **kw):
     """Run a tiled CD&R kernel in Morton-sorted slot space and map the
     results back to the caller's slot order.
 
@@ -213,9 +224,18 @@ def run_spatially_sorted(kernel, lat, lon, trk, gs, alt, vs, gseast,
     arguments plus *args/**kw and return a RowConflictData), then
     inverse-permutes the row outputs and maps the partner indices
     through the permutation (they are sorted-space positions).
+
+    ``perm`` lets the caller supply a (possibly stale) cached permutation
+    — exact for ANY permutation, since block reachability is recomputed
+    from the true positions; staleness only loosens the block bounding
+    boxes (core/asas.py carries it in ``AsasArrays.sort_perm``).
     """
-    perm = spatial_permutation(lat, lon, active)
-    inv = jnp.argsort(perm)
+    if perm is None:
+        perm = spatial_permutation(lat, lon, active)
+    # Invert by scatter: an O(N) store instead of a second O(N log^2 N)
+    # TPU sort (argsort of 100k keys costs more than the CD kernel).
+    inv = jnp.zeros_like(perm).at[perm].set(
+        jnp.arange(perm.shape[0], dtype=perm.dtype))
     g = lambda a: a[perm]
     rd = kernel(g(lat), g(lon), g(trk), g(gs), g(alt), g(vs),
                 g(gseast), g(gsnorth), g(active), g(noreso),
@@ -296,7 +316,7 @@ def block_reachability(lat, lon, gs, active, nb, block, rpz, tlookahead):
 def detect_resolve_tiled(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                          active, noreso, rpz, hpz, tlookahead, mvpcfg,
                          block=512, k_partners=8, prefilter=True,
-                         spatial_sort=True):
+                         spatial_sort=True, perm=None):
     """One fused pass over all aircraft pairs in [block, block] tiles.
 
     Args mirror ``ops.cd.detect`` plus the MVP inputs; ``mvpcfg`` is a
@@ -323,7 +343,7 @@ def detect_resolve_tiled(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                               k_partners=k_partners, prefilter=prefilter,
                               spatial_sort=False),
             lat, lon, trk, gs, alt, vs, gseast, gsnorth, active, noreso,
-            rpz, hpz, tlookahead, mvpcfg)
+            rpz, hpz, tlookahead, mvpcfg, perm=perm)
     block = min(block, max(n, 1))
     kk = min(k_partners, block)   # per-tile candidates merged into the top-K
     nb = -(-n // block)
@@ -391,13 +411,15 @@ def detect_resolve_tiled(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         dv = c["v"][None, :] - r["v"][:, None]
         dv2 = du * du + dv * dv
         dv2 = jnp.where(jnp.abs(dv2) < 1e-6, 1e-6, dv2)
-        vrel = jnp.sqrt(dv2)
+        # One rsqrt replaces the sqrt + two divides of the reference
+        # formulation (1/vrel and 1/dv2 both derive from it)
+        rvrel = jax.lax.rsqrt(dv2)
 
-        tcpa = -(du * dx + dv * dy) / dv2 + excl
+        tcpa = -(du * dx + dv * dy) * (rvrel * rvrel) + excl
         dcpa2 = dist * dist - tcpa * tcpa * dv2
         swhorconf = dcpa2 < r2
 
-        dtinhor = jnp.sqrt(jnp.maximum(0.0, r2 - dcpa2)) / vrel
+        dtinhor = jnp.sqrt(jnp.maximum(0.0, r2 - dcpa2)) * rvrel
         tinhor = jnp.where(swhorconf, tcpa - dtinhor, 1e8)
         touthor = jnp.where(swhorconf, tcpa + dtinhor, -1e8)
 
@@ -405,8 +427,9 @@ def detect_resolve_tiled(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         dalt = c["alt"][None, :] - r["alt"][:, None] + excl
         dvs = c["vs"][None, :] - r["vs"][:, None]
         dvs = jnp.where(jnp.abs(dvs) < 1e-6, 1e-6, dvs)
-        tcrosshi = (dalt + hpz) / -dvs
-        tcrosslo = (dalt - hpz) / -dvs
+        nrdvs = -1.0 / dvs            # one divide for both crossings
+        tcrosshi = (dalt + hpz) * nrdvs
+        tcrosslo = (dalt - hpz) * nrdvs
         tinver = jnp.minimum(tcrosshi, tcrosslo)
         toutver = jnp.maximum(tcrosshi, tcrosslo)
 

@@ -52,3 +52,21 @@ def asin(x):
     """arcsin on [-1, 1] via atan2(x, sqrt(1-x^2))."""
     x = jnp.clip(x, -1.0, 1.0)
     return atan2(x, jnp.sqrt(jnp.maximum(0.0, 1.0 - x * x)))
+
+
+def asin_taylor(s):
+    """Odd Taylor arcsin for the haversine arc length, |s| <= 1.
+
+    Error bounds that matter for conflict detection (s = sin(d/2R)):
+    < 1e-9 relative for d <= 400 km — and a pair beyond ~400 km can
+    neither be in LoS (d >> rpz) nor enter conflict within the 300 s
+    lookahead (closing speed would have to exceed 1.3 km/s), so every
+    distance that can flip a conflict/LoS flag is evaluated to full f32
+    precision.  For far pairs the polynomial *under*-estimates the arc
+    (up to 16% at the antipode), which cannot create a false conflict:
+    dcpa scales with dist, so shrinking a >400 km pair still leaves
+    dcpa orders of magnitude above the protected zone.
+    """
+    s2 = s * s
+    return s * (1.0 + s2 * (1.0 / 6.0 + s2 * (3.0 / 40.0 + s2 * (
+        15.0 / 336.0 + s2 * (105.0 / 3456.0)))))

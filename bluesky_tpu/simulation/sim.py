@@ -272,6 +272,20 @@ class Simulation:
         datalog.reset()
         return True
 
+    def reset_traffic(self):
+        """Traffic-scoped reset: clear aircraft + routes + deferred
+        conditions, keep sim settings/stack/logs/plugins.
+
+        Mirrors the reference's ``bs.traf.reset()`` (trafficarrays cascade:
+        routes and conditional commands are traf children there), which is
+        what the SYN generators call (reference synthetic.py:48,58,...) —
+        unlike the full ``reset`` they must NOT wipe SimConfig (CDMETHOD,
+        DT), datalog or plugin state."""
+        self.traf.reset()
+        self.cond.reset()
+        self.routes = RouteManager(self.traf, self.routes.wmax)
+        return True
+
     def reset(self):
         self.state_flag = INIT
         self.traf.reset()

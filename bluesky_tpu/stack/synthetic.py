@@ -26,7 +26,9 @@ def process(sim, subcmd, args):
     nargs = len(args)
 
     def reset():
-        sim.reset()
+        # Traffic-only, like the reference generators' bs.traf.reset()
+        # (synthetic.py:48-327): sim settings/stack/logs must survive.
+        sim.reset_traffic()
 
     if c == "SIMPLE":
         reset()

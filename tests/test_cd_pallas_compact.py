@@ -1,0 +1,100 @@
+"""Compact-worklist Pallas path: parity with the lax oracle + NaN regression.
+
+The worklist scheduler (cd_pallas._kernel_compact) only engages at nb >= 8
+ownship blocks, so these tests run 1024 aircraft at block=128 (nb=8) in
+interpret mode — large enough to exercise the worklist, the sentinel
+padding entries, the never-visited-row neutralisation, and the
+count-vs-capacity cond fallback.
+"""
+import numpy as np
+import numpy.testing as npt
+import jax.numpy as jnp
+import pytest
+
+from bluesky_tpu.ops import cd_pallas, cd_tiled, cr_mvp
+
+NM, FT = 1852.0, 0.3048
+
+
+def _scene(n=1024, seed=1):
+    rng = np.random.default_rng(seed)
+    lat = jnp.asarray(rng.uniform(40, 55, n), jnp.float32)
+    lon = jnp.asarray(rng.uniform(-5, 15, n), jnp.float32)
+    trk = jnp.asarray(rng.uniform(0, 360, n), jnp.float32)
+    gs = jnp.asarray(rng.uniform(150, 250, n), jnp.float32)
+    alt = jnp.asarray(rng.uniform(3000, 11000, n), jnp.float32)
+    vs = jnp.asarray(rng.uniform(-10, 10, n), jnp.float32)
+    gse = gs * jnp.sin(jnp.radians(trk))
+    gsn = gs * jnp.cos(jnp.radians(trk))
+    act = jnp.asarray(rng.random(n) > 0.05)
+    nor = jnp.zeros(n, bool)
+    cfg = cr_mvp.MVPConfig(rpz_m=5 * NM * 1.05, hpz_m=1000 * FT * 1.05,
+                           tlookahead=300.0)
+    return (lat, lon, trk, gs, alt, vs, gse, gsn, act, nor,
+            5 * NM, 1000 * FT, 300.0, cfg)
+
+
+def _check(ref, got, label):
+    for name in ref._fields:
+        a, b = np.asarray(getattr(ref, name)), np.asarray(getattr(got, name))
+        if a.dtype == bool or a.dtype.kind == "i":
+            npt.assert_array_equal(a, b, err_msg=f"{label}:{name}")
+        else:
+            npt.assert_allclose(a, b, rtol=2e-4, atol=2e-3,
+                                err_msg=f"{label}:{name}")
+
+
+@pytest.fixture(scope="module")
+def scene():
+    return _scene()
+
+
+@pytest.fixture(scope="module")
+def oracle(scene):
+    return cd_tiled.detect_resolve_tiled(*scene, block=128)
+
+
+def test_compact_worklist_matches_lax_oracle(scene, oracle):
+    """nb=8 engages the worklist path (default cap covers the count)."""
+    got = cd_pallas.detect_resolve_pallas(*scene, block=128, interpret=True)
+    assert int(oracle.nconf) > 0          # scene must actually have conflicts
+    _check(oracle, got, "compact")
+
+
+def test_overflow_falls_back_to_full_grid(scene, oracle):
+    """compact_cap below the reachable count takes the full-grid branch."""
+    got = cd_pallas.detect_resolve_pallas(*scene, block=128, interpret=True,
+                                          compact_cap=3)
+    _check(oracle, got, "fallback")
+
+
+def test_compact_disabled_full_grid(scene, oracle):
+    got = cd_pallas.detect_resolve_pallas(*scene, block=128, interpret=True,
+                                          compact_cap=0)
+    _check(oracle, got, "full")
+
+
+def test_colocated_pair_conflict_not_dropped():
+    """Regression: the bearing-normalization clamp must stay f32-normal.
+
+    Two co-located aircraft on reciprocal tracks are the closest possible
+    conflict; an underflowing clamp (1e-60 -> 0 in f32) made rsqrt return
+    inf and the NaN bearing silently dropped the conflict.
+    """
+    z = jnp.zeros(2, jnp.float32)
+    lat = jnp.asarray([52.0, 52.0], jnp.float32)
+    lon = jnp.asarray([4.0, 4.0], jnp.float32)
+    trk = jnp.asarray([90.0, 270.0], jnp.float32)
+    gs = jnp.asarray([200.0, 200.0], jnp.float32)
+    gse = gs * jnp.sin(jnp.radians(trk))
+    gsn = gs * jnp.cos(jnp.radians(trk))
+    act = jnp.ones(2, bool)
+    cfg = cr_mvp.MVPConfig(rpz_m=5 * NM * 1.05, hpz_m=1000 * FT * 1.05,
+                           tlookahead=300.0)
+    args = (lat, lon, trk, gs, z, z, gse, gsn, act, jnp.zeros(2, bool),
+            5 * NM, 1000 * FT, 300.0, cfg)
+    rd = cd_tiled.detect_resolve_tiled(*args, block=2)
+    assert int(rd.nconf) == 2 and int(rd.nlos) == 2
+    assert bool(rd.inconf.all())
+    rdp = cd_pallas.detect_resolve_pallas(*args, interpret=True)
+    assert int(rdp.nconf) == 2 and bool(rdp.inconf.all())
