@@ -32,7 +32,7 @@ def _make_traffic(n_ac, geometry, pair_matrix, dtype):
     if geometry == "global":
         # 100k concurrent aircraft worldwide: ~5-10x today's global peak —
         # the realistic reading of the 100k north star
-        lat = np.degrees(np.arcsin(rng.uniform(-0.93, 0.94, n_ac)))  # area-uniform, ~±70
+        lat = np.degrees(np.arcsin(rng.uniform(-0.94, 0.94, n_ac)))  # area-uniform, ~±70
         lon = rng.uniform(-180.0, 180.0, n_ac)
     elif geometry == "continental":
         lat = rng.uniform(35.0, 60.0, n_ac)

@@ -53,8 +53,7 @@ _BIG = 1e9
 
 #: Identity elements of the 10 accumulator outputs, in output-tuple order:
 #: inconf, tcpamax, sdve, sdvn, sdvv, tsolv, ncnt, lcnt, ctin, cidx.
-#: Single source of truth for both kernels' init blocks and the
-#: never-visited-row neutralisation in run_compact.
+#: Single source of truth for every kernel's accumulator-init block.
 _ACC_NEUTRAL = (0.0, 0.0, 0.0, 0.0, 0.0, _BIG, 0.0, 0.0, _BIG, 2**30)
 
 
@@ -115,9 +114,9 @@ def _tile_body(ib, jb, ksub, own_ref, intr_ref,
         return islab_t[:, _IDX[k]:_IDX[k] + 1]            # (block, 1)
 
     gid_own = ib * block + jax.lax.broadcasted_iota(
-        jnp.int32, (block, block), 1)
+        jnp.int32, (1, block), 1)                         # ownships on lanes
     gid_int = jb * block + jax.lax.broadcasted_iota(
-        jnp.int32, (block, block), 0)
+        jnp.int32, (block, 1), 0)                         # intruders sublanes
     act_o = own("active") > 0.5                           # (1, block)
     act_i = intr("active") > 0.5                          # (block, 1)
     pairmask = (act_o & act_i) & (gid_own != gid_int)
@@ -247,46 +246,152 @@ def _tile_pairs(pairmask, gid_int, own, intr,
         cidx_ref[0] = jnp.concatenate(new_i, axis=0)
 
 
-def _kernel_compact(ilist_ref, jlist_ref, own_ref, intr_ref,
-                    inconf_ref, tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref,
-                    tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref, cidx_ref,
-                    *, block, kk, rpz, hpz, tlookahead, mvpcfg):
-    """Tile worklist variant: program t computes reachable tile
-    (ilist[t], jlist[t]) — no grid step is ever spent on a skipped tile.
+def _kernel_cand(own_ref, cand_ref, cgid_ref,
+                 inconf_ref, tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref,
+                 tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref, cidx_ref,
+                 *, block, kk, rpz, hpz, tlookahead, mvpcfg):
+    """Candidate-list variant: ownship block i vs its GATHERED candidate
+    aircraft (sub-chunk j of the per-block candidate table).
 
-    The worklist is row-major sorted, so all programs of one ownship block
-    are consecutive: accumulators are initialised on the first program of
-    each ownship block (detected by comparing with the previous list entry)
-    and stay VMEM-resident until the block changes.  Padding entries beyond
-    the real worklist point both slabs at the all-inactive sentinel block,
-    whose pair mask is empty — they accumulate nothing.
+    Tiles are (candidate, ownship)-shaped exactly like the block kernels,
+    but the intruder axis holds only aircraft that passed the exact
+    point-to-bounding-box reachability bound (_build_candidates) — the
+    pair count approaches the physics floor (aircraft within
+    rpz + tlookahead * closing speed) instead of the block-granular
+    superset.  Candidate global ids ride along in ``cgid_ref`` (sentinel
+    entries point at the all-inactive padding row and mask out).
     """
-    t = pl.program_id(0)
-    ib = ilist_ref[t]
-    prev = ilist_ref[jnp.maximum(t - 1, 0)]
+    i = pl.program_id(0)
+    j = pl.program_id(1)
 
-    @pl.when((t == 0) | (ib != prev))
+    @pl.when(j == 0)
     def _():
         _init_accumulators((inconf_ref, tcpamax_ref, sdve_ref, sdvn_ref,
                             sdvv_ref, tsolv_ref, ncnt_ref, lcnt_ref,
                             ctin_ref, cidx_ref), block, kk)
 
-    _tile_body(ib, jlist_ref[t], 0, own_ref, intr_ref, inconf_ref,
-               tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref, tsolv_ref,
-               ncnt_ref, lcnt_ref, ctin_ref, cidx_ref, block=block, kk=kk,
-               rpz=rpz, hpz=hpz, tlookahead=tlookahead, mvpcfg=mvpcfg)
+    oslab = own_ref[0]                                    # (_NF, block)
+    cslab_t = cand_ref[0].T                               # (block, _NF)
+
+    def own(k):
+        return oslab[_IDX[k]:_IDX[k] + 1, :]
+
+    def intr(k):
+        return cslab_t[:, _IDX[k]:_IDX[k] + 1]
+
+    gid_own = i * block + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block), 1)
+    gid_int = cgid_ref[0].T                               # (block, 1)
+    act_o = own("active") > 0.5
+    act_i = intr("active") > 0.5
+    pairmask = (act_o & act_i) & (gid_own != gid_int)
+
+    @pl.when(jnp.any(pairmask))
+    def _live_tile():
+        _tile_pairs(pairmask, gid_int, own, intr, inconf_ref, tcpamax_ref,
+                    sdve_ref, sdvn_ref, sdvv_ref, tsolv_ref, ncnt_ref,
+                    lcnt_ref, ctin_ref, cidx_ref, kk=kk, rpz=rpz, hpz=hpz,
+                    tlookahead=tlookahead, mvpcfg=mvpcfg)
+
+
+def _build_candidates(lat, lon, gs, active, nb, block, c_cap, rpz,
+                      tlookahead, sub=32):
+    """Per-ownship-block candidate aircraft: exact bbox-to-bbox bound at
+    ``sub``-aircraft granularity.
+
+    For each ownship block's active bounding box, a sub-block of ``sub``
+    consecutive (Morton-sorted) aircraft is a candidate iff the
+    conservative distance lower bound between the boxes is within
+    ``rpz + tlookahead * (gsmax_row + gsmax_sub)`` — the same exact skip
+    predicate as ``block_reachability`` evaluated 8x finer.  Candidate
+    sub-block ids are compacted per row with a SORT (ascending id keys),
+    not a scatter — TPU scatters over the [nb, n] domain serialize into
+    hundreds of ms, while a batched [nb, nb*block/sub] sort is
+    milliseconds — then expanded to aircraft ids.
+
+    Returns ``(cand [nb, c_cap] int32, row_over [nb] bool)``; entries
+    beyond a row's count hold the sentinel id ``n`` (the all-inactive
+    padding column).  Rows whose candidate count exceeds c_cap are
+    OVERFLOW rows: their table is forced all-sentinel (so the candidate
+    kernel skips them for free) and the caller must cover them with a
+    row-masked full-grid pass — the straddle blocks of the Morton curve
+    (bounding boxes spanning Z-order jumps) make a handful of such rows
+    unavoidable at any practical capacity.
+    """
+    n = lat.shape[0]                       # nb*block, padded sorted space
+    nsb = n // sub                         # number of sub-blocks
+    c_sub = c_cap // sub
+
+    def boxes(shape):
+        inf = jnp.asarray(jnp.inf, lat.dtype)
+        blat, blon = lat.reshape(shape), lon.reshape(shape)
+        act = active.reshape(shape)
+        return (jnp.min(jnp.where(act, blat, inf), axis=1),
+                jnp.max(jnp.where(act, blat, -inf), axis=1),
+                jnp.min(jnp.where(act, blon, inf), axis=1),
+                jnp.max(jnp.where(act, blon, -inf), axis=1),
+                jnp.max(jnp.where(act, gs.reshape(shape), 0.0), axis=1),
+                jnp.any(act, axis=1))
+
+    rlatmin, rlatmax, rlonmin, rlonmax, rgsmax, _ = boxes((nb, block))
+    slatmin, slatmax, slonmin, slonmax, sgsmax, s_any = boxes((nsb, sub))
+    r_abslat = jnp.maximum(jnp.abs(rlatmin), jnp.abs(rlatmax))
+    s_abslat = jnp.maximum(jnp.abs(slatmin), jnp.abs(slatmax))
+
+    # [nb, nsb] box-to-box gaps — same conservative bound family as
+    # block_reachability (meridional <110 km/deg; zonal via the min
+    # meridian distance at the highest |lat|; circular longitude gap)
+    dlat_gap = jnp.maximum(0.0, jnp.maximum(
+        rlatmin[:, None] - slatmax[None, :],
+        slatmin[None, :] - rlatmax[:, None]))
+    lin_gap = jnp.maximum(0.0, jnp.maximum(
+        rlonmin[:, None] - slonmax[None, :],
+        slonmin[None, :] - rlonmax[:, None]))
+    wrap_gap = jnp.maximum(0.0, 360.0 - (
+        jnp.maximum(rlonmax[:, None], slonmax[None, :])
+        - jnp.minimum(rlonmin[:, None], slonmin[None, :])))
+    dlon_gap = jnp.minimum(lin_gap, wrap_gap)
+    cos_lb = jnp.cos(jnp.radians(jnp.minimum(
+        90.0, jnp.maximum(r_abslat[:, None], s_abslat[None, :]))))
+    zonal = 2.0 * 6335000.0 * jnp.arcsin(jnp.clip(
+        cos_lb * jnp.sin(jnp.radians(0.5 * jnp.minimum(dlon_gap, 360.0))),
+        0.0, 1.0))
+    dist_lb = jnp.maximum(dlat_gap * 110000.0, zonal)
+    thresh = rpz + tlookahead * (rgsmax[:, None] + sgsmax[None, :])
+    mask = (dist_lb <= thresh * 1.05) & s_any[None, :]
+
+    count = jnp.sum(mask, axis=1, dtype=jnp.int32)
+    row_over = count > c_sub
+    # Sort-based compaction: candidate ids ascend, non-candidates sink
+    key = jnp.where(mask, jnp.arange(nsb, dtype=jnp.int32)[None, :],
+                    jnp.int32(2**30))
+    cand_sub = jnp.sort(key, axis=1)[:, :c_sub]          # [nb, c_sub]
+    valid = (cand_sub < 2**30) & ~row_over[:, None]
+    cand = jnp.where(valid, cand_sub, 0)[:, :, None] * sub \
+        + jnp.arange(sub, dtype=jnp.int32)[None, None, :]
+    cand = jnp.where(valid[:, :, None], cand, n).reshape(nb, c_sub * sub)
+    return cand, row_over
 
 
 def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                           active, noreso, rpz, hpz, tlookahead, mvpcfg,
                           block=256, k_partners=8, interpret=False,
                           spatial_sort=True, cols_per_prog=4,
-                          compact_cap=None, perm=None):
+                          cand_cap=0, perm=None):
     """Pallas-backed equivalent of ``cd_tiled.detect_resolve_tiled``.
 
     Returns a ``RowConflictData``; reductions match the lax formulation to
     float tolerance (identical per-tile math, same block iteration order).
     Always computes in float32 (the TPU-native dtype for this kernel).
+
+    ``cand_cap`` > 0 enables the mixed-mode candidate scheduler: a
+    per-ownship-block table of sub-block-granular candidate aircraft
+    (exact bound), with overflow rows covered by a row-masked full-grid
+    pass.  Measured on v5e at N=100k it is at best ~10% ahead of the
+    default block grid (the reach annulus is dominated by the
+    rpz + tlookahead*vrel physics radius, not by block granularity), so
+    it stays off by default; it is exact at any capacity and may win for
+    much sparser or larger-N fleets.
     """
     n = lat.shape[0]
     if spatial_sort and n > block:
@@ -297,7 +402,7 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                               k_partners=k_partners, interpret=interpret,
                               spatial_sort=False,
                               cols_per_prog=cols_per_prog,
-                              compact_cap=compact_cap),
+                              cand_cap=cand_cap),
             lat, lon, trk, gs, alt, vs, gseast, gsnorth, active, noreso,
             rpz, hpz, tlookahead, mvpcfg, perm=perm)
     dtype = jnp.float32
@@ -343,14 +448,16 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         jax.ShapeDtypeStruct((m, kk, block), dtype),       # ctin
         jax.ShapeDtypeStruct((m, kk, block), jnp.int32)]   # cidx
 
-    def run_full(_):
+    def run_full(reach_in=None):
         """Grid over ALL tile pairs; unreachable ones branch past the body.
 
         Several column tiles per grid program amortize the per-program
-        overhead (grid steps + slab DMA) across the skipped tiles."""
+        overhead (grid steps + slab DMA) across the skipped tiles.
+        ``reach_in`` restricts the pass to a row subset (mixed-mode
+        overflow rows)."""
         cpp = min(cols_per_prog, nb)
         nbp = -(-nb // cpp) * cpp
-        reach_i = reach.astype(jnp.int32)
+        reach_i = (reach if reach_in is None else reach_in).astype(jnp.int32)
         packed_f = packed
         if nbp != nb:
             # One padded buffer serves BOTH inputs (the ownship grid
@@ -381,70 +488,71 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
             interpret=interpret,
         )(reach_i, packed_f, packed_f))
 
-    def run_compact(operand):
-        """Grid over the compacted worklist of reachable tiles only.
+    def run_cand(cand):
+        """Grid over (ownship block, candidate sub-chunk): the intruder
+        axis holds only aircraft that can possibly conflict with the
+        block (exact bound, _build_candidates), so the pair count
+        approaches the physics floor instead of the block-granular
+        superset — the win that makes spread-out 100k-aircraft
+        geometries pair-math-bound rather than tile-granularity-bound."""
+        nsub = cand.shape[1] // block
+        # Gather candidate columns; sentinel id n selects the appended
+        # all-zero (inactive) column.
+        allf = jnp.stack([fields[k] for k in _FIELDS])     # [_NF, n]
+        allf = jnp.concatenate(
+            [allf, jnp.zeros((_NF, 1), dtype)], axis=1)
+        csl = allf[:, cand]                                # [_NF, nb, c_cap]
+        csl = csl.transpose(1, 0, 2).reshape(nb, _NF, nsub, block) \
+            .transpose(0, 2, 1, 3).reshape(nb * nsub, _NF, block)
+        cgid = cand.reshape(nb * nsub, 1, block)
 
-        Per-program cost is all real work, so the grid shrinks from nb^2
-        tile visits to ~(reachable fraction) * nb^2 — the win that makes
-        spread-out 100k-aircraft geometries CD-bound rather than
-        grid-overhead-bound.  Ownship blocks with no reachable tile are
-        never visited; their (uninitialised) output rows are neutralised
-        after the call."""
-        ilist, jlist = operand
-        # Sentinel slab nb: all-inactive (zeros) — padding worklist entries
-        # and never-visited output rows both resolve to it.
-        packed_c = jnp.concatenate(
-            [packed, jnp.zeros((1, _NF, block), dtype)], axis=0)
-        kern = functools.partial(_kernel_compact, **kern_kw)
-        own_map = lambda t, il, jl: (il[t], 0, 0)
-        intr_map = lambda t, il, jl: (jl[t], 0, 0)
+        kern = functools.partial(_kernel_cand, **kern_kw)
+        own_map = lambda i, j: (i, 0, 0)
+        sub_map = lambda i, j: (i * nsub + j, 0, 0)
         acc_spec = lambda: pl.BlockSpec((1, 1, block), own_map,
                                         memory_space=pltpu.VMEM)
         cand_spec = lambda: pl.BlockSpec((1, kk, block), own_map,
                                          memory_space=pltpu.VMEM)
-        outs = pl.pallas_call(
+        return list(pl.pallas_call(
             kern,
-            grid_spec=pltpu.PrefetchScalarGridSpec(
-                num_scalar_prefetch=2,
-                grid=(ilist.shape[0],),
-                in_specs=[
-                    pl.BlockSpec((1, _NF, block), own_map,
-                                 memory_space=pltpu.VMEM),   # ownship slab
-                    pl.BlockSpec((1, _NF, block), intr_map,
-                                 memory_space=pltpu.VMEM),   # intruder slab
-                ],
-                out_specs=[acc_spec() for _ in range(8)]
-                + [cand_spec(), cand_spec()],
-            ),
-            out_shape=acc(nb + 1),
+            grid=(nb, nsub),
+            in_specs=[
+                pl.BlockSpec((1, _NF, block), own_map,
+                             memory_space=pltpu.VMEM),     # ownship slab
+                pl.BlockSpec((1, _NF, block), sub_map,
+                             memory_space=pltpu.VMEM),     # candidate slab
+                pl.BlockSpec((1, 1, block), sub_map,
+                             memory_space=pltpu.VMEM),     # candidate ids
+            ],
+            out_specs=[acc_spec() for _ in range(8)]
+            + [cand_spec(), cand_spec()],
+            out_shape=acc(nb),
             interpret=interpret,
-        )(ilist, jlist, packed_c, packed_c)
-        # Neutralise rows whose ownship block was never visited (no
-        # reachable tiles -> uninitialised memory), and drop the sentinel.
-        visited = jnp.any(reach, axis=1)[:, None, None]
-        return [jnp.where(visited, o[:nb], jnp.asarray(v, o.dtype))
-                for o, v in zip(outs, _ACC_NEUTRAL)]
+        )(packed, csl, cgid))
 
-    # Worklist capacity: static. Geometries whose reachable set overflows it
-    # (dense regional traffic) take the full-grid path — bit-identical
-    # results, the worklist is purely a scheduling optimization.
-    if compact_cap is None:
-        compact_cap = max(512, (nb * nb) // 8)
-    compact_cap = min(compact_cap, nb * nb)
-    if nb >= 8 and compact_cap > 0:
-        flat = reach.reshape(-1)
-        count = jnp.sum(flat.astype(jnp.int32))
-        # Stable argsort keeps the reachable tiles in row-major order, so
-        # each ownship block's programs are consecutive in the worklist.
-        order = jnp.argsort(jnp.where(flat, jnp.int32(0), jnp.int32(1)),
-                            stable=True)[:compact_cap]
-        valid = jnp.arange(compact_cap, dtype=jnp.int32) < count
-        ilist = jnp.where(valid, (order // nb).astype(jnp.int32), nb)
-        jlist = jnp.where(valid, (order % nb).astype(jnp.int32), nb)
-        outs = jax.lax.cond(count <= compact_cap, run_compact, run_full,
-                            (ilist, jlist))
+    # Mixed-mode dispatch: the candidate pass covers rows whose table
+    # fits the static capacity; the handful of overflow rows (Morton
+    # straddle blocks, or every row when the whole fleet is mutually
+    # reachable — dense regional traffic) are covered by a row-masked
+    # full-grid pass and the row-disjoint outputs merged.  Identical
+    # results either way — the split is purely a scheduling optimization.
+    c_cap = -(-cand_cap // block) * block if cand_cap else 0
+    if nb >= 8 and 0 < c_cap < nb * block:
+        cand, row_over = _build_candidates(
+            pad(lat), pad(lon), pad(gs), fields["active"] > 0.5,
+            nb, block, c_cap, float(rpz), float(tlookahead))
+        outs_c = run_cand(cand)
+        reach_f = reach & row_over[:, None]
+
+        def neutral(_):
+            return [jnp.full(o.shape, v, o.dtype)
+                    for o, v in zip(outs_c, _ACC_NEUTRAL)]
+
+        outs_f = jax.lax.cond(jnp.any(row_over), run_full, neutral, reach_f)
+        rsel = row_over[:, None, None]
+        outs = [jnp.where(rsel, f, c) for f, c in zip(outs_f, outs_c)]
     else:
-        outs = run_full(None)
+        outs = run_full()
 
     (inconf, tcpamax, sdve, sdvn, sdvv, tsolv, ncnt, lcnt,
      ctin, cidx) = outs

@@ -8,4 +8,13 @@ streams (simulation/screenio.py), (b) the client-side nodeData mirror
 that draws the same picture the RadarWidget draws — aircraft symbols
 with labels, trails, area shapes, the selected route — into a file any
 browser displays.  SCREENSHOT renders it sim-side.
+
+Shared frontend logic, usable by any client (reference parity):
+- ``radarclick`` — click-to-command-line completion (ui/radarclick.py)
+- ``console``    — command-line state/history/IC-autocomplete
+  (ui/qtgl/console.py + autocomplete.py, de-Qt-ified)
+- ``polytools``  — polygon -> triangle buffers (GLU tessellator replaced
+  by pure-NumPy ear clipping)
+- ``palette``    — colour registry (exec()-based palette files replaced
+  by literal-parsed ones)
 """

@@ -1,0 +1,191 @@
+"""Shared UI logic: radarclick, console/autocomplete, polytools, palette.
+
+Reference parity anchors: ui/radarclick.py:10-191 (click-to-command),
+ui/qtgl/console.py:49-184 + autocomplete.py (command line state),
+ui/polytools.py (polygon tessellation), ui/palette.py (colour registry).
+"""
+import numpy as np
+import pytest
+
+from bluesky_tpu.simulation.sim import Simulation
+from bluesky_tpu.ui import palette, polytools, radarclick
+from bluesky_tpu.ui.console import Autocomplete, Console
+
+
+@pytest.fixture(scope="module")
+def sim():
+    s = Simulation(nmax=16)
+    for cmd in ("CRE KL204 B744 52.0 4.0 90 FL200 250",
+                "CRE PH808 B744 53.0 5.0 180 FL100 220"):
+        s.stack.stack(cmd)
+        s.stack.process()
+    s.stack.stack("ADDWPT KL204 52.5 4.5")
+    s.stack.stack("ADDWPT KL204 52.8 4.9")
+    s.stack.process()
+    return s
+
+
+class TestRadarclick:
+    def test_empty_line_click_inserts_nearest_acid(self, sim):
+        tostack, todisp = radarclick.radarclick("", 52.01, 4.02, sim)
+        assert todisp.strip() == "KL204"
+        assert tostack == ""
+
+    def test_acid_typed_click_is_pos(self, sim):
+        tostack, todisp = radarclick.radarclick("KL204", 52.0, 4.0, sim)
+        assert tostack == "POS KL204"
+        assert todisp == "\n"
+
+    def test_latlon_click_completes_pan(self, sim):
+        tostack, todisp = radarclick.radarclick("PAN ", 51.5, 3.25, sim)
+        assert tostack == "PAN 51.5,3.25 "
+        assert todisp.endswith("\n")
+
+    def test_hdg_click_from_aircraft(self, sim):
+        # Click due east of KL204 -> heading ~90
+        tostack, todisp = radarclick.radarclick("HDG KL204 ", 52.0, 5.0, sim)
+        hdg = int(todisp.strip())
+        assert 88 <= hdg <= 92
+        assert tostack.startswith("HDG KL204")
+
+    def test_wpinroute_click(self, sim):
+        _, todisp = radarclick.radarclick("DIRECT KL204 ", 52.79, 4.89, sim)
+        assert todisp.split()[-1].startswith("WPT") or todisp.strip()
+
+    def test_unknown_command_ignored(self, sim):
+        assert radarclick.radarclick("NOSUCH ", 52.0, 4.0, sim) == ("", "")
+
+    def test_synonym_resolves(self, sim):
+        # DELETE is a synonym of DEL (clickable acid)
+        _, todisp = radarclick.radarclick("DELETE ", 52.99, 4.99, sim)
+        assert todisp.strip() == "PH808"
+
+    def test_two_corner_box_by_clicks(self, sim):
+        """Comma-aware arg counting: the first clicked corner counts as
+        TWO stack tokens, so the second click lands on the second latlon
+        slot and completes the command (reference cmdsplit semantics)."""
+        line = "BOX A "
+        _, todisp = radarclick.radarclick(line, 50.0, 3.0, sim)
+        assert "50.0,3.0" in todisp and not todisp.endswith("\n")
+        line += todisp
+        tostack, todisp = radarclick.radarclick(line, 51.0, 4.0, sim)
+        assert "51.0,4.0" in todisp and todisp.endswith("\n")
+        assert tostack == "BOX A 50.0,3.0 51.0,4.0 "
+
+    def test_polygon_repeating_vertex(self, sim):
+        # POLY: "-,latlon,..." — every further click keeps adding vertices
+        tostack, todisp = radarclick.radarclick(
+            "POLY A 50,4 51,4 ", 51.0, 5.0, sim)
+        assert "51.0,5.0" in todisp
+        assert tostack == ""          # never auto-completes
+
+
+class TestConsole:
+    def test_stack_and_history(self):
+        sent = []
+        c = Console(sent.append)
+        for ch in "OP":
+            c.key_char(ch)
+        c.key_enter()
+        assert sent == ["OP"]
+        assert c.command_line == ""
+        c.key_char("X")
+        c.key_up()
+        assert c.command_line == "OP"
+        c.key_down()
+        assert c.command_line == "X"
+
+    def test_history_walk(self):
+        c = Console(lambda t: None)
+        for cmd in ("A", "B", "C"):
+            c.set_cmdline(cmd)
+            c.key_enter()
+        c.key_up()
+        assert c.command_line == "C"
+        c.key_up()
+        assert c.command_line == "B"
+        c.key_down()
+        assert c.command_line == "C"
+
+    def test_append_cmdline_radarclick_contract(self):
+        sent = []
+        c = Console(sent.append)
+        c.set_cmdline("PAN")
+        c.append_cmdline(" 51.0,4.0 \n")   # '\n' = completed, line clears
+        assert c.command_line == ""
+
+    def test_autocomplete_ic(self, tmp_path):
+        (tmp_path / "demo1.scn").write_text("0:00:00.00>OP\n")
+        (tmp_path / "demo2.scn").write_text("0:00:00.00>OP\n")
+        (tmp_path / "other.scn").write_text("0:00:00.00>OP\n")
+        ac = Autocomplete(str(tmp_path))
+        new, disp = ac.complete("IC dem")
+        assert new.startswith("IC demo")
+        assert "demo1.scn" in disp and "demo2.scn" in disp
+        new2, _ = ac.complete("IC oth")
+        # cycling keeps the previous glob (reference behavior)
+        assert new2.startswith("IC ")
+
+    def test_autocomplete_single_match(self, tmp_path):
+        (tmp_path / "solo.scn").write_text("0:00:00.00>OP\n")
+        ac = Autocomplete(str(tmp_path))
+        new, disp = ac.complete("IC so")
+        assert new == "IC solo.scn"
+        assert disp == ""
+
+
+class TestPolytools:
+    def test_square_two_triangles(self):
+        tris = polytools.earclip([0, 0, 1, 0, 1, 1, 0, 1])
+        assert len(tris) == 12           # 2 triangles * 3 vertices * 2
+        # Total triangulated area == polygon area
+        area = 0.0
+        for t in range(0, len(tris), 6):
+            x0, y0, x1, y1, x2, y2 = tris[t:t + 6]
+            area += abs((x1 - x0) * (y2 - y0) - (x2 - x0) * (y1 - y0)) / 2
+        assert area == pytest.approx(1.0)
+
+    def test_concave_polygon_area_preserved(self):
+        # L-shape, area 3
+        contour = [0, 0, 2, 0, 2, 1, 1, 1, 1, 2, 0, 2]
+        tris = polytools.earclip(contour)
+        area = 0.0
+        for t in range(0, len(tris), 6):
+            x0, y0, x1, y1, x2, y2 = tris[t:t + 6]
+            area += abs((x1 - x0) * (y2 - y0) - (x2 - x0) * (y1 - y0)) / 2
+        assert area == pytest.approx(3.0)
+        assert len(tris) == 4 * 6        # n-2 = 4 triangles
+
+    def test_winding_and_closing_point_normalized(self):
+        cw = polytools.earclip([0, 0, 0, 1, 1, 1, 1, 0, 0, 0])
+        assert len(cw) == 12
+
+    def test_polygonset_accumulates(self):
+        ps = polytools.PolygonSet()
+        ps.addContour([0, 0, 1, 0, 1, 1])
+        ps.addContour([2, 2, 3, 2, 3, 3, 2, 3])
+        assert ps.bufsize() == 6 + 12
+
+
+class TestPalette:
+    def test_defaults_registered(self):
+        assert palette.aircraft == (0, 255, 0)
+        assert palette.get("background") == (0, 0, 0)
+
+    def test_set_default_does_not_override(self):
+        palette.set_default_colours(aircraft=(1, 2, 3))
+        assert palette.aircraft == (0, 255, 0)
+
+    def test_load_palette_file(self, tmp_path):
+        p = tmp_path / "pal"
+        p.write_text("aircraft = (10, 20, 30)  # override\n"
+                     "junk line without equals\n"
+                     "bad = not_a_tuple\n")
+        assert palette.load(str(p))
+        assert palette.aircraft == (10, 20, 30)
+        # restore for other tests (module-global registry)
+        palette._colours["aircraft"] = (0, 255, 0)
+
+    def test_missing_colour_raises(self):
+        with pytest.raises(AttributeError):
+            palette.nope
