@@ -41,6 +41,9 @@ class HostRoute:
         self.wtype: List[int] = []
         self.flyby: List[float] = []
         self.iactwp = -1
+        # Landing chain fired for this plan (reference
+        # Route.flag_landed_runway, route.py:741-775)
+        self.flag_landed = False
 
     @property
     def nwp(self):
@@ -65,10 +68,13 @@ class RouteManager:
     def addwpt(self, idx: int, name: str, lat: float, lon: float,
                alt: float = -999.0, spd: float = -999.0,
                wtype: int = WPT_LATLON, flyby: float = 1.0,
-               afterwp: Optional[str] = None) -> int:
+               afterwp: Optional[str] = None, as_dest: bool = False) -> int:
         """Insert a waypoint with the reference's ordering rules.
 
-        Returns the insertion index, or -1 on error (unknown afterwp).
+        ``as_dest`` marks a runway threshold added BY the DEST command
+        (wtype WPT_RWY but destination placement: replace any trailing
+        DEST/RWY, go last).  Returns the insertion index, or -1 on error
+        (unknown afterwp).
         """
         r = self.route(idx)
         name = name.upper()
@@ -83,14 +89,18 @@ class RouteManager:
             if r.nwp > 0 and r.wtype[0] == WPT_ORIG:
                 self._pop(r, 0)
             wpidx = 0
-        elif wtype == WPT_DEST:
+        elif wtype == WPT_DEST or as_dest:
             # Destination goes at the end, replacing an existing dest
-            if r.nwp > 0 and r.wtype[-1] == WPT_DEST:
+            # (which may itself be a runway threshold)
+            if r.nwp > 0 and r.wtype[-1] in (WPT_DEST, WPT_RWY):
                 self._pop(r, r.nwp - 1)
             wpidx = r.nwp
         else:
             # Normal waypoints go before the destination if there is one
-            wpidx = r.nwp - 1 if (r.nwp > 0 and r.wtype[-1] == WPT_DEST) \
+            # (a trailing runway threshold IS the destination — reference
+            # setdestorig runway branch)
+            wpidx = r.nwp - 1 \
+                if (r.nwp > 0 and r.wtype[-1] in (WPT_DEST, WPT_RWY)) \
                 else r.nwp
 
         if r.nwp >= self.wmax:
@@ -282,6 +292,13 @@ class RouteManager:
             wptoalt[i] = toalt
             wpxtoalt[i] = xtoalt
         return wptoalt, wpxtoalt
+
+    def runway_final_slots(self):
+        """Slots whose plan ends at a runway waypoint and whose landing
+        chain has not fired — the candidates for _check_runway_landings."""
+        return [(s, r) for s, r in self.routes.items()
+                if r.nwp > 0 and r.wtype[-1] == WPT_RWY
+                and not r.flag_landed]
 
     def sync(self, idx: int, point_active: bool = False):
         """Write one slot's host route into the device tables."""

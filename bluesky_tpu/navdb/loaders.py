@@ -177,11 +177,64 @@ def load_countries(path):
     return codes
 
 
+def load_rwythresholds(path):
+    """apt -> {rwy -> (lat, lon, bearing)} from X-Plane apt.dat in apt.zip.
+
+    Same source rows as the reference (load_visuals_txt.py:256-302):
+    airport row '1 ... icao', runway row '100' with both runway ends —
+    each end yields a threshold displaced along the runway bearing by its
+    displacement distance.  Vectorized per-file parse is pointless here
+    (one-time, cached); the displaced-threshold great-circle step uses
+    the same spherical forward equations as the reference ``thrpoints``.
+    """
+    import math
+    import zipfile
+    rearth = 6371000.0
+    out = {}
+    cur = None
+
+    def displaced(lat0, lon0, lat1, lon1, offset):
+        """Threshold of the runway end at (lat0, lon0), displaced toward
+        (lat1, lon1) by offset metres; returns (latd, lond, bearing_deg)."""
+        la0, lo0 = math.radians(lat0), math.radians(lon0)
+        la1, lo1 = math.radians(lat1), math.radians(lon1)
+        dl = lo1 - lo0
+        brg = math.atan2(math.sin(dl) * math.cos(la1),
+                         math.cos(la0) * math.sin(la1)
+                         - math.sin(la0) * math.cos(la1) * math.cos(dl))
+        d = offset / rearth
+        latd = math.asin(math.sin(la0) * math.cos(d)
+                         + math.cos(la0) * math.sin(d) * math.cos(brg))
+        lond = lo0 + math.atan2(
+            math.sin(brg) * math.sin(d) * math.cos(la0),
+            math.cos(d) - math.sin(la0) * math.sin(latd))
+        return (math.degrees(latd), math.degrees(lond),
+                math.degrees(brg) % 360.0)
+
+    with zipfile.ZipFile(path) as zf, zf.open("apt.dat") as f:
+        for raw in f:
+            elems = raw.decode("ascii", errors="ignore").split()
+            if not elems:
+                continue
+            if elems[0] == "1" and len(elems) > 4:
+                cur = out.setdefault(elems[4], {})
+            elif elems[0] == "100" and cur is not None and len(elems) > 20:
+                if int(elems[2]) > 2:      # asphalt/concrete only
+                    continue
+                lat0, lon0, off0 = (float(elems[9]), float(elems[10]),
+                                    float(elems[11]))
+                lat1, lon1, off1 = (float(elems[18]), float(elems[19]),
+                                    float(elems[20]))
+                cur[elems[8]] = displaced(lat0, lon0, lat1, lon1, off0)
+                cur[elems[17]] = displaced(lat1, lon1, lat0, lon0, off1)
+    return out
+
+
 def load_navdata(navdata_path, cache_path=None):
     """Load everything available under navdata_path, with pickle caching."""
     sources = {name: os.path.join(navdata_path, name)
                for name in ("fix.dat", "nav.dat", "airports.dat", "awy.dat",
-                            "icao-countries.dat")}
+                            "icao-countries.dat", "apt.zip")}
     sources["fir"] = os.path.join(navdata_path, "fir")
     stamps = {k: os.path.getmtime(p) for k, p in sources.items()
               if os.path.exists(p)}
@@ -224,6 +277,8 @@ def load_navdata(navdata_path, cache_path=None):
         data["firs"] = load_firs(sources["fir"])
     if "icao-countries.dat" in stamps:
         data["countries"] = load_countries(sources["icao-countries.dat"])
+    if "apt.zip" in stamps:
+        data["rwythresholds"] = load_rwythresholds(sources["apt.zip"])
 
     if cachefile:
         try:

@@ -55,6 +55,10 @@ class Navdatabase:
         self.awtolon = np.asarray(d.get("awtolon", np.zeros(0)), float)
         self.firs = d.get("firs", {})
         self.countries = d.get("countries", {})
+        # apt -> {rwy -> (lat, lon, bearing_deg)} displaced thresholds
+        # (reference load_visuals_txt.navdata_load_rwythresholds; empty
+        # when no apt.zip ships — defrwy() registers runways at runtime)
+        self.rwythresholds = d.get("rwythresholds", {})
         # O(1) name -> [indices] maps
         self._wpmap = defaultdict(list)
         for i, name in enumerate(self.wpid):
@@ -188,7 +192,11 @@ class Navdatabase:
     # ------------------------------------------------------- text position
     def txt2pos(self, txt, reflat=999999.0, reflon=999999.0):
         """Resolve a named position to (lat, lon): airport first, then
-        waypoint/navaid (parity: tools/position.py:6)."""
+        waypoint/navaid (parity: tools/position.py:6).  ``APT/RWNN`` (or
+        RWYNN) resolves to the runway threshold when known."""
+        if "/" in txt:
+            thr = self.getrwythreshold(*txt.split("/", 1))
+            return None if thr is None else (thr[0], thr[1])
         i = self.getaptidx(txt)
         if i >= 0:
             return (float(self.aptlat[i]), float(self.aptlon[i]))
@@ -196,3 +204,26 @@ class Navdatabase:
         if i >= 0:
             return (float(self.wplat[i]), float(self.wplon[i]))
         return None
+
+    # ------------------------------------------------------- runways
+    def getrwythreshold(self, apt, rwy):
+        """(lat, lon, bearing_deg) of a runway threshold, or None.
+
+        Accepts RW06/RWY06/06 spellings (reference stores bare ids)."""
+        table = self.rwythresholds.get(apt.upper())
+        if not table:
+            return None
+        r = rwy.upper()
+        for cand in (r, r.removeprefix("RWY"), r.removeprefix("RW")):
+            if cand in table:
+                return tuple(table[cand])
+        return None
+
+    def defrwy(self, apt, rwy, lat, lon, hdg):
+        """Register a runway threshold at runtime — scenarios/tests can
+        define runways when no apt.zip data ships (the reference's
+        threshold database comes from an apt.zip absent from this
+        snapshot; the loader in loaders.py reads it when present)."""
+        key = rwy.upper().removeprefix("RWY").removeprefix("RW")
+        self.rwythresholds.setdefault(apt.upper(), {})[key] = (
+            float(lat), float(lon), float(hdg) % 360.0)

@@ -373,6 +373,12 @@ class Simulation:
         dtclamp = None
         if self.cond.ncond > 0:
             dtclamp = max(1, int(round(1.0 / self.cfg.simdt)))
+        if self._runway_approach_active(15.0):
+            # Landing detection must sample at ~1 s, like conditionals —
+            # but only once an aircraft is actually near its threshold,
+            # so en-route fast-forward keeps its long chunks
+            c = max(1, int(round(1.0 / self.cfg.simdt)))
+            dtclamp = c if dtclamp is None else min(dtclamp, c)
         if self.traf.trails.active:
             c = max(1, int(round(self.traf.trails.dt / self.cfg.simdt)))
             dtclamp = c if dtclamp is None else min(dtclamp, c)
@@ -438,6 +444,7 @@ class Simulation:
         self.plugins.update(self.simt)
         self.traf.flush()
         self.cond.update()
+        self._check_runway_landings()
         self.plotter.update(self.simt)
         self.metrics.update()
         self.traf.trails.update(self.simt)
@@ -447,6 +454,72 @@ class Simulation:
         if self.ffstop is not None and self.simt >= self.ffstop - 1e-9:
             self._end_ff()
         return True
+
+    def _runway_approach_active(self, radius_nm: float) -> bool:
+        """Any unlanded runway-destination aircraft within radius of its
+        threshold?  Cheap host flat-earth test — gates the 1 s landing
+        sampling clamp so cruise fast-forward keeps long chunks."""
+        cands = self.routes.runway_final_slots()
+        if not cands:
+            return False
+        st = self.traf.state
+        lat = np.asarray(st.ac.lat)
+        lon = np.asarray(st.ac.lon)
+        for slot, r in cands:
+            if self.traf.ids[slot] is None:
+                continue
+            last = r.nwp - 1
+            dlat = lat[slot] - r.lat[last]
+            dlon = (lon[slot] - r.lon[last]) * np.cos(np.radians(r.lat[last]))
+            if np.hypot(dlat, dlon) * 60.0 <= radius_nm:
+                return True
+        return False
+
+    def _check_runway_landings(self):
+        """Runway-landing chain (reference route.py getnextwp:741-775).
+
+        When the device FMS has reached an aircraft's FINAL waypoint and
+        that waypoint is a runway threshold (DEST/ADDWPT ``APT/RWNN``),
+        issue the reference's landing command sequence: hold the runway
+        heading, decelerate after 10 s, delete after 42 s.  Runs at chunk
+        edges; a 3 nm proximity guard distinguishes "reached the
+        threshold" from a manual LNAV OFF far from the field.
+        """
+        cands = self.routes.runway_final_slots()
+        if not cands:
+            return
+        st = self.traf.state
+        swlnav = np.asarray(st.ac.swlnav)
+        iact = np.asarray(st.route.iactwp)
+        lat = np.asarray(st.ac.lat)
+        lon = np.asarray(st.ac.lon)
+        for slot, r in cands:
+            acid = self.traf.ids[slot]
+            last = r.nwp - 1
+            if acid is None or iact[slot] < last or swlnav[slot]:
+                continue
+            dlat = lat[slot] - r.lat[last]
+            dlon = (lon[slot] - r.lon[last]) * np.cos(np.radians(r.lat[last]))
+            if np.hypot(dlat, dlon) * 60.0 > 3.0:     # [nm] proximity guard
+                continue
+            # Runway heading from the threshold database when known, else
+            # the final leg bearing (same number the FMS flew)
+            apt, _, rwy = r.name[last].partition("/")
+            thr = self.navdb.getrwythreshold(apt, rwy) if rwy else None
+            if thr is not None:
+                hdg = thr[2]
+            elif last > 0:
+                from ..ops import geo
+                hdg = float(np.asarray(geo.qdrdist(
+                    r.lat[last - 1], r.lon[last - 1],
+                    r.lat[last], r.lon[last])[0])) % 360.0
+            else:
+                hdg = float(np.asarray(st.ac.trk)[slot])
+            r.flag_landed = True
+            self.stack.stack(f"HDG {acid} {hdg:.1f}")
+            self.stack.stack(f"DELAY 10 SPD {acid} 10")
+            self.stack.stack(f"DELAY 42 DEL {acid}")
+        self.stack.process()
 
     def _end_ff(self):
         self.ffmode = False

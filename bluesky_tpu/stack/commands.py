@@ -212,15 +212,18 @@ def register_all(stack):
 
     def addwpt(idx, pos, alt=None, spd=None, afterwp=None):
         """ADDWPT acid,(wpt/lat,lon),[alt,spd,afterwp] (route.py:472)."""
-        from ..core.route import WPT_LATLON
+        from ..core.route import WPT_LATLON, WPT_RWY
         lat, lon = pos
         # navdb-resolved positions carry their name (NamedPos)
         name = getattr(pos, "name", None) \
             or f"WP{sim.routes.route(idx).nwp + 1:03d}"
+        # APT/RWNN threshold waypoints are runway-typed (route.py:472
+        # runway branch) so the landing chain can engage
+        wtype = WPT_RWY if "/" in name else WPT_LATLON
         wpidx = sim.routes.addwpt(idx, name, lat, lon,
                                   alt if alt is not None else -999.0,
                                   spd if spd is not None else -999.0,
-                                  WPT_LATLON, 1.0, afterwp)
+                                  wtype, 1.0, afterwp)
         if wpidx < 0:
             return False, "ADDWPT: afterwp not found"
         # First waypoint: engage LNAV and aim at it (route.py addwpt behavior)
@@ -230,21 +233,30 @@ def register_all(stack):
         return True
 
     def dest_orig(cmd, idx, pos=None):
-        """DEST/ORIG acid,[apt/lat,lon] (autopilot.py:360-442)."""
-        from ..core.route import WPT_DEST, WPT_ORIG
+        """DEST/ORIG acid,[apt[/rwy]/lat,lon] (autopilot.py:360-442)."""
+        from ..core.route import WPT_DEST, WPT_ORIG, WPT_RWY
         r = sim.routes.route(idx)
         if pos is None:
             return True, f"{cmd} {acname(idx)}: (not set)"
         lat, lon = pos
         wtype = WPT_DEST if cmd == "DEST" else WPT_ORIG
-        sim.routes.addwpt(idx, cmd, lat, lon, 0.0,
-                          float(st().ac.cas[idx]), wtype)
+        name = getattr(pos, "name", None) or cmd
+        if cmd == "DEST" and "/" in name:
+            # Runway destination (autopilot.py setdestorig runway branch):
+            # the final waypoint is the displaced threshold, typed RWY so
+            # the landing chain (sim._check_runway_landings) engages.
+            wtype = WPT_RWY
+        sim.routes.addwpt(idx, name if wtype == WPT_RWY else cmd,
+                          lat, lon, 0.0,
+                          float(st().ac.cas[idx]), wtype,
+                          as_dest=(cmd == "DEST"))
         if cmd == "DEST":
             r = sim.routes.route(idx)
             if r.nwp == 1 or (r.nwp == 2 and r.wtype[0] == WPT_ORIG):
                 setslot("swlnav", idx, True)
                 setslot("swvnav", idx, True)
-                sim.routes.direct(idx, "DEST")
+                # the new final waypoint may be named DEST or APT/RWNN
+                sim.routes.direct(idx, r.name[-1])
         return True
 
     def delwpt(idx, name):

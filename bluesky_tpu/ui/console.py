@@ -75,7 +75,10 @@ class Console:
 
     # ------------------------------------------------------------ editing
     def set_cmdline(self, text: str):
+        """Replace the command line; any edit invalidates the cached
+        autocomplete glob (Tab must match the text now on the line)."""
         self.command_line = text
+        self.autocomplete.reset()
 
     def append_cmdline(self, text: str):
         """Append text (radarclick output); '\\n' submits/clears
@@ -107,6 +110,7 @@ class Console:
         if len(self.command_history) >= self.history_pos + 1:
             self.history_pos += 1
             self.command_line = self.command_history[-self.history_pos]
+            self.autocomplete.reset()
 
     def key_down(self):
         """History forward (reference console.py:148-156)."""
@@ -114,6 +118,7 @@ class Console:
             self.history_pos -= 1
             self.command_line = self.command_mem if self.history_pos == 0 \
                 else self.command_history[-self.history_pos]
+            self.autocomplete.reset()
 
     def key_tab(self):
         """Filename autocomplete for IC/BATCH (reference console.py:158+)."""
@@ -125,6 +130,7 @@ class Console:
 
     def key_backspace(self):
         self.command_line = self.command_line[:-1]
+        self.autocomplete.reset()
 
     def key_char(self, ch: str):
         self.command_line += ch

@@ -23,15 +23,22 @@ def _signed_area(pts):
     return 0.5 * float(np.sum(x * np.roll(y, -1) - np.roll(x, -1) * y))
 
 
-def _point_in_tri(p, a, b, c, eps=1e-12):
+def _any_point_in_tri(pts, a, b, c, eps=1e-12):
+    """True if ANY of pts [k,2] lies inside/on triangle (a,b,c) —
+    vectorized so the ear test is O(n) NumPy, not O(n) Python."""
+    if len(pts) == 0:
+        return False
+
     def cross(o, u, v):
-        return (u[0] - o[0]) * (v[1] - o[1]) - (u[1] - o[1]) * (v[0] - o[0])
-    d1 = cross(a, b, p)
-    d2 = cross(b, c, p)
-    d3 = cross(c, a, p)
-    neg = (d1 < -eps) or (d2 < -eps) or (d3 < -eps)
-    pos = (d1 > eps) or (d2 > eps) or (d3 > eps)
-    return not (neg and pos)
+        return (u[0] - o[0]) * (v[:, 1] - o[1]) \
+            - (u[1] - o[1]) * (v[:, 0] - o[0])
+
+    d1 = cross(a, b, pts)
+    d2 = cross(b, c, pts)
+    d3 = cross(c, a, pts)
+    neg = (d1 < -eps) | (d2 < -eps) | (d3 < -eps)
+    pos = (d1 > eps) | (d2 > eps) | (d3 > eps)
+    return bool(np.any(~(neg & pos)))
 
 
 def earclip(contour) -> List[float]:
@@ -68,8 +75,8 @@ def earclip(contour) -> List[float]:
                     - (b[1] - a[1]) * (c[0] - a[0]) <= 0.0:
                 continue
             # No other active vertex inside the candidate ear
-            if any(_point_in_tri(pts[j], a, b, c)
-                   for j in idx if j not in (i0, i1, i2)):
+            others = pts[[j for j in idx if j not in (i0, i1, i2)]]
+            if _any_point_in_tri(others, a, b, c):
                 continue
             tris.extend([*a, *b, *c])
             del idx[k]
