@@ -57,6 +57,28 @@ class RouteManager:
         self.traf = traf
         self.wmax = wmax
         self.routes = {}   # slot -> HostRoute
+        # Deleted aircraft must not leave a stale plan for a reused slot
+        # (the reference's route is a traf child cleared by the delete
+        # cascade, trafficarrays.py:111-120).  The hook list survives
+        # RouteManager replacement (sim reset), so register one shared
+        # trampoline per Traffic that always targets its CURRENT manager.
+        if getattr(traf, "_route_delete_hooked", None) is not traf:
+            traf.delete_hooks.append(
+                lambda idx, t=traf: t._route_mgr.drop_slots(idx)
+                if getattr(t, "_route_mgr", None) else None)
+            traf._route_delete_hooked = traf
+        traf._route_mgr = self
+
+    def drop_slots(self, idx):
+        """Clear the host plans of deleted slots and blank their device
+        route rows (stale waypoint tables must not greet a reused slot)."""
+        import numpy as np
+        for i in np.atleast_1d(np.asarray(idx)):
+            i = int(i)
+            if i in self.routes:
+                self.routes[i] = HostRoute()
+                self.sync(i)          # blank the device row
+                del self.routes[i]    # (sync would setdefault it back)
 
     def route(self, idx: int) -> HostRoute:
         return self.routes.setdefault(idx, HostRoute())

@@ -18,10 +18,11 @@ NM = 1852.0
 
 
 def _kwikdist_nm(lata, lona, latb, lonb):
-    """Fast flat-earth distance [nm] with antimeridian wrap (shared impl,
-    cf. reference tools/geo.py kwikdist)."""
-    from ..ops.geo import kwikdist_wrapped
-    return kwikdist_wrapped(lata, lona, latb, lonb, xp=np)
+    """Fast flat-earth distance [nm] with antimeridian wrap — via the
+    compiled host geodesy core when built (reference runs these queries
+    through its cgeo extension)."""
+    from ..ops import hostgeo
+    return hostgeo.kwikdist_wrapped(lata, lona, latb, lonb)
 
 
 class Navdatabase:

@@ -373,10 +373,15 @@ class Simulation:
         dtclamp = None
         if self.cond.ncond > 0:
             dtclamp = max(1, int(round(1.0 / self.cfg.simdt)))
-        if self._runway_approach_active(15.0):
-            # Landing detection must sample at ~1 s, like conditionals —
-            # but only once an aircraft is actually near its threshold,
-            # so en-route fast-forward keeps its long chunks
+        # Landing detection must sample at ~1 s, like conditionals — but
+        # only once an aircraft is actually near its threshold, so
+        # en-route fast-forward keeps its long chunks.  The gate radius
+        # covers the worst one-chunk travel (ladder max x simdt at
+        # 340 m/s) so no aircraft can jump from outside the gate past
+        # the landing guard within a single unclamped chunk.
+        gate_nm = 5.0 + self.CHUNK_LADDER[0] * self.cfg.simdt * 340.0 / 1852.0
+        self._rwy_near = self._runway_approach_active(gate_nm)
+        if self._rwy_near:
             c = max(1, int(round(1.0 / self.cfg.simdt)))
             dtclamp = c if dtclamp is None else min(dtclamp, c)
         if self.traf.trails.active:
@@ -485,6 +490,11 @@ class Simulation:
         edges; a 3 nm proximity guard distinguishes "reached the
         threshold" from a manual LNAV OFF far from the field.
         """
+        # The pre-chunk gate (step(), gate_nm covers one-chunk travel)
+        # proves nobody can be near a threshold this chunk — skip the
+        # device transfers entirely for the cruise phase.
+        if not getattr(self, "_rwy_near", True):
+            return
         cands = self.routes.runway_final_slots()
         if not cands:
             return
@@ -493,6 +503,7 @@ class Simulation:
         iact = np.asarray(st.route.iactwp)
         lat = np.asarray(st.ac.lat)
         lon = np.asarray(st.ac.lon)
+        fired = False
         for slot, r in cands:
             acid = self.traf.ids[slot]
             last = r.nwp - 1
@@ -509,17 +520,19 @@ class Simulation:
             if thr is not None:
                 hdg = thr[2]
             elif last > 0:
-                from ..ops import geo
-                hdg = float(np.asarray(geo.qdrdist(
+                from ..ops import hostgeo
+                hdg = float(hostgeo.qdrdist(
                     r.lat[last - 1], r.lon[last - 1],
-                    r.lat[last], r.lon[last])[0])) % 360.0
+                    r.lat[last], r.lon[last])[0]) % 360.0
             else:
                 hdg = float(np.asarray(st.ac.trk)[slot])
             r.flag_landed = True
+            fired = True
             self.stack.stack(f"HDG {acid} {hdg:.1f}")
             self.stack.stack(f"DELAY 10 SPD {acid} 10")
             self.stack.stack(f"DELAY 42 DEL {acid}")
-        self.stack.process()
+        if fired:
+            self.stack.process()
 
     def _end_ff(self):
         self.ffmode = False

@@ -1,0 +1,215 @@
+// Host-side geodesy core (native twin of ops/geo.py).
+//
+// Role parity with the reference's compiled geodesy extension
+// (bluesky/tools/src_cpp/cgeo.cpp): the DEVICE hot path in this framework
+// is XLA (ops/geo.py jitted), but host-side consumers — navdb nearest
+// queries, scenario tooling, landing checks, plugins — run NumPy at
+// Python speed.  This extension provides the same formulas compiled.
+//
+// Design (deliberately different from the reference extension): the
+// Python wrapper (ops/hostgeo.py) normalizes every call to flat,
+// contiguous, equal-length float64 arrays (it owns broadcasting and the
+// scalar/matrix conventions), so the C side is a handful of tight loops
+// over raw pointers with zero per-element Python API traffic and no
+// shape logic.  Formulas follow ops/geo.py, which documents the
+// reference-parity quirks (hemisphere-aware mean radius; the matrix
+// variant's radius-at-latitude-sum).
+#define NPY_NO_DEPRECATED_API NPY_1_7_API_VERSION
+#include <Python.h>
+#include <numpy/arrayobject.h>
+#include <cmath>
+
+namespace {
+
+constexpr double A = 6378137.0;              // WGS-84 semi-major axis [m]
+constexpr double B = 6356752.314245;         // WGS-84 semi-minor axis [m]
+constexpr double REARTH = 6371000.0;         // kwik* mean radius [m]
+constexpr double NM = 1852.0;
+constexpr double D2R = 0.017453292519943295;
+constexpr double R2D = 57.29577951308232;
+
+inline double rwgs84_rad(double coslat, double sinlat) {
+    const double an = A * A * coslat, bn = B * B * sinlat;
+    const double ad = A * coslat, bd = B * sinlat;
+    return std::sqrt((an * an + bn * bn) / (ad * ad + bd * bd));
+}
+
+inline double rwgs84_deg(double latd) {
+    const double lat = D2R * latd;
+    return rwgs84_rad(std::cos(lat), std::sin(lat));
+}
+
+// Hemisphere-aware mean radius; mode 0 = scalar qdrdist semantics
+// (radius at the average latitude), mode 1 = the matrix-variant quirks
+// (radius at the SUM of latitudes; 1e-6 deg epsilon when lat1 == 0).
+inline double mean_radius(double lat1, double lat2, int mode) {
+    if (mode == 0) {
+        if (lat1 * lat2 >= 0.0) return rwgs84_deg(0.5 * (lat1 + lat2));
+        double denom = std::fabs(lat1) + std::fabs(lat2);
+        if (denom < 1e-30) denom = 1e-30;
+        return 0.5 * (std::fabs(lat1) * (rwgs84_deg(lat1) + A)
+                      + std::fabs(lat2) * (rwgs84_deg(lat2) + A)) / denom;
+    }
+    if (lat1 * lat2 < 0.0) {
+        const double denom = std::fabs(lat1) + std::fabs(lat2)
+                             + (lat1 == 0.0 ? 1e-6 : 0.0);
+        return 0.5 * (std::fabs(lat1) * (rwgs84_deg(lat1) + A)
+                      + std::fabs(lat2) * (rwgs84_deg(lat2) + A)) / denom;
+    }
+    return rwgs84_deg(lat1 + lat2);
+}
+
+inline void haversine(double latd1, double lond1, double latd2,
+                      double lond2, double r, double* qdr, double* dist) {
+    const double lat1 = D2R * latd1, lon1 = D2R * lond1;
+    const double lat2 = D2R * latd2, lon2 = D2R * lond2;
+    const double s1 = std::sin(0.5 * (lat2 - lat1));
+    const double s2 = std::sin(0.5 * (lon2 - lon1));
+    const double c1 = std::cos(lat1), c2 = std::cos(lat2);
+    const double root = s1 * s1 + c1 * c2 * s2 * s2;
+    *dist = 2.0 * r * std::atan2(std::sqrt(root), std::sqrt(1.0 - root));
+    *qdr = R2D * std::atan2(
+        std::sin(lon2 - lon1) * c2,
+        c1 * std::sin(lat2) - std::sin(lat1) * c2 * std::cos(lon2 - lon1));
+}
+
+// ---------------------------------------------------------------------
+// Argument plumbing: every export takes flat float64 arrays of one
+// common length (the wrapper guarantees it) and returns new arrays.
+// ---------------------------------------------------------------------
+
+struct Args {
+    PyArrayObject* arr[4] = {nullptr, nullptr, nullptr, nullptr};
+    const double* p[4] = {nullptr, nullptr, nullptr, nullptr};
+    npy_intp n = 0;
+    bool ok = false;
+
+    Args(PyObject* args, int count, int extra_int = -1, int* mode = nullptr) {
+        PyObject* o[4] = {nullptr, nullptr, nullptr, nullptr};
+        static const char* fmts[] = {"O", "OO", "OOO", "OOOO", "OOOOi"};
+        if (mode) {
+            if (!PyArg_ParseTuple(args, fmts[4], &o[0], &o[1], &o[2], &o[3],
+                                  mode))
+                return;
+        } else if (!PyArg_ParseTuple(args, fmts[count - 1],
+                                     &o[0], &o[1], &o[2], &o[3])) {
+            return;
+        }
+        (void)extra_int;
+        for (int i = 0; i < count; ++i) {
+            arr[i] = (PyArrayObject*)PyArray_FROM_OTF(
+                o[i], NPY_DOUBLE, NPY_ARRAY_IN_ARRAY);
+            if (!arr[i]) return;
+            p[i] = (const double*)PyArray_DATA(arr[i]);
+        }
+        n = PyArray_SIZE(arr[0]);
+        ok = true;
+    }
+
+    ~Args() {
+        for (auto* a : arr) Py_XDECREF(a);
+    }
+};
+
+PyObject* out_like(npy_intp n, double** data) {
+    PyObject* o = PyArray_SimpleNew(1, &n, NPY_DOUBLE);
+    *data = (double*)PyArray_DATA((PyArrayObject*)o);
+    return o;
+}
+
+PyObject* py_rwgs84(PyObject*, PyObject* args) {
+    Args a(args, 1);
+    if (!a.ok) return nullptr;
+    double* r;
+    PyObject* out = out_like(a.n, &r);
+    for (npy_intp i = 0; i < a.n; ++i) r[i] = rwgs84_deg(a.p[0][i]);
+    return out;
+}
+
+PyObject* py_wgsg(PyObject*, PyObject* args) {
+    Args a(args, 1);
+    if (!a.ok) return nullptr;
+    double* g;
+    PyObject* out = out_like(a.n, &g);
+    for (npy_intp i = 0; i < a.n; ++i) {
+        const double s = std::sin(D2R * a.p[0][i]);
+        g[i] = 9.7803 * (1.0 + 0.001932 * s * s)
+               / std::sqrt(1.0 - 6.694e-3 * s * s);
+    }
+    return out;
+}
+
+// qdrdist(lat1, lon1, lat2, lon2, mode) -> (qdr_deg, dist_m)
+PyObject* py_qdrdist(PyObject*, PyObject* args) {
+    int mode = 0;
+    Args a(args, 4, -1, &mode);
+    if (!a.ok) return nullptr;
+    double *q, *d;
+    PyObject* qo = out_like(a.n, &q);
+    PyObject* dn = out_like(a.n, &d);
+    for (npy_intp i = 0; i < a.n; ++i) {
+        const double r = mean_radius(a.p[0][i], a.p[2][i], mode);
+        haversine(a.p[0][i], a.p[1][i], a.p[2][i], a.p[3][i], r,
+                  &q[i], &d[i]);
+    }
+    return Py_BuildValue("(NN)", qo, dn);
+}
+
+// qdrpos(lat1, lon1, qdr_deg, dist_nm) -> (lat2, lon2) [deg]
+PyObject* py_qdrpos(PyObject*, PyObject* args) {
+    Args a(args, 4);
+    if (!a.ok) return nullptr;
+    double *la, *lo;
+    PyObject* lao = out_like(a.n, &la);
+    PyObject* loo = out_like(a.n, &lo);
+    for (npy_intp i = 0; i < a.n; ++i) {
+        const double R = rwgs84_deg(a.p[0][i]) / NM;
+        const double lat1 = D2R * a.p[0][i], lon1 = D2R * a.p[1][i];
+        const double dr = a.p[3][i] / R, qdrr = D2R * a.p[2][i];
+        const double sl = std::sin(lat1), cl = std::cos(lat1);
+        const double lat2 = std::asin(
+            sl * std::cos(dr) + cl * std::sin(dr) * std::cos(qdrr));
+        la[i] = R2D * lat2;
+        lo[i] = R2D * (lon1 + std::atan2(
+            std::sin(qdrr) * std::sin(dr) * cl,
+            std::cos(dr) - sl * std::sin(lat2)));
+    }
+    return Py_BuildValue("(NN)", lao, loo);
+}
+
+// kwik(lat1, lon1, lat2, lon2) -> (qdr_deg in [0,360), dist_m)
+PyObject* py_kwik(PyObject*, PyObject* args) {
+    Args a(args, 4);
+    if (!a.ok) return nullptr;
+    double *q, *d;
+    PyObject* qo = out_like(a.n, &q);
+    PyObject* dn = out_like(a.n, &d);
+    for (npy_intp i = 0; i < a.n; ++i) {
+        const double dlat = D2R * (a.p[2][i] - a.p[0][i]);
+        const double dlon = D2R * (a.p[3][i] - a.p[1][i]);
+        const double cav = std::cos(D2R * 0.5 * (a.p[0][i] + a.p[2][i]));
+        d[i] = REARTH * std::sqrt(dlat * dlat + dlon * dlon * cav * cav);
+        q[i] = std::fmod(R2D * std::atan2(dlon * cav, dlat) + 360.0, 360.0);
+    }
+    return Py_BuildValue("(NN)", qo, dn);
+}
+
+PyMethodDef methods[] = {
+    {"rwgs84", py_rwgs84, METH_VARARGS, "WGS-84 local radius [m]"},
+    {"wgsg", py_wgsg, METH_VARARGS, "WGS-84 gravity [m/s2]"},
+    {"qdrdist", py_qdrdist, METH_VARARGS,
+     "(qdr deg, dist m); mode 0 scalar / 1 matrix radius semantics"},
+    {"qdrpos", py_qdrpos, METH_VARARGS, "dead-reckoned (lat2, lon2) [deg]"},
+    {"kwik", py_kwik, METH_VARARGS, "flat-earth (qdr deg, dist m)"},
+    {nullptr, nullptr, 0, nullptr}};
+
+PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "_cgeo",
+                         "compiled host geodesy core", -1, methods,
+                         nullptr, nullptr, nullptr, nullptr};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__cgeo(void) {
+    import_array();
+    return PyModule_Create(&moduledef);
+}

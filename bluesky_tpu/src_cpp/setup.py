@@ -1,0 +1,21 @@
+"""Build the compiled host geodesy core:
+
+    cd bluesky_tpu/src_cpp && python setup.py build_ext --inplace
+
+Produces ``_cgeo`` next to this file; ``ops/hostgeo.py`` picks it up
+automatically and falls back to NumPy when it is absent.
+"""
+import numpy as np
+from setuptools import Extension, setup
+
+setup(
+    name="bluesky_tpu_cgeo",
+    ext_modules=[
+        Extension(
+            "_cgeo",
+            sources=["cgeo.cpp"],
+            include_dirs=[np.get_include()],
+            extra_compile_args=["-O3", "-std=c++17"],
+        )
+    ],
+)

@@ -87,6 +87,7 @@ class Console:
             self.command_line = ""
         else:
             self.command_line += text
+        self.autocomplete.reset()     # line changed: stale glob invalid
 
     def stack(self, text: Optional[str] = None):
         """Submit a command line (reference console.py:82-92)."""

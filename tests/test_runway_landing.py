@@ -49,16 +49,17 @@ def test_landing_chain_fires(sim):
                 "OP"):
         sim.stack.stack(cmd)
         sim.stack.process()
-    # threshold is ~3.7 nm east at 150 kt CAS -> reached within ~2 min
-    sim.run(until_simt=180.0)
+    # threshold is ~3.7 nm east at 150 kt CAS -> reached at ~89 s; read
+    # the flag BEFORE the DELAY 42 DEL fires (the delete also drops the
+    # host route, so route(0) after deletion is a fresh empty plan)
     r = sim.routes.route(0)
+    sim.run(until_simt=110.0)
     assert r.flag_landed, "landing chain did not fire"
-    # heading held on the runway bearing while still alive, if alive
-    if sim.traf.ntraf:
-        hdg = float(np.asarray(sim.traf.state.ac.hdg)[0])
-        assert abs((hdg - 90.0 + 180) % 360 - 180) < 5.0
+    assert sim.traf.ntraf == 1
+    hdg = float(np.asarray(sim.traf.state.ac.hdg)[0])
+    assert abs((hdg - 90.0 + 180) % 360 - 180) < 5.0
     # 42 s after the chain fired the aircraft must be deleted
-    sim.run(until_simt=sim.simt + 60.0)
+    sim.run(until_simt=180.0)
     assert sim.traf.ntraf == 0, "aircraft not deleted after landing"
 
 
@@ -79,6 +80,30 @@ def test_runway_dest_keeps_last_place(sim):
     r = sim.routes.route(0)
     assert r.nwp == 2                       # replaced, not appended
     assert r.name[-1] == "TEST/RW27"
+
+
+def test_deleted_aircraft_leaves_no_stale_route(sim):
+    """A reused slot must not inherit the previous occupant's runway
+    destination (reference: routes are traf children cleared by the
+    delete cascade)."""
+    for cmd in ("CRE KL1 B744 52.0 4.0 90 FL100 250",
+                "DEST KL1 TEST/RW09"):
+        sim.stack.stack(cmd)
+        sim.stack.process()
+    slot = sim.traf.id2idx("KL1")
+    assert sim.routes.route(slot).nwp == 1
+    sim.stack.stack("DEL KL1")
+    sim.stack.process()
+    assert slot not in sim.routes.routes
+    # Recreate into the same slot: clean plan, no runway final
+    sim.stack.stack("CRE KL2 B744 52.0 4.0 90 FL100 250")
+    sim.stack.process()
+    slot2 = sim.traf.id2idx("KL2")
+    assert slot2 == slot
+    assert sim.routes.route(slot2).nwp == 0
+    assert not sim.routes.runway_final_slots()
+    sim.stack.stack("DEL KL2")
+    sim.stack.process()
 
 
 def test_no_false_fire_on_lnav_off_far_away(sim):
