@@ -161,6 +161,15 @@ def detail():
                 except Exception as e:  # noqa: BLE001 (sweep keeps going)
                     print(f"# {backend} N={n} {geometry}: "
                           f"{type(e).__name__}: {str(e)[:120]}")
+    # 10x the north star: one-million-aircraft scale demo.  Short chunks:
+    # the tunnel watchdog kills device executions running multiple
+    # minutes, and 1000 steps at N=1M is one such program.
+    try:
+        r = run_one(1_000_000, "pallas", "global", nsteps=40, reps=2)
+        rows.append(r)
+        print(json.dumps(r))
+    except Exception as e:  # noqa: BLE001
+        print(f"# pallas N=1000000 global: {type(e).__name__}: {str(e)[:120]}")
     with open("BENCH_DETAIL.json", "w") as f:
         json.dump(rows, f, indent=1)
     return rows

@@ -87,10 +87,14 @@ def _kernel(reach_ref, own_ref, intr_ref,
     # a scalar-predicated branch in Mosaic, so unreachable tiles cost no
     # VPU work.  The cpp sub-tiles run sequentially in one program,
     # amortizing grid/DMA overhead (skipped sub-tiles still skip).
+    # reach_ref holds a BIT-PACKED 8-row SMEM window around the current
+    # row (the whole [nb, nb] matrix is 61 MB of SMEM at N=1M, and even
+    # one unpacked row breaks the SMEM budget there; 8-row granularity
+    # because SMEM block rows must be 8-divisible).
     for k in range(cpp):
         jb = jp * cpp + k
 
-        @pl.when(reach_ref[ib, jb] > 0)
+        @pl.when(((reach_ref[ib % 8, jb // 32] >> (jb % 32)) & 1) > 0)
         def _compute(k=k, jb=jb):
             _tile_body(ib, jb, k, own_ref, intr_ref, inconf_ref,
                        tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref,
@@ -457,15 +461,21 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         overflow rows)."""
         cpp = min(cols_per_prog, nb)
         nbp = -(-nb // cpp) * cpp
-        reach_i = (reach if reach_in is None else reach_in).astype(jnp.int32)
+        nb8 = -(-nb // 8) * 8
+        nw = -(-nbp // 32)
+        reach_b = (reach if reach_in is None else reach_in)
+        bits = jnp.zeros((nb8, nw * 32), jnp.uint32).at[:nb, :nb].set(
+            reach_b.astype(jnp.uint32))
+        reach_i = jnp.sum(
+            bits.reshape(nb8, nw, 32)
+            << jnp.arange(32, dtype=jnp.uint32)[None, None, :],
+            axis=2, dtype=jnp.uint32).astype(jnp.int32)
         packed_f = packed
         if nbp != nb:
             # One padded buffer serves BOTH inputs (the ownship grid
             # dimension stays nb, so its padded rows are never read)
             packed_f = jnp.concatenate(
                 [packed, jnp.zeros((nbp - nb, _NF, block), dtype)], axis=0)
-            reach_i = jnp.concatenate(
-                [reach_i, jnp.zeros((nb, nbp - nb), jnp.int32)], axis=1)
 
         kern = functools.partial(_kernel, cpp=cpp, **kern_kw)
         acc_spec = lambda: pl.BlockSpec(
@@ -476,7 +486,8 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
             kern,
             grid=(nb, nbp // cpp),
             in_specs=[
-                pl.BlockSpec(memory_space=pltpu.SMEM),       # reach flags
+                pl.BlockSpec((8, nw), lambda i, j: (i // 8, 0),
+                             memory_space=pltpu.SMEM),       # reach window
                 pl.BlockSpec((1, _NF, block), lambda i, j: (i, 0, 0),
                              memory_space=pltpu.VMEM),       # ownship slab
                 pl.BlockSpec((cpp, _NF, block), lambda i, j: (j, 0, 0),
