@@ -80,12 +80,26 @@ def run_one(n_ac, backend=None, geometry=None, nsteps=1000, reps=3):
     state = run_steps(state, cfg, nsteps)     # warmup/compile
     jax.block_until_ready(state)
     best = 0.0
-    for _ in range(reps):
+    retried = False
+    rep = 0
+    while rep < reps:
+        rep += 1
         t0 = time.perf_counter()
         state = run_steps(state, cfg, nsteps)
         jax.block_until_ready(state)
         dt = time.perf_counter() - t0
-        best = max(best, n_ac * nsteps / dt)
+        rate = n_ac * nsteps / dt
+        if rate > 5e8 and not retried:
+            # No config measures near this on one chip — an
+            # instant-return tunnel glitch; re-measure once.
+            retried = True
+            rep -= 1
+            continue
+        if rate > 5e8:
+            raise RuntimeError(
+                f"implausible rate {rate:.3g} ac-steps/s (dt={dt:.4f}s) — "
+                "tunnel glitch persisted")
+        best = max(best, rate)
     # sim-seconds advanced per wall-second
     x_realtime = best * cfg.simdt / n_ac
     return dict(n=n_ac, backend=backend, geometry=geometry,
@@ -155,7 +169,13 @@ def detail():
                 else ("regional", "continental", "global")
             for geometry in geoms:
                 try:
-                    r = run_one(n, backend, geometry, nsteps=400, reps=2)
+                    # Keep every single device execution well under the
+                    # tunnel watchdog (~1 min): the slowest config
+                    # (tiled regional at 100k, ~0.4M ac-steps/s) must
+                    # still finish its chunk quickly.
+                    nsteps = 400 if n < 100_000 else 100
+                    r = run_one(n, backend, geometry, nsteps=nsteps,
+                                reps=2)
                     rows.append(r)
                     print(json.dumps(r))
                 except Exception as e:  # noqa: BLE001 (sweep keeps going)

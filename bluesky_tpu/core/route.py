@@ -44,6 +44,9 @@ class HostRoute:
         # Landing chain fired for this plan (reference
         # Route.flag_landed_runway, route.py:741-775)
         self.flag_landed = False
+        # Turn mode for subsequently added waypoints (reference
+        # Route.swflyby, route.py:50; toggled by ADDWPT FLYBY/FLYOVER)
+        self.swflyby = True
 
     @property
     def nwp(self):
@@ -89,17 +92,20 @@ class RouteManager:
     # ------------------------------------------------------------- editing
     def addwpt(self, idx: int, name: str, lat: float, lon: float,
                alt: float = -999.0, spd: float = -999.0,
-               wtype: int = WPT_LATLON, flyby: float = 1.0,
+               wtype: int = WPT_LATLON, flyby: Optional[float] = None,
                afterwp: Optional[str] = None, as_dest: bool = False) -> int:
         """Insert a waypoint with the reference's ordering rules.
 
         ``as_dest`` marks a runway threshold added BY the DEST command
         (wtype WPT_RWY but destination placement: replace any trailing
-        DEST/RWY, go last).  Returns the insertion index, or -1 on error
-        (unknown afterwp).
+        DEST/RWY, go last).  ``flyby=None`` takes the route's current
+        turn mode (ADDWPT FLYBY/FLYOVER keyword, reference route.py:50).
+        Returns the insertion index, or -1 on error (unknown afterwp).
         """
         r = self.route(idx)
         name = name.upper()
+        if flyby is None:
+            flyby = 1.0 if r.swflyby else 0.0
 
         if afterwp is not None:
             names = [n.upper() for n in r.name]
@@ -176,7 +182,7 @@ class RouteManager:
         r.alt.insert(wpidx, float(alt))
         r.spd.insert(wpidx, float(spd))
         r.wtype.insert(wpidx, WPT_LATLON)
-        r.flyby.insert(wpidx, 1.0)
+        r.flyby.insert(wpidx, 1.0 if r.swflyby else 0.0)
         if r.iactwp >= wpidx:
             r.iactwp += 1
         self.sync(idx)
@@ -198,6 +204,24 @@ class RouteManager:
             spdtxt = "-----" if r.spd[i] < 0 else f"{r.spd[i]:.2f}"
             return True, f"{wpname}: alt {alttxt}, spd {spdtxt}"
         w = what.upper()
+        if w.count("/") == 1:
+            # acid AT wpname alt"/"spd — both constraints in one token
+            # (reference route.py:344-375; "---" deletes a constraint).
+            # Parse BOTH halves before mutating: a bad spd half must not
+            # leave a half-applied, unsynced constraint.
+            from ..utils.units import txt2alt, txt2spd
+            alttxt, spdtxt = w.split("/")
+            try:
+                newalt = r.alt[i] if not alttxt else (
+                    -999.0 if alttxt.count("-") > 1 else float(txt2alt(alttxt)))
+                newspd = r.spd[i] if not spdtxt else (
+                    -999.0 if spdtxt.count("-") > 1 else float(txt2spd(spdtxt)))
+            except Exception as e:
+                return False, f"Could not parse {what} as alt/spd ({e})"
+            r.alt[i] = newalt
+            r.spd[i] = newspd
+            self.sync(idx)
+            return True, None
         if w == "DEL":
             which = (str(value).upper() if value is not None else "BOTH")
             if which in ("ALT", "BOTH"):

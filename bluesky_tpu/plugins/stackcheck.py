@@ -25,6 +25,7 @@ SAMPLE_ARGS = {
     "float": "1.5", "int": "2", "onoff": "ON", "alt": "FL100",
     "spd": "250", "vspd": "1000", "hdg": "90", "time": "60",
     "lat": "52.0", "lon": "4.0", "latlon": "52.0 4.0", "wpt": "52.0 4.0",
+    "wppos": "52.0 4.0",
     "wpinroute": "WP001", "pandir": "LEFT", "color": "RED",
 }
 

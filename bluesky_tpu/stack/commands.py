@@ -213,6 +213,10 @@ def register_all(stack):
     def addwpt(idx, pos, alt=None, spd=None, afterwp=None):
         """ADDWPT acid,(wpt/lat,lon),[alt,spd,afterwp] (route.py:472)."""
         from ..core.route import WPT_LATLON, WPT_RWY
+        # FLYBY/FLYOVER are turn-mode KEYWORDS, not waypoints
+        # (reference route.py:77-92; the wppos argtype preserves them)
+        if _turnmode_kw(idx, pos):
+            return True
         lat, lon = pos
         # navdb-resolved positions carry their name (NamedPos)
         name = getattr(pos, "name", None) \
@@ -223,7 +227,7 @@ def register_all(stack):
         wpidx = sim.routes.addwpt(idx, name, lat, lon,
                                   alt if alt is not None else -999.0,
                                   spd if spd is not None else -999.0,
-                                  wtype, 1.0, afterwp)
+                                  wtype, None, afterwp)
         if wpidx < 0:
             return False, "ADDWPT: afterwp not found"
         # First waypoint: engage LNAV and aim at it (route.py addwpt behavior)
@@ -645,6 +649,18 @@ def register_all(stack):
         return False, "Usage: TRAIL ON/OFF,[dt] or TRAIL acid,color"
 
     # -------------------------------------------- route editing (FMS)
+    _TURNMODE = ("FLYBY", "FLY-BY", "FLYOVER", "FLY-OVER")
+
+    def _turnmode_kw(idx, pos):
+        """FLYBY/FLYOVER keyword via any route-editing command toggles
+        the route turn mode (reference routes all ADDWPT forms through
+        addwptStack, route.py:77-92).  Returns True when handled."""
+        if getattr(pos, "name", "") in _TURNMODE:
+            sim.routes.route(idx).swflyby = \
+                getattr(pos, "name", "") in ("FLYBY", "FLY-BY")
+            return True
+        return False
+
     def _resolve_wpt(token, idx):
         """wpt token -> (name, lat, lon): the 'latlon' argtype always
         yields a tuple — plain for numeric pairs, NamedPos (carrying the
@@ -660,11 +676,13 @@ def register_all(stack):
         if str(sub).upper() != "ADDWPT":
             return False, "Syntax: acid AFTER wpname ADDWPT wpname"
         from ..core.route import WPT_LATLON
+        if _turnmode_kw(idx, wpt):
+            return True
         name, lat, lon = _resolve_wpt(wpt, idx)
         wpidx = sim.routes.addwpt(idx, name, lat, lon,
                                   alt if alt is not None else -999.0,
                                   spd if spd is not None else -999.0,
-                                  WPT_LATLON, 1.0, afterwp)
+                                  WPT_LATLON, None, afterwp)
         if wpidx < 0:
             return False, f"AFTER: {afterwp} not in route"
         return True
@@ -674,6 +692,8 @@ def register_all(stack):
         beforeaddwptStack)."""
         if str(sub).upper() != "ADDWPT":
             return False, "Syntax: acid BEFORE wpname ADDWPT wpname"
+        if _turnmode_kw(idx, wpt):
+            return True
         name, lat, lon = _resolve_wpt(wpt, idx)
         wpidx = sim.routes.addwpt_before(
             idx, beforewp, name, lat, lon,
@@ -1031,8 +1051,9 @@ def register_all(stack):
 
     # ----------------------------------------------------------- dictionary
     stack.append_commands({
-        "ADDWPT": ["ADDWPT acid,(wpname/lat,lon),[alt,spd,afterwp]",
-                   "acid,latlon,[alt,spd,wpinroute]", addwpt,
+        "ADDWPT": ["ADDWPT acid,(wpname/FLYBY/FLYOVER/lat,lon),"
+                   "[alt,spd,afterwp]",
+                   "acid,wppos,[alt,spd,wpinroute]", addwpt,
                    "Add a waypoint to the route of an aircraft"],
         "ALT": ["ALT acid,alt,[vspd]", "acid,alt,[vspd]", selalt,
                 "Altitude select command"],
@@ -1163,7 +1184,7 @@ def register_all(stack):
         "ADDNODES": ["ADDNODES number", "int", addnodes,
                      "Add a simulation instance/node"],
         "AFTER": ["acid AFTER afterwp ADDWPT (wpname/lat,lon),[alt,spd]",
-                  "acid,wpinroute,txt,latlon,[alt,spd]", after,
+                  "acid,wpinroute,txt,wppos,[alt,spd]", after,
                   "After waypoint, add a waypoint to route of aircraft"],
         "AIRWAY": ["AIRWAY wp/airway", "txt", airway,
                    "Get info on airway or connections of a waypoint"],
@@ -1175,7 +1196,7 @@ def register_all(stack):
         "BATCH": ["BATCH filename", "string", batchcmd,
                   "Start a scenario file as batch simulation"],
         "BEFORE": ["acid BEFORE beforewp ADDWPT (wpname/lat,lon),[alt,spd]",
-                   "acid,wpinroute,txt,latlon,[alt,spd]", before,
+                   "acid,wpinroute,txt,wppos,[alt,spd]", before,
                    "Before waypoint, add a waypoint to route of aircraft"],
         "CD": ["CD [path]", "[txt]", cdcmd,
                "Change to a different scenario folder"],

@@ -81,10 +81,14 @@ class Stack:
         cmd = args[0].upper()
         rest = args[1:]
 
-        # "acid first" syntax: KL204 LNAV ON -> LNAV KL204 ON (stack.py:1390)
+        # "acid first" syntax: KL204 LNAV ON -> LNAV KL204 ON; a bare
+        # acid line means POS acid (stack.py:1390-1396)
         if cmd not in self.cmddict and cmd not in self.synonyms \
-                and self.sim.traf.id2idx(cmd) >= 0 and rest:
-            cmd, rest = rest[0].upper(), [args[0]] + rest[1:]
+                and self.sim.traf.id2idx(cmd) >= 0:
+            if rest:
+                cmd, rest = rest[0].upper(), [args[0]] + rest[1:]
+            else:
+                cmd, rest = "POS", [args[0]]
 
         cmd = self.synonyms.get(cmd, cmd)
         entry = self.cmddict.get(cmd)
