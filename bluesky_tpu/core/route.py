@@ -241,9 +241,13 @@ class RouteManager:
         self.sync(idx)   # sync recomputes calcfp's constraint tables
         return True, None
 
-    def dumproute(self, idx: int, acid: str, path: str = "output") -> str:
-        """DUMPRTE: append the route table to output/routelog.txt
+    def dumproute(self, idx: int, acid: str,
+                  path: Optional[str] = None) -> str:
+        """DUMPRTE: append the route table to <log_path>/routelog.txt
         (route.py dumpRoute)."""
+        if path is None:
+            from .. import settings
+            path = settings.log_path
         os.makedirs(path, exist_ok=True)
         fname = os.path.join(path, "routelog.txt")
         r = self.route(idx)

@@ -196,8 +196,22 @@ class Navdatabase:
         waypoint/navaid (parity: tools/position.py:6).  ``APT/RWNN`` (or
         RWYNN) resolves to the runway threshold when known."""
         if "/" in txt:
-            thr = self.getrwythreshold(*txt.split("/", 1))
-            return None if thr is None else (thr[0], thr[1])
+            apt, rwy = txt.split("/", 1)
+            thr = self.getrwythreshold(apt, rwy)
+            if thr is not None:
+                return (thr[0], thr[1])
+            if not self.rwythresholds.get(apt.upper()):
+                # No threshold data for this AIRPORT at all (apt.zip
+                # absent, no DEFRWY): degrade to the airport's own
+                # position instead of failing hard (the reference raises
+                # here, tools/position.py:52-60 — but it always ships
+                # apt.zip).  When the airport HAS a threshold table, a
+                # miss is a bad runway ident and stays an error.
+                i = self.getaptidx(apt)
+                if i >= 0:
+                    return (float(self.aptlat[i]), float(self.aptlon[i]))
+            # Not a resolvable runway (or a '/'-containing fix name):
+            # fall through to the normal full-token lookup.
         i = self.getaptidx(txt)
         if i >= 0:
             return (float(self.aptlat[i]), float(self.aptlon[i]))

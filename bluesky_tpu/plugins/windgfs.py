@@ -79,8 +79,9 @@ class WindGFS:
                 data = r.read()
         except (urllib.error.URLError, OSError) as e:
             return False, f"WINDGFS: download failed ({e})"
-        tmp = "output/gfs_wind.grb2"
-        os.makedirs("output", exist_ok=True)
+        from bluesky_tpu import settings
+        tmp = os.path.join(settings.log_path, "gfs_wind.grb2")
+        os.makedirs(settings.log_path, exist_ok=True)
         with open(tmp, "wb") as f:
             f.write(data)
         return self._install(tmp)

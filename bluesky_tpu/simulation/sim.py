@@ -376,11 +376,12 @@ class Simulation:
         # Landing detection must sample at ~1 s, like conditionals — but
         # only once an aircraft is actually near its threshold, so
         # en-route fast-forward keeps its long chunks.  The gate radius
-        # covers the worst one-chunk travel (ladder max x simdt at
-        # 340 m/s) so no aircraft can jump from outside the gate past
-        # the landing guard within a single unclamped chunk.
-        gate_nm = 5.0 + self.CHUNK_LADDER[0] * self.cfg.simdt * 340.0 / 1852.0
-        self._rwy_near = self._runway_approach_active(gate_nm)
+        # covers the worst one-chunk travel (ladder max x simdt at each
+        # aircraft's own ground speed, floored at 340 m/s) so no aircraft
+        # — supersonic or strong-tailwind included — can jump from
+        # outside the gate past the landing guard within a single
+        # unclamped chunk.
+        self._rwy_near = self._runway_approach_active()
         if self._rwy_near:
             c = max(1, int(round(1.0 / self.cfg.simdt)))
             dtclamp = c if dtclamp is None else min(dtclamp, c)
@@ -460,23 +461,37 @@ class Simulation:
             self._end_ff()
         return True
 
-    def _runway_approach_active(self, radius_nm: float) -> bool:
-        """Any unlanded runway-destination aircraft within radius of its
-        threshold?  Cheap host flat-earth test — gates the 1 s landing
-        sampling clamp so cruise fast-forward keeps long chunks."""
+    def _runway_approach_active(self) -> bool:
+        """Any unlanded runway-destination aircraft within its landing
+        gate?  Cheap host flat-earth test — gates the 1 s landing
+        sampling clamp so cruise fast-forward keeps long chunks.
+
+        The gate radius is per-aircraft: threshold proximity guard plus
+        the worst one-chunk travel at that aircraft's actual ground
+        speed (floored at 340 m/s so a stale/slow reading still covers
+        normal jets)."""
         cands = self.routes.runway_final_slots()
         if not cands:
             return False
         st = self.traf.state
         lat = np.asarray(st.ac.lat)
         lon = np.asarray(st.ac.lon)
+        gs = np.asarray(st.ac.gs)
+        chunk_s = self.CHUNK_LADDER[0] * self.cfg.simdt
+        # Worst-case acceleration cushion: gs is sampled at chunk START,
+        # and an aircraft can accelerate through the chunk (perf-model
+        # accel is ~0.5-2 m/s^2); 2 m/s^2 * chunk_s bounds the extra
+        # travel so the gate still covers one full unclamped chunk.
+        accel_cushion = 2.0 * chunk_s
         for slot, r in cands:
             if self.traf.ids[slot] is None:
                 continue
             last = r.nwp - 1
+            gate_nm = 5.0 + chunk_s * (
+                max(340.0, float(gs[slot]) + accel_cushion)) / 1852.0
             dlat = lat[slot] - r.lat[last]
             dlon = (lon[slot] - r.lon[last]) * np.cos(np.radians(r.lat[last]))
-            if np.hypot(dlat, dlon) * 60.0 <= radius_nm:
+            if np.hypot(dlat, dlon) * 60.0 <= gate_nm:
                 return True
         return False
 

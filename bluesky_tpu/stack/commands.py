@@ -944,10 +944,11 @@ def register_all(stack):
         """SCREENSHOT [fname]: SVG radar render of the current state
         (ui/radar.py — the headless RadarWidget)."""
         import os as _os
+        from .. import settings as _settings
         from ..ui import radar
         if fname is None:
-            _os.makedirs("output", exist_ok=True)
-            fname = _os.path.join("output",
+            _os.makedirs(_settings.log_path, exist_ok=True)
+            fname = _os.path.join(_settings.log_path,
                                   f"radar_{sim.simt:08.1f}.svg")
         radar.render_sim(sim, fname)
         return True, f"Radar snapshot written to {fname}"
@@ -1030,8 +1031,9 @@ def register_all(stack):
     def makedoc():
         """MAKEDOC: write command reference markdown (stack.py makedoc)."""
         import os as _os
-        _os.makedirs("output", exist_ok=True)
-        fname = _os.path.join("output", "commands.md")
+        from .. import settings as _settings
+        _os.makedirs(_settings.log_path, exist_ok=True)
+        fname = _os.path.join(_settings.log_path, "commands.md")
         with open(fname, "w") as f:
             f.write("# Stack command reference\n\n")
             for name in sorted(stack.cmddict):

@@ -36,9 +36,13 @@ def _any_point_in_tri(pts, a, b, c, eps=1e-12):
     d1 = cross(a, b, pts)
     d2 = cross(b, c, pts)
     d3 = cross(c, a, pts)
-    neg = (d1 < -eps) | (d2 < -eps) | (d3 < -eps)
-    pos = (d1 > eps) | (d2 > eps) | (d3 > eps)
-    return bool(np.any(~(neg & pos)))
+    # Callers only test strictly convex CCW ears, so inside/on-edge is
+    # "no edge sees the point on its right": all three cross products
+    # non-negative (within eps).  A mixed-sign point is strictly outside
+    # and must NOT veto the ear (collinear-vertex polygons would
+    # otherwise bail early with a partial triangle buffer).
+    inside = (d1 >= -eps) & (d2 >= -eps) & (d3 >= -eps)
+    return bool(np.any(inside))
 
 
 def earclip(contour) -> List[float]:

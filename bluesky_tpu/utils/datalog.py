@@ -18,8 +18,16 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from .. import settings
+
 _loggers: Dict[str, "CSVLogger"] = {}
-log_path = "output"
+
+
+def log_dir() -> str:
+    """Output directory for logs — reads ``settings.log_path`` at call
+    time so tests (and SETLOGPATH-style reconfiguration) can redirect
+    all file output without touching module globals."""
+    return settings.log_path
 
 
 class CSVLogger:
@@ -39,10 +47,10 @@ class CSVLogger:
     def start(self, sim, dt: Optional[float] = None):
         if dt is not None:
             self.dt = dt
-        os.makedirs(log_path, exist_ok=True)
+        os.makedirs(log_dir(), exist_ok=True)
         scen = sim.stack.scenname or "untitled"
         stamp = time.strftime("%Y%m%d_%H-%M-%S")
-        fname = os.path.join(log_path, f"{self.name}_{scen}_{stamp}.log")
+        fname = os.path.join(log_dir(), f"{self.name}_{scen}_{stamp}.log")
         self.file = open(fname, "w")
         self.file.write(f"# {self.header}\n")
         self.file.write("# simt, " + ", ".join(self.selvars) + "\n")

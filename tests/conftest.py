@@ -25,3 +25,16 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _isolated_log_path(tmp_path, monkeypatch):
+    """Route ALL file output (MAKEDOC, DUMPRTE, datalog CSV logs) into the
+    test's tmp dir: a full pytest run must leave `git status` clean
+    (VERDICT r2 'test-run hygiene').  Tests that assert on specific log
+    locations re-patch settings.log_path on top of this."""
+    from bluesky_tpu import settings
+    monkeypatch.setattr(settings, "log_path", str(tmp_path / "output"))

@@ -11,8 +11,8 @@ from bluesky_tpu.ops import aero
 
 @pytest.fixture()
 def sim(tmp_path, monkeypatch):
-    from bluesky_tpu.utils import datalog
-    monkeypatch.setattr(datalog, "log_path", str(tmp_path))
+    from bluesky_tpu import settings
+    monkeypatch.setattr(settings, "log_path", str(tmp_path))
     from bluesky_tpu.simulation.sim import Simulation
     return Simulation(nmax=16, dtype=jnp.float64)
 

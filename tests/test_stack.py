@@ -231,8 +231,8 @@ def test_benchmark_command(sim, tmp_path):
 
 
 def test_snaplog_logger(sim, tmp_path, monkeypatch):
-    from bluesky_tpu.utils import datalog
-    monkeypatch.setattr(datalog, "log_path", str(tmp_path))
+    from bluesky_tpu import settings
+    monkeypatch.setattr(settings, "log_path", str(tmp_path))
     do(sim, "CRE KL204 B744 52 4 90 FL200 250", "SNAPLOG ON 1")
     sim.run(until_simt=3.0, max_iters=100)
     do(sim, "SNAPLOG OFF")
