@@ -30,12 +30,16 @@ def main(argv=None):
                       help="embedded sim, no networking")
     mode.add_argument("--client", action="store_true",
                       help="console client")
+    mode.add_argument("--web", action="store_true",
+                      help="embedded sim + live browser radar UI")
     parser.add_argument("--config-file", default="", help="settings file")
     parser.add_argument("--scenfile", default="", help="startup scenario")
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--event-port", type=int, default=None)
     parser.add_argument("--stream-port", type=int, default=None)
     parser.add_argument("--discoverable", action="store_true")
+    parser.add_argument("--web-port", type=int, default=8080,
+                        help="port for --web mode")
     args = parser.parse_args(argv)
 
     settings.init(args.config_file)
@@ -46,6 +50,8 @@ def main(argv=None):
         return run_detached(args)
     if args.client:
         return run_client(args)
+    if args.web:
+        return run_web(args)
     return run_server(args)
 
 
@@ -104,6 +110,19 @@ def run_detached(args):
     if args.scenfile:
         node.sim.stack.ic(args.scenfile)
     node.run()
+    return 0
+
+
+def run_web(args):
+    """Embedded sim + the live browser radar (ui/web.py): the headless
+    replacement for the reference's Qt radar window."""
+    from .simulation.sim import Simulation
+    from .ui.web import serve_sim
+    sim = Simulation()
+    _start_telnet(sim)
+    if args.scenfile:
+        sim.stack.ic(args.scenfile)
+    serve_sim(sim, host=args.host, port=args.web_port)
     return 0
 
 

@@ -186,6 +186,55 @@ def detect_only(state: SimState, cfg: AsasConfig):
     return state.replace(asas=asas), cd
 
 
+def impl_for_backend(cd_backend: str) -> str:
+    """SimConfig.cd_backend -> update_tiled/refresh_spatial_sort impl."""
+    return {"pallas": "pallas", "sparse": "sparse"}.get(cd_backend, "lax")
+
+
+def refresh_spatial_sort(state: SimState, cfg: AsasConfig,
+                         block: int = 512, impl: str = "lax") -> SimState:
+    """Recompute the cached spatial sort for the tiled/pallas/sparse
+    backends.  HOST-called at chunk boundaries, deliberately outside the
+    jitted step (see the note in ``update_tiled``); cadence is the
+    caller's (Simulation refreshes every ``cfg.sort_every`` CD intervals
+    of sim time, bench once per scan chunk) — any staleness is exact."""
+    ac = state.ac
+    if impl == "sparse":
+        from ..ops import cd_sched
+        block = min(block, 256)
+        thresh = cd_sched.reach_threshold_m(
+            ac.gs, ac.active, cfg.dtlookahead, cfg.rpz)
+        dest = cd_sched.stripe_sort_dest(
+            ac.lat, ac.lon, ac.gs, ac.active, thresh, block, 32,
+            alt=ac.alt, vs=ac.vs).astype(jnp.int32)
+        # Remap the sorted-space partner table old-layout -> new-layout:
+        # old slot -> caller slot (inverse of the old dest) -> new slot.
+        # Costs a few [n_tot,K] gathers ONCE per refresh — amortized over
+        # sort_every intervals, vs. per-interval gathers if the table
+        # lived in caller space.
+        n = ac.lat.shape[0]
+        old = state.asas.sort_perm
+        n_tot = cd_sched.padded_size(n, block)
+        ar = jnp.arange(n, dtype=jnp.int32)
+        inv_old = jnp.full((n_tot + 1,), -1, jnp.int32).at[
+            jnp.clip(old, 0, n_tot)].set(ar)
+        pv = state.asas.partners_s[:n_tot]
+        caller_vals = jnp.where(
+            pv >= 0, inv_old[jnp.clip(pv, 0, n_tot)], -1)
+        new_vals = jnp.where(
+            caller_vals >= 0,
+            dest[jnp.clip(caller_vals, 0, n - 1)], -1)
+        per_caller = new_vals[jnp.clip(old, 0, n_tot - 1), :]   # [n, K]
+        spad = state.asas.partners_s.shape[0]
+        partners_s = jnp.full((spad, pv.shape[1]), -1,
+                              jnp.int32).at[dest].set(per_caller)
+        return state.replace(asas=state.asas.replace(
+            sort_perm=dest, partners_s=partners_s))
+    perm = cd_tiled.spatial_permutation(ac.lat, ac.lon, ac.active)
+    return state.replace(asas=state.asas.replace(
+        sort_perm=perm.astype(jnp.int32)))
+
+
 def update_tiled(state: SimState, cfg: AsasConfig, block: int = 512,
                  impl: str = "lax") -> Tuple[SimState, RowConflictData]:
     """One ASAS interval via the blockwise large-N backend (ops/cd_tiled.py).
@@ -204,31 +253,38 @@ def update_tiled(state: SimState, cfg: AsasConfig, block: int = 512,
         swresohoriz=cfg.swresohoriz, swresospd=cfg.swresospd,
         swresohdg=cfg.swresohdg, swresovert=cfg.swresovert)
 
-    if impl == "pallas":
-        from ..ops import cd_pallas
-        detect_fn = cd_pallas.detect_resolve_pallas
+    # Cached spatial sort, refreshed by the HOST at chunk boundaries
+    # (refresh_spatial_sort below) — never inside the step: an in-jit
+    # ``lax.cond``ed refresh was measured to cost the full ~70 ms
+    # argsort EVERY interval, because XLA speculatively hoists the pure
+    # sort out of the conditional, so the cache never cached.  Any
+    # staleness (including the initial identity layout) is exact —
+    # block reachability is recomputed from true positions each
+    # interval; staleness only loosens the windows.
+    perm = asas.sort_perm
+
+    if impl == "sparse":
+        from ..ops import cd_sched
+        block = min(block, 256)
+        n_tot = cd_sched.padded_size(ac.lat.shape[0], block)
+        rd, partners_s, act_new = cd_sched.detect_resolve_sched(
+            ac.lat, ac.lon, ac.trk, ac.gs, ac.alt, ac.vs,
+            ac.gseast, ac.gsnorth, ac.active, asas.noreso,
+            cfg.rpz, cfg.hpz, cfg.dtlookahead, mvpcfg, block=block,
+            k_partners=asas.partners_s.shape[1], perm=perm,
+            partners=asas.partners_s[:n_tot],
+            resume_rpz_m=cfg.rpz * cfg.resofach)
     else:
-        detect_fn = cd_tiled.detect_resolve_tiled
-
-    # Cached Morton permutation: sorting 100k keys costs more than the CD
-    # kernel, and any permutation is exact (reachability is recomputed from
-    # true positions) — so refresh only every cfg.sort_every intervals.
-    refresh = asas.sort_age >= cfg.sort_every
-    perm = jax.lax.cond(
-        refresh,
-        lambda: cd_tiled.spatial_permutation(
-            ac.lat, ac.lon, ac.active).astype(jnp.int32),
-        lambda: asas.sort_perm)
-    asas = asas.replace(
-        sort_perm=perm,
-        sort_age=jnp.where(refresh, 1, asas.sort_age + 1))
-    state = state.replace(asas=asas)
-
-    rd = detect_fn(
-        ac.lat, ac.lon, ac.trk, ac.gs, ac.alt, ac.vs,
-        ac.gseast, ac.gsnorth, ac.active, asas.noreso,
-        cfg.rpz, cfg.hpz, cfg.dtlookahead, mvpcfg, block=block,
-        k_partners=k, perm=perm)
+        if impl == "pallas":
+            from ..ops import cd_pallas
+            detect_fn = cd_pallas.detect_resolve_pallas
+        else:
+            detect_fn = cd_tiled.detect_resolve_tiled
+        rd = detect_fn(
+            ac.lat, ac.lon, ac.trk, ac.gs, ac.alt, ac.vs,
+            ac.gseast, ac.gsnorth, ac.active, asas.noreso,
+            cfg.rpz, cfg.hpz, cfg.dtlookahead, mvpcfg, block=block,
+            k_partners=k, perm=perm)
 
     if cfg.reso_on:
         newtrk, newgs, newvs, newalt, asase, asasn = cr_mvp.resolve_from_sums(
@@ -245,6 +301,23 @@ def update_tiled(state: SimState, cfg: AsasConfig, block: int = 512,
             alt=jnp.where(upd, newalt, asas.alt),
             asase=jnp.where(upd, asase, asas.asase),
             asasn=jnp.where(upd, asasn, asas.asasn))
+
+    if impl == "sparse":
+        # Resume-nav already happened IN-KERNEL (keep + merge on the
+        # sorted-space table) — just store the new table + flags.
+        spad = asas.partners_s.shape[0] - partners_s.shape[0]
+        if spad > 0:
+            partners_s = jnp.concatenate(
+                [partners_s,
+                 jnp.full((spad, partners_s.shape[1]), -1, jnp.int32)])
+        asas = asas.replace(
+            partners_s=partners_s,
+            active=act_new & cfg.reso_on,
+            inconf=rd.inconf,
+            tcpamax=rd.tcpamax,
+            nconf_cur=rd.nconf,
+            nlos_cur=rd.nlos)
+        return state.replace(asas=asas), rd
 
     # Resume-nav on the partner table, matching the dense path's pruning of
     # (old | new swconfl) through resume_nav (asas.py:409-471) as closely as

@@ -30,6 +30,12 @@ from flax import struct
 from ..ops import aero
 
 
+#: worst-case extra padded slots of the sparse backend's stripe-sorted
+#: layout: 32 pad blocks of <= 256 slots plus block rounding
+#: (ops/cd_sched.stripe_sort_dest with block <= 256, extra_blocks = 32).
+SORT_PAD = 33 * 256
+
+
 @struct.dataclass
 class AircraftArrays:
     """Kinematic + autopilot-selection state, one row per aircraft slot.
@@ -140,13 +146,21 @@ class AsasArrays:
     # Cumulative counts (device-side; unique-pair sets stay host-side)
     nconf_cur: jnp.ndarray  # scalar int — current directional conflict pairs
     nlos_cur: jnp.ndarray   # scalar int — current LoS pairs
-    # Cached Morton slot permutation for the tiled backends.  Sorting 100k
-    # keys on TPU costs more than the CD kernel itself, and ANY permutation
-    # is exact (results are mapped back; tile reachability is recomputed
-    # from true positions every interval) — so the sort is refreshed only
-    # every AsasConfig.sort_every CD intervals and carried here.
-    sort_perm: jnp.ndarray  # [N] int32 — slot permutation (sorted order)
-    sort_age: jnp.ndarray   # scalar int32 — CD intervals since refresh
+    # Cached spatial sort for the tiled/pallas/sparse backends (Morton
+    # permutation, or padded stripe destinations for 'sparse').  Sorting
+    # 100k keys on TPU costs more than the CD kernel itself, and ANY
+    # layout is exact (results are mapped back; tile reachability is
+    # recomputed from true positions every interval) — so the sort is
+    # refreshed by the HOST at chunk boundaries
+    # (core/asas.refresh_spatial_sort) and carried here.
+    sort_perm: jnp.ndarray  # [N] int32 — slot permutation / stripe dest
+    # Sorted-space partner table for the 'sparse' backend: rows are
+    # PADDED-SORTED slots (layout of ops/cd_sched.stripe_sort_dest,
+    # bounded by SORT_PAD extra slots), values are sorted-slot ids, -1
+    # empty.  Lives in sorted space so the in-kernel resume-nav needs no
+    # [N,K] gathers; remapped on host sort refreshes.  The other
+    # backends keep using ``partners`` (caller-slot semantics).
+    partners_s: jnp.ndarray  # [N + SORT_PAD, K] int32
 
 
 @struct.dataclass
@@ -296,7 +310,7 @@ def make_state(nmax: int = 64, wmax: int = 32,
         asasn=f(), asase=f(), noreso=b(), resooff=b(),
         nconf_cur=jnp.zeros((), jnp.int32), nlos_cur=jnp.zeros((), jnp.int32),
         sort_perm=jnp.arange(nmax, dtype=jnp.int32),
-        sort_age=jnp.asarray(1 << 30, jnp.int32),   # refresh at first CD
+        partners_s=jnp.full((nmax + SORT_PAD, k_partners), -1, jnp.int32),
     )
     route = RouteArrays(
         wplat=jnp.full((nmax, wmax), 89.99, dtype),

@@ -41,7 +41,9 @@ class SimConfig(NamedTuple):
     # fine to ~16k AC); 'tiled' streams [cd_block]² tiles with a [N,K]
     # partner table — required for the 100k north star (ops/cd_tiled.py);
     # 'pallas' is the tiled scheme as a hand-written TPU kernel
-    # (ops/cd_pallas.py, TPU-only).
+    # (ops/cd_pallas.py, TPU-only); 'sparse' is the segment-scheduled
+    # kernel with the stripe sort (ops/cd_sched.py, TPU-only) — the
+    # fastest large-N path for spread-out fleets, exact-equal results.
     cd_backend: str = "dense"
     cd_block: int = 512
 
@@ -72,10 +74,10 @@ def step(state: SimState, cfg: SimConfig) -> SimState:
 
     # ---------- ASAS CD&R (traffic.py:396), gated at dtasas ----------
     if cfg.asas.swasas:
-        if cfg.cd_backend not in ("dense", "tiled", "pallas"):
+        if cfg.cd_backend not in ("dense", "tiled", "pallas", "sparse"):
             raise ValueError(
                 f"Unknown SimConfig.cd_backend {cfg.cd_backend!r}; "
-                "expected 'dense', 'tiled' or 'pallas'.")
+                "expected 'dense', 'tiled', 'pallas' or 'sparse'.")
         if cfg.cd_backend == "dense" and state.asas.resopairs.size == 0:
             raise ValueError(
                 "State was allocated with pair_matrix=False (no [N,N] "
@@ -91,8 +93,8 @@ def step(state: SimState, cfg: SimConfig) -> SimState:
         asas_due = simt >= state.asas_tnext
 
         def run_asas(s):
-            if cfg.cd_backend in ("tiled", "pallas"):
-                impl = "pallas" if cfg.cd_backend == "pallas" else "lax"
+            if cfg.cd_backend in ("tiled", "pallas", "sparse"):
+                impl = asasmod.impl_for_backend(cfg.cd_backend)
                 s2, _cd = asasmod.update_tiled(s, cfg.asas,
                                                block=cfg.cd_block, impl=impl)
             else:

@@ -260,7 +260,16 @@ class Traffic:
         partners = st.asas.partners.at[jidx, :].set(-1)
         stale = jnp.isin(partners, jnp.asarray(jidx, jnp.int32))
         partners = jnp.where(stale, -1, partners)
+        # Sorted-space table (sparse backend): the deleted caller slots
+        # live at sort_perm[jidx] in the padded layout; purge those rows
+        # and every value referencing them, for the same slot-reuse
+        # reason as above.
+        sidx = st.asas.sort_perm[jidx]
+        partners_s = st.asas.partners_s.at[sidx, :].set(-1)
+        stale_s = jnp.isin(partners_s, sidx.astype(jnp.int32))
+        partners_s = jnp.where(stale_s, -1, partners_s)
         asas = st.asas.replace(resopairs=rp, partners=partners,
+                               partners_s=partners_s,
                                active=st.asas.active.at[jidx].set(False))
         self.state = st.replace(ac=ac, asas=asas)
         for hook in self.delete_hooks:

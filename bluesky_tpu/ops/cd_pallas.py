@@ -42,10 +42,11 @@ from . import cd_tiled, cr_mvp
 from .cd_tiled import RowConflictData, TRIG_FIELDS, block_reachability, \
     precompute_trig, tile_geometry
 
-# Packed state row order for the [nb, 13, block] slabs: 7 trig/geometry
-# columns (cd_tiled.TRIG_FIELDS), 4 velocity/altitude columns, then the
-# active and noreso masks.
-_FIELDS = TRIG_FIELDS + ("u", "v", "alt", "vs", "gse", "gsn",
+# Packed state row order for the [nb, 14, block] slabs: 7 trig/geometry
+# columns (cd_tiled.TRIG_FIELDS), 4 velocity/altitude columns, the track
+# angle (for the resume-nav "bouncing" predicate), then the active and
+# noreso masks.
+_FIELDS = TRIG_FIELDS + ("u", "v", "alt", "vs", "gse", "gsn", "trk",
                          "active", "noreso")
 _NF = len(_FIELDS)
 _IDX = {k: i for i, k in enumerate(_FIELDS)}
@@ -69,7 +70,8 @@ def _init_accumulators(refs, block, kk):
 def _kernel(reach_ref, own_ref, intr_ref,
             inconf_ref, tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref,
             tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref, cidx_ref,
-            *, block, kk, cpp, rpz, hpz, tlookahead, mvpcfg):
+            *, block, kk, cpp, rpz, hpz, tlookahead, mvpcfg,
+            same_hemi=False):
     ib = pl.program_id(0)
     jp = pl.program_id(1)      # program handles cpp column tiles
 
@@ -100,13 +102,15 @@ def _kernel(reach_ref, own_ref, intr_ref,
                        tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref,
                        tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref,
                        cidx_ref, block=block, kk=kk, rpz=rpz, hpz=hpz,
-                       tlookahead=tlookahead, mvpcfg=mvpcfg)
+                       tlookahead=tlookahead, mvpcfg=mvpcfg,
+                       same_hemi=same_hemi)
 
 
 def _tile_body(ib, jb, ksub, own_ref, intr_ref,
                inconf_ref, tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref,
                tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref, cidx_ref,
-               *, block, kk, rpz, hpz, tlookahead, mvpcfg):
+               *, block, kk, rpz, hpz, tlookahead, mvpcfg,
+               same_hemi=False, resume_refs=None, rpz_m=None):
     oslab = own_ref[0]                                    # (_NF, block)
     islab_t = intr_ref[ksub].T                            # (block, _NF): ONE
     # lane->sublane relayout shared by all intruder columns
@@ -133,13 +137,16 @@ def _tile_body(ib, jb, ksub, own_ref, intr_ref,
         _tile_pairs(pairmask, gid_int, own, intr, inconf_ref, tcpamax_ref,
                     sdve_ref, sdvn_ref, sdvv_ref, tsolv_ref, ncnt_ref,
                     lcnt_ref, ctin_ref, cidx_ref, kk=kk, rpz=rpz, hpz=hpz,
-                    tlookahead=tlookahead, mvpcfg=mvpcfg)
+                    tlookahead=tlookahead, mvpcfg=mvpcfg,
+                    same_hemi=same_hemi, jb=jb, resume_refs=resume_refs,
+                    rpz_m=rpz_m)
 
 
 def _tile_pairs(pairmask, gid_int, own, intr,
                 inconf_ref, tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref,
                 tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref, cidx_ref,
-                *, kk, rpz, hpz, tlookahead, mvpcfg):
+                *, kk, rpz, hpz, tlookahead, mvpcfg, same_hemi=False,
+                jb=None, resume_refs=None, rpz_m=None):
     block = pairmask.shape[1]
     excl = jnp.where(pairmask, 0.0, _BIG)
 
@@ -147,7 +154,8 @@ def _tile_pairs(pairmask, gid_int, own, intr,
     # evaluated [intruder, ownship] so per-ownship reductions are axis 0.
     trig_o = {k: own(k) for k in TRIG_FIELDS}
     trig_i = {k: intr(k) for k in TRIG_FIELDS}
-    dist0, sinqdr, cosqdr = tile_geometry(trig_o, trig_i)
+    dist0, sinqdr, cosqdr = tile_geometry(trig_o, trig_i,
+                                          same_hemisphere=same_hemi)
     dist = dist0 + excl
     dx = dist * sinqdr
     dy = dist * cosqdr
@@ -218,23 +226,47 @@ def _tile_pairs(pairmask, gid_int, own, intr,
         ncnt_ref[0] = ncnt_ref[0] + t_ncnt
         lcnt_ref[0] = lcnt_ref[0] + t_lcnt
 
-    # Partner candidates: merge this tile's top-kk most urgent conflicts
-    # into the running per-ownship top-kk held in the candidate refs.
-    # Extraction is kk passes of masked index-min (argmin has no stable
-    # Mosaic lowering); conflict-free tiles skip the whole thing.
-    @pl.when(jnp.any(swconfl))
-    def _():
-        urg = jnp.where(swconfl, tinconf, _BIG)
-        tins, idxs = [], []
-        for _s in range(kk):
+    # In-kernel resume-nav: evaluate the keep predicate for every OLD
+    # partner pair this tile visits (reference asas.py:426-455 — the
+    # same cr_mvp.resume_keep_core the host paths use, so the math
+    # cannot drift).  The tile already holds all required pair state, so
+    # this replaces the [N,K] gather storm of the host-side
+    # ``cd_tiled.partner_keep`` (measured ~60 ms/interval at N=100k with
+    # TPU gathers serializing at ~30 ns/element).  Pairs OUTSIDE the
+    # visited windows are provably non-conflicting within the lookahead
+    # AND out of LoS; the kernel path releases them (no keep bit) — a
+    # documented, bounded divergence from the dense path, which can hold
+    # a far-but-approaching pair engaged until CPA (such pairs re-engage
+    # on their next detection).
+    def _extract_merge(cand_mask):
+        """Fold this tile's candidate conflicts (cand_mask) into the
+        running per-ownship top-kk held in the candidate refs.
+        Extraction is masked index-min passes (argmin has no stable
+        Mosaic lowering); the pass count is bounded by the tile's MAX
+        per-ownship candidate count (usually 1-3 ≪ kk) — passes beyond
+        it would only extract the BIG sentinel, which is exactly what
+        the unrun passes' slots hold."""
+        urg0 = jnp.where(cand_mask, tinconf, _BIG)
+        cmax = jnp.max(jnp.sum(cand_mask.astype(jnp.int32), axis=0))
+        pio = jax.lax.broadcasted_iota(jnp.int32, (kk, block), 0)
+        carry0 = (urg0,
+                  jnp.full((kk, block), _BIG, urg0.dtype),
+                  jnp.full((kk, block), 2**30, jnp.int32))
+
+        def extract(p, carry):
+            urg, tins, idxs = carry
             minv = jnp.min(urg, axis=0, keepdims=True)    # (1, block)
             jloc = jnp.min(jnp.where(urg == minv, gid_int, jnp.int32(2**30)),
                            axis=0, keepdims=True)
-            tins.append(minv)
-            idxs.append(jloc)
+            tins = jnp.where(pio == p, minv, tins)
+            idxs = jnp.where(pio == p, jloc, idxs)
             urg = jnp.where(gid_int == jloc, _BIG, urg)
-        cat_t = jnp.concatenate([ctin_ref[0]] + tins, axis=0)   # (2kk, block)
-        cat_i = jnp.concatenate([cidx_ref[0]] + idxs, axis=0)
+            return urg, tins, idxs
+
+        _, tins, idxs = jax.lax.fori_loop(
+            0, jnp.minimum(cmax, kk), extract, carry0)
+        cat_t = jnp.concatenate([ctin_ref[0], tins], axis=0)    # (2kk, block)
+        cat_i = jnp.concatenate([cidx_ref[0], idxs], axis=0)
         rio = jax.lax.broadcasted_iota(jnp.int32, (2 * kk, block), 0)
         new_t, new_i = [], []
         for _s in range(kk):
@@ -248,6 +280,133 @@ def _tile_pairs(pairmask, gid_int, own, intr,
             cat_t = jnp.where(rio == rloc, _BIG, cat_t)
         ctin_ref[0] = jnp.concatenate(new_t, axis=0)
         cidx_ref[0] = jnp.concatenate(new_i, axis=0)
+
+    if resume_refs is None:
+        # Partner candidates only; conflict-free tiles skip entirely.
+        @pl.when(jnp.any(swconfl))
+        def _():
+            _extract_merge(swconfl)
+    else:
+        # In-kernel resume-nav (reference asas.py:409-471, the same
+        # cr_mvp.resume_keep_core the host paths use so the math cannot
+        # drift): evaluate the keep predicate for every visited pair,
+        # (a) OR it into the keep bits of OLD partner pairs present in
+        # this tile, and (b) filter the FRESH candidates with it — the
+        # dense path prunes the union (old | swconfl) through resume_nav
+        # each interval, so a fresh conflict already past CPA must not
+        # enter the table either.  Pairs OUTSIDE the visited windows are
+        # provably non-conflicting within the lookahead AND out of LoS;
+        # the kernel path releases them — a documented, bounded
+        # divergence from the dense path, which can hold a
+        # far-but-approaching pair engaged until CPA (such pairs
+        # re-engage on their next detection).
+        pold_ref, keep_ref = resume_refs
+        pold = pold_ref[0]                        # (kk, block) sorted ids
+        in_rng = (pold >= jb * block) & (pold < (jb + 1) * block)
+
+        @pl.when(jnp.any(in_rng) | jnp.any(swconfl))
+        def _resume_and_candidates():
+            # Flat-earth displacement of cr_mvp.resume_displacement from
+            # per-aircraft trig: cos(0.5*(lat_o+lat_i)) =
+            # sqrt((1+cos(lat_o+lat_i))/2), exact for |lat sum| <= 180.
+            cos_sum = own("cl") * intr("cl") - own("sl") * intr("sl")
+            cos_half = jnp.sqrt(jnp.maximum(0.5 + 0.5 * cos_sum, 0.0))
+            from . import geo
+            dist_e = geo.REARTH * jnp.radians(intr("lon") - own("lon")) \
+                * cos_half
+            dist_n = geo.REARTH * jnp.radians(intr("lat") - own("lat"))
+            vrel_e = intr("gse") - own("gse")
+            vrel_n = intr("gsn") - own("gsn")
+            keep_pair = cr_mvp.resume_keep_core(
+                dist_e, dist_n, vrel_e, vrel_n, own("trk"), intr("trk"),
+                pairmask, rpz, rpz_m)
+
+            @pl.when(jnp.any(in_rng))
+            def _keep_old():
+                for k in range(kk):
+                    match = (gid_int == pold[k:k + 1, :]) & keep_pair
+                    hit = jnp.max(match.astype(jnp.float32), axis=0,
+                                  keepdims=True)
+                    keep_ref[0, k:k + 1] = jnp.maximum(
+                        keep_ref[0, k:k + 1], hit)
+
+            @pl.when(jnp.any(swconfl))
+            def _fresh():
+                _extract_merge(swconfl & keep_pair)
+
+
+def _merge_partners_block(pold_ref, keep_ref, ctin_ref, cidx_ref,
+                          pnew_ref, pact_ref, kk):
+    """In-kernel partner merge for one ownship block (kernel-space
+    equivalent of ``cd_tiled.merge_partners`` + the active flag).
+
+    Fresh conflict candidates (already urgency-ordered in the ctin/cidx
+    refs) take the leading slots; old partners surviving their keep bit
+    fill the rest in original slot order; duplicates are dropped.  The
+    compaction is ``kk`` masked-min selection passes over the (2kk,
+    block) concatenation — pure VPU, no sort."""
+    big_i = jnp.int32(2 ** 30)
+    new_ids = jnp.where(ctin_ref[0] < _BIG, cidx_ref[0], -1)   # (kk, block)
+    old_ids = jnp.where(keep_ref[0] > 0.5, pold_ref[0], -1)
+    dup = jnp.zeros_like(old_ids, dtype=bool)
+    for m in range(kk):
+        nm = new_ids[m:m + 1, :]
+        dup = dup | ((old_ids == nm) & (nm >= 0))
+    old_ids = jnp.where(dup, -1, old_ids)
+
+    cat = jnp.concatenate([new_ids, old_ids], axis=0)          # (2kk, block)
+    rio = jax.lax.broadcasted_iota(jnp.int32, cat.shape, 0)
+    key = jnp.where(cat >= 0, rio, big_i)
+    outs = []
+    for _s in range(kk):
+        m = jnp.min(key, axis=0, keepdims=True)
+        val = jnp.min(jnp.where(key == m, cat, big_i), axis=0,
+                      keepdims=True)
+        outs.append(jnp.where(m < big_i, val, -1))
+        key = jnp.where(key == m, big_i, key)
+    pnew = jnp.concatenate(outs, axis=0)
+    pnew_ref[0] = pnew
+    pact_ref[0] = jnp.max((pnew >= 0).astype(jnp.float32), axis=0,
+                          keepdims=True)
+
+
+def _kernel_resume(reach_ref, own_ref, intr_ref, pold_ref,
+                   inconf_ref, tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref,
+                   tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref, cidx_ref,
+                   keep_ref, pnew_ref, pact_ref,
+                   *, block, kk, cpp, rpz, hpz, tlookahead, mvpcfg,
+                   rpz_m, same_hemi=False):
+    """Full-grid kernel with in-kernel resume-nav (the sparse scheduler's
+    overflow fallback): same tile sweep as ``_kernel`` plus the keep
+    evaluation per visited tile and the partner merge on the last
+    intruder program."""
+    ib = pl.program_id(0)
+    jp = pl.program_id(1)
+
+    @pl.when(jp == 0)
+    def _():
+        _init_accumulators((inconf_ref, tcpamax_ref, sdve_ref, sdvn_ref,
+                            sdvv_ref, tsolv_ref, ncnt_ref, lcnt_ref,
+                            ctin_ref, cidx_ref), block, kk)
+        keep_ref[0] = jnp.zeros((kk, block), jnp.float32)
+
+    for k in range(cpp):
+        jb = jp * cpp + k
+
+        @pl.when(((reach_ref[ib % 8, jb // 32] >> (jb % 32)) & 1) > 0)
+        def _compute(k=k, jb=jb):
+            _tile_body(ib, jb, k, own_ref, intr_ref, inconf_ref,
+                       tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref,
+                       tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref,
+                       cidx_ref, block=block, kk=kk, rpz=rpz, hpz=hpz,
+                       tlookahead=tlookahead, mvpcfg=mvpcfg,
+                       same_hemi=same_hemi,
+                       resume_refs=(pold_ref, keep_ref), rpz_m=rpz_m)
+
+    @pl.when(jp == pl.num_programs(1) - 1)
+    def _finish():
+        _merge_partners_block(pold_ref, keep_ref, ctin_ref, cidx_ref,
+                              pnew_ref, pact_ref, kk)
 
 
 def _kernel_cand(own_ref, cand_ref, cgid_ref,
@@ -377,6 +536,79 @@ def _build_candidates(lat, lon, gs, active, nb, block, c_cap, rpz,
     return cand, row_over
 
 
+def full_grid_pass(packed, reach, *, block, kk, cpp, kern_kw,
+                   interpret=False, pold=None, rpz_m=None):
+    """Grid over ALL tile pairs; unreachable ones branch past the body.
+
+    Several column tiles per grid program amortize the per-program
+    overhead (grid steps + slab DMA) across the skipped tiles.  ``reach``
+    [nb, nb] restricts the pass to a tile subset (prefilter skip and the
+    mixed-mode / sparse-scheduler overflow rows — ops/cd_sched.py reuses
+    this as its exact fallback).  ``packed`` is the [nb, _NF, block] slab
+    array; returns the 10 accumulator outputs in standard order.
+
+    With ``pold`` ([nb, kk, block] int32 partner table in the same slot
+    space as the pair ids) the kernel also evaluates in-kernel resume-nav
+    and appends 3 outputs: keep [nb, kk, block] f32, merged partners
+    [nb, kk, block] int32, active [nb, 1, block] f32.
+    """
+    nb = packed.shape[0]
+    dtype = packed.dtype
+    cpp = min(cpp, nb)
+    nbp = -(-nb // cpp) * cpp
+    nb8 = -(-nb // 8) * 8
+    nw = -(-nbp // 32)
+    bits = jnp.zeros((nb8, nw * 32), jnp.uint32).at[:nb, :nb].set(
+        reach.astype(jnp.uint32))
+    reach_i = jnp.sum(
+        bits.reshape(nb8, nw, 32)
+        << jnp.arange(32, dtype=jnp.uint32)[None, None, :],
+        axis=2, dtype=jnp.uint32).astype(jnp.int32)
+    packed_f = packed
+    if nbp != nb:
+        # One padded buffer serves BOTH inputs (the ownship grid
+        # dimension stays nb, so its padded rows are never read)
+        packed_f = jnp.concatenate(
+            [packed, jnp.zeros((nbp - nb, _NF, block), dtype)], axis=0)
+
+    acc_spec = lambda: pl.BlockSpec(
+        (1, 1, block), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM)
+    cand_spec = lambda: pl.BlockSpec(
+        (1, kk, block), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM)
+    acc = [jax.ShapeDtypeStruct((nb, 1, block), dtype)] * 8 + [
+        jax.ShapeDtypeStruct((nb, kk, block), dtype),       # ctin
+        jax.ShapeDtypeStruct((nb, kk, block), jnp.int32)]   # cidx
+    in_specs = [
+        pl.BlockSpec((8, nw), lambda i, j: (i // 8, 0),
+                     memory_space=pltpu.SMEM),       # reach window
+        pl.BlockSpec((1, _NF, block), lambda i, j: (i, 0, 0),
+                     memory_space=pltpu.VMEM),       # ownship slab
+        pl.BlockSpec((cpp, _NF, block), lambda i, j: (j, 0, 0),
+                     memory_space=pltpu.VMEM),       # intruder slabs
+    ]
+    out_specs = [acc_spec() for _ in range(8)] + [cand_spec(), cand_spec()]
+    args = [reach_i, packed_f, packed_f]
+    if pold is None:
+        kern = functools.partial(_kernel, cpp=cpp, **kern_kw)
+    else:
+        kern = functools.partial(_kernel_resume, cpp=cpp,
+                                 rpz_m=float(rpz_m), **kern_kw)
+        in_specs.append(cand_spec())                 # pold
+        args.append(pold)
+        out_specs += [cand_spec(), cand_spec(), acc_spec()]
+        acc += [jax.ShapeDtypeStruct((nb, kk, block), dtype),      # keep
+                jax.ShapeDtypeStruct((nb, kk, block), jnp.int32),  # merged
+                jax.ShapeDtypeStruct((nb, 1, block), dtype)]       # active
+    return list(pl.pallas_call(
+        kern,
+        grid=(nb, nbp // cpp),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=acc,
+        interpret=interpret,
+    )(*args))
+
+
 def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                           active, noreso, rpz, hpz, tlookahead, mvpcfg,
                           block=256, k_partners=8, interpret=False,
@@ -431,7 +663,7 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         "u": pad(gs.astype(dtype) * jnp.sin(trkrad)),
         "v": pad(gs.astype(dtype) * jnp.cos(trkrad)),
         "alt": pad(alt), "vs": pad(vs), "gse": pad(gseast),
-        "gsn": pad(gsnorth),
+        "gsn": pad(gsnorth), "trk": pad(trk),
         "active": pad(active.astype(dtype)),
         "noreso": pad(noreso.astype(dtype)),
     })
@@ -453,51 +685,9 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         jax.ShapeDtypeStruct((m, kk, block), jnp.int32)]   # cidx
 
     def run_full(reach_in=None):
-        """Grid over ALL tile pairs; unreachable ones branch past the body.
-
-        Several column tiles per grid program amortize the per-program
-        overhead (grid steps + slab DMA) across the skipped tiles.
-        ``reach_in`` restricts the pass to a row subset (mixed-mode
-        overflow rows)."""
-        cpp = min(cols_per_prog, nb)
-        nbp = -(-nb // cpp) * cpp
-        nb8 = -(-nb // 8) * 8
-        nw = -(-nbp // 32)
-        reach_b = (reach if reach_in is None else reach_in)
-        bits = jnp.zeros((nb8, nw * 32), jnp.uint32).at[:nb, :nb].set(
-            reach_b.astype(jnp.uint32))
-        reach_i = jnp.sum(
-            bits.reshape(nb8, nw, 32)
-            << jnp.arange(32, dtype=jnp.uint32)[None, None, :],
-            axis=2, dtype=jnp.uint32).astype(jnp.int32)
-        packed_f = packed
-        if nbp != nb:
-            # One padded buffer serves BOTH inputs (the ownship grid
-            # dimension stays nb, so its padded rows are never read)
-            packed_f = jnp.concatenate(
-                [packed, jnp.zeros((nbp - nb, _NF, block), dtype)], axis=0)
-
-        kern = functools.partial(_kernel, cpp=cpp, **kern_kw)
-        acc_spec = lambda: pl.BlockSpec(
-            (1, 1, block), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM)
-        cand_spec = lambda: pl.BlockSpec(
-            (1, kk, block), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM)
-        return list(pl.pallas_call(
-            kern,
-            grid=(nb, nbp // cpp),
-            in_specs=[
-                pl.BlockSpec((8, nw), lambda i, j: (i // 8, 0),
-                             memory_space=pltpu.SMEM),       # reach window
-                pl.BlockSpec((1, _NF, block), lambda i, j: (i, 0, 0),
-                             memory_space=pltpu.VMEM),       # ownship slab
-                pl.BlockSpec((cpp, _NF, block), lambda i, j: (j, 0, 0),
-                             memory_space=pltpu.VMEM),       # intruder slabs
-            ],
-            out_specs=[acc_spec() for _ in range(8)]
-            + [cand_spec(), cand_spec()],
-            out_shape=acc(nb),
-            interpret=interpret,
-        )(reach_i, packed_f, packed_f))
+        return full_grid_pass(packed, reach if reach_in is None else reach_in,
+                              block=block, kk=kk, cpp=cols_per_prog,
+                              kern_kw=kern_kw, interpret=interpret)
 
     def run_cand(cand):
         """Grid over (ownship block, candidate sub-chunk): the intruder

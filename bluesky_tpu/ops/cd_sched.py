@@ -1,0 +1,470 @@
+"""Sparse segment-scheduled CD&R: near-physics-floor pair enumeration.
+
+The full-grid Pallas kernel (``ops/cd_pallas.py``) visits every
+[block, block] tile of the N x N pair space and skips unreachable ones.
+Round-3 profiling on the v5e showed that at N=100k continental this costs
+~120 ms per CD interval: ~82 ms of pair math over 7.6e8 block-granular
+pairs and ~38 ms of pure grid+DMA overhead across 38k grid programs,
+while the *physics floor* — pairs within ``rpz + tlookahead*(gs_i+gs_j)``
+of each other, the exact conservative bound of the reference C++
+prefilter idea (``bluesky/traffic/asas/src_cpp/asas.hpp:24-27``) — is
+only ~5.5e7 pairs.  This module restructures the schedule so both costs
+approach their floors:
+
+* **Stripe sort** (``stripe_sort_dest``): aircraft are ordered by
+  latitude stripe (stripe height >= the reach radius), longitude within
+  the stripe, and each stripe is padded to a block boundary.  Unlike the
+  Morton curve, this guarantees the reachable columns of any row block
+  form at most ONE contiguous run per lat-reachable stripe (the lon
+  window in a lon-sorted stripe is an interval), i.e. ~3 runs instead of
+  Morton's fragmented ~7-21.
+
+* **Segment schedule** (``build_windows``): from the exact block
+  reachability matrix (``cd_tiled.block_reachability`` — unchanged
+  bound, so the skip stays exact), each row's reachable columns are
+  covered by at most ``S_cap`` contiguous segments of at most ``Wmax``
+  blocks.  Rows needing more (dense geometries where everyone reaches
+  everyone — e.g. the regional benchmark circle) are OVERFLOW rows,
+  covered exactly by the old full-grid kernel restricted to those rows
+  (``cd_pallas.full_grid_pass``), and the row-disjoint outputs merged.
+
+* **Segment kernel** (``_sched_kernel``): ONE grid program per ownship
+  block (grid = (nb,), not (nb, nb/cpp)): the program loops over its
+  prefetched (start, len) segments, each an ``pl.Element``-indexed
+  contiguous [Wmax, 16, block] slab DMA — no per-tile grid step, no
+  gathers.  Tile math is byte-identical to the other backends
+  (``cd_pallas._tile_pairs`` traced into this kernel), so results match
+  the dense oracle exactly like the tiled/pallas paths do.
+
+Semantics: identical reductions to ``cd_tiled.detect_resolve_tiled`` —
+the schedule only changes WHICH provably-conflict-free tiles are
+skipped, never the computed pairs' math.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import cd_pallas, cd_tiled
+from .cd_pallas import _ACC_NEUTRAL, _FIELDS, _IDX, _init_accumulators
+from .cd_tiled import RowConflictData, block_reachability, precompute_trig
+
+#: slab rows padded 13 -> 16 so a dynamic leading-index of a
+#: [Wmax, _NFP, block] VMEM ref lands on a whole-vreg boundary
+#: (16*block is a multiple of the (8, 128) vreg for block >= 128)
+_NFP = 16
+
+
+def padded_size(n, block=256, extra=32):
+    """Total slots of the padded stripe-sorted layout for n aircraft."""
+    block = min(block, 256)
+    return (-(-n // block) + extra) * block
+
+
+def reach_threshold_m(gs, active, tlookahead, rpz):
+    """Worst-case reach radius [m]: the exact conservative CD bound at
+    fleet-max closing speed (used to size stripes; per-block thresholds
+    in the reachability matrix stay per-block)."""
+    gsmax = jnp.max(jnp.where(active, gs, 0.0))
+    return rpz + tlookahead * 2.0 * gsmax
+
+
+#: altitude layers per stripe (cruise bands); one extra "climber" bucket
+#: collects |vs| > _CLIMB_VS aircraft so they cannot poison a cruise
+#: block's vsmax in the vertical reachability bound.  Measured at N=100k
+#: continental the layering INCREASES scheduled pairs (5.4e8 vs 3.4e8:
+#: thinning the lat-lon buckets makes blocks longitude-fat, and the
+#: +block-span dilation outweighs the vertical selectivity), so it is
+#: disabled; the vertical term of block_reachability stays on — it can
+#: only remove tiles, and fleets with genuinely spatially-banded
+#: altitudes get the skip for free.
+_N_LAYERS = 0
+_CLIMB_VS = 1.0     # [m/s]
+
+
+def stripe_sort_dest(lat, lon, gs, active, thresh_m, block, extra,
+                     alt=None, vs=None):
+    """Padded stripe-major sort: per-aircraft destination slots.
+
+    Returns ``dest`` [n] int32: aircraft i occupies padded slot dest[i]
+    in a layout of ``n + extra*block`` slots where each latitude stripe
+    starts on a block boundary (so no row block straddles two stripes —
+    straddle blocks have airspace-wide bounding boxes that blow up their
+    column windows).  Stripe height is the larger of the reach radius
+    and what caps the stripe count at ``extra - 1`` (so the padding
+    always fits); inactive aircraft sort into the last stripe.
+
+    With ``alt``/``vs``, aircraft are sub-ordered inside each stripe by
+    altitude band (cruisers) with climbers/descenders in a separate
+    bucket, then longitude — so blocks are homogeneous in altitude and
+    the vertical term of ``block_reachability`` can skip whole
+    flight-level bands.  Bucket boundaries are soft: they only shape
+    block contents, never correctness (the reachability bound reads the
+    true per-block ranges every interval).
+
+    Like the Morton permutation this is refreshed only every
+    ``sort_every`` CD intervals — ANY staleness is exact because block
+    reachability is recomputed from true positions each interval;
+    staleness only loosens the windows.
+    """
+    n = lat.shape[0]
+    act = active
+    big = jnp.asarray(1e9, lat.dtype)
+    latmin = jnp.min(jnp.where(act, lat, big))
+    latmax = jnp.max(jnp.where(act, lat, -big))
+    any_act = jnp.any(act)
+    latmin = jnp.where(any_act, latmin, 0.0)
+    latmax = jnp.where(any_act, latmax, 1.0)
+    span = jnp.maximum(latmax - latmin, 1e-6)
+    # [m] -> [deg]: 1 deg of great-circle is >= 110 km everywhere, so
+    # thresh/110000 over-estimates the needed stripe height -> safe.
+    h = jnp.maximum(jnp.maximum(thresh_m * 1.05 / 110000.0,
+                                span / (extra - 1)), 0.05)
+    s = jnp.clip(jnp.floor((lat - latmin) / h), 0, extra - 2).astype(jnp.int32)
+    s = jnp.where(act, s, extra - 1)
+
+    if alt is None or _N_LAYERS == 0:
+        layer = jnp.zeros((n,), jnp.int32)
+    else:
+        amin = jnp.where(any_act, jnp.min(jnp.where(act, alt, big)), 0.0)
+        amax = jnp.where(any_act, jnp.max(jnp.where(act, alt, -big)), 1.0)
+        lh = jnp.maximum((amax - amin) / _N_LAYERS, 1.0)
+        layer = jnp.clip(jnp.floor((alt - amin) / lh), 0,
+                         _N_LAYERS - 1).astype(jnp.int32)
+        layer = jnp.where(jnp.abs(vs) > _CLIMB_VS, _N_LAYERS, layer)
+
+    qlon = jnp.clip((lon + 180.0) * (2 ** 19 / 360.0), 0, 2 ** 19 - 1)
+    key = (s * (_N_LAYERS + 1) + layer) * (2 ** 19) + qlon.astype(jnp.int32)
+    order = jnp.argsort(key)                       # sorted -> original
+    ss = s[order]
+
+    onehot = ss[:, None] == jnp.arange(extra, dtype=jnp.int32)[None, :]
+    counts = jnp.sum(onehot, axis=0, dtype=jnp.int32)          # [extra]
+    nblocks = -(-counts // block)
+    base = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                            jnp.cumsum(nblocks)[:-1]]) * block
+    first = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                             jnp.cumsum(counts)[:-1]])
+    rank = jnp.arange(n, dtype=jnp.int32) - first[ss]
+    dest_sorted = base[ss] + rank
+    return jnp.zeros((n,), jnp.int32).at[order].set(dest_sorted)
+
+
+def scatter_padded(arrs, dest, n_tot, neutral=0.0):
+    """Place per-aircraft columns into the padded sorted layout.
+
+    Unfilled slots get ``neutral`` (0 -> inactive for the mask columns).
+    One shared index computation; each array costs one O(n) scatter.
+    """
+    return [jnp.full((n_tot,), neutral, a.dtype).at[dest].set(a)
+            for a in arrs]
+
+
+def build_windows(reach, s_cap, wmax, pad_start):
+    """Cover each row's reachable columns with <= s_cap segments of
+    <= wmax blocks.
+
+    ``reach`` [nb, nb] bool.  Returns ``(start, ln, overflow)``:
+    ``start``/``ln`` [nb, s_cap] int32 (unused slots: start=pad_start,
+    ln=0), ``overflow`` [nb] bool marking rows whose reachable set needs
+    more segments than s_cap — the caller covers those with the
+    full-grid fallback.  Covering a SUPERSET of reachable columns is
+    always exact (extra tiles just compute provably-empty pairs), so the
+    segmentation never needs to be tight, only sufficient.
+    """
+    nb = reach.shape[0]
+    col = jnp.arange(nb, dtype=jnp.int32)
+    prev = jnp.pad(reach[:, :-1], ((0, 0), (1, 0)))
+    starts = reach & ~prev
+    # run start id per column (within its run), then split runs at wmax
+    rs = jax.lax.cummax(jnp.where(starts, col, -1), axis=1)
+    newseg = reach & (starts | ((col - rs) % wmax == 0))
+    segid = jnp.cumsum(newseg, axis=1) - 1                 # [nb, nb]
+    nseg = jnp.max(jnp.where(reach, segid, -1), axis=1) + 1
+    overflow = nseg > s_cap
+
+    sel = (segid[:, None, :] == jnp.arange(s_cap, dtype=jnp.int32)
+           [None, :, None]) & reach[:, None, :]            # [nb, S, nb]
+    st = jnp.min(jnp.where(sel, col[None, None, :], nb), axis=2)
+    en = jnp.max(jnp.where(sel, col[None, None, :], -1), axis=2)
+    ln = jnp.maximum(en - st + 1, 0)
+    use = (ln > 0) & ~overflow[:, None]
+    st = jnp.where(use, st, pad_start).astype(jnp.int32)
+    ln = jnp.where(use, ln, 0).astype(jnp.int32)
+    return st, ln, overflow
+
+
+def _sched_kernel(st_ref, ln_ref, own_ref, *rest,
+                  block, kk, s_cap, wmax, rpz, hpz, tlookahead, mvpcfg,
+                  same_hemi=False, rpz_m=None):
+    resume = rpz_m is not None
+    intr_refs = rest[:s_cap]
+    rest = rest[s_cap:]
+    if resume:
+        pold_ref = rest[0]
+        out_refs = rest[1:11]
+        keep_ref, pnew_ref, pact_ref = rest[11:]
+    else:
+        pold_ref = keep_ref = pnew_ref = pact_ref = None
+        out_refs = rest
+    i = pl.program_id(0)
+    _init_accumulators(out_refs, block, kk)
+    if resume:
+        keep_ref[0] = jnp.zeros((kk, block), jnp.float32)
+
+    oslab = own_ref[0]                                     # (_NFP, block)
+
+    def own(k):
+        return oslab[_IDX[k]:_IDX[k] + 1, :]
+
+    gid_own = i * block + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block), 1)
+    act_o = own("active") > 0.5
+
+    # Whole-row skip: a row block of padding/inactive slots has no work
+    # in any segment.
+    @pl.when(jnp.any(act_o))
+    def _row():
+        for s in range(s_cap):
+            base = st_ref[i, s]
+            ln = ln_ref[i, s]
+            slab_ref = intr_refs[s]
+
+            def body(k, _, base=base, slab_ref=slab_ref):
+                islab_t = slab_ref[k].T                    # (block, _NFP)
+                # (a pre-transposed slab layout was measured SLOWER:
+                # per-field column reads of a (block, _NFP) VMEM slab
+                # stride across lanes; one .T per tile wins)
+
+                def intr(f):
+                    return islab_t[:, _IDX[f]:_IDX[f] + 1]
+
+                jb = base + k
+                gid_int = jb * block + jax.lax.broadcasted_iota(
+                    jnp.int32, (block, 1), 0)
+                act_i = intr("active") > 0.5
+                pairmask = (act_o & act_i) & (gid_own != gid_int)
+
+                @pl.when(jnp.any(pairmask))
+                def _tile():
+                    cd_pallas._tile_pairs(
+                        pairmask, gid_int, own, intr, *out_refs,
+                        kk=kk, rpz=rpz, hpz=hpz, tlookahead=tlookahead,
+                        mvpcfg=mvpcfg, same_hemi=same_hemi, jb=jb,
+                        resume_refs=(pold_ref, keep_ref) if resume
+                        else None, rpz_m=rpz_m)
+                return 0
+
+            jax.lax.fori_loop(0, jnp.minimum(ln, wmax), body, 0)
+
+    if resume:
+        # ctin/cidx refs hold the finished per-ownship candidates after
+        # the segment loops; fold in the surviving old partners.
+        cd_pallas._merge_partners_block(
+            pold_ref, keep_ref, out_refs[8], out_refs[9],
+            pnew_ref, pact_ref, kk)
+
+
+def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
+                         active, noreso, rpz, hpz, tlookahead, mvpcfg,
+                         block=256, k_partners=8, s_cap=8, wmax=12,
+                         extra_blocks=32, interpret=False, perm=None,
+                         cols_per_prog=4, partners=None, resume_rpz_m=None):
+    """Sparse-scheduled equivalent of ``cd_pallas.detect_resolve_pallas``.
+
+    ``perm`` is the cached ``stripe_sort_dest`` destination table (NOT a
+    Morton permutation); recomputed when None.  Results match the other
+    backends' reductions (same tile math, superset tile coverage).
+
+    With ``partners`` ([n_tot, K] int32, SORTED-space ids, -1 empty) the
+    kernels also run in-kernel resume-nav (keep evaluation on every
+    visited partner pair + the candidate/old merge — reference
+    asas.py:409-471 without any [N,K] host gathers), and the return
+    value becomes ``(rd, partners_new, active)`` where ``partners_new``
+    [n_tot, K] stays in sorted space (the caller keeps the table there
+    between intervals; ``rd.topk_*`` are then also sorted-space and
+    mainly diagnostic) and ``active`` [n] is the caller-space ASAS
+    engagement flag.
+    ``resume_rpz_m`` is the margin-scaled resume radius (rpz*resofach).
+    """
+    n = lat.shape[0]
+    dtype = jnp.float32
+    block = min(block, 256)
+    if partners is None and n <= 2 * block:
+        # Too small to schedule — the plain kernel is already one tile.
+        return cd_pallas.detect_resolve_pallas(
+            lat, lon, trk, gs, alt, vs, gseast, gsnorth, active, noreso,
+            rpz, hpz, tlookahead, mvpcfg, block=block,
+            k_partners=k_partners, interpret=interpret)
+    resume = partners is not None
+
+    thresh = reach_threshold_m(gs.astype(dtype), active,
+                               float(tlookahead), float(rpz))
+    if perm is None:
+        perm = stripe_sort_dest(lat.astype(dtype), lon.astype(dtype),
+                                gs.astype(dtype), active, thresh, block,
+                                extra_blocks, alt=alt.astype(dtype),
+                                vs=vs.astype(dtype))
+    nb = -(-n // block) + extra_blocks
+    n_tot = nb * block
+
+    cols = {
+        "lat": lat, "lon": lon, "trk": trk, "gs": gs, "alt": alt,
+        "vs": vs, "gse": gseast, "gsn": gsnorth,
+        "active": active.astype(dtype), "noreso": noreso.astype(dtype),
+    }
+    padded = dict(zip(cols, scatter_padded(
+        [v.astype(dtype) for v in cols.values()], perm, n_tot)))
+
+    fields = precompute_trig(padded["lat"], padded["lon"])
+    trkrad = jnp.radians(padded["trk"])
+    fields.update({
+        "u": padded["gs"] * jnp.sin(trkrad),
+        "v": padded["gs"] * jnp.cos(trkrad),
+        "alt": padded["alt"], "vs": padded["vs"],
+        "gse": padded["gse"], "gsn": padded["gsn"],
+        "active": padded["active"], "noreso": padded["noreso"],
+    })
+    fields["trk"] = padded["trk"]
+    packed = jnp.stack([fields[k] for k in _FIELDS]).reshape(
+        len(_FIELDS), nb, block).transpose(1, 0, 2)        # [nb, _NF, block]
+
+    act_b = padded["active"] > 0.5
+    reach = block_reachability(padded["lat"], padded["lon"], padded["gs"],
+                               act_b, nb, block, float(rpz),
+                               float(tlookahead), alt=padded["alt"],
+                               vs=padded["vs"], hpz=float(hpz))
+
+    # Segment windows + the Wmax-block pad region the sentinel slots
+    # point at (slots are clamped so every DMA stays in bounds).
+    st, ln, overflow = build_windows(reach, s_cap, wmax, pad_start=nb)
+    st = jnp.clip(st, 0, nb + wmax - wmax)                 # [0, nb]
+    packed16 = jnp.concatenate([
+        jnp.concatenate(                                   # 13 -> 16 rows
+            [packed, jnp.zeros((nb, _NFP - len(_FIELDS), block), dtype)],
+            axis=1),
+        jnp.zeros((wmax, _NFP, block), dtype)], axis=0)    # DMA pad region
+
+    kk = k_partners
+    own_spec = pl.BlockSpec((1, _NFP, block), lambda i, st, ln: (i, 0, 0),
+                            memory_space=pltpu.VMEM)
+    intr_specs = [
+        pl.BlockSpec((pl.Element(wmax), pl.Element(_NFP),
+                      pl.Element(block)),
+                     functools.partial(
+                         lambda i, st, ln, s=0: (st[i, s], 0, 0), s=s),
+                     memory_space=pltpu.VMEM)
+        for s in range(s_cap)]
+    acc_spec = lambda: pl.BlockSpec((1, 1, block),
+                                    lambda i, st, ln: (i, 0, 0),
+                                    memory_space=pltpu.VMEM)
+    cand_spec = lambda: pl.BlockSpec((1, kk, block),
+                                     lambda i, st, ln: (i, 0, 0),
+                                     memory_space=pltpu.VMEM)
+    out_shape = [jax.ShapeDtypeStruct((nb, 1, block), dtype)] * 8 + [
+        jax.ShapeDtypeStruct((nb, kk, block), dtype),
+        jax.ShapeDtypeStruct((nb, kk, block), jnp.int32)]
+    pold = None
+    if resume:
+        pold = partners.reshape(nb, block, kk).transpose(0, 2, 1) \
+            .astype(jnp.int32)                             # [nb, kk, block]
+        out_shape = out_shape + [
+            jax.ShapeDtypeStruct((nb, kk, block), dtype),       # keep
+            jax.ShapeDtypeStruct((nb, kk, block), jnp.int32),   # merged
+            jax.ShapeDtypeStruct((nb, 1, block), dtype)]        # active
+    reach_f = reach & overflow[:, None]
+    rsel = overflow[:, None, None]
+    neutral_vals = _ACC_NEUTRAL + ((0.0, -1, 0.0) if resume else ())
+
+    def run(same_hemi):
+        """Sched kernel + overflow fallback, specialised on the static
+        cross-equator-radius-branch elision (exact: only taken when no
+        active pair can straddle the equator)."""
+        kern = functools.partial(
+            _sched_kernel, block=block, kk=kk, s_cap=s_cap, wmax=wmax,
+            rpz=float(rpz), hpz=float(hpz), tlookahead=float(tlookahead),
+            mvpcfg=mvpcfg, same_hemi=same_hemi,
+            rpz_m=float(resume_rpz_m) if resume else None)
+        in_specs = [own_spec] + [intr_specs[s] for s in range(s_cap)]
+        out_specs = [acc_spec() for _ in range(8)] \
+            + [cand_spec(), cand_spec()]
+        args = [st, ln, packed16] + [packed16] * s_cap
+        if resume:
+            in_specs.append(cand_spec())               # pold
+            args.append(pold)
+            out_specs += [cand_spec(), cand_spec(), acc_spec()]
+        outs_s = list(pl.pallas_call(
+            kern,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(nb,),
+                in_specs=in_specs,
+                out_specs=out_specs,
+            ),
+            out_shape=out_shape,
+            interpret=interpret,
+        )(*args))
+
+        # Overflow rows (dense geometries): exact full-grid fallback on
+        # the row-restricted reachability, merged row-disjointly.
+        kern_kw = dict(block=block, kk=kk, rpz=float(rpz), hpz=float(hpz),
+                       tlookahead=float(tlookahead), mvpcfg=mvpcfg,
+                       same_hemi=same_hemi)
+
+        def fallback(rf):
+            return cd_pallas.full_grid_pass(
+                packed, rf, block=block, kk=kk, cpp=cols_per_prog,
+                kern_kw=kern_kw, interpret=interpret, pold=pold,
+                rpz_m=resume_rpz_m)
+
+        def neutral(_):
+            return [jnp.full(o.shape, v, o.dtype)
+                    for o, v in zip(outs_s, neutral_vals)]
+
+        outs_f = jax.lax.cond(jnp.any(overflow), fallback, neutral, reach_f)
+        return [jnp.where(rsel, f, s) for f, s in zip(outs_f, outs_s)]
+
+    lat_a = jnp.where(act_b, padded["lat"], 0.0)
+    cross = (jnp.min(lat_a) < 0.0) & (jnp.max(lat_a) > 0.0)
+    outs = jax.lax.cond(cross,
+                        functools.partial(run, False),
+                        functools.partial(run, True))
+
+    (inconf, tcpamax, sdve, sdvn, sdvv, tsolv, ncnt, lcnt,
+     ctin, cidx) = outs[:10]
+
+    # Map padded-sorted rows back to caller slots with ONE fused gather
+    # (aircraft i lives at padded slot perm[i]; separate per-array
+    # gathers serialize on TPU at ~30 ns/element).
+    rows = [inconf, tcpamax, sdve, sdvn, sdvv, tsolv]
+    if resume:
+        rows.append(outs[12])                              # active
+    stacked = jnp.stack([o.reshape(n_tot) for o in rows])
+    backed = stacked[:, perm]                              # [6|7, n]
+    topk_tin = ctin.transpose(0, 2, 1).reshape(n_tot, kk)[perm]
+    topk_idx = cidx.transpose(0, 2, 1).reshape(n_tot, kk)[perm]
+    if not resume:
+        # Translate sorted-space partner ids to caller slots via the
+        # inverse scatter (sentinel-filled with n -> invalid -> -1).
+        inv = jnp.full((n_tot + 1,), n, jnp.int32).at[perm].set(
+            jnp.arange(n, dtype=jnp.int32))
+        topk_idx = inv[jnp.clip(topk_idx, 0, n_tot)]
+    topk_idx = jnp.where((topk_tin < cd_pallas._BIG) & (topk_idx < n_tot),
+                         topk_idx, -1)
+
+    rd = RowConflictData(
+        inconf=backed[0] > 0.5,
+        tcpamax=backed[1],
+        sum_dve=backed[2], sum_dvn=backed[3], sum_dvv=backed[4],
+        tsolv=backed[5],
+        nconf=jnp.sum(ncnt.astype(jnp.int32), dtype=jnp.int32),
+        nlos=jnp.sum(lcnt.astype(jnp.int32), dtype=jnp.int32),
+        topk_idx=topk_idx, topk_tin=topk_tin)
+    if not resume:
+        return rd
+    pmerged = outs[11]
+    partners_new = pmerged.transpose(0, 2, 1).reshape(n_tot, kk)
+    active_caller = backed[6] > 0.5
+    return rd, partners_new, active_caller

@@ -129,8 +129,15 @@ def _sin_poly(x):
     return x * (1.0 - x2 / 6.0 * (1.0 - x2 / 20.0 * (1.0 - x2 / 42.0)))
 
 
-def tile_geometry(own, intr):
+def tile_geometry(own, intr, same_hemisphere=False):
     """Pair distance [m] + bearing sin/cos for one tile.
+
+    ``same_hemisphere=True`` (static) asserts no pair in the tile can
+    have lat_o * lat_i < 0, eliding the reference's cross-equator radius
+    branch (geo.py:117-128 ``res2``) — bit-identical for such tiles
+    because the per-pair ``where`` would always pick ``res1``.  Callers
+    must only set it when the assertion provably holds (ops/cd_sched.py
+    derives it from the active fleet's latitude signs).
 
     ``own``/``intr`` are dicts of TRIG_FIELDS columns, broadcast-shaped
     (ownship vs intruder axes).  Mirrors geo.qdrdist_matrix semantics
@@ -151,11 +158,14 @@ def tile_geometry(own, intr):
     cos_sum = cl_o * cl_i - sl_o * sl_i
     sin_sum = sl_o * cl_i + cl_o * sl_i
     res1 = _rwgs84_from_trig(cos_sum, sin_sum)
-    denom = own["abslat"] + intr["abslat"] \
-        + jnp.where(own["lat"] == 0.0, 1e-6, 0.0)
-    res2 = 0.5 * (own["abslat"] * (own["rloc"] + geo.A_WGS84)
-                  + intr["abslat"] * (intr["rloc"] + geo.A_WGS84)) / denom
-    r = jnp.where(own["lat"] * intr["lat"] < 0.0, res2, res1)
+    if same_hemisphere:
+        r = res1
+    else:
+        denom = own["abslat"] + intr["abslat"] \
+            + jnp.where(own["lat"] == 0.0, 1e-6, 0.0)
+        res2 = 0.5 * (own["abslat"] * (own["rloc"] + geo.A_WGS84)
+                      + intr["abslat"] * (intr["rloc"] + geo.A_WGS84)) / denom
+        r = jnp.where(own["lat"] * intr["lat"] < 0.0, res2, res1)
 
     # Coordinate deltas; dlon wrapped into [-180, 180] (the reference's
     # pairwise sin/cos are periodic — the polynomial needs the wrap).
@@ -252,7 +262,8 @@ def run_spatially_sorted(kernel, lat, lon, trk, gs, alt, vs, gseast,
         topk_idx=back(topk_idx), topk_tin=back(rd.topk_tin))
 
 
-def block_reachability(lat, lon, gs, active, nb, block, rpz, tlookahead):
+def block_reachability(lat, lon, gs, active, nb, block, rpz, tlookahead,
+                       alt=None, vs=None, hpz=None):
     """[nb, nb] bool: which block pairs can possibly contain a conflict
     or LoS.
 
@@ -260,6 +271,15 @@ def block_reachability(lat, lon, gs, active, nb, block, rpz, tlookahead):
     a pair farther apart than ``rpz + tlookahead * (gsmax_r + gsmax_c)``
     has horizontal conflict-entry time >= (dist - rpz)/vrel > tlookahead
     and dist > rpz, so neither swconfl nor swlos can hold.
+
+    With ``alt``/``vs``/``hpz`` given, an analogous EXACT vertical skip
+    is AND-ed in: blocks whose altitude ranges are separated by more
+    than ``hpz + tlookahead * (vsmax_r + vsmax_c)`` have vertical entry
+    time ``tinver >= (altgap - hpz)/dvs > tlookahead`` (so
+    ``tinconf = max(tinver, tinhor)`` exceeds the lookahead) and
+    ``|dalt| > hpz`` (no LoS).  This is what makes the altitude-layered
+    sort of ``cd_sched.stripe_sort_dest`` pay off: cruise blocks only
+    reach ~one flight-level band instead of the whole column.
 
     Distance lower bounds between the blocks' active-aircraft bounding
     boxes, valid on the whole sphere:
@@ -310,7 +330,19 @@ def block_reachability(lat, lon, gs, active, nb, block, rpz, tlookahead):
     merid = dlat_gap * 110000.0
     dist_lb = jnp.maximum(merid, zonal)
     thresh = rpz + tlookahead * (gsmax[:, None] + gsmax[None, :])
-    return dist_lb <= thresh * 1.05
+    reach = dist_lb <= thresh * 1.05
+    if alt is not None:
+        balt = alt.reshape(shape)
+        bvs = jnp.abs(vs.reshape(shape))
+        altmin = jnp.min(jnp.where(act, balt, inf), axis=1)
+        altmax = jnp.max(jnp.where(act, balt, -inf), axis=1)
+        vsmax = jnp.max(jnp.where(act, bvs, 0.0), axis=1)
+        altgap = jnp.maximum(0.0, jnp.maximum(
+            altmin[:, None] - altmax[None, :],
+            altmin[None, :] - altmax[:, None]))
+        vthresh = hpz + tlookahead * (vsmax[:, None] + vsmax[None, :])
+        reach = reach & (altgap <= vthresh * 1.05)
+    return reach
 
 
 def detect_resolve_tiled(lat, lon, trk, gs, alt, vs, gseast, gsnorth,

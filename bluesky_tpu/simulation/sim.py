@@ -149,6 +149,8 @@ class Simulation:
         self.bencht = 0.0
         self.benchdt = -1.0
         self._step_count = 0
+        self._sort_simt = -1.0    # simt of last spatial-sort refresh
+        self._sort_backend = None  # cd_backend the cached sort belongs to
         self._wall_t0 = time.perf_counter()
         import datetime
         self._utc0 = datetime.datetime.combine(datetime.date.today(),
@@ -284,10 +286,14 @@ class Simulation:
         self.traf.reset()
         self.cond.reset()
         self.routes = RouteManager(self.traf, self.routes.wmax)
+        self._sort_simt = -1.0
+        self._sort_backend = None
         return True
 
     def reset(self):
         self.state_flag = INIT
+        self._sort_simt = -1.0
+        self._sort_backend = None
         self.traf.reset()
         self.areas.reset()
         self.cond.reset()
@@ -440,6 +446,27 @@ class Simulation:
         # (simulation.py:83)
         self.plugins.preupdate(self.simt)
         self.traf.flush()   # preupdate hooks may have queued aircraft
+
+        # Host-side spatial-sort refresh for the large-N CD backends,
+        # every sort_every CD intervals of sim time (exact at any
+        # staleness; see core/asas.refresh_spatial_sort).
+        if self.cfg.cd_backend in ("tiled", "pallas", "sparse"):
+            due = self.cfg.asas.sort_every * self.cfg.asas.dtasas
+            # Also force a refresh when the backend changed: 'sparse'
+            # stores stripe DESTINATIONS in sort_perm, the others a
+            # Morton PERMUTATION — feeding one into the other scrambles
+            # the sorted layout.
+            if (self.simt - self._sort_simt >= due
+                    or self._sort_simt < 0
+                    or self._sort_backend != self.cfg.cd_backend):
+                from ..core.asas import impl_for_backend, \
+                    refresh_spatial_sort
+                self.traf.state = refresh_spatial_sort(
+                    self.traf.state, self.cfg.asas,
+                    block=self.cfg.cd_block,
+                    impl=impl_for_backend(self.cfg.cd_backend))
+                self._sort_simt = self.simt
+                self._sort_backend = self.cfg.cd_backend
 
         self.traf.state = run_steps(self.traf.state, self.cfg, chunk)
         self._step_count += chunk

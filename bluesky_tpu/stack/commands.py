@@ -800,10 +800,20 @@ def register_all(stack):
             return True, f"CDMETHOD {sim.cfg.cd_backend.upper()}"
         m = method.upper()
         table = {"STATEBASED": "dense", "DENSE": "dense",
-                 "TILED": "tiled", "PALLAS": "pallas"}
+                 "TILED": "tiled", "PALLAS": "pallas", "SPARSE": "sparse"}
         if m not in table:
             return False, (f"CDMETHOD {method} not available "
-                           "(have: STATEBASED/DENSE, TILED, PALLAS)")
+                           "(have: STATEBASED/DENSE, TILED, PALLAS, "
+                           "SPARSE)")
+        if table[m] != sim.cfg.cd_backend:
+            # sort_perm semantics differ per backend (Morton permutation
+            # vs stripe destinations); the identity layout is valid for
+            # both, and Simulation.update force-refreshes on backend
+            # change.
+            st = sim.traf.state
+            sim.traf.state = st.replace(asas=st.asas.replace(
+                sort_perm=jnp.arange(st.asas.sort_perm.shape[0],
+                                     dtype=jnp.int32)))
         sim.cfg = sim.cfg._replace(cd_backend=table[m])
         return True
 

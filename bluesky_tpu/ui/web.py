@@ -1,0 +1,237 @@
+"""Live browser frontend: the reference's radar-view UX, headlessly.
+
+The reference's flagship user experience is a live Qt-OpenGL radar
+window (``bluesky/ui/qtgl/radarwidget.py:115-1031``) with a command line
+(``mainwindow.py:93-399``).  This module serves the same picture to a
+web browser instead of a GL context: a tiny stdlib HTTP server streams
+the existing SVG radar frames (``ui/radar.py`` — the same renderer the
+SCREENSHOT command uses) over Server-Sent Events at a few Hz, and a
+command box posts stack commands back, so a user can *watch* moving
+traffic and fly the sim from any browser with zero dependencies.
+
+Two backends plug in behind one ``WebUI`` facade:
+  * an embedded :class:`~bluesky_tpu.simulation.sim.Simulation`
+    (``python -m bluesky_tpu --web``), rendered from live state;
+  * a connected :class:`~bluesky_tpu.network.guiclient.GuiClient`,
+    rendered from its ACDATA/ROUTEDATA nodeData mirror — the same
+    client path the reference GUI consumes (screenio.py:18-21 streams).
+
+Threading: the HTTP server runs daemon threads; frame rendering reads
+immutable device arrays / the client's mirror dicts, and stack commands
+are queued to the owner loop (the sim thread calls ``pump()`` between
+chunks), so no state is mutated from a server thread.
+"""
+import json
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>bluesky_tpu radar</title><style>
+ body { background:#10141c; color:#9fd49f; font-family:monospace;
+        margin:0; display:flex; flex-direction:column; height:100vh; }
+ #radar { flex:1; display:flex; align-items:center;
+          justify-content:center; overflow:hidden; }
+ #radar svg { max-width:100%; max-height:100%; }
+ #bar { display:flex; padding:6px; background:#181e2a; }
+ #cmd { flex:1; background:#0c0f16; color:#d0e8d0; border:1px solid
+        #334; font-family:monospace; padding:4px 8px; }
+ #echo { height:9em; overflow-y:auto; background:#0c0f16;
+         padding:4px 8px; font-size:12px; white-space:pre-wrap; }
+ #info { padding:2px 8px; color:#678; font-size:12px; }
+</style></head><body>
+ <div id="radar">connecting&hellip;</div>
+ <div id="info"></div>
+ <div id="bar"><input id="cmd" autofocus placeholder="stack command
+ (CRE KL204 B744 52 4 90 FL200 250 / OP / FF 60 ...)"/></div>
+ <div id="echo"></div>
+<script>
+ const radar = document.getElementById('radar');
+ const info = document.getElementById('info');
+ const echo = document.getElementById('echo');
+ const cmd = document.getElementById('cmd');
+ const es = new EventSource('/events');
+ es.onmessage = ev => {
+   const d = JSON.parse(ev.data);
+   if (d.svg) radar.innerHTML = d.svg;
+   if (d.info) info.textContent = d.info;
+ };
+ const hist = []; let hidx = -1;
+ cmd.addEventListener('keydown', async ev => {
+   if (ev.key === 'Enter' && cmd.value.trim()) {
+     const line = cmd.value.trim(); hist.unshift(line); hidx = -1;
+     cmd.value = '';
+     const r = await fetch('/cmd', {method:'POST', body: line});
+     const t = await r.text();
+     echo.textContent = '> ' + line + '\\n' + t + '\\n' + echo.textContent;
+   } else if (ev.key === 'ArrowUp') {
+     hidx = Math.min(hidx + 1, hist.length - 1);
+     if (hidx >= 0) cmd.value = hist[hidx];
+   } else if (ev.key === 'ArrowDown') {
+     hidx = Math.max(hidx - 1, -1);
+     cmd.value = hidx >= 0 ? hist[hidx] : '';
+   }
+ });
+</script></body></html>
+"""
+
+
+class SimBackend:
+    """Frame/command adapter over an embedded Simulation."""
+
+    def __init__(self, sim):
+        self.sim = sim
+        self._pending = queue.Queue()
+
+    def frame(self):
+        from . import radar
+        svg = radar.render_sim(self.sim)
+        return svg, (f"simt {float(self.sim.simt):8.1f} s   "
+                     f"ntraf {self.sim.traf.ntraf}   "
+                     f"state {self.sim.state_flag}")
+
+    def command(self, line):
+        """Queue a stack command; executed by the sim loop via pump()."""
+        done = queue.Queue()
+        self._pending.put((line, done))
+        try:
+            return done.get(timeout=5.0)
+        except queue.Empty:
+            return "(queued)"
+
+    def pump(self):
+        """Run queued commands on the sim thread (call between chunks)."""
+        while True:
+            try:
+                line, done = self._pending.get_nowait()
+            except queue.Empty:
+                return
+            self.sim.scr.echobuf.clear()
+            self.sim.stack.stack(line)
+            self.sim.stack.process()
+            done.put("\n".join(self.sim.scr.echobuf))
+
+
+class ClientBackend:
+    """Frame/command adapter over a connected GuiClient."""
+
+    def __init__(self, client):
+        self.client = client
+
+    def frame(self):
+        svg = self.client.render_svg()
+        nd = self.client.get_nodedata()
+        n = len(nd.acdata.get("id", [])) if nd.acdata else 0
+        return svg, f"ntraf {n}   node {self.client.act or '-'}"
+
+    def command(self, line):
+        self.client.stack(line)
+        time.sleep(0.15)                     # echo arrives via stream
+        out = list(self.client.echobuf)
+        self.client.echobuf.clear()
+        return "\n".join(out)
+
+    def pump(self):
+        self.client.receive()
+
+
+class WebUI:
+    """The HTTP/SSE server; ``start()`` returns immediately (daemon)."""
+
+    def __init__(self, backend, host="127.0.0.1", port=8080, fps=4.0):
+        self.backend = backend
+        self.host, self.port = host, port
+        self.period = 1.0 / max(fps, 0.1)
+        self.httpd = None
+        ui = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):       # silence request spam
+                pass
+
+            def _send(self, code, ctype, body):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path in ("/", "/index.html"):
+                    self._send(200, "text/html; charset=utf-8",
+                               _PAGE.encode())
+                elif self.path == "/frame.svg":
+                    svg, _ = ui.backend.frame()
+                    self._send(200, "image/svg+xml", svg.encode())
+                elif self.path == "/events":
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.send_header("Cache-Control", "no-cache")
+                    self.end_headers()
+                    try:
+                        while True:
+                            svg, inf = ui.backend.frame()
+                            payload = json.dumps({"svg": svg, "info": inf})
+                            self.wfile.write(
+                                f"data: {payload}\n\n".encode())
+                            self.wfile.flush()
+                            time.sleep(ui.period)
+                    except (BrokenPipeError, ConnectionResetError,
+                            OSError):
+                        return               # browser went away
+                else:
+                    self._send(404, "text/plain", b"not found")
+
+            def do_POST(self):
+                if self.path == "/cmd":
+                    n = int(self.headers.get("Content-Length", 0))
+                    line = self.rfile.read(n).decode().strip()
+                    out = ui.backend.command(line)
+                    self._send(200, "text/plain; charset=utf-8",
+                               (out or "").encode())
+                else:
+                    self._send(404, "text/plain", b"not found")
+
+        self._handler = Handler
+
+    def start(self):
+        self.httpd = ThreadingHTTPServer((self.host, self.port),
+                                         self._handler)
+        self.port = self.httpd.server_address[1]      # resolve port 0
+        t = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        t.start()
+        return self
+
+    def stop(self):
+        if self.httpd:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+            self.httpd = None
+
+
+def serve_sim(sim, host="127.0.0.1", port=8080, fps=4.0, run=True):
+    """Serve an embedded sim and (optionally) drive its loop forever.
+
+    The loop advances the sim (wall-clock paced unless the stack said
+    FF/DTMULT) and pumps queued browser commands between chunks — the
+    web equivalent of the reference's Qt event loop around the sim
+    timer (``ui/qtgl/mainwindow.py``)."""
+    backend = SimBackend(sim)
+    ui = WebUI(backend, host=host, port=port, fps=fps).start()
+    print(f"bluesky_tpu web UI on http://{ui.host}:{ui.port}/")
+    if not run:
+        return ui
+    from ..simulation.sim import OP
+    try:
+        while True:
+            backend.pump()
+            if not sim.step():               # END
+                break
+            if sim.state_flag != OP:         # INIT/HOLD: idle politely
+                time.sleep(0.05)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        ui.stop()
+    return ui

@@ -1,0 +1,227 @@
+"""Pair-space structure analysis at the north-star geometry (CPU only).
+
+Quantifies, for N=100k continental (bench.py geometry):
+  * brute-force pair count (N^2)
+  * pairs surviving the current block-level reachability skip (256-blocks,
+    Morton sort) — what the Pallas full-grid kernel computes today
+  * pairs at sub-block (32) candidate granularity — what the mixed-mode
+    candidate scheduler computes
+  * the per-AIRCRAFT physics floor: pairs within
+    rpz + tlookahead * (gs_i + gs_j)  (the exact conservative bound)
+  * per-row-block candidate counts (distribution) to size capacities.
+
+Pure NumPy on host — no TPU, no jit.  Run: python scripts/analyze_pairspace.py [N]
+"""
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+NM = 1852.0
+RPZ = 5 * NM
+TLOOK = 300.0
+
+
+def make_geometry(n, geometry="continental", seed=0):
+    rng = np.random.default_rng(seed)
+    if geometry == "global":
+        lat = np.degrees(np.arcsin(rng.uniform(-0.94, 0.94, n)))
+        lon = rng.uniform(-180.0, 180.0, n)
+    elif geometry == "continental":
+        lat = rng.uniform(35.0, 60.0, n)
+        lon = rng.uniform(-10.0, 30.0, n)
+    else:
+        ang = rng.uniform(0, 2 * np.pi, n)
+        r = 3.8 * np.sqrt(rng.random(n))
+        lat = 52.6 + r * np.cos(ang)
+        lon = 5.4 + r * np.sin(ang) / 0.6
+    # TAS 130-240 like bench -> gs the same (no wind)
+    gs = rng.uniform(130.0, 240.0, n)
+    return lat, lon, gs
+
+
+def morton_perm(lat, lon):
+    qlat = np.clip((lat + 90.0) / 180.0 * 32767.0, 0, 32767).astype(np.uint64)
+    qlon = np.clip((lon + 180.0) / 360.0 * 32767.0, 0, 32767).astype(np.uint64)
+
+    def spread(x):
+        x = (x | (x << 8)) & 0x00FF00FF
+        x = (x | (x << 4)) & 0x0F0F0F0F
+        x = (x | (x << 2)) & 0x33333333
+        x = (x | (x << 1)) & 0x55555555
+        return x
+
+    return np.argsort(spread(qlat) | (spread(qlon) << 1), kind="stable")
+
+
+def stripe_perm(lat, lon, stripe_deg):
+    """Lat-stripe-major, lon-within-stripe ordering."""
+    s = np.floor((lat - lat.min()) / stripe_deg).astype(np.int64)
+    return np.lexsort((lon, s)), s
+
+
+def box_gap_m(latmin_r, latmax_r, lonmin_r, lonmax_r,
+              latmin_c, latmax_c, lonmin_c, lonmax_c):
+    """Conservative box-to-box distance lower bound (same family as
+    cd_tiled.block_reachability)."""
+    dlat = np.maximum(0.0, np.maximum(latmin_r[:, None] - latmax_c[None, :],
+                                      latmin_c[None, :] - latmax_r[:, None]))
+    dlon = np.maximum(0.0, np.maximum(lonmin_r[:, None] - lonmax_c[None, :],
+                                      lonmin_c[None, :] - lonmax_r[:, None]))
+    maxabs = np.maximum(
+        np.maximum(np.abs(latmin_r), np.abs(latmax_r))[:, None],
+        np.maximum(np.abs(latmin_c), np.abs(latmax_c))[None, :])
+    cos_lb = np.cos(np.radians(np.minimum(90.0, maxabs)))
+    zonal = 2 * 6335000.0 * np.arcsin(
+        np.clip(cos_lb * np.sin(np.radians(0.5 * np.minimum(dlon, 360.0))),
+                0, 1))
+    return np.maximum(dlat * 110000.0, zonal)
+
+
+def block_boxes(lat, lon, gs, block):
+    n = len(lat)
+    nb = -(-n // block)
+    npad = nb * block - n
+    pad = lambda a, v: np.concatenate([a, np.full(npad, v)])
+    sh = (nb, block)
+    blat = pad(lat, np.nan).reshape(sh)
+    blon = pad(lon, np.nan).reshape(sh)
+    bgs = pad(gs, 0.0).reshape(sh)
+    return (np.nanmin(blat, 1), np.nanmax(blat, 1),
+            np.nanmin(blon, 1), np.nanmax(blon, 1), np.nanmax(bgs, 1), nb)
+
+
+def physics_floor(lat, lon, gs, sample=4000, seed=1):
+    """Per-aircraft conservative candidate count, estimated on a sample."""
+    n = len(lat)
+    rng = np.random.default_rng(seed)
+    idx = rng.choice(n, size=min(sample, n), replace=False)
+    # local-ENU approximate distances (fine at continental scale for stats)
+    counts = np.empty(len(idx))
+    for k, i in enumerate(idx):
+        dy = (lat - lat[i]) * 111000.0
+        dx = (lon - lon[i]) * 111000.0 * np.cos(np.radians(lat[i]))
+        d = np.hypot(dx, dy)
+        thresh = RPZ + TLOOK * (gs + gs[i])
+        counts[k] = np.sum(d <= thresh) - 1
+    return counts
+
+
+def main(n=100_000, geometry="continental"):
+    lat, lon, gs = make_geometry(n, geometry)
+    print(f"N={n} {geometry}: brute pairs {n*n:.3e}")
+
+    counts = physics_floor(lat, lon, gs)
+    floor = counts.mean() * n
+    print(f"physics floor (exact conservative bound): "
+          f"mean cand/ac {counts.mean():.0f} p99 {np.percentile(counts,99):.0f}"
+          f" -> total pairs {floor:.3e}  ({n*n/floor:.0f}x below brute)")
+
+    for block in (256, 128):
+        p = morton_perm(lat, lon)
+        la, lo, g = lat[p], lon[p], gs[p]
+        lmn, lmx, omn, omx, gmx, nb = block_boxes(la, lo, g, block)
+        gap = box_gap_m(lmn, lmx, omn, omx, lmn, lmx, omn, omx)
+        thresh = RPZ + TLOOK * (gmx[:, None] + gmx[None, :])
+        reach = gap <= thresh * 1.05
+        pairs = reach.sum() * block * block
+        print(f"Morton block={block}: {nb} blocks, reach frac "
+              f"{reach.mean():.3f}, pairs {pairs:.3e} "
+              f"({pairs/floor:.1f}x floor)")
+
+        # sub-block candidate granularity (mixed-mode scheduler)
+        for sub in (32,):
+            smn, smx, son, sox, sgx, nsb = block_boxes(la, lo, g, sub)
+            gap2 = box_gap_m(lmn, lmx, omn, omx, smn, smx, son, sox)
+            th2 = RPZ + TLOOK * (gmx[:, None] + sgx[None, :])
+            m = gap2 <= th2 * 1.05
+            cand = m.sum(1) * sub          # candidate AC per row block
+            pairs2 = (cand * block).sum()
+            print(f"  Morton cand sub={sub}: mean cand/blk {cand.mean():.0f} "
+                  f"p99 {np.percentile(cand,99):.0f} max {cand.max()} "
+                  f"pairs {pairs2:.3e} ({pairs2/floor:.1f}x floor)")
+
+    # Stripe sort: stripes ~ reach radius tall; lon-sorted within
+    for stripe_deg in (1.5, 2.0):
+        for block in (256, 128):
+            p, s = stripe_perm(lat, lon, stripe_deg)
+            la, lo, g = lat[p], lon[p], gs[p]
+            lmn, lmx, omn, omx, gmx, nb = block_boxes(la, lo, g, block)
+            for sub in (32,):
+                smn, smx, son, sox, sgx, nsb = block_boxes(la, lo, g, sub)
+                gap2 = box_gap_m(lmn, lmx, omn, omx, smn, smx, son, sox)
+                th2 = RPZ + TLOOK * (gmx[:, None] + sgx[None, :])
+                m = gap2 <= th2 * 1.05
+                cand = m.sum(1) * sub
+                pairs2 = (cand * block).sum()
+                # contiguity: how many contiguous runs of candidate
+                # sub-blocks per row (DMA-friendliness)
+                runs = np.array([
+                    int(np.sum(np.diff(np.flatnonzero(r)) > 1) + 1)
+                    if r.any() else 0 for r in m])
+                print(f"stripe={stripe_deg} block={block} sub={sub}: "
+                      f"mean cand/blk {cand.mean():.0f} "
+                      f"p99 {np.percentile(cand,99):.0f} max {cand.max()} "
+                      f"pairs {pairs2:.3e} ({pairs2/floor:.1f}x floor) "
+                      f"runs mean {runs.mean():.1f} max {runs.max()}")
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    geom = sys.argv[2] if len(sys.argv) > 2 else "continental"
+    main(n, geom)
+
+
+def runs_analysis(n=100_000, geometry="continental"):
+    """Block-granular reachability runs: how many contiguous (start,len)
+    segments per row block, and the padded pair count after capping the
+    segment count by gap-merging (merging only ADDS tiles - stays exact)."""
+    lat, lon, gs = make_geometry(n, geometry)
+    for name in ("morton", "stripe1.5"):
+        if name == "morton":
+            p = morton_perm(lat, lon)
+        else:
+            p, _ = stripe_perm(lat, lon, 1.5)
+        la, lo, g = lat[p], lon[p], gs[p]
+        for block in (256, 128):
+            lmn, lmx, omn, omx, gmx, nb = block_boxes(la, lo, g, block)
+            gap = box_gap_m(lmn, lmx, omn, omx, lmn, lmx, omn, omx)
+            thresh = RPZ + TLOOK * (gmx[:, None] + gmx[None, :])
+            reach = gap <= thresh * 1.05
+            nruns, merged_pairs = [], {}
+            for cap in (4, 6, 8):
+                merged_pairs[cap] = 0
+            widths = []
+            for i in range(nb):
+                r = reach[i]
+                j = np.flatnonzero(r)
+                if len(j) == 0:
+                    nruns.append(0)
+                    continue
+                # contiguous runs
+                splits = np.flatnonzero(np.diff(j) > 1)
+                starts = np.concatenate([[j[0]], j[splits + 1]])
+                ends = np.concatenate([j[splits], [j[-1]]])  # inclusive
+                nruns.append(len(starts))
+                widths.append((ends - starts + 1).max())
+                for cap in (4, 6, 8):
+                    s, e = list(starts), list(ends)
+                    while len(s) > cap:
+                        gaps = np.array(s[1:]) - np.array(e[:-1])
+                        k = int(np.argmin(gaps))
+                        e[k] = e[k + 1]
+                        del s[k + 1], e[k + 1]
+                    merged_pairs[cap] += sum(
+                        (ee - ss + 1) for ss, ee in zip(s, e)) * block * block
+            nruns = np.array(nruns)
+            print(f"{name} block={block}: runs mean {nruns.mean():.1f} "
+                  f"p99 {np.percentile(nruns,99):.0f} max {nruns.max()}; "
+                  f"max single-run width {max(widths)}; "
+                  + " ".join(f"cap{c}: {merged_pairs[c]:.3e}"
+                             for c in (4, 6, 8)))
+
+
+if __name__ == "__main__" and "--runs" in sys.argv:
+    runs_analysis(int(sys.argv[1]) if sys.argv[1:2] and
+                  sys.argv[1].isdigit() else 100_000)

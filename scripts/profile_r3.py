@@ -1,0 +1,134 @@
+"""Round-3 TPU profiling: where does the 100k-continental wall time go?
+
+Measures, strictly serially on the one real chip (axon tunnel rules:
+amortize the ~80 ms dispatch latency, keep every device program well
+under the ~1 min watchdog):
+
+  1. CD sweep (pallas, current):     per-sweep ms
+  2. CD program-overhead probe:      same kernel, all aircraft inactive
+     (every tile skips by any(pairmask) -> time = grid+DMA overhead only)
+  3. Full pipeline (current bench):  ms/step
+  4. Pipeline, ASAS off:             ms/step (FMS+kinematics+perf)
+  5. Pipeline, ASAS+FMS off:         ms/step (kinematics+perf only)
+  6. spatial_permutation:            ms (the cached Morton argsort)
+  7. MVP resolve_from_sums + partner bookkeeping: ms (the ASAS tail)
+
+Run: python scripts/profile_r3.py   (on the TPU host, nothing else running)
+"""
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, reps=5, warmup=2):
+    for _ in range(warmup):
+        jax.block_until_ready(fn())
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def main():
+    from bench import _make_traffic
+    from bluesky_tpu.core.step import SimConfig, run_steps
+    from bluesky_tpu.ops import cd_pallas, cr_mvp, cd_tiled
+
+    n = 100_000
+    print(f"backend: {jax.default_backend()}, N={n} continental")
+    traf = _make_traffic(n, "continental", False, jnp.float32)
+    ac = traf.state.ac
+    asas = traf.state.asas
+    NMm, FT = 1852.0, 0.3048
+    mcfg = cr_mvp.MVPConfig(rpz_m=5 * NMm * 1.05, hpz_m=1000 * FT * 1.05,
+                            tlookahead=300.0)
+
+    # --- 1. CD sweep, current kernel (includes the cached-perm sort path
+    # as used in-step?  No: raw kernel, fresh perm each call is how the
+    # bench cd_pairs_per_s measures; time both with and without perm).
+    perm = cd_tiled.spatial_permutation(ac.lat, ac.lon, ac.active)
+    perm = jax.block_until_ready(perm.astype(jnp.int32))
+    args = (ac.lat, ac.lon, ac.trk, ac.gs, ac.alt, ac.vs,
+            ac.gseast, ac.gsnorth, ac.active, asas.noreso)
+
+    cd_cached = jax.jit(lambda: cd_pallas.detect_resolve_pallas(
+        *args, 5 * NMm, 1000 * FT, 300.0, mcfg, perm=perm).inconf)
+    t = timeit(cd_cached)
+    print(f"1. CD sweep (pallas, cached perm): {t*1e3:.1f} ms")
+
+    # --- 2. overhead probe: all-inactive fleet, same shapes
+    inact = jnp.zeros_like(ac.active)
+    cd_dead = jax.jit(lambda: cd_pallas.detect_resolve_pallas(
+        ac.lat, ac.lon, ac.trk, ac.gs, ac.alt, ac.vs,
+        ac.gseast, ac.gsnorth, inact, asas.noreso,
+        5 * NMm, 1000 * FT, 300.0, mcfg, perm=perm).inconf)
+    t = timeit(cd_dead)
+    print(f"2. CD all-inactive (pure grid+DMA overhead): {t*1e3:.1f} ms")
+
+    # 2b. no-prefilter variant: every tile computed -> pair cost slope
+    cd_nopf = jax.jit(lambda: cd_pallas.detect_resolve_pallas(
+        *args, 5 * NMm, 1000 * FT, 300.0, mcfg, perm=perm,
+        spatial_sort=False).inconf)
+    t_nopf = timeit(cd_nopf, reps=2, warmup=1)
+    print(f"2b. CD unsorted slots (reach skip ~useless): {t_nopf*1e3:.1f} ms")
+
+    # --- 3-5. pipeline splits (100 steps per chunk, 3 reps)
+    nsteps = 100
+
+    def run(cfg):
+        tr = _make_traffic(n, "continental", False, jnp.float32)
+        st = run_steps(tr.state, cfg, nsteps)      # compile+warm
+        jax.block_until_ready(st)
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            st = run_steps(st, cfg, nsteps)
+            jax.block_until_ready(st)
+            ts.append(time.perf_counter() - t0)
+        return min(ts) / nsteps
+
+    t3 = run(SimConfig(cd_backend="pallas"))
+    print(f"3. full pipeline: {t3*1e3:.2f} ms/step "
+          f"({0.05/t3:.1f}x realtime)")
+    from bluesky_tpu.core.asas import AsasConfig
+    t4 = run(SimConfig(cd_backend="pallas", asas=AsasConfig(swasas=False)))
+    print(f"4. ASAS off: {t4*1e3:.2f} ms/step")
+    t5 = run(SimConfig(cd_backend="pallas", asas=AsasConfig(swasas=False),
+                       fms_dt=1e9))
+    print(f"5. ASAS+FMS off: {t5*1e3:.2f} ms/step")
+
+    # --- 6. sort cost
+    sortfn = jax.jit(lambda la, lo, a: cd_tiled.spatial_permutation(la, lo, a))
+    t6 = timeit(lambda: sortfn(ac.lat, ac.lon, ac.active))
+    print(f"6. spatial_permutation (argsort 100k): {t6*1e3:.1f} ms")
+
+    # --- 7. ASAS tail: resolve_from_sums + partner ops on dummy data
+    rd = jax.block_until_ready(jax.jit(
+        lambda: cd_pallas.detect_resolve_pallas(
+            *args, 5 * NMm, 1000 * FT, 300.0, mcfg, perm=perm))())
+
+    def tail():
+        out = cr_mvp.resolve_from_sums(
+            rd.sum_dve, rd.sum_dvn, rd.sum_dvv, rd.tsolv,
+            ac.alt, ac.gseast, ac.gsnorth, ac.vs, ac.trk, ac.gs,
+            ac.selalt, traf.state.ap.vs, asas.alt,
+            100.0, 300.0, -15.0, 15.0, mcfg, resooff=asas.resooff)
+        keep = cd_tiled.partner_keep(
+            asas.partners, ac.lat, ac.lon, ac.gseast, ac.gsnorth,
+            ac.trk, ac.active, 5 * NMm, 5 * NMm * 1.05)
+        merged = cd_tiled.merge_partners(
+            cd_tiled.topk_partners(rd, 8), asas.partners, keep)
+        return out[0], merged
+    t7 = timeit(jax.jit(tail))
+    print(f"7. MVP tail + partner bookkeeping: {t7*1e3:.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,88 @@
+"""Live web frontend (ui/web.py): frames flow over the socket, commands
+round-trip, and the radar picture tracks the simulation."""
+import json
+import threading
+import time
+import urllib.request
+
+import jax.numpy as jnp
+import pytest
+
+from bluesky_tpu.simulation.sim import Simulation
+from bluesky_tpu.ui.web import SimBackend, WebUI
+
+
+@pytest.fixture()
+def served_sim():
+    sim = Simulation(nmax=16, dtype=jnp.float64)
+    backend = SimBackend(sim)
+    ui = WebUI(backend, port=0, fps=8.0).start()
+    stop = threading.Event()
+
+    def pumper():                 # stands in for the sim loop
+        while not stop.is_set():
+            backend.pump()
+            time.sleep(0.02)
+
+    t = threading.Thread(target=pumper, daemon=True)
+    t.start()
+    yield sim, ui
+    stop.set()
+    ui.stop()
+
+
+def _get(ui, path, timeout=10):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{ui.port}{path}", timeout=timeout) as r:
+        return r.read()
+
+
+def _post(ui, path, body, timeout=15):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{ui.port}{path}", data=body.encode(),
+        method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def test_page_and_frame(served_sim):
+    sim, ui = served_sim
+    page = _get(ui, "/").decode()
+    assert "EventSource" in page and "/cmd" in page
+    svg = _get(ui, "/frame.svg").decode()
+    assert svg.startswith("<svg")
+
+
+def test_command_roundtrip_and_frame_contents(served_sim):
+    sim, ui = served_sim
+    out = _post(ui, "/cmd", "CRE KL204 B744 52 4 90 FL200 250")
+    assert "Unknown" not in out
+    svg = _get(ui, "/frame.svg").decode()
+    assert "KL204" in svg
+    out = _post(ui, "/cmd", "POS KL204")
+    assert "KL204" in out
+
+
+def test_sse_frames_flow(served_sim):
+    sim, ui = served_sim
+    _post(ui, "/cmd", "CRE SSE1 B744 52 4 90 FL200 250")
+    req = urllib.request.urlopen(
+        f"http://127.0.0.1:{ui.port}/events", timeout=10)
+    frames = []
+    buf = b""
+    t0 = time.time()
+    while len(frames) < 2 and time.time() - t0 < 10:
+        chunk = req.read1(65536)
+        if not chunk:
+            break
+        buf += chunk
+        while b"\n\n" in buf:
+            raw, buf = buf.split(b"\n\n", 1)
+            if raw.startswith(b"data: "):
+                frames.append(json.loads(raw[6:]))
+    req.close()
+    assert len(frames) >= 2
+    for f in frames:
+        assert f["svg"].startswith("<svg")
+        assert "SSE1" in f["svg"]
+        assert "ntraf 1" in f["info"]
