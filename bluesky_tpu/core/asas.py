@@ -263,6 +263,23 @@ def update_tiled(state: SimState, cfg: AsasConfig, block: int = 512,
     # interval; staleness only loosens the windows.
     perm = asas.sort_perm
 
+    # Resolver mode: the blockwise kernels accumulate per-pair sums for
+    # MVP or Eby (both are additive row reductions — reference
+    # MVP.py:149-231, Eby.py:73-138); SWARM/SSD still need the dense
+    # matrices (core/step.py enforces).
+    reso_m = cfg.reso_method.upper()
+    kern_reso = "mvp"
+    if cfg.reso_on and reso_m == "EBY":
+        kern_reso = "eby"
+    elif cfg.reso_on and reso_m == "SWARM" and impl == "lax":
+        # Swarm = MVP sums + 7 neighbour sums; carried by the lax tiled
+        # backend (cd_tiled) — the Pallas kernels stay MVP/EBY-only.
+        kern_reso = "swarm"
+    elif cfg.reso_on and reso_m != "MVP":
+        raise ValueError(
+            f"Resolver {cfg.reso_method!r} is not available on the "
+            f"{impl!r} blockwise backend (MVP/EBY everywhere, SWARM on "
+            "'lax'; SSD needs the dense path).")
     if impl == "sparse":
         from ..ops import cd_sched
         block = min(block, 256)
@@ -273,20 +290,76 @@ def update_tiled(state: SimState, cfg: AsasConfig, block: int = 512,
             cfg.rpz, cfg.hpz, cfg.dtlookahead, mvpcfg, block=block,
             k_partners=asas.partners_s.shape[1], perm=perm,
             partners=asas.partners_s[:n_tot],
-            resume_rpz_m=cfg.rpz * cfg.resofach)
+            resume_rpz_m=cfg.rpz * cfg.resofach,
+            tas=ac.tas if kern_reso == "eby" else None, reso=kern_reso)
     else:
         if impl == "pallas":
             from ..ops import cd_pallas
             detect_fn = cd_pallas.detect_resolve_pallas
         else:
             detect_fn = cd_tiled.detect_resolve_tiled
-        rd = detect_fn(
+        extra = None
+        if kern_reso == "eby":
+            extra = {"tas": ac.tas}
+        elif kern_reso == "swarm":
+            extra = {"cas": ac.cas}
+        out = detect_fn(
             ac.lat, ac.lon, ac.trk, ac.gs, ac.alt, ac.vs,
             ac.gseast, ac.gsnorth, ac.active, asas.noreso,
             cfg.rpz, cfg.hpz, cfg.dtlookahead, mvpcfg, block=block,
-            k_partners=k, perm=perm)
+            k_partners=k, perm=perm, reso=kern_reso, extra_cols=extra)
+        swarm_sums = None
+        if kern_reso == "swarm":
+            rd, swarm_sums = out
+        else:
+            rd = out
 
-    if cfg.reso_on:
+    if cfg.reso_on and kern_reso == "swarm":
+        from ..ops import cr_swarm
+        # MVP collision-avoidance part from the accumulated MVP sums
+        # (the reference runs MVP first, Swarm.py:68), then the blend
+        # with the neighbour sums; mvp_active is the PREVIOUS interval's
+        # engagement flags, like the dense path (Swarm.py:70-73).
+        m_trk, m_gs, m_vs, _m_alt, _e, _n = cr_mvp.resolve_from_sums(
+            rd.sum_dve, rd.sum_dvn, rd.sum_dvv, rd.tsolv,
+            ac.alt, ac.gseast, ac.gsnorth, ac.vs, ac.trk, ac.gs,
+            ac.selalt, state.ap.vs, asas.alt,
+            cfg.vmin, cfg.vmax, cfg.vsmin, cfg.vsmax, mvpcfg,
+            resooff=asas.resooff)
+        _, selcas, _ = aero.vcasormach(ac.selspd, ac.alt)
+        newtrk, newgs, newvs, newalt = cr_swarm.resolve_from_sums(
+            *swarm_sums, ac.alt, ac.trk, ac.cas, ac.vs,
+            ac.gseast, ac.gsnorth, ac.active,
+            m_trk, m_gs, m_vs, asas.active,
+            state.ap.trk, selcas, ac.selvs, cfg.vmin, cfg.vmax)
+        asase = newgs * jnp.sin(jnp.radians(newtrk))
+        asasn = newgs * jnp.cos(jnp.radians(newtrk))
+        # the whole swarm updates once any conflict exists (Swarm
+        # semantics, see core/asas.update)
+        upd = ac.active & (rd.nconf > 0)
+        asas = asas.replace(
+            trk=jnp.where(upd, newtrk, asas.trk),
+            tas=jnp.where(upd, newgs, asas.tas),
+            vs=jnp.where(upd, newvs, asas.vs),
+            alt=jnp.where(upd, newalt, asas.alt),
+            asase=jnp.where(upd, asase, asas.asase),
+            asasn=jnp.where(upd, asasn, asas.asasn))
+    elif cfg.reso_on and reso_m == "EBY":
+        from ..ops import cr_eby
+        newtrk, newgs, newvs, newalt = cr_eby.resolve_from_sums(
+            rd.sum_dve, rd.sum_dvn, rd.sum_dvv,
+            ac.alt, ac.vs, ac.trk, ac.tas, cfg.vmin, cfg.vmax)
+        asase = newgs * jnp.sin(jnp.radians(newtrk))
+        asasn = newgs * jnp.cos(jnp.radians(newtrk))
+        upd = rd.inconf
+        asas = asas.replace(
+            trk=jnp.where(upd, newtrk, asas.trk),
+            tas=jnp.where(upd, newgs, asas.tas),
+            vs=jnp.where(upd, newvs, asas.vs),
+            alt=jnp.where(upd, newalt, asas.alt),
+            asase=jnp.where(upd, asase, asas.asase),
+            asasn=jnp.where(upd, asasn, asas.asasn))
+    elif cfg.reso_on:
         newtrk, newgs, newvs, newalt, asase, asasn = cr_mvp.resolve_from_sums(
             rd.sum_dve, rd.sum_dvn, rd.sum_dvv, rd.tsolv,
             ac.alt, ac.gseast, ac.gsnorth, ac.vs, ac.trk, ac.gs,
@@ -334,9 +407,14 @@ def update_tiled(state: SimState, cfg: AsasConfig, block: int = 512,
                                      prune(asas.partners))
     partners = jnp.where(prune(merged), merged, -1)
 
+    act_tbl = jnp.any(partners >= 0, axis=1)
+    if cfg.reso_on and kern_reso == "swarm":
+        # Whole swarm follows ASAS once any conflict triggered a resolve
+        # (asas.py:487 gate + Swarm.py:101-102 active.fill(True))
+        act_tbl = jnp.where(rd.nconf > 0, ac.active, act_tbl)
     asas = asas.replace(
         partners=partners,
-        active=jnp.any(partners >= 0, axis=1) & cfg.reso_on,
+        active=act_tbl & cfg.reso_on,
         inconf=rd.inconf,
         tcpamax=rd.tcpamax,
         nconf_cur=rd.nconf,

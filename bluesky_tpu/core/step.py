@@ -84,12 +84,16 @@ def step(state: SimState, cfg: SimConfig) -> SimState:
                 "resopairs) but SimConfig.cd_backend is "
                 f"'{cfg.cd_backend}'. Use SimConfig(cd_backend='tiled') or "
                 "allocate Traffic(pair_matrix=True).")
-        if cfg.cd_backend != "dense" and cfg.asas.reso_on \
-                and cfg.asas.reso_method.upper() != "MVP":
-            raise ValueError(
-                f"Resolver {cfg.asas.reso_method} needs the dense [N,N] "
-                "backend; the tiled/pallas large-N path carries only the "
-                "MVP pair sums. Use RESO MVP or cd_backend='dense'.")
+        if cfg.cd_backend != "dense" and cfg.asas.reso_on:
+            rm = cfg.asas.reso_method.upper()
+            allowed = ("MVP", "EBY", "SWARM") \
+                if cfg.cd_backend == "tiled" else ("MVP", "EBY")
+            if rm not in allowed:
+                raise ValueError(
+                    f"Resolver {cfg.asas.reso_method} is not available on "
+                    f"cd_backend='{cfg.cd_backend}' (large-N paths carry "
+                    "the MVP/Eby pair sums; SWARM additionally needs the "
+                    "lax 'tiled' backend; SSD needs 'dense').")
         asas_due = simt >= state.asas_tnext
 
         def run_asas(s):

@@ -42,12 +42,13 @@ from . import cd_tiled, cr_mvp
 from .cd_tiled import RowConflictData, TRIG_FIELDS, block_reachability, \
     precompute_trig, tile_geometry
 
-# Packed state row order for the [nb, 14, block] slabs: 7 trig/geometry
-# columns (cd_tiled.TRIG_FIELDS), 4 velocity/altitude columns, the track
-# angle (for the resume-nav "bouncing" predicate), then the active and
-# noreso masks.
+# Packed state row order for the [nb, 16, block] slabs: 6 trig/geometry
+# columns (cd_tiled.TRIG_FIELDS), the gs velocity components + altitude
+# columns, the track angle (resume-nav "bouncing" predicate), the
+# tas/gs ratio (Eby builds its velocity from TAS: ve = tr*u), then the
+# active and noreso masks.
 _FIELDS = TRIG_FIELDS + ("u", "v", "alt", "vs", "gse", "gsn", "trk",
-                         "active", "noreso")
+                         "tr", "active", "noreso")
 _NF = len(_FIELDS)
 _IDX = {k: i for i, k in enumerate(_FIELDS)}
 _BIG = 1e9
@@ -71,7 +72,7 @@ def _kernel(reach_ref, own_ref, intr_ref,
             inconf_ref, tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref,
             tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref, cidx_ref,
             *, block, kk, cpp, rpz, hpz, tlookahead, mvpcfg,
-            same_hemi=False):
+            same_hemi=False, reso="mvp"):
     ib = pl.program_id(0)
     jp = pl.program_id(1)      # program handles cpp column tiles
 
@@ -103,14 +104,14 @@ def _kernel(reach_ref, own_ref, intr_ref,
                        tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref,
                        cidx_ref, block=block, kk=kk, rpz=rpz, hpz=hpz,
                        tlookahead=tlookahead, mvpcfg=mvpcfg,
-                       same_hemi=same_hemi)
+                       same_hemi=same_hemi, reso=reso)
 
 
 def _tile_body(ib, jb, ksub, own_ref, intr_ref,
                inconf_ref, tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref,
                tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref, cidx_ref,
                *, block, kk, rpz, hpz, tlookahead, mvpcfg,
-               same_hemi=False, resume_refs=None, rpz_m=None):
+               same_hemi=False, resume_refs=None, rpz_m=None, reso="mvp"):
     oslab = own_ref[0]                                    # (_NF, block)
     islab_t = intr_ref[ksub].T                            # (block, _NF): ONE
     # lane->sublane relayout shared by all intruder columns
@@ -139,14 +140,14 @@ def _tile_body(ib, jb, ksub, own_ref, intr_ref,
                     lcnt_ref, ctin_ref, cidx_ref, kk=kk, rpz=rpz, hpz=hpz,
                     tlookahead=tlookahead, mvpcfg=mvpcfg,
                     same_hemi=same_hemi, jb=jb, resume_refs=resume_refs,
-                    rpz_m=rpz_m)
+                    rpz_m=rpz_m, reso=reso)
 
 
 def _tile_pairs(pairmask, gid_int, own, intr,
                 inconf_ref, tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref,
                 tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref, cidx_ref,
                 *, kk, rpz, hpz, tlookahead, mvpcfg, same_hemi=False,
-                jb=None, resume_refs=None, rpz_m=None):
+                jb=None, resume_refs=None, rpz_m=None, reso="mvp"):
     block = pairmask.shape[1]
     excl = jnp.where(pairmask, 0.0, _BIG)
 
@@ -198,12 +199,25 @@ def _tile_pairs(pairmask, gid_int, own, intr,
     # single any-hit flag cuts the common tile to the core CPA geometry.
     @pl.when(jnp.any(swconfl | swlos))
     def _accumulate():
-        dve_p, dvn_p, dvv_p, tsolv_p = cr_mvp.pair_contrib_trig(
-            sinqdr, cosqdr, dist, tcpa, tinconf,
-            intr("alt") - own("alt"), intr("gse") - own("gse"),
-            intr("gsn") - own("gsn"), intr("vs") - own("vs"), mvpcfg)
-        nor_i = intr("noreso") > 0.5
-        mvpmask = swconfl & ~nor_i
+        if reso == "eby":
+            # Eby pair displacement (cr_eby.pair_contrib — same code as
+            # the dense matrix path) built on TAS velocities via the
+            # per-aircraft tas/gs ratio column: ve = tr*u.
+            from . import cr_eby
+            dve_p, dvn_p, dvv_p = cr_eby.pair_contrib(
+                dx, dy, intr("alt") - own("alt"),
+                intr("tr") * intr("u") - own("tr") * own("u"),
+                intr("tr") * intr("v") - own("tr") * own("v"),
+                intr("vs") - own("vs"), mvpcfg.rpz_m)
+            tsolv_p = jnp.full_like(dve_p, _BIG)
+            mvpmask = swconfl           # Eby has no noreso handling
+        else:
+            dve_p, dvn_p, dvv_p, tsolv_p = cr_mvp.pair_contrib_trig(
+                sinqdr, cosqdr, dist, tcpa, tinconf,
+                intr("alt") - own("alt"), intr("gse") - own("gse"),
+                intr("gsn") - own("gsn"), intr("vs") - own("vs"), mvpcfg)
+            nor_i = intr("noreso") > 0.5
+            mvpmask = swconfl & ~nor_i
         maskf = mvpmask.astype(dist.dtype)
 
         conff = swconfl.astype(dist.dtype)
@@ -375,7 +389,7 @@ def _kernel_resume(reach_ref, own_ref, intr_ref, pold_ref,
                    tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref, cidx_ref,
                    keep_ref, pnew_ref, pact_ref,
                    *, block, kk, cpp, rpz, hpz, tlookahead, mvpcfg,
-                   rpz_m, same_hemi=False):
+                   rpz_m, same_hemi=False, reso="mvp"):
     """Full-grid kernel with in-kernel resume-nav (the sparse scheduler's
     overflow fallback): same tile sweep as ``_kernel`` plus the keep
     evaluation per visited tile and the partner merge on the last
@@ -401,7 +415,8 @@ def _kernel_resume(reach_ref, own_ref, intr_ref, pold_ref,
                        cidx_ref, block=block, kk=kk, rpz=rpz, hpz=hpz,
                        tlookahead=tlookahead, mvpcfg=mvpcfg,
                        same_hemi=same_hemi,
-                       resume_refs=(pold_ref, keep_ref), rpz_m=rpz_m)
+                       resume_refs=(pold_ref, keep_ref), rpz_m=rpz_m,
+                       reso=reso)
 
     @pl.when(jp == pl.num_programs(1) - 1)
     def _finish():
@@ -412,7 +427,7 @@ def _kernel_resume(reach_ref, own_ref, intr_ref, pold_ref,
 def _kernel_cand(own_ref, cand_ref, cgid_ref,
                  inconf_ref, tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref,
                  tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref, cidx_ref,
-                 *, block, kk, rpz, hpz, tlookahead, mvpcfg):
+                 *, block, kk, rpz, hpz, tlookahead, mvpcfg, reso="mvp"):
     """Candidate-list variant: ownship block i vs its GATHERED candidate
     aircraft (sub-chunk j of the per-block candidate table).
 
@@ -454,7 +469,7 @@ def _kernel_cand(own_ref, cand_ref, cgid_ref,
         _tile_pairs(pairmask, gid_int, own, intr, inconf_ref, tcpamax_ref,
                     sdve_ref, sdvn_ref, sdvv_ref, tsolv_ref, ncnt_ref,
                     lcnt_ref, ctin_ref, cidx_ref, kk=kk, rpz=rpz, hpz=hpz,
-                    tlookahead=tlookahead, mvpcfg=mvpcfg)
+                    tlookahead=tlookahead, mvpcfg=mvpcfg, reso=reso)
 
 
 def _build_candidates(lat, lon, gs, active, nb, block, c_cap, rpz,
@@ -613,7 +628,8 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                           active, noreso, rpz, hpz, tlookahead, mvpcfg,
                           block=256, k_partners=8, interpret=False,
                           spatial_sort=True, cols_per_prog=4,
-                          cand_cap=0, perm=None):
+                          cand_cap=0, perm=None, extra_cols=None,
+                          reso="mvp"):
     """Pallas-backed equivalent of ``cd_tiled.detect_resolve_tiled``.
 
     Returns a ``RowConflictData``; reductions match the lax formulation to
@@ -638,9 +654,9 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                               k_partners=k_partners, interpret=interpret,
                               spatial_sort=False,
                               cols_per_prog=cols_per_prog,
-                              cand_cap=cand_cap),
+                              cand_cap=cand_cap, reso=reso),
             lat, lon, trk, gs, alt, vs, gseast, gsnorth, active, noreso,
-            rpz, hpz, tlookahead, mvpcfg, perm=perm)
+            rpz, hpz, tlookahead, mvpcfg, perm=perm, extra_cols=extra_cols)
     dtype = jnp.float32
     # Scoped-VMEM budget: the tile temporaries exceed the 16 MiB stack
     # limit above block=256 on v5e (measured 18-21 MiB at block=512).
@@ -664,6 +680,12 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         "v": pad(gs.astype(dtype) * jnp.cos(trkrad)),
         "alt": pad(alt), "vs": pad(vs), "gse": pad(gseast),
         "gsn": pad(gsnorth), "trk": pad(trk),
+        # tas/gs ratio: Eby's velocity basis (ve = tr*u = tas*sin(trk));
+        # 1.0 when no tas given (MVP never reads it; no-wind tas == gs)
+        "tr": pad(jnp.ones_like(gs.astype(dtype))
+                  if not extra_cols or "tas" not in extra_cols
+                  else extra_cols["tas"].astype(dtype)
+                  / jnp.maximum(gs.astype(dtype), 1e-6)),
         "active": pad(active.astype(dtype)),
         "noreso": pad(noreso.astype(dtype)),
     })
@@ -678,7 +700,7 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
 
     kk = k_partners
     kern_kw = dict(block=block, kk=kk, rpz=float(rpz), hpz=float(hpz),
-                   tlookahead=float(tlookahead), mvpcfg=mvpcfg)
+                   tlookahead=float(tlookahead), mvpcfg=mvpcfg, reso=reso)
 
     acc = lambda m: [jax.ShapeDtypeStruct((m, 1, block), dtype)] * 8 + [
         jax.ShapeDtypeStruct((m, kk, block), dtype),       # ctin

@@ -198,7 +198,7 @@ def build_windows(reach, s_cap, wmax, pad_start):
 
 def _sched_kernel(st_ref, ln_ref, own_ref, *rest,
                   block, kk, s_cap, wmax, rpz, hpz, tlookahead, mvpcfg,
-                  same_hemi=False, rpz_m=None):
+                  same_hemi=False, rpz_m=None, reso="mvp"):
     resume = rpz_m is not None
     intr_refs = rest[:s_cap]
     rest = rest[s_cap:]
@@ -254,7 +254,7 @@ def _sched_kernel(st_ref, ln_ref, own_ref, *rest,
                         kk=kk, rpz=rpz, hpz=hpz, tlookahead=tlookahead,
                         mvpcfg=mvpcfg, same_hemi=same_hemi, jb=jb,
                         resume_refs=(pold_ref, keep_ref) if resume
-                        else None, rpz_m=rpz_m)
+                        else None, rpz_m=rpz_m, reso=reso)
                 return 0
 
             jax.lax.fori_loop(0, jnp.minimum(ln, wmax), body, 0)
@@ -271,7 +271,8 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                          active, noreso, rpz, hpz, tlookahead, mvpcfg,
                          block=256, k_partners=8, s_cap=8, wmax=12,
                          extra_blocks=32, interpret=False, perm=None,
-                         cols_per_prog=4, partners=None, resume_rpz_m=None):
+                         cols_per_prog=4, partners=None, resume_rpz_m=None,
+                         tas=None, reso="mvp"):
     """Sparse-scheduled equivalent of ``cd_pallas.detect_resolve_pallas``.
 
     ``perm`` is the cached ``stripe_sort_dest`` destination table (NOT a
@@ -297,7 +298,8 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         return cd_pallas.detect_resolve_pallas(
             lat, lon, trk, gs, alt, vs, gseast, gsnorth, active, noreso,
             rpz, hpz, tlookahead, mvpcfg, block=block,
-            k_partners=k_partners, interpret=interpret)
+            k_partners=k_partners, interpret=interpret, reso=reso,
+            extra_cols=None if tas is None else {"tas": tas})
     resume = partners is not None
 
     thresh = reach_threshold_m(gs.astype(dtype), active,
@@ -313,6 +315,11 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
     cols = {
         "lat": lat, "lon": lon, "trk": trk, "gs": gs, "alt": alt,
         "vs": vs, "gse": gseast, "gsn": gsnorth,
+        # tas/gs ratio: Eby's velocity basis (ve = tr*u); 1.0 when no
+        # tas given (MVP never reads it)
+        "tr": (jnp.ones_like(gs.astype(dtype)) if tas is None
+               else tas.astype(dtype)
+               / jnp.maximum(gs.astype(dtype), 1e-6)),
         "active": active.astype(dtype), "noreso": noreso.astype(dtype),
     }
     padded = dict(zip(cols, scatter_padded(
@@ -324,7 +331,7 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         "u": padded["gs"] * jnp.sin(trkrad),
         "v": padded["gs"] * jnp.cos(trkrad),
         "alt": padded["alt"], "vs": padded["vs"],
-        "gse": padded["gse"], "gsn": padded["gsn"],
+        "gse": padded["gse"], "gsn": padded["gsn"], "tr": padded["tr"],
         "active": padded["active"], "noreso": padded["noreso"],
     })
     fields["trk"] = padded["trk"]
@@ -386,7 +393,7 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
             _sched_kernel, block=block, kk=kk, s_cap=s_cap, wmax=wmax,
             rpz=float(rpz), hpz=float(hpz), tlookahead=float(tlookahead),
             mvpcfg=mvpcfg, same_hemi=same_hemi,
-            rpz_m=float(resume_rpz_m) if resume else None)
+            rpz_m=float(resume_rpz_m) if resume else None, reso=reso)
         in_specs = [own_spec] + [intr_specs[s] for s in range(s_cap)]
         out_specs = [acc_spec() for _ in range(8)] \
             + [cand_spec(), cand_spec()]
@@ -411,7 +418,7 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         # the row-restricted reachability, merged row-disjointly.
         kern_kw = dict(block=block, kk=kk, rpz=float(rpz), hpz=float(hpz),
                        tlookahead=float(tlookahead), mvpcfg=mvpcfg,
-                       same_hemi=same_hemi)
+                       same_hemi=same_hemi, reso=reso)
 
         def fallback(rf):
             return cd_pallas.full_grid_pass(

@@ -225,7 +225,8 @@ def spatial_permutation(lat, lon, active):
 
 
 def run_spatially_sorted(kernel, lat, lon, trk, gs, alt, vs, gseast,
-                         gsnorth, active, noreso, *args, perm=None, **kw):
+                         gsnorth, active, noreso, *args, perm=None,
+                         extra_cols=None, **kw):
     """Run a tiled CD&R kernel in Morton-sorted slot space and map the
     results back to the caller's slot order.
 
@@ -247,19 +248,27 @@ def run_spatially_sorted(kernel, lat, lon, trk, gs, alt, vs, gseast,
     inv = jnp.zeros_like(perm).at[perm].set(
         jnp.arange(perm.shape[0], dtype=perm.dtype))
     g = lambda a: a[perm]
+    if extra_cols:
+        kw = dict(kw, extra_cols={k: g(v) for k, v in extra_cols.items()})
     rd = kernel(g(lat), g(lon), g(trk), g(gs), g(alt), g(vs),
                 g(gseast), g(gsnorth), g(active), g(noreso),
                 *args, **kw)
+    extra = None
+    if not isinstance(rd, RowConflictData):    # (rd, swarm_sums) pair
+        rd, extra = rd
     back = lambda a: a[inv]
     topk_idx = jnp.where(
         rd.topk_idx >= 0,
         perm[jnp.maximum(rd.topk_idx, 0)].astype(jnp.int32), -1)
-    return RowConflictData(
+    rd = RowConflictData(
         inconf=back(rd.inconf), tcpamax=back(rd.tcpamax),
         sum_dve=back(rd.sum_dve), sum_dvn=back(rd.sum_dvn),
         sum_dvv=back(rd.sum_dvv), tsolv=back(rd.tsolv),
         nconf=rd.nconf, nlos=rd.nlos,
         topk_idx=back(topk_idx), topk_tin=back(rd.topk_tin))
+    if extra is not None:
+        return rd, tuple(back(a) for a in extra)
+    return rd
 
 
 def block_reachability(lat, lon, gs, active, nb, block, rpz, tlookahead,
@@ -348,7 +357,8 @@ def block_reachability(lat, lon, gs, active, nb, block, rpz, tlookahead,
 def detect_resolve_tiled(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                          active, noreso, rpz, hpz, tlookahead, mvpcfg,
                          block=512, k_partners=8, prefilter=True,
-                         spatial_sort=True, perm=None):
+                         spatial_sort=True, perm=None, extra_cols=None,
+                         reso="mvp"):
     """One fused pass over all aircraft pairs in [block, block] tiles.
 
     Args mirror ``ops.cd.detect`` plus the MVP inputs; ``mvpcfg`` is a
@@ -373,9 +383,9 @@ def detect_resolve_tiled(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         return run_spatially_sorted(
             functools.partial(detect_resolve_tiled, block=block,
                               k_partners=k_partners, prefilter=prefilter,
-                              spatial_sort=False),
+                              spatial_sort=False, reso=reso),
             lat, lon, trk, gs, alt, vs, gseast, gsnorth, active, noreso,
-            rpz, hpz, tlookahead, mvpcfg, perm=perm)
+            rpz, hpz, tlookahead, mvpcfg, perm=perm, extra_cols=extra_cols)
     block = min(block, max(n, 1))
     kk = min(k_partners, block)   # per-tile candidates merged into the top-K
     nb = -(-n // block)
@@ -401,6 +411,14 @@ def detect_resolve_tiled(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
     trkrad = jnp.radians(_pad1(trk, npad, 0.0))
     packed["u"] = _pad1(gs, npad, 0.0) * jnp.sin(trkrad)
     packed["v"] = _pad1(gs, npad, 0.0) * jnp.cos(trkrad)
+    # tas/gs ratio: Eby's TAS velocity basis (ve = tr*u); 1.0 when no
+    # tas column is supplied (MVP never reads it)
+    tas = (extra_cols or {}).get("tas")
+    packed["tr"] = _pad1(jnp.ones_like(gs) if tas is None
+                         else tas / jnp.maximum(gs, 1e-6), npad, 1.0)
+    if reso == "swarm":
+        packed["trk"] = _pad1(trk, npad, 0.0)
+        packed["cas"] = _pad1((extra_cols or {}).get("cas", gs), npad, 0.0)
     packed = {k: v.reshape(nb, block) for k, v in packed.items()}
     act_b = _pad1(active, npad, False).reshape(nb, block)
     nor_b = _pad1(noreso, npad, False).reshape(nb, block)
@@ -418,7 +436,7 @@ def detect_resolve_tiled(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
     def tile(ri, ci, rows_active, carry):
         """Compute one [block, block] tile and fold it into the row carry."""
         (inconf, tcpamax, sdve, sdvn, sdvv, tsolv, nconf, nlos,
-         topk_tin, topk_idx) = carry
+         topk_tin, topk_idx) = carry[:10]
         r = {k: v[ri] for k, v in packed.items()}
         c = {k: v[ci] for k, v in packed.items()}
         cols_active = act_b[ci]
@@ -471,16 +489,45 @@ def detect_resolve_tiled(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                    & (tinconf < tlookahead) & pairmask)
         swlos = (dist < rpz) & (jnp.abs(dalt) < hpz) & pairmask
 
-        # MVP pair contributions on the tile (shared core, MVP.py:149-231)
-        dve_p, dvn_p, dvv_p, tsolv_p = cr_mvp.pair_contrib_trig(
-            sinqdr, cosqdr, dist, tcpa, tinconf,
-            c["alt"][None, :] - r["alt"][:, None],
-            c["gse"][None, :] - r["gse"][:, None],
-            c["gsn"][None, :] - r["gsn"][:, None],
-            c["vs"][None, :] - r["vs"][:, None],
-            mvpcfg)
-        mvpmask = swconfl & ~cols_noreso[None, :]
+        if reso == "eby":
+            # Eby pair displacement (cr_eby.pair_contrib) on TAS
+            # velocities via the per-aircraft tas/gs ratio column.
+            from . import cr_eby
+            dve_p, dvn_p, dvv_p = cr_eby.pair_contrib(
+                dx, dy, c["alt"][None, :] - r["alt"][:, None],
+                (c["tr"] * c["u"])[None, :] - (r["tr"] * r["u"])[:, None],
+                (c["tr"] * c["v"])[None, :] - (r["tr"] * r["v"])[:, None],
+                c["vs"][None, :] - r["vs"][:, None], mvpcfg.rpz_m)
+            tsolv_p = jnp.full_like(dve_p, 1e9)
+            mvpmask = swconfl          # Eby has no noreso handling
+        else:
+            # MVP pair contributions (shared core, MVP.py:149-231)
+            dve_p, dvn_p, dvv_p, tsolv_p = cr_mvp.pair_contrib_trig(
+                sinqdr, cosqdr, dist, tcpa, tinconf,
+                c["alt"][None, :] - r["alt"][:, None],
+                c["gse"][None, :] - r["gse"][:, None],
+                c["gsn"][None, :] - r["gsn"][:, None],
+                c["vs"][None, :] - r["vs"][:, None],
+                mvpcfg)
+            mvpmask = swconfl & ~cols_noreso[None, :]
         maskf = mvpmask.astype(dtype)
+
+        if reso == "swarm":
+            # Swarm neighbour sums (Swarm.py:47-66 via cr_swarm.pair_weight)
+            from . import cr_swarm
+            dtrk = (c["trk"][None, :] - r["trk"][:, None]
+                    + 180.0) % 360.0 - 180.0
+            w = cr_swarm.pair_weight(
+                dx, dy, c["alt"][None, :] - r["alt"][:, None], dtrk,
+                pairmask).astype(dtype)
+            sw = carry[-1]
+            sw = (sw[0] + jnp.sum(w, axis=1),
+                  sw[1] + jnp.sum(w * c["cas"][None, :], axis=1),
+                  sw[2] + jnp.sum(w * c["vs"][None, :], axis=1),
+                  sw[3] + jnp.sum(w * dtrk, axis=1),
+                  sw[4] + jnp.sum(w * dx, axis=1),
+                  sw[5] + jnp.sum(w * dy, axis=1),
+                  sw[6] + jnp.sum(w * c["alt"][None, :], axis=1))
 
         # Fold tile reductions into the row carry
         inconf = inconf | jnp.any(swconfl, axis=1)
@@ -504,8 +551,11 @@ def detect_resolve_tiled(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         negv, sel = jax.lax.top_k(-cat_tin, kk)
         topk_tin = -negv
         topk_idx = jnp.take_along_axis(cat_idx, sel, axis=1)
-        return ((inconf, tcpamax, sdve, sdvn, sdvv, tsolv, nconf, nlos,
-                 topk_tin, topk_idx), None)
+        out = (inconf, tcpamax, sdve, sdvn, sdvv, tsolv, nconf, nlos,
+               topk_tin, topk_idx)
+        if reso == "swarm":
+            out = out + (sw,)
+        return (out, None)
 
     def row_block(ri):
         rows_active = act_b[ri]
@@ -517,6 +567,8 @@ def detect_resolve_tiled(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                   jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32),
                   jnp.full((block, kk), bigval, dtype),   # running top-K tin
                   jnp.full((block, kk), -1, jnp.int32))   # running top-K idx
+        if reso == "swarm":
+            carry0 = carry0 + ((z, z, z, z, z, z, z),)    # neighbour sums
 
         def colstep(carry, ci):
             if not prefilter:
@@ -531,17 +583,20 @@ def detect_resolve_tiled(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
 
     out = jax.lax.map(row_block, jnp.arange(nb))
     (inconf, tcpamax, sdve, sdvn, sdvv, tsolv, nconf, nlos,
-     topk_tin, topk_idx) = out
+     topk_tin, topk_idx) = out[:10]
     topk_idx = jnp.where(topk_tin < bigval, topk_idx, -1)
 
     unb = lambda a: a.reshape(nb * block, *a.shape[2:])[:n]
-    return RowConflictData(
+    rd = RowConflictData(
         inconf=unb(inconf), tcpamax=unb(tcpamax),
         sum_dve=unb(sdve), sum_dvn=unb(sdvn), sum_dvv=unb(sdvv),
         tsolv=unb(tsolv),
         nconf=jnp.sum(nconf, dtype=jnp.int32),
         nlos=jnp.sum(nlos, dtype=jnp.int32),
         topk_idx=unb(topk_idx), topk_tin=unb(topk_tin))
+    if reso == "swarm":
+        return rd, tuple(unb(a) for a in out[10])
+    return rd
 
 
 def topk_partners(rd, k):

@@ -22,37 +22,29 @@ import jax.numpy as jnp
 from . import aero
 
 
-def resolve(cd, alt, vs, trk, tas, rpz_m, vmin, vmax):
-    """Eby resolution commands.
+def pair_contrib(dx, dy, dz, vx, vy, vz, rpz_m):
+    """Per-pair Eby displacement (Eby.py:73-138), shape-agnostic.
 
-    Args:
-      cd:       ConflictData (ops/cd.py) — swconfl/qdr/dist matrices
-      alt/vs:   [N] state arrays
-      trk/tas:  [N] track + TRUE AIRSPEED — the reference builds its
-                velocity vectors from tas, not groundspeed (Eby.py:44-46,
-                84-87), so the EAS cap stays wind-independent
-      rpz_m:    resolution zone radius Rm [m] (asas.Rm)
-      vmin/vmax: EAS caps [m/s]
-    Returns (newtrk, newtas, newvs, newalt) per aircraft.
+    ``dx/dy/dz``: relative position of the intruder w.r.t. the ownship;
+    ``vx/vy/vz``: relative TAS-based velocity (v_j - v_i).  Returns
+    (dve_p, dvn_p, dvv_p); callers sum over conflict pairs and NEGATE
+    (the reference applies ``dv[id1] -= dv_eby`` per pair).  Shared by
+    the dense matrix path and the tiled/pallas/sparse kernels so the
+    math cannot drift.
+
+    Evaluated in protected-zone-radius units: in meters the quadratic's
+    ``b*b`` overflows float32 for pairs a few hundred km apart
+    (b ~ dist^2 * vrel * 2 ~ 1e19), and the inf - inf NaN then leaks
+    through the masked conflict-pair sums (NaN * 0 = NaN).  Scaling
+    positions AND velocities by 1/rpz_m keeps every intermediate in
+    range for any airspace-scale separation; tstar is scale-invariant
+    and the output displacement just unscales.
     """
     eps = 1e-12
-    mask = cd.swconfl
-    maskf = mask.astype(tas.dtype)
-    trkrad = jnp.radians(trk)
-    ve = tas * jnp.sin(trkrad)
-    vn = tas * jnp.cos(trkrad)
-
-    # Pairwise relative position (Eby.py:73-78)
-    qdrrad = jnp.radians(cd.qdr)
-    dx = cd.dist * jnp.sin(qdrrad)
-    dy = cd.dist * jnp.cos(qdrrad)
-    dz = alt[None, :] - alt[:, None]
-
-    # Relative velocity v = v_j - v_i (Eby.py:85-87)
-    vx = ve[None, :] - ve[:, None]
-    vy = vn[None, :] - vn[:, None]
-    vz = vs[None, :] - vs[:, None]
-
+    s = 1.0 / rpz_m
+    dx, dy, dz = dx * s, dy * s, dz * s
+    vx, vy, vz = vx * s, vy * s, vz * s
+    rpz_m = 1.0
     r2 = rpz_m * rpz_m
     d2 = dx * dx + dy * dy + dz * dz
     v2 = vx * vx + vy * vy + vz * vz
@@ -77,7 +69,7 @@ def resolve(cd, alt, vs, trk, tas, rpz_m, vmin, vmax):
 
     # Exact-collision-course fix (Eby.py:125-131): if passing within
     # 10 m, push drelstar out sideways to 10 m
-    dif = 10.0 - dstarabs
+    dif = 10.0 * s - dstarabs
     vperp_norm = jnp.sqrt(vy * vy + vx * vx)
     vp_safe = jnp.where(vperp_norm < eps, eps, vperp_norm)
     fixmask = dif > 0.0
@@ -85,27 +77,68 @@ def resolve(cd, alt, vs, trk, tas, rpz_m, vmin, vmax):
     dsy = dsy + fixmask * dif * vx / vp_safe
     dstarabs = jnp.sqrt(dsx * dsx + dsy * dsy + dsz * dsz)
 
-    # Intrusion and displacement (Eby.py:134-138)
+    # Intrusion and displacement (Eby.py:134-138); the 1/s restores the
+    # velocity scale (dsx and intr both carry one factor of s)
     intr = rpz_m - dstarabs
     denom = dstarabs * tstar
     denom = jnp.where(jnp.abs(denom) < eps, eps, denom)
-    scale = intr / denom
-    dve_p = scale * dsx
-    dvn_p = scale * dsy
-    dvv_p = scale * dsz
+    scale = intr / (denom * s)
+    return scale * dsx, scale * dsy, scale * dsz
 
-    # dv[i] = -sum_j over conflict pairs (see module docstring)
-    dve = -jnp.sum(dve_p * maskf, axis=1)
-    dvn = -jnp.sum(dvn_p * maskf, axis=1)
-    dvv = -jnp.sum(dvv_p * maskf, axis=1)
 
-    # New velocity vector -> polar commands (Eby.py:42-61)
-    newv_e = dve + ve
-    newv_n = dvn + vn
-    newv_v = dvv + vs
+def resolve_from_sums(sum_dve, sum_dvn, sum_dvv, alt, vs, trk, tas,
+                      vmin, vmax):
+    """Eby commands from the per-ownship conflict-pair sums (the tiled/
+    sparse backends accumulate them blockwise; the negation of the
+    reference's ``dv[id1] -= dv_eby`` is applied here).  Eby.py:42-61."""
+    trkrad = jnp.radians(trk)
+    ve = tas * jnp.sin(trkrad)
+    vn = tas * jnp.cos(trkrad)
+    newv_e = -sum_dve + ve
+    newv_n = -sum_dvn + vn
+    newv_v = -sum_dvv + vs
     newtrk = jnp.degrees(jnp.arctan2(newv_e, newv_n)) % 360.0
     newgs = jnp.sqrt(newv_e * newv_e + newv_n * newv_n)
     neweas = aero.vtas2eas(newgs, alt)
     newtas = jnp.clip(neweas, vmin, vmax)
     newalt = jnp.sign(newv_v) * 1e5
     return newtrk, newtas, newv_v, newalt
+
+
+def resolve(cd, alt, vs, trk, tas, rpz_m, vmin, vmax):
+    """Eby resolution commands.
+
+    Args:
+      cd:       ConflictData (ops/cd.py) — swconfl/qdr/dist matrices
+      alt/vs:   [N] state arrays
+      trk/tas:  [N] track + TRUE AIRSPEED — the reference builds its
+                velocity vectors from tas, not groundspeed (Eby.py:44-46,
+                84-87), so the EAS cap stays wind-independent
+      rpz_m:    resolution zone radius Rm [m] (asas.Rm)
+      vmin/vmax: EAS caps [m/s]
+    Returns (newtrk, newtas, newvs, newalt) per aircraft.
+    """
+    maskf = cd.swconfl.astype(tas.dtype)
+    trkrad = jnp.radians(trk)
+    ve = tas * jnp.sin(trkrad)
+    vn = tas * jnp.cos(trkrad)
+
+    # Pairwise relative position (Eby.py:73-78)
+    qdrrad = jnp.radians(cd.qdr)
+    dx = cd.dist * jnp.sin(qdrrad)
+    dy = cd.dist * jnp.cos(qdrrad)
+    dz = alt[None, :] - alt[:, None]
+
+    # Relative velocity v = v_j - v_i (Eby.py:85-87)
+    vx = ve[None, :] - ve[:, None]
+    vy = vn[None, :] - vn[:, None]
+    vz = vs[None, :] - vs[:, None]
+
+    dve_p, dvn_p, dvv_p = pair_contrib(dx, dy, dz, vx, vy, vz, rpz_m)
+
+    # dv[i] = -sum_j over conflict pairs (see module docstring); the
+    # negation lives in resolve_from_sums.
+    return resolve_from_sums(jnp.sum(dve_p * maskf, axis=1),
+                             jnp.sum(dvn_p * maskf, axis=1),
+                             jnp.sum(dvv_p * maskf, axis=1),
+                             alt, vs, trk, tas, vmin, vmax)

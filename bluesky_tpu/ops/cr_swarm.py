@@ -30,6 +30,67 @@ def _wavg(x, w):
     return jnp.sum(x * w, axis=1) / den
 
 
+def pair_weight(dx, dy, dalt, dtrk, pairok):
+    """Swarm-neighbour weight for one pair (Swarm.py:47-58, 65-66):
+    within 7.5 nm / 1500 ft, flying within 90 deg of the own track.
+    ``dtrk`` must already be wrapped to (-180, 180].  Shape-agnostic —
+    shared by the dense matrix path and the tiled backend."""
+    close = (dx * dx + dy * dy < R_SWARM * R_SWARM) \
+        & (jnp.abs(dalt) < DH_SWARM) & pairok
+    return close & (jnp.abs(dtrk) < 90.0)
+
+
+def resolve_from_sums(sw_w, sw_cas, sw_vs, sw_dtrk, sw_dx, sw_dy, sw_alt,
+                      alt, trk, cas, vs, gseast, gsnorth, active,
+                      mvp_trk, mvp_tas, mvp_vs, mvp_active,
+                      ap_trk, selspd, selvs, vmin, vmax):
+    """Swarm commands from per-ownship neighbour sums (the tiled backend
+    accumulates them blockwise; the reference's diagonal self-terms —
+    Swarm.py:53-58: w=1, dtrk=0, flock dx/dy = own velocity/100 — are
+    folded in here so the kernels never special-case the diagonal)."""
+    selfw = active.astype(cas.dtype)
+    den = sw_w + selfw
+    den = jnp.where(den == 0.0, 1.0, den)
+
+    # Velocity alignment (Swarm.py:75-84); self terms: cas/vs own, dtrk 0
+    va_cas = (sw_cas + selfw * cas) / den
+    va_vs = (sw_vs + selfw * vs) / den
+    va_trk = trk + sw_dtrk / den
+
+    # Flock centering (Swarm.py:86-97); self terms: own velocity / 100
+    fc_dx = (sw_dx + selfw * gseast / 100.0) / den
+    fc_dy = (sw_dy + selfw * gsnorth / 100.0) / den
+    fc_dz = (sw_alt + selfw * alt) / den - alt
+    fc_trk = jnp.degrees(jnp.arctan2(fc_dx, fc_dy))
+    fc_cas = cas
+    cas_safe = jnp.where(cas == 0.0, 1.0, cas)
+    ttoreach = jnp.sqrt(fc_dx * fc_dx + fc_dy * fc_dy) / cas_safe
+    fc_vs = jnp.where(ttoreach == 0.0, 0.0,
+                      fc_dz / jnp.where(ttoreach == 0.0, 1.0, ttoreach))
+
+    # Collision avoidance part: MVP output where ASAS-active, else AP
+    ca_trk = jnp.where(mvp_active, mvp_trk, ap_trk)
+    ca_cas = jnp.where(mvp_active, mvp_tas, selspd)
+    ca_vs = jnp.where(mvp_active, mvp_vs, selvs)
+
+    # Blend the three parts in cartesian velocity space (Swarm.py:99-110)
+    wsum = sum(WEIGHTS)
+
+    def blend(a, b, c):
+        return (WEIGHTS[0] * a + WEIGHTS[1] * b + WEIGHTS[2] * c) / wsum
+
+    trks = [ca_trk, va_trk, fc_trk]
+    cass = [ca_cas, va_cas, fc_cas]
+    vxs = [c * jnp.sin(jnp.radians(t)) for t, c in zip(trks, cass)]
+    vys = [c * jnp.cos(jnp.radians(t)) for t, c in zip(trks, cass)]
+    newtrk = jnp.degrees(jnp.arctan2(blend(*vxs), blend(*vys))) % 360.0
+    newcas = blend(ca_cas, va_cas, fc_cas)
+    newvs = blend(ca_vs, va_vs, fc_vs)
+    newtas = jnp.clip(newcas, vmin, vmax)
+    newalt = jnp.sign(newvs) * 1e5
+    return newtrk, newtas, newvs, newalt
+
+
 def resolve(cd, lat, lon, alt, trk, gs, cas, vs, gseast, gsnorth,
             active,
             mvp_trk, mvp_tas, mvp_vs, mvp_active,

@@ -305,11 +305,19 @@ def register_all(stack):
             _setasas(reso_on=True)
             return True
         if m in ("MVP", "EBY", "SWARM", "SSD"):
-            if m != "MVP" and sim.cfg.cd_backend != "dense":
-                return False, (f"RESO {m} needs the dense CD backend "
-                               f"(current: {sim.cfg.cd_backend}); only "
-                               "MVP runs on the tiled/pallas large-N "
-                               "path")
+            # Resolver x backend availability (mirrors core/step.py):
+            # MVP/EBY run on every backend (pair-sum kernels), SWARM
+            # additionally on the lax 'tiled' backend, SSD dense-only.
+            backend = sim.cfg.cd_backend
+            allowed = {"dense": ("MVP", "EBY", "SWARM", "SSD"),
+                       "tiled": ("MVP", "EBY", "SWARM")}.get(
+                backend, ("MVP", "EBY"))
+            if m not in allowed:
+                return False, (f"RESO {m} is not available on CD backend "
+                               f"'{backend}' (supported there: "
+                               f"{'/'.join(allowed)}); use CDMETHOD "
+                               "DENSE" + ("/TILED" if m == "SWARM" else "")
+                               + f" for RESO {m}")
             _setasas(reso_on=True, reso_method=m)
             return True
         if m in ("OFF", "NONE", "DONOTHING"):
@@ -809,11 +817,17 @@ def register_all(stack):
             # sort_perm semantics differ per backend (Morton permutation
             # vs stripe destinations); the identity layout is valid for
             # both, and Simulation.update force-refreshes on backend
-            # change.
+            # change.  The partner tables are cleared too: caller-space
+            # ids (partners) and sorted-space ids (partners_s) are not
+            # interchangeable, and a later refresh would remap stale
+            # sorted-space rows onto the wrong aircraft.  Hysteresis
+            # re-establishes within one CD interval.
             st = sim.traf.state
             sim.traf.state = st.replace(asas=st.asas.replace(
                 sort_perm=jnp.arange(st.asas.sort_perm.shape[0],
-                                     dtype=jnp.int32)))
+                                     dtype=jnp.int32),
+                partners=jnp.full_like(st.asas.partners, -1),
+                partners_s=jnp.full_like(st.asas.partners_s, -1)))
         sim.cfg = sim.cfg._replace(cd_backend=table[m])
         return True
 

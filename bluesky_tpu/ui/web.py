@@ -126,11 +126,12 @@ class ClientBackend:
         return svg, f"ntraf {n}   node {self.client.act or '-'}"
 
     def command(self, line):
+        nd = self.client.get_nodedata()
+        n0 = len(nd.echo_text)
         self.client.stack(line)
-        time.sleep(0.15)                     # echo arrives via stream
-        out = list(self.client.echobuf)
-        self.client.echobuf.clear()
-        return "\n".join(out)
+        time.sleep(0.15)                     # ECHO arrives via the event
+        self.client.receive()                # socket; pump it in
+        return "\n".join(nd.echo_text[n0:])
 
     def pump(self):
         self.client.receive()

@@ -254,3 +254,101 @@ def test_sparse_delete_purges_sorted_table():
     ps2 = np.asarray(traf.state.asas.partners_s)
     assert (ps2[dest[1]] == -1).all()          # deleted row purged
     assert dest[1] not in ps2                  # no references remain
+
+
+def _clump_traffic(n, seed, spread=1.5, pair_matrix=True):
+    from bluesky_tpu.core.traffic import Traffic
+    rng = np.random.default_rng(seed)
+    traf = Traffic(nmax=n, dtype=jnp.float32, pair_matrix=pair_matrix)
+    lat = rng.uniform(52.6 - spread, 52.6 + spread, n)
+    lon = rng.uniform(5.4 - spread * 2, 5.4 + spread * 2, n)
+    traf.create(n, "B744", rng.uniform(3000.0, 11000.0, n),
+                rng.uniform(130.0, 240.0, n), None, lat, lon,
+                rng.uniform(0.0, 360.0, n))
+    traf.flush()
+    return traf
+
+
+def test_eby_large_n_backends_match_dense():
+    """RESO EBY on the lax-tiled and sparse backends vs the dense [N,N]
+    path (VERDICT r2 #5: large-N runs were MVP-only).  Eby's grazing
+    pairs amplify f32 input noise (scale = intrusion/(dstar*tstar) with
+    tstar -> 0 in LoS), so the commanded-track comparison is p99-based
+    with a loose max; the two blockwise backends must agree closely."""
+    import functools
+    from unittest import mock
+    from bluesky_tpu.core import asas as asasmod
+    from bluesky_tpu.core.asas import AsasConfig
+
+    traf = _clump_traffic(800, seed=21)
+    cfg = AsasConfig(reso_method="EBY")
+    st_dense, _ = asasmod.update(traf.state, cfg)
+    st_lax, _ = asasmod.update_tiled(traf.state, cfg, block=256, impl="lax")
+    with mock.patch.object(
+            cd_sched, "detect_resolve_sched",
+            functools.partial(cd_sched.detect_resolve_sched,
+                              interpret=True)):
+        st_sp0 = asasmod.refresh_spatial_sort(traf.state, cfg, block=256,
+                                              impl="sparse")
+        st_sp, _ = asasmod.update_tiled(st_sp0, cfg, block=256,
+                                        impl="sparse")
+
+    for st in (st_lax, st_sp):
+        assert bool(jnp.all(st.asas.inconf == st_dense.asas.inconf))
+        for f, p99tol, maxtol in (("trk", 0.3, 5.0), ("tas", 0.05, 1.0)):
+            d = np.abs(np.asarray(getattr(st.asas, f), np.float64)
+                       - np.asarray(getattr(st_dense.asas, f), np.float64))
+            if f == "trk":
+                d = np.minimum(d, 360.0 - d)
+            assert np.percentile(d, 99) < p99tol, (f, np.percentile(d, 99))
+            assert d.max() < maxtol, (f, d.max())
+    # The two blockwise backends share the tile math; only the tile
+    # REDUCTION ORDER differs (stripe-window vs sequential scan), which
+    # Eby's grazing-pair amplification can blow up on a few rows.
+    for f in ("trk", "tas"):
+        d = np.abs(np.asarray(getattr(st_lax.asas, f), np.float64)
+                   - np.asarray(getattr(st_sp.asas, f), np.float64))
+        if f == "trk":
+            d = np.minimum(d, 360.0 - d)
+        assert np.percentile(d, 99) < 0.3, (f, np.percentile(d, 99))
+        assert d.max() < 5.0, (f, d.max())
+
+
+def test_eby_no_nan_at_airspace_scale():
+    """The Eby quadratic overflowed f32 for pairs a few hundred km apart
+    (b^2 ~ 1e38) and the NaN leaked through masked sums; the rpz-unit
+    rescale must keep every command finite at continental separations."""
+    from bluesky_tpu.core import asas as asasmod
+    from bluesky_tpu.core.asas import AsasConfig
+    from bluesky_tpu.core.traffic import Traffic
+    rng = np.random.default_rng(3)
+    n = 400
+    traf = Traffic(nmax=n, dtype=jnp.float32, pair_matrix=True)
+    traf.create(n, "B744", rng.uniform(3000, 11000, n),
+                rng.uniform(130, 240, n), None,
+                rng.uniform(40.0, 60.0, n), rng.uniform(-10.0, 30.0, n),
+                rng.uniform(0, 360, n))
+    traf.flush()
+    st, _ = asasmod.update(traf.state, AsasConfig(reso_method="EBY"))
+    for f in ("trk", "tas", "vs", "alt"):
+        assert not np.isnan(np.asarray(getattr(st.asas, f))).any(), f
+
+
+def test_swarm_tiled_matches_dense():
+    """RESO SWARM on the lax tiled backend (MVP sums + 7 neighbour sums
+    accumulated blockwise, blended by cr_swarm.resolve_from_sums) vs the
+    dense matrix path."""
+    from bluesky_tpu.core import asas as asasmod
+    from bluesky_tpu.core.asas import AsasConfig
+
+    traf = _clump_traffic(700, seed=22)
+    cfg = AsasConfig(reso_method="SWARM")
+    st_dense, _ = asasmod.update(traf.state, cfg)
+    st_lax, _ = asasmod.update_tiled(traf.state, cfg, block=256, impl="lax")
+    assert bool(jnp.all(st_lax.asas.active == st_dense.asas.active))
+    for f in ("trk", "tas", "vs", "alt"):
+        d = np.abs(np.asarray(getattr(st_lax.asas, f), np.float64)
+                   - np.asarray(getattr(st_dense.asas, f), np.float64))
+        if f == "trk":
+            d = np.minimum(d, 360.0 - d)
+        assert d.max() < 0.1, (f, d.max())

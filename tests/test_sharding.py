@@ -117,3 +117,19 @@ def test_ensemble_replicas_match_individual_runs():
                 np.asarray(getattr(out.ac, name))[r],
                 np.asarray(getattr(ref.ac, name)),
                 rtol=0, atol=1e-9, err_msg=f"replica {r} {name}")
+
+
+def test_sharded_tiled_multi_block_per_device(mesh):
+    """The north-star blockwise scheme with MULTIPLE blocks per device
+    (VERDICT r2 #4): 16 cd_blocks over 8 devices, so every device owns
+    two tile rows and the cross-device column streams exercise the
+    GSPMD collectives the 100k configuration relies on."""
+    cfg = SimConfig(cd_backend="tiled", cd_block=8)
+    nsteps = 40
+    nmax, n = 128, 96
+
+    ref = run_steps(make_scene(nmax=nmax, n=n, seed=5), cfg, nsteps)
+    st = sharding.shard_state(make_scene(nmax=nmax, n=n, seed=5), mesh)
+    out = jax.block_until_ready(
+        sharding.sharded_step_fn(mesh, cfg, nsteps=nsteps)(st))
+    assert_state_close(out, ref)

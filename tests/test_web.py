@@ -86,3 +86,37 @@ def test_sse_frames_flow(served_sim):
         assert f["svg"].startswith("<svg")
         assert "SSE1" in f["svg"]
         assert "ntraf 1" in f["info"]
+
+
+def test_client_backend_interface():
+    """ClientBackend against a stub with the GuiClient surface it uses
+    (get_nodedata().echo_text, stack, receive, render_svg, act)."""
+    from bluesky_tpu.ui.web import ClientBackend
+
+    class Node:
+        def __init__(self):
+            self.echo_text = []
+            self.acdata = {"id": ["X1"]}
+
+    class StubClient:
+        def __init__(self):
+            self.nd = Node()
+            self.act = b"node1"
+
+        def get_nodedata(self, nodeid=None):
+            return self.nd
+
+        def stack(self, line, target=None):
+            self.nd.echo_text.append(f"ok: {line}")
+
+        def receive(self, timeout_ms=0):
+            return 0
+
+        def render_svg(self, fname=None, nodeid=None):
+            return "<svg>stub</svg>"
+
+    b = ClientBackend(StubClient())
+    svg, info = b.frame()
+    assert svg.startswith("<svg") and "ntraf 1" in info
+    out = b.command("POS X1")
+    assert out == "ok: POS X1"
