@@ -117,12 +117,20 @@ def update(state: SimState,
             asasn = newgs * jnp.cos(jnp.radians(newtrk))
         elif method == "SSD":
             from ..ops import cr_ssd
+            # PRIORULES RS1..RS9 select the SSD ruleset (reference
+            # SSD.py:429-558); non-RS priocodes (the MVP FF*/LAY* family)
+            # fall back to the RS1 default like the reference's separate
+            # registries do.
+            rs = cfg.priocode.upper() if cfg.swprio \
+                and cfg.priocode.upper().startswith("RS") else "RS1"
             ssdcfg = cr_ssd.SSDConfig(rpz_m=cfg.rpz_m,
-                                      tlookahead=cfg.dtlookahead)
+                                      tlookahead=cfg.dtlookahead,
+                                      priocode=rs)
             newtrk, newgs = cr_ssd.resolve(
                 cd, ac.lat, ac.lon, ac.alt, ac.trk, ac.gs, ac.vs,
                 ac.gseast, ac.gsnorth, ac.active,
-                cfg.vmin, cfg.vmax, ssdcfg)
+                cfg.vmin, cfg.vmax, ssdcfg, hdg=ac.hdg,
+                ap_trk=state.ap.trk, ap_tas=state.ap.tas)
             # SSD is a horizontal method (SSD.py:99-104)
             newvs, newalt = asas.vs, asas.alt
             asase = newgs * jnp.sin(jnp.radians(newtrk))

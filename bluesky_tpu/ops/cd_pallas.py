@@ -685,7 +685,7 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         "tr": pad(jnp.ones_like(gs.astype(dtype))
                   if not extra_cols or "tas" not in extra_cols
                   else extra_cols["tas"].astype(dtype)
-                  / jnp.maximum(gs.astype(dtype), 1e-6)),
+                  / jnp.maximum(gs.astype(dtype), 0.5)),
         "active": pad(active.astype(dtype)),
         "noreso": pad(noreso.astype(dtype)),
     })

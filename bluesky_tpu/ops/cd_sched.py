@@ -319,7 +319,7 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         # tas given (MVP never reads it)
         "tr": (jnp.ones_like(gs.astype(dtype)) if tas is None
                else tas.astype(dtype)
-               / jnp.maximum(gs.astype(dtype), 1e-6)),
+               / jnp.maximum(gs.astype(dtype), 0.5)),
         "active": active.astype(dtype), "noreso": noreso.astype(dtype),
     }
     padded = dict(zip(cols, scatter_padded(

@@ -272,7 +272,7 @@ def run_spatially_sorted(kernel, lat, lon, trk, gs, alt, vs, gseast,
 
 
 def block_reachability(lat, lon, gs, active, nb, block, rpz, tlookahead,
-                       alt=None, vs=None, hpz=None):
+                       alt=None, vs=None, hpz=None, min_reach_m=0.0):
     """[nb, nb] bool: which block pairs can possibly contain a conflict
     or LoS.
 
@@ -339,6 +339,10 @@ def block_reachability(lat, lon, gs, active, nb, block, rpz, tlookahead,
     merid = dlat_gap * 110000.0
     dist_lb = jnp.maximum(merid, zonal)
     thresh = rpz + tlookahead * (gsmax[:, None] + gsmax[None, :])
+    # min_reach_m widens the bound for reductions over pairs beyond the
+    # conflict horizon (the Swarm 7.5 nm neighbourhood: with a short
+    # DTLOOK the conflict bound alone could skip genuine neighbours)
+    thresh = jnp.maximum(thresh, min_reach_m)
     reach = dist_lb <= thresh * 1.05
     if alt is not None:
         balt = alt.reshape(shape)
@@ -419,6 +423,13 @@ def detect_resolve_tiled(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
     if reso == "swarm":
         packed["trk"] = _pad1(trk, npad, 0.0)
         packed["cas"] = _pad1((extra_cols or {}).get("cas", gs), npad, 0.0)
+    if reso == "eby":
+        # Exact TAS velocity columns (the lax dict has no slab-row
+        # budget, unlike the Pallas kernels' tas/gs-ratio encoding,
+        # so the gs->0 hover-in-headwind corner is exact here)
+        tas_col = _pad1(gs if tas is None else tas, npad, 0.0)
+        packed["ute"] = tas_col * jnp.sin(trkrad)
+        packed["utn"] = tas_col * jnp.cos(trkrad)
     packed = {k: v.reshape(nb, block) for k, v in packed.items()}
     act_b = _pad1(active, npad, False).reshape(nb, block)
     nor_b = _pad1(noreso, npad, False).reshape(nb, block)
@@ -427,11 +438,19 @@ def detect_resolve_tiled(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
     bigval = jnp.asarray(1e9, dtype)
     col_ids = jnp.arange(nb * block, dtype=jnp.int32).reshape(nb, block)
 
-    # Reachability flags for the exact tile skip (see docstring)
+    # Reachability flags for the exact tile skip (see docstring); the
+    # Swarm mode widens the bound to its 7.5 nm neighbourhood so short
+    # lookaheads cannot skip genuine swarm neighbours.
+    if reso == "swarm":
+        from . import cr_swarm
+        min_reach = cr_swarm.R_SWARM
+    else:
+        min_reach = 0.0
     reach = block_reachability(_pad1(lat, npad, 0.0),
                                _pad1(lon, npad, 0.0),
                                _pad1(gs, npad, 0.0), act_b.reshape(-1),
-                               nb, block, rpz, tlookahead)
+                               nb, block, rpz, tlookahead,
+                               min_reach_m=min_reach)
 
     def tile(ri, ci, rows_active, carry):
         """Compute one [block, block] tile and fold it into the row carry."""
@@ -490,13 +509,13 @@ def detect_resolve_tiled(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         swlos = (dist < rpz) & (jnp.abs(dalt) < hpz) & pairmask
 
         if reso == "eby":
-            # Eby pair displacement (cr_eby.pair_contrib) on TAS
-            # velocities via the per-aircraft tas/gs ratio column.
+            # Eby pair displacement (cr_eby.pair_contrib) on the exact
+            # TAS velocity columns
             from . import cr_eby
             dve_p, dvn_p, dvv_p = cr_eby.pair_contrib(
                 dx, dy, c["alt"][None, :] - r["alt"][:, None],
-                (c["tr"] * c["u"])[None, :] - (r["tr"] * r["u"])[:, None],
-                (c["tr"] * c["v"])[None, :] - (r["tr"] * r["v"])[:, None],
+                c["ute"][None, :] - r["ute"][:, None],
+                c["utn"][None, :] - r["utn"][:, None],
                 c["vs"][None, :] - r["vs"][:, None], mvpcfg.rpz_m)
             tsolv_p = jnp.full_like(dve_p, 1e9)
             mvpmask = swconfl          # Eby has no noreso handling

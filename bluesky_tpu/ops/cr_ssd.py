@@ -2,30 +2,55 @@
 
 Capability parity with the reference ``traffic/asas/SSD.py:99-625``,
 which builds velocity-obstacle polygons with pyclipper and picks the
-resolution velocity per priority rule.  That construction is inherently
-sequential host geometry; this is a ground-up TPU redesign:
+resolution velocity per priority rule RS1-RS9.  That construction is
+inherently sequential host geometry; this is a ground-up TPU redesign:
 
 * The solution space is DISCRETIZED: candidate velocities sample a polar
   grid (``ntrk`` tracks x ``nspd`` speeds spanning [vmin, vmax] —
   matching the reference's SSD bounded by the speed envelope ring,
-  SSD.py:131-141).
-* Each candidate is tested against every intruder with the same
-  CPA predicate as conflict detection (a candidate lies inside the
-  velocity obstacle of intruder j iff flying it would come within
-  ``rpz_m`` of j inside the lookahead) — an [N, C, N] elementwise mask
-  instead of polygon clipping, which is exactly the shape the VPU eats.
-* Resolution rule RS1 "shortest way out" (the reference default,
-  SSD.py:429-500): among free candidates, take the one closest to the
-  current velocity.  If the whole grid is forbidden, fall back to the
-  candidate whose earliest conflict is farthest away (max min-tin).
+  SSD.py:131-141), plus two per-aircraft specials: the CURRENT velocity
+  (whose freedom is the reference's ``inconf2`` test, SSD.py:304-307)
+  and the AP velocity (the ``ap_free`` test, SSD.py:308-310).
+* Each candidate is tested against every intruder with the same CPA
+  predicate as conflict detection (a candidate lies inside the velocity
+  obstacle of intruder j iff flying it would come within ``rpz_m`` of j
+  inside the lookahead) — elementwise masks instead of polygon clipping,
+  which is exactly the shape the VPU eats.  The intruder axis is
+  CHUNKED (``lax.map`` over slices), so peak memory is [N, C, chunk]
+  instead of [N, C, N] — the former ~500-aircraft ceiling is gone.
+* The reference's nine priority codes (SSD.py:369-399, 429-558) become
+  masks/objectives over the same free-velocity set:
+    RS1  shortest way out: free candidate closest to current velocity.
+    RS2  clockwise:  restrict to the half-plane RIGHT of own heading
+         (the right-turn box of SSD.py:373-387).
+    RS3  heading-only: restrict to the AP-speed ring (SSD.py:388-391).
+    RS4  speed-only: restrict to the own-heading wedge (SSD.py:392-398).
+    RS5  closest to the AP velocity; the AP velocity itself wins when
+         free (SSD.py:446-453).
+    RS6  rules-of-the-air: ignore VOs of intruders the ownship has
+         priority over (bearing gates of SSD.py:296-302), with the RS2
+         right-turn preference.
+    RS7  sequential RS1: a second layer built from intruders within
+         HALF the ADS-B range (SSD.py:113-114); when the current
+         velocity conflicts in that near layer and the near solution
+         differs from the full one, prefer the near-layer candidate
+         (choice tie-broken by latest earliest-LoS, the grid analogue
+         of minTLOS, SSD.py:515-558).
+    RS8  sequential RS5: as RS7 with the AP-velocity objective.
+    RS9  counter-clockwise: the LEFT half-plane (SSD.py:377-381).
+  Restricted sets fall back to the unrestricted free set when empty,
+  and to max earliest-conflict-time delay when nothing is free at all.
 
-Memory: N * C * N floats with C = ntrk*nspd.  With the default 24x6
-grid and N=500 that is ~2 GB transient — SSD is a small-N study tool in
-the reference too (pyclipper per pair per step); for big-N use MVP.
+SSD remains a dense-backend tool (it consumes the [N,N] qdr/dist
+matrices of ``ops/cd.py``), but the chunking lifts the memory ceiling to
+what the dense CD itself allows (~16k aircraft).
 """
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
+
+ADSB_MAX = 65.0 * 1852.0     # [m] SSD.py:110 adsbmax
 
 
 class SSDConfig(NamedTuple):
@@ -33,71 +58,193 @@ class SSDConfig(NamedTuple):
     nspd: int = 6         # speed ring samples between vmin and vmax
     rpz_m: float = 9260.0  # resolution zone [m]
     tlookahead: float = 300.0
+    priocode: str = "RS1"
+    chunk: int = 512      # intruder-axis slab (memory: N*C*chunk floats)
+
+
+def _wrap180(a):
+    return (a + 180.0) % 360.0 - 180.0
+
+
+def _vo_masks(cve, cvn, dxm, dym, gseast, gsnorth, pairok, cfg):
+    """Chunked candidate-vs-intruder conflict reduction.
+
+    cve/cvn: [N, C] candidate velocities.  Returns (anyconf [N, C],
+    min_tin [N, C]) reduced over the intruder axis, never materialising
+    [N, C, N]: ``lax.map`` walks intruder slabs of cfg.chunk.
+    """
+    n = dxm.shape[0]
+    dtype = cve.dtype
+    r2 = cfg.rpz_m * cfg.rpz_m
+    big = jnp.asarray(1e18, dtype)
+    nch = -(-n // cfg.chunk)
+    npad = nch * cfg.chunk - n
+
+    pad2 = lambda a: jnp.pad(a, ((0, 0), (0, npad)))
+    dxp = pad2(dxm)
+    dyp = pad2(dym)
+    okp = jnp.pad(pairok, ((0, 0), (0, npad)))
+    gep = jnp.pad(gseast, (0, npad))
+    gnp_ = jnp.pad(gsnorth, (0, npad))
+
+    def slab(c):
+        s = c * cfg.chunk
+        dx = jax.lax.dynamic_slice_in_dim(dxp, s, cfg.chunk, 1)[:, None, :]
+        dy = jax.lax.dynamic_slice_in_dim(dyp, s, cfg.chunk, 1)[:, None, :]
+        ok = jax.lax.dynamic_slice_in_dim(okp, s, cfg.chunk, 1)[:, None, :]
+        ge = jax.lax.dynamic_slice_in_dim(gep, s, cfg.chunk, 0)
+        gn = jax.lax.dynamic_slice_in_dim(gnp_, s, cfg.chunk, 0)
+        # w = v_j - u_c (StateBasedCD.py:39-40 convention)
+        wve = ge[None, None, :] - cve[:, :, None]      # [N, C, chunk]
+        wvn = gn[None, None, :] - cvn[:, :, None]
+        dv2 = wve * wve + wvn * wvn
+        dv2 = jnp.where(dv2 < 1e-6, 1e-6, dv2)
+        tcpa = -(wve * dx + wvn * dy) / dv2
+        dcpa2 = dx * dx + dy * dy - tcpa * tcpa * dv2
+        dtinhor = jnp.sqrt(jnp.maximum(0.0, r2 - dcpa2) / dv2)
+        tin = tcpa - dtinhor
+        conf = (dcpa2 < r2) & (tcpa + dtinhor > 0.0) \
+            & (tin < cfg.tlookahead) & ok
+        return (jnp.any(conf, axis=2),
+                jnp.min(jnp.where(conf, jnp.maximum(tin, 0.0), big),
+                        axis=2))
+
+    anyc, mint = jax.lax.map(slab, jnp.arange(nch))
+    return jnp.any(anyc, axis=0), jnp.min(mint, axis=0)
+
+
+def _pick(free, allowed, dist2, min_tin):
+    """Free candidate minimising dist2, preferring the ``allowed``
+    restriction (fall back to any free candidate when the restricted set
+    is empty — reference SSD.py:317-333 intersects and falls back), and
+    to max earliest-conflict delay when nothing is free at all."""
+    big = jnp.asarray(1e18, dist2.dtype)
+    free_r = free & allowed
+    has_r = jnp.any(free_r, axis=1)
+    has_f = jnp.any(free, axis=1)
+    sel = jnp.where(has_r[:, None], free_r, free)
+    best_free = jnp.argmin(jnp.where(sel, dist2, big), axis=1)
+    best_delay = jnp.argmax(jnp.where(jnp.isfinite(min_tin), min_tin, 0.0),
+                            axis=1)
+    return jnp.where(has_f, best_free, best_delay), has_f
 
 
 def resolve(cd, lat, lon, alt, trk, gs, vs, gseast, gsnorth, active,
-            vmin, vmax, cfg: SSDConfig):
-    """RS1 resolution velocities for in-conflict aircraft.
+            vmin, vmax, cfg: SSDConfig, hdg=None, ap_trk=None,
+            ap_tas=None):
+    """Priority-rule resolution velocities for in-conflict aircraft.
 
     Returns (newtrk, newgs): per-aircraft track/speed of the chosen free
-    velocity (aircraft not in conflict get their current trk/gs back).
+    velocity (aircraft not in conflict keep their current trk/gs).
+    ``hdg``/``ap_trk``/``ap_tas`` feed the heading- and AP-referenced
+    rules; they default to trk/gs when omitted (RS1 needs neither).
     """
     n = lat.shape[0]
     dtype = gs.dtype
+    rule = cfg.priocode.upper()
+    hdg = trk if hdg is None else hdg
+    ap_trk = trk if ap_trk is None else ap_trk
+    ap_tas = gs if ap_tas is None else ap_tas
+    ap_ve = ap_tas * jnp.sin(jnp.radians(ap_trk))
+    ap_vn = ap_tas * jnp.cos(jnp.radians(ap_trk))
 
-    # Candidate velocity grid [C]: polar product of tracks and speeds
-    trks = jnp.linspace(0.0, 360.0, cfg.ntrk, endpoint=False, dtype=dtype)
-    spds = jnp.linspace(vmin, vmax, cfg.nspd, dtype=dtype)
-    ctrk = jnp.repeat(trks, cfg.nspd)              # [C]
-    cspd = jnp.tile(spds, cfg.ntrk)                # [C]
-    cve = cspd * jnp.sin(jnp.radians(ctrk))        # [C] east
-    cvn = cspd * jnp.cos(jnp.radians(ctrk))        # [C] north
+    # ---- Candidate grid [N, C]: polar product + the two specials ----
+    if rule == "RS3":
+        # heading-only: every track at the AP speed (SSD.py:388-391 ring)
+        ctrk = jnp.linspace(0.0, 360.0, cfg.ntrk, endpoint=False,
+                            dtype=dtype)[None, :].repeat(n, 0)
+        cspd = jnp.clip(ap_tas, vmin, vmax)[:, None].repeat(cfg.ntrk, 1)
+    elif rule == "RS4":
+        # speed-only: the own-heading wedge (SSD.py:392-398)
+        cspd = jnp.linspace(vmin, vmax, cfg.nspd,
+                            dtype=dtype)[None, :].repeat(n, 0)
+        ctrk = hdg[:, None].repeat(cfg.nspd, 1)
+    else:
+        trks = jnp.linspace(0.0, 360.0, cfg.ntrk, endpoint=False,
+                            dtype=dtype)
+        spds = jnp.linspace(vmin, vmax, cfg.nspd, dtype=dtype)
+        ctrk = jnp.repeat(trks, cfg.nspd)[None, :].repeat(n, 0)
+        cspd = jnp.tile(spds, cfg.ntrk)[None, :].repeat(n, 0)
+    cve = cspd * jnp.sin(jnp.radians(ctrk))
+    cvn = cspd * jnp.cos(jnp.radians(ctrk))
+    # specials: [C] = current velocity, [C+1] = AP velocity
+    cve = jnp.concatenate([cve, gseast[:, None], ap_ve[:, None]], axis=1)
+    cvn = jnp.concatenate([cvn, gsnorth[:, None], ap_vn[:, None]], axis=1)
+    i_cur = cve.shape[1] - 2
+    i_ap = cve.shape[1] - 1
 
-    # Pairwise geometry from the CD output (relative position i->j)
+    # ---- Pair geometry from the CD output ----
     qdrrad = jnp.radians(cd.qdr)
-    dxm = cd.dist * jnp.sin(qdrrad)                # [N,N]
+    dxm = cd.dist * jnp.sin(qdrrad)                # [N,N] i->j east
     dym = cd.dist * jnp.cos(qdrrad)
     eye = jnp.eye(n, dtype=bool)
     pairok = (active[:, None] & active[None, :]) & ~eye
+    # The reference only sees intruders within ADS-B range (SSD.py:110)
+    pairok = pairok & (cd.dist < ADSB_MAX)
 
-    # Relative velocity for candidate c of ownship i vs intruder j, in
-    # the CD convention (StateBasedCD.py:39-40 via its (1,N)/(N,1)
-    # broadcast): w = v_j - u_c.  [1,C,N] against [N,1,N] geometry.
-    wve = gseast[None, None, :] - cve[None, :, None]    # [1,C,N]
-    wvn = gsnorth[None, None, :] - cvn[None, :, None]
-    dx = dxm[:, None, :]                                # [N,1,N]
-    dy = dym[:, None, :]
+    if rule == "RS6":
+        # Rules of the air (SSD.py:296-302): the VO of intruder j binds
+        # only when own must give way — head-on / converging from the
+        # right (bearing from own view in [-20, 110]) or own overtaking
+        # (bearing from j's view beyond +-110).
+        brg_own = _wrap180(cd.qdr - hdg[:, None])
+        brg_oth = _wrap180(cd.qdr + 180.0 - hdg[None, :])
+        must_avoid = ((brg_own >= -20.0) & (brg_own <= 110.0)) \
+            | (brg_oth <= -110.0) | (brg_oth >= 110.0)
+        pairok = pairok & must_avoid
 
-    dv2 = wve * wve + wvn * wvn
-    dv2 = jnp.where(dv2 < 1e-6, 1e-6, dv2)
-    tcpa = -(wve * dx + wvn * dy) / dv2                 # [N,C,N]
-    dcpa2 = dx * dx + dy * dy - tcpa * tcpa * dv2
-    r2 = cfg.rpz_m * cfg.rpz_m
-    # Horizontal-only VO test (the reference SSD is a horizontal method,
-    # SSD.py:99-104): conflict if CPA inside rpz within the lookahead
-    dxinhor = jnp.sqrt(jnp.maximum(0.0, r2 - dcpa2))
-    dtinhor = dxinhor / jnp.sqrt(dv2)
-    tin = tcpa - dtinhor
-    conflict = (dcpa2 < r2) & (tcpa + dtinhor > 0.0) \
-        & (tin < cfg.tlookahead)
-    conflict = conflict & pairok[:, None, :]
+    anyconf, min_tin = _vo_masks(cve, cvn, dxm, dym, gseast, gsnorth,
+                                 pairok, cfg)
+    free = ~anyconf
 
-    free = ~jnp.any(conflict, axis=2)                   # [N,C]
+    # ---- Objective + candidate restriction per rule ----
+    if rule in ("RS5", "RS8"):
+        ref_e, ref_n = ap_ve, ap_vn
+    else:
+        ref_e, ref_n = gseast, gsnorth
+    dist2 = (cve - ref_e[:, None]) ** 2 + (cvn - ref_n[:, None]) ** 2
 
-    # RS1: free candidate closest to the current velocity (SSD.py:429+)
-    dist2 = (cve[None, :] - gseast[:, None]) ** 2 \
-        + (cvn[None, :] - gsnorth[:, None]) ** 2       # [N,C]
-    big = jnp.asarray(1e18, dtype)
-    best_free = jnp.argmin(jnp.where(free, dist2, big), axis=1)
+    allowed = jnp.ones(cve.shape, bool)
+    if rule in ("RS2", "RS6"):
+        rel = _wrap180(ctrk - hdg[:, None])
+        allowed = allowed.at[:, :-2].set(rel >= 0.0)   # right half-plane
+    elif rule == "RS9":
+        rel = _wrap180(ctrk - hdg[:, None])
+        allowed = allowed.at[:, :-2].set(rel <= 0.0)   # left half-plane
+    # the specials only participate where the reference consults them
+    allowed = allowed.at[:, i_cur].set(False)
+    allowed = allowed.at[:, i_ap].set(rule in ("RS5", "RS8"))
 
-    # Fallback when nothing is free: max earliest-conflict time
-    tin_masked = jnp.where(conflict, jnp.maximum(tin, 0.0), big)
-    min_tin = jnp.min(tin_masked, axis=2)               # [N,C]
-    best_delay = jnp.argmax(jnp.where(jnp.isfinite(min_tin), min_tin,
-                                      0.0), axis=1)
-    any_free = jnp.any(free, axis=1)
-    best = jnp.where(any_free, best_free, best_delay)
+    best, has_f = _pick(free, allowed, dist2, min_tin)
 
-    newtrk = jnp.where(cd.inconf, ctrk[best], trk)
-    newgs = jnp.where(cd.inconf, cspd[best], gs)
+    if rule in ("RS7", "RS8"):
+        # Second, nearer layer: intruders within HALF the ADS-B range
+        # (SSD.py:113-114); inconf2 = current velocity inside a near VO.
+        pairok2 = pairok & (cd.dist < ADSB_MAX / 2.0)
+        anyc2, mint2 = _vo_masks(cve, cvn, dxm, dym, gseast, gsnorth,
+                                 pairok2, cfg)
+        free2 = ~anyc2
+        inconf2 = anyc2[:, i_cur]
+        best2, has_f2 = _pick(free2, allowed, dist2, mint2)
+        # Prefer the near-layer solution when the current velocity
+        # conflicts nearby and the two solutions genuinely differ
+        # (SSD.py:515-545; the <1 m/s^2 sameness test), tie-broken
+        # toward the later earliest-LoS via _pick's dist2 objective.
+        d12 = (cve[jnp.arange(n), best] - cve[jnp.arange(n), best2]) ** 2 \
+            + (cvn[jnp.arange(n), best] - cvn[jnp.arange(n), best2]) ** 2
+        use2 = inconf2 & has_f2 & (d12 >= 1.0)
+        best = jnp.where(use2, best2, best)
+
+    if rule == "RS5":
+        # AP setting wins when it is conflict-free (SSD.py:446-453)
+        best = jnp.where(free[:, i_ap], i_ap, best)
+
+    btrk = jnp.degrees(jnp.arctan2(
+        jnp.take_along_axis(cve, best[:, None], 1)[:, 0],
+        jnp.take_along_axis(cvn, best[:, None], 1)[:, 0])) % 360.0
+    bspd = jnp.sqrt(
+        jnp.take_along_axis(cve, best[:, None], 1)[:, 0] ** 2
+        + jnp.take_along_axis(cvn, best[:, None], 1)[:, 0] ** 2)
+    newtrk = jnp.where(cd.inconf, btrk, trk)
+    newgs = jnp.where(cd.inconf, bspd, gs)
     return newtrk, newgs

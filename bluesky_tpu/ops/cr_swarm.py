@@ -118,13 +118,10 @@ def resolve(cd, lat, lon, alt, trk, gs, cas, vs, gseast, gsnorth,
     dy = cd.dist * jnp.cos(qdrrad)
     dalt = alt[:, None] - alt[None, :]
     pairok = (active[:, None] & active[None, :]) & ~eye
-    close = (dx * dx + dy * dy < R_SWARM * R_SWARM) \
-        & (jnp.abs(dalt) < DH_SWARM) & pairok
-
     trkdif = trk[None, :] - trk[:, None]
     dtrk = (trkdif + 180.0) % 360.0 - 180.0
-    samedirection = jnp.abs(dtrk) < 90.0
-    swarming = (close & samedirection) | (eye & active[:, None])
+    swarming = pair_weight(dx, dy, dalt, dtrk, pairok) \
+        | (eye & active[:, None])
     w = swarming.astype(gs.dtype)
 
     # Collision avoidance part: MVP output where ASAS-active, else AP

@@ -858,9 +858,14 @@ def register_all(stack):
         kw = dict(swprio=bool(flag))
         if priocode is not None:
             pc = priocode.upper()
-            if pc not in ("FF1", "FF2", "FF3", "LAY1", "LAY2"):
+            # FF*/LAY* feed the MVP priority masks (MVP.py:235-300);
+            # RS1-RS9 select the SSD ruleset (SSD.py:429-558)
+            if pc not in ("FF1", "FF2", "FF3", "LAY1", "LAY2",
+                          "RS1", "RS2", "RS3", "RS4", "RS5", "RS6",
+                          "RS7", "RS8", "RS9"):
                 return False, (f"Priority code {priocode} not understood;"
-                               " use FF1/FF2/FF3/LAY1/LAY2")
+                               " use FF1/FF2/FF3/LAY1/LAY2 (MVP) or "
+                               "RS1..RS9 (SSD)")
             kw["priocode"] = pc
         _setasas(**kw)
         return True

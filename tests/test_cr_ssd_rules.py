@@ -1,0 +1,158 @@
+"""SSD priority rules RS1-RS9 (ops/cr_ssd.py) on small geometries.
+
+Each rule is checked for its qualitative defining property against the
+reference's intent (SSD.py:369-399, 429-558): turn direction (RS2/RS9),
+heading-only vs speed-only restrictions (RS3/RS4), AP-referenced
+objectives (RS5/RS8), right-of-way exemptions (RS6), and the sequential
+near-layer preference (RS7).  The chunked intruder sweep is additionally
+checked against the unchunked result.
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from bluesky_tpu.ops import aero, cd as cdops, cr_ssd
+
+NM, FT = 1852.0, 0.3048
+RPZ, HPZ, TLOOK = 5 * NM, 1000 * FT, 300.0
+VMIN, VMAX = 100.0 * aero.kts, 180.0 * aero.kts
+
+
+def scene(rows):
+    """rows: (lat, lon, trk, gs_kts, alt_m). Returns args for resolve."""
+    lat = jnp.asarray([r[0] for r in rows], jnp.float32)
+    lon = jnp.asarray([r[1] for r in rows], jnp.float32)
+    trk = jnp.asarray([r[2] for r in rows], jnp.float32)
+    gs = jnp.asarray([r[3] * aero.kts for r in rows], jnp.float32)
+    alt = jnp.asarray([r[4] for r in rows], jnp.float32)
+    vs = jnp.zeros_like(gs)
+    active = jnp.ones(len(rows), bool)
+    gse = gs * jnp.sin(jnp.radians(trk))
+    gsn = gs * jnp.cos(jnp.radians(trk))
+    cd = cdops.detect(lat, lon, trk, gs, alt, vs, active, RPZ, HPZ, TLOOK)
+    return cd, lat, lon, alt, trk, gs, vs, gse, gsn, active
+
+
+def head_on():
+    # Two aircraft head-on at the same altitude, ~14 nm apart
+    return scene([(52.0, 4.0, 90.0, 150.0, 5000.0),
+                  (52.0, 4.38, 270.0, 150.0, 5000.0)])
+
+
+def run(rule, sc=None, **kw):
+    sc = sc or head_on()
+    cd = sc[0]
+    assert bool(cd.inconf[0]), "scenario must be in conflict"
+    cfg = cr_ssd.SSDConfig(rpz_m=RPZ * 1.05, tlookahead=TLOOK,
+                           priocode=rule, chunk=kw.pop("chunk", 512))
+    newtrk, newgs = cr_ssd.resolve(*sc, VMIN, VMAX, cfg, **kw)
+    return sc, np.asarray(newtrk), np.asarray(newgs)
+
+
+def turn_of(sc, newtrk, i=0):
+    trk0 = float(np.asarray(sc[4])[i])
+    return (newtrk[i] - trk0 + 180.0) % 360.0 - 180.0
+
+
+def test_rs1_resolves_and_deviates_minimally():
+    sc, newtrk, newgs = run("RS1")
+    # both aircraft deviate, and stay within the speed envelope
+    assert abs(turn_of(sc, newtrk, 0)) > 1.0
+    assert (newgs >= VMIN - 1e-3).all() and (newgs <= VMAX + 1e-3).all()
+
+
+def test_rs2_turns_right_rs9_turns_left():
+    _, t2, _ = run("RS2")
+    _, t9, _ = run("RS9")
+    sc = head_on()
+    assert turn_of(sc, t2, 0) > 0.0          # clockwise
+    assert turn_of(sc, t9, 0) < 0.0          # counter-clockwise
+
+
+def test_rs3_keeps_speed_changes_heading():
+    sc = head_on()
+    gs0 = np.asarray(sc[5])
+    cfg = cr_ssd.SSDConfig(rpz_m=RPZ * 1.05, tlookahead=TLOOK,
+                           priocode="RS3")
+    newtrk, newgs = cr_ssd.resolve(*sc, VMIN, VMAX, cfg, ap_tas=sc[5])
+    assert abs(float(newgs[0]) - gs0[0]) < 1.0       # speed held
+    assert abs(turn_of(sc, np.asarray(newtrk), 0)) > 1.0   # heading moved
+
+
+def test_rs4_keeps_heading_changes_speed():
+    sc = head_on()
+    cfg = cr_ssd.SSDConfig(rpz_m=RPZ * 1.05, tlookahead=TLOOK,
+                           priocode="RS4")
+    newtrk, newgs = cr_ssd.resolve(*sc, VMIN, VMAX, cfg, hdg=sc[4])
+    # pure head-on cannot be solved by speed alone: the rule falls back
+    # to the full free set (reference intersects and falls back too) —
+    # use a crossing geometry where slowing down resolves it.
+    sc2 = scene([(52.0, 4.0, 90.0, 150.0, 5000.0),
+                 (51.88, 4.25, 0.0, 150.0, 5000.0)])
+    newtrk, newgs = cr_ssd.resolve(*sc2, VMIN, VMAX, cfg, hdg=sc2[4])
+    assert abs(turn_of(sc2, np.asarray(newtrk), 0)) < 1.0   # heading held
+    assert abs(float(newgs[0]) - float(sc2[5][0])) > 1.0    # speed moved
+
+
+def test_rs5_takes_free_ap_velocity():
+    # AP command points AWAY from the conflict -> it is free -> chosen
+    sc = head_on()
+    ap_trk = jnp.asarray([0.0, 180.0], jnp.float32)       # turn north
+    ap_tas = sc[5]
+    cfg = cr_ssd.SSDConfig(rpz_m=RPZ * 1.05, tlookahead=TLOOK,
+                           priocode="RS5")
+    newtrk, newgs = cr_ssd.resolve(*sc, VMIN, VMAX, cfg,
+                                   ap_trk=ap_trk, ap_tas=ap_tas)
+    assert abs(float(newtrk[0]) - 0.0) < 1.0
+    assert abs(float(newgs[0]) - float(ap_tas[0])) < 1.0
+
+
+def test_rs6_right_of_way_keeps_course():
+    # Crossing geometry: intruder approaches from the LEFT of ownship
+    # (bearing ~ -90), so ownship has priority and ignores the VO; the
+    # give-way aircraft (which sees ownship on its right) must deviate.
+    sc = scene([(52.0, 4.0, 90.0, 150.0, 5000.0),      # ownship eastbound
+                (52.12, 4.25, 180.0, 150.0, 5000.0)])  # from own's left
+    cd = sc[0]
+    assert bool(cd.inconf[0]) and bool(cd.inconf[1])
+    cfg = cr_ssd.SSDConfig(rpz_m=RPZ * 1.05, tlookahead=TLOOK,
+                           priocode="RS6")
+    newtrk, newgs = cr_ssd.resolve(*sc, VMIN, VMAX, cfg, hdg=sc[4])
+    assert abs(turn_of(sc, np.asarray(newtrk), 0)) < 1.0   # priority: holds
+    # give-way traffic sees ownship at bearing ~ +90 (from the right)
+    assert abs(turn_of(sc, np.asarray(newtrk), 1)) > 1.0   # must act
+
+
+def test_rs7_near_layer_preferred_when_current_conflicts_nearby():
+    sc, newtrk, newgs = run("RS7")
+    # qualitative: still resolves (deviates) and stays in envelope
+    assert abs(turn_of(sc, newtrk, 0)) > 1.0
+    assert (newgs <= VMAX + 1e-3).all()
+
+
+def test_rs8_uses_ap_objective():
+    sc = head_on()
+    ap_trk = jnp.asarray([45.0, 225.0], jnp.float32)
+    cfg = cr_ssd.SSDConfig(rpz_m=RPZ * 1.05, tlookahead=TLOOK,
+                           priocode="RS8")
+    newtrk, _ = cr_ssd.resolve(*sc, VMIN, VMAX, cfg,
+                               ap_trk=ap_trk, ap_tas=sc[5])
+    # solution gravitates toward the AP track, not the current track
+    d_ap = abs((float(newtrk[0]) - 45.0 + 180.0) % 360.0 - 180.0)
+    d_cur = abs((float(newtrk[0]) - 90.0 + 180.0) % 360.0 - 180.0)
+    assert d_ap <= d_cur + 1e-6
+
+
+def test_chunked_matches_unchunked():
+    rng = np.random.default_rng(3)
+    n = 40
+    rows = [(52.0 + rng.uniform(-0.3, 0.3), 4.0 + rng.uniform(-0.5, 0.5),
+             rng.uniform(0, 360), rng.uniform(120, 170),
+             rng.uniform(4900, 5100)) for _ in range(n)]
+    sc = scene(rows)
+    big = cr_ssd.SSDConfig(rpz_m=RPZ * 1.05, tlookahead=TLOOK, chunk=64)
+    small = cr_ssd.SSDConfig(rpz_m=RPZ * 1.05, tlookahead=TLOOK, chunk=8)
+    t1, g1 = cr_ssd.resolve(*sc, VMIN, VMAX, big)
+    t2, g2 = cr_ssd.resolve(*sc, VMIN, VMAX, small)
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
