@@ -81,6 +81,7 @@ class Plotter:
         self.plots = []
         self.maxfig = 0
         self.varlist = {}
+        self._extra_parents = {}        # survive refresh_sources()
         self.stream_hook = None         # node mode: send_stream callable
         self.refresh_sources()
 
@@ -96,8 +97,13 @@ class Plotter:
             "asas": getvarsfromobj(st.asas),
             "perf": getvarsfromobj(st.perf),
         }
+        # re-resolve registered extra parents (metrics, plugins) so
+        # their attribute lists stay current across state replacements
+        for name, obj in self._extra_parents.items():
+            self.varlist[name] = getvarsfromobj(obj)
 
     def register_data_parent(self, obj, name):
+        self._extra_parents[name] = obj
         self.varlist[name] = getvarsfromobj(obj)
 
     def findvar(self, varname):
