@@ -52,6 +52,11 @@ class Traffic:
             cand = os.path.join(settings.perf_path, "OpenAP")
             if os.path.isdir(os.path.join(cand, "fixwing")):
                 openap_path = cand
+            elif not getattr(Traffic, "_warned_builtin", False):
+                Traffic._warned_builtin = True
+                print(f"perf: no OpenAP coefficient data at {cand} — "
+                      "using the BUILTIN approximate set (unknown types "
+                      "map to 'NA'; see docs/DATA.md)")
         self.coeffdb = perf_coeffs.CoeffDB(openap_path, model=model,
                                            perf_path=settings.perf_path)
         self.area = area  # default creation area (lat0, lat1, lon0, lon1)

@@ -229,7 +229,15 @@ class CoeffDB:
                 if d is not None:
                     self.table[code.upper()] = coeff_bada.bada_to_generic(d)
         elif openap_path:
-            self.table.update(load_openap_dir(openap_path))
+            loaded = load_openap_dir(openap_path)
+            if not loaded:
+                # an explicitly-given path with no data is caller error
+                # territory; the default-path fallback notice lives at
+                # the resolution point (core/traffic.py)
+                print(f"perf: no coefficient data at {openap_path} — "
+                      "using the BUILTIN approximate set "
+                      f"({len(BUILTIN)} types; unknown types map to 'NA')")
+            self.table.update(loaded)
 
     def get(self, actype: str) -> dict:
         return self.table.get(actype.upper(), self.table['NA'])

@@ -33,9 +33,14 @@ class Navdatabase:
         self.reset()
 
     def reset(self):
-        d = load_navdata(self.navdata_path, self.cache_path) \
-            if self.navdata_path and os.path.isdir(self.navdata_path) \
-            else {}
+        have = self.navdata_path and os.path.isdir(self.navdata_path)
+        if not have and not getattr(Navdatabase, "_warned_empty", False):
+            Navdatabase._warned_empty = True
+            print(f"navdb: no navigation data at "
+                  f"{self.navdata_path or '(unset)'} — starting with an "
+                  "empty database (DEFWPT/DEFRWY can define positions; "
+                  "see docs/DATA.md for the expected layout)")
+        d = load_navdata(self.navdata_path, self.cache_path) if have else {}
         self.wpid = list(d.get("wpid", []))
         self.wplat = np.asarray(d.get("wplat", np.zeros(0)), float)
         self.wplon = np.asarray(d.get("wplon", np.zeros(0)), float)

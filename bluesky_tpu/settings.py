@@ -15,7 +15,11 @@ import ast
 import os
 import sys
 
-_REF_DATA = "/root/reference/data"
+# BLUESKY_TPU_NO_REF=1 pretends the read-only reference mount is absent
+# (standalone mode): navdata starts empty, performance falls back to the
+# BUILTIN coefficients, and the scenario library is the local dir only.
+_NO_REF = os.environ.get("BLUESKY_TPU_NO_REF") == "1"
+_REF_DATA = "" if _NO_REF else "/root/reference/data"
 
 # ----------------------------------------------------------------- defaults
 simdt = 0.05
@@ -29,7 +33,7 @@ log_path = "output"
 scenario_path = "scenario"
 # the reference's ~90-file scenario library, searched after the local
 # dir (like the navdata/performance mounts above)
-_REF_SCN = "/root/reference/scenario"
+_REF_SCN = "" if _NO_REF else "/root/reference/scenario"
 ref_scenario_path = _REF_SCN if os.path.isdir(_REF_SCN) else ""
 plugin_path = "plugins"
 enabled_plugins = ["datafeed"]
