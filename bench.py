@@ -58,6 +58,11 @@ def _pick_backend(n_ac):
     on_tpu = jax.default_backend() in ("tpu", "axon")
     if n_ac <= 8192:
         return "dense"
+    if n_ac > 500_000:
+        # the sparse scheduler's window-build graph blows up the TPU
+        # compiler around the million-aircraft mark (BENCH_DETAIL
+        # records the failure); the plain pallas grid still runs there
+        return "pallas" if on_tpu else "tiled"
     return "sparse" if on_tpu else "tiled"
 
 
@@ -135,6 +140,17 @@ def cd_pairs_per_s(n_ac, backend, geometry, reps=3):
         fn = jax.jit(lambda: cd.detect(
             ac.lat, ac.lon, ac.trk, ac.gs, ac.alt, ac.vs, ac.active,
             5 * NM, 1000 * FT, 300.0).swconfl)
+    elif backend == "sparse":
+        from bluesky_tpu.ops import cd_sched
+        thresh = cd_sched.reach_threshold_m(ac.gs, ac.active, 300.0,
+                                            5 * NM)
+        dest = jax.block_until_ready(
+            jax.jit(cd_sched.stripe_sort_dest, static_argnums=(5, 6))(
+                ac.lat, ac.lon, ac.gs, ac.active, thresh, 256, 32))
+        fn = jax.jit(lambda: cd_sched.detect_resolve_sched(
+            ac.lat, ac.lon, ac.trk, ac.gs, ac.alt, ac.vs, ac.gseast,
+            ac.gsnorth, ac.active, traf.state.asas.noreso,
+            5 * NM, 1000 * FT, 300.0, cfg, perm=dest.astype(jnp.int32)))
     else:
         kern = cd_pallas.detect_resolve_pallas if backend == "pallas" \
             else cd_tiled.detect_resolve_tiled
@@ -198,10 +214,13 @@ def detail():
             for geometry in geoms:
                 try:
                     # Keep every single device execution well under the
-                    # tunnel watchdog (~1 min): the slowest config
-                    # (tiled regional at 100k, ~0.4M ac-steps/s) must
-                    # still finish its chunk quickly.
-                    nsteps = 400 if n < 100_000 else 100
+                    # tunnel watchdog (~1 min): the slow lax 'tiled'
+                    # backend gets short chunks at large N (regional
+                    # 100k runs ~0.6M ac-steps/s); the fast kernels keep
+                    # long chunks so per-chunk dispatch + host re-sort
+                    # stay amortized like production fast-forward runs.
+                    nsteps = 100 if (backend == "tiled"
+                                     and n >= 50_000) else 400
                     r = run_one(n, backend, geometry, nsteps=nsteps,
                                 reps=2)
                     rows.append(r)
@@ -213,7 +232,10 @@ def detail():
     # minutes, and 1000 steps at N=1M is one such program.
     for backend in ("pallas", "sparse"):
         try:
-            r = run_one(1_000_000, backend, "global", nsteps=40, reps=2)
+            # sparse at 1M: the stripe sort + window build alone run
+            # near the watchdog; even shorter chunks
+            r = run_one(1_000_000, backend, "global",
+                        nsteps=40 if backend == "pallas" else 20, reps=2)
             rows.append(r)
             print(json.dumps(r))
         except Exception as e:  # noqa: BLE001
