@@ -58,10 +58,10 @@ def _pick_backend(n_ac):
     on_tpu = jax.default_backend() in ("tpu", "axon")
     if n_ac <= 8192:
         return "dense"
-    if n_ac > 500_000:
-        # the sparse scheduler's window-build graph blows up the TPU
-        # compiler around the million-aircraft mark (BENCH_DETAIL
-        # records the failure); the plain pallas grid still runs there
+    if n_ac > 400_000:
+        # the TPU compiler crashes on the sparse scheduler's kernel
+        # somewhere above ~500k aircraft (BENCH_DETAIL records the
+        # failure); the plain pallas grid still runs at the 1M scale
         return "pallas" if on_tpu else "tiled"
     return "sparse" if on_tpu else "tiled"
 

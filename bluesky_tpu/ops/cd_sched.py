@@ -177,26 +177,34 @@ def build_windows(reach, s_cap, wmax, pad_start):
     nb = reach.shape[0]
     col = jnp.arange(nb, dtype=jnp.int32)
     prev = jnp.pad(reach[:, :-1], ((0, 0), (1, 0)))
+    nxt = jnp.pad(reach[:, 1:], ((0, 0), (0, 1)))
     starts = reach & ~prev
     # run start id per column (within its run), then split runs at wmax
     rs = jax.lax.cummax(jnp.where(starts, col, -1), axis=1)
-    newseg = reach & (starts | ((col - rs) % wmax == 0))
-    segid = jnp.cumsum(newseg, axis=1) - 1                 # [nb, nb]
-    nseg = jnp.max(jnp.where(reach, segid, -1), axis=1) + 1
+    off = col - rs
+    newseg = reach & (starts | (off % wmax == 0))
+    # a segment ENDS at a run end or just before the next wmax split
+    segend = reach & (~nxt | (off % wmax == wmax - 1))
+    nseg = jnp.sum(newseg, axis=1)
     overflow = nseg > s_cap
 
-    sel = (segid[:, None, :] == jnp.arange(s_cap, dtype=jnp.int32)
-           [None, :, None]) & reach[:, None, :]            # [nb, S, nb]
-    st = jnp.min(jnp.where(sel, col[None, None, :], nb), axis=2)
-    en = jnp.max(jnp.where(sel, col[None, None, :], -1), axis=2)
-    ln = jnp.maximum(en - st + 1, 0)
-    use = (ln > 0) & ~overflow[:, None]
+    # Extract the s-th start/end per row with a searchsorted on the
+    # running flag counts — O(nb log nb) and graph-size O(1), unlike the
+    # former [nb, s_cap, nb] one-hot reduction whose window-build graph
+    # broke the TPU compiler around nb ~ 4000 (N = 1M).
+    want = jnp.arange(1, s_cap + 1, dtype=jnp.int32)
+    find = jax.vmap(lambda cnt: jnp.searchsorted(cnt, want, side="left"))
+    st = find(jnp.cumsum(newseg, axis=1)).astype(jnp.int32)    # [nb, S]
+    en = find(jnp.cumsum(segend, axis=1)).astype(jnp.int32)
+    valid = want[None, :] <= nseg[:, None]
+    ln = jnp.where(valid, en - st + 1, 0)
+    use = valid & ~overflow[:, None]
     st = jnp.where(use, st, pad_start).astype(jnp.int32)
     ln = jnp.where(use, ln, 0).astype(jnp.int32)
     return st, ln, overflow
 
 
-def _sched_kernel(st_ref, ln_ref, own_ref, *rest,
+def _sched_kernel(wl_ref, own_ref, *rest,
                   block, kk, s_cap, wmax, rpz, hpz, tlookahead, mvpcfg,
                   same_hemi=False, rpz_m=None, reso="mvp"):
     resume = rpz_m is not None
@@ -228,8 +236,13 @@ def _sched_kernel(st_ref, ln_ref, own_ref, *rest,
     @pl.when(jnp.any(act_o))
     def _row():
         for s in range(s_cap):
-            base = st_ref[i, s]
-            ln = ln_ref[i, s]
+            # (start, len) are bit-packed into one scalar-prefetch array
+            # (start low 20 bits, len high 12): the scalar-prefetch SMEM
+            # budget overflows with two [nb, s_cap] int32 tables around
+            # nb ~ 1600 (the TPU compiler crashes ungracefully there).
+            w = wl_ref[i, s]
+            base = w & 0xFFFFF
+            ln = w >> 20
             slab_ref = intr_refs[s]
 
             def body(k, _, base=base, slab_ref=slab_ref):
@@ -293,6 +306,15 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
     n = lat.shape[0]
     dtype = jnp.float32
     block = min(block, 256)
+    if n > 400_000:
+        # The TPU compiler crashes (tpu_compile_helper exit 1, no
+        # diagnostics) on this kernel somewhere above ~500k aircraft —
+        # measured OK at 400k, failing at 700k; neither scalar-prefetch
+        # size, Element-dim size nor grid shape proved to be the
+        # variable.  The plain pallas grid covers the 1M scale
+        # (bench._pick_backend routes there); shrinking s_cap extends
+        # the sparse range a little.
+        s_cap = min(s_cap, 4)
     if partners is None and n <= 2 * block:
         # Too small to schedule — the plain kernel is already one tile.
         return cd_pallas.detect_resolve_pallas(
@@ -345,9 +367,17 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                                vs=padded["vs"], hpz=float(hpz))
 
     # Segment windows + the Wmax-block pad region the sentinel slots
-    # point at (slots are clamped so every DMA stays in bounds).
+    # point at (slots are clamped so every DMA stays in bounds); start
+    # and len ride one bit-packed scalar-prefetch array (SMEM budget,
+    # see _sched_kernel).
+    if nb >= 2 ** 20 or wmax >= 2 ** 11:
+        raise ValueError(
+            f"worklist bit-pack overflow: nb={nb} must be < 2^20 and "
+            f"wmax={wmax} < 2^11 (start|len share one int32; a silent "
+            "overflow would drop conflict windows)")
     st, ln, overflow = build_windows(reach, s_cap, wmax, pad_start=nb)
-    st = jnp.clip(st, 0, nb + wmax - wmax)                 # [0, nb]
+    st = jnp.clip(st, 0, nb)
+    wl = st | (ln << 20)
     packed16 = jnp.concatenate([
         jnp.concatenate(                                   # 13 -> 16 rows
             [packed, jnp.zeros((nb, _NFP - len(_FIELDS), block), dtype)],
@@ -355,20 +385,21 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         jnp.zeros((wmax, _NFP, block), dtype)], axis=0)    # DMA pad region
 
     kk = k_partners
-    own_spec = pl.BlockSpec((1, _NFP, block), lambda i, st, ln: (i, 0, 0),
+    own_spec = pl.BlockSpec((1, _NFP, block), lambda i, wl: (i, 0, 0),
                             memory_space=pltpu.VMEM)
     intr_specs = [
         pl.BlockSpec((pl.Element(wmax), pl.Element(_NFP),
                       pl.Element(block)),
                      functools.partial(
-                         lambda i, st, ln, s=0: (st[i, s], 0, 0), s=s),
+                         lambda i, wl, s=0: (wl[i, s] & 0xFFFFF, 0, 0),
+                         s=s),
                      memory_space=pltpu.VMEM)
         for s in range(s_cap)]
     acc_spec = lambda: pl.BlockSpec((1, 1, block),
-                                    lambda i, st, ln: (i, 0, 0),
+                                    lambda i, wl: (i, 0, 0),
                                     memory_space=pltpu.VMEM)
     cand_spec = lambda: pl.BlockSpec((1, kk, block),
-                                     lambda i, st, ln: (i, 0, 0),
+                                     lambda i, wl: (i, 0, 0),
                                      memory_space=pltpu.VMEM)
     out_shape = [jax.ShapeDtypeStruct((nb, 1, block), dtype)] * 8 + [
         jax.ShapeDtypeStruct((nb, kk, block), dtype),
@@ -397,7 +428,7 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         in_specs = [own_spec] + [intr_specs[s] for s in range(s_cap)]
         out_specs = [acc_spec() for _ in range(8)] \
             + [cand_spec(), cand_spec()]
-        args = [st, ln, packed16] + [packed16] * s_cap
+        args = [wl, packed16] + [packed16] * s_cap
         if resume:
             in_specs.append(cand_spec())               # pold
             args.append(pold)
@@ -405,7 +436,7 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         outs_s = list(pl.pallas_call(
             kern,
             grid_spec=pltpu.PrefetchScalarGridSpec(
-                num_scalar_prefetch=2,
+                num_scalar_prefetch=1,
                 grid=(nb,),
                 in_specs=in_specs,
                 out_specs=out_specs,
@@ -433,11 +464,17 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         outs_f = jax.lax.cond(jnp.any(overflow), fallback, neutral, reach_f)
         return [jnp.where(rsel, f, s) for f, s in zip(outs_f, outs_s)]
 
-    lat_a = jnp.where(act_b, padded["lat"], 0.0)
-    cross = (jnp.min(lat_a) < 0.0) & (jnp.max(lat_a) > 0.0)
-    outs = jax.lax.cond(cross,
-                        functools.partial(run, False),
-                        functools.partial(run, True))
+    if nb > 1024:
+        # Large-N: compile a single kernel variant (both equator-branch
+        # variants double compile time for a ~10% saving that huge
+        # fleets, which usually straddle the equator, rarely get).
+        outs = run(False)
+    else:
+        lat_a = jnp.where(act_b, padded["lat"], 0.0)
+        cross = (jnp.min(lat_a) < 0.0) & (jnp.max(lat_a) > 0.0)
+        outs = jax.lax.cond(cross,
+                            functools.partial(run, False),
+                            functools.partial(run, True))
 
     (inconf, tcpamax, sdve, sdvn, sdvv, tsolv, ncnt, lcnt,
      ctin, cidx) = outs[:10]
