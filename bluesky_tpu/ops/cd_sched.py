@@ -282,7 +282,7 @@ def _sched_kernel(wl_ref, own_ref, *rest,
 
 def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                          active, noreso, rpz, hpz, tlookahead, mvpcfg,
-                         block=256, k_partners=8, s_cap=8, wmax=12,
+                         block=256, k_partners=8, s_cap=6, wmax=16,
                          extra_blocks=32, interpret=False, perm=None,
                          cols_per_prog=4, partners=None, resume_rpz_m=None,
                          tas=None, reso="mvp"):

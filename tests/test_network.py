@@ -12,6 +12,7 @@ zmq = pytest.importorskip("zmq")
 
 from bluesky_tpu.network import npcodec
 from bluesky_tpu.network.node import Node, split_envelope
+from bluesky_tpu.network.node_mt import MTNode
 from bluesky_tpu.network.client import Client
 from bluesky_tpu.network.server import Server, split_scenarios
 
@@ -52,15 +53,36 @@ class EchoNode(Node):
                             route=list(sender_route))
 
 
-@pytest.fixture
-def fabric():
-    """A running Server + registered EchoNode + connected Client."""
+class EchoMTNode(MTNode):
+    """MTNode flavor of EchoNode (reference node_mt.py parity): same
+    behavior through the dedicated I/O thread."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.got = []
+
+    def event(self, name, data, sender_route):
+        self.got.append((name, data))
+        if name == b"STACKCMD":
+            self.send_event(b"ECHO", f"ok: {data}",
+                            route=list(sender_route))
+
+
+@pytest.fixture(params=["node", "node_mt"])
+def fabric(request):
+    """A running Server + registered echo node + connected Client.
+
+    Parametrized over the single-threaded Node and the I/O-threaded
+    MTNode (reference node_mt.py), so every fabric behavior —
+    register, event routing, broadcast, streams, QUIT fan-out — is
+    verified against both flavors (MTNode claims drop-in parity)."""
     ev, st, wev, wst = free_ports(4)
     ports = dict(event=ev, stream=st, wevent=wev, wstream=wst)
     server = Server(headless=True, ports=ports, spawn_workers=False)
     server.start()
     time.sleep(0.2)                      # let the binds land
-    node = EchoNode(event_port=wev, stream_port=wst)
+    node_cls = EchoNode if request.param == "node" else EchoMTNode
+    node = node_cls(event_port=wev, stream_port=wst)
     node_thread = threading.Thread(target=node.run, daemon=True)
     node_thread.start()
     client = Client()
