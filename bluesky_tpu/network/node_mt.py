@@ -101,11 +101,28 @@ class MTNode(Node):
 
     # ------------------------------------------------------------ lifecycle
     def connect(self):
+        # A PAIR send with no connected peer blocks forever; if the
+        # IOThread dies on startup (bad endpoint, context teardown) the
+        # REGISTER send would hang the sim thread.  Bound only this send
+        # — steady-state sends keep the blocking-backpressure contract
+        # (the thread buffers; a stalled broker must not crash the loop).
         self.io_thread.start()
-        self.send_event(b"REGISTER", None)
+        self.event_io.setsockopt(zmq.SNDTIMEO, 2000)
+        try:
+            self.send_event(b"REGISTER", None)
+        except zmq.Again:
+            alive = self.io_thread.is_alive()
+            raise RuntimeError(
+                "MTNode I/O thread %s — REGISTER send timed out"
+                % ("is not consuming" if alive else "died on startup"))
+        finally:
+            self.event_io.setsockopt(zmq.SNDTIMEO, -1)
 
     def close(self):
-        # stop the I/O thread first, then tear down the inproc pair
+        # stop the I/O thread first, then tear down the inproc pair;
+        # bound the _QUIT send the same way as REGISTER (a dead thread
+        # must not hang teardown).
+        self.event_io.setsockopt(zmq.SNDTIMEO, 2000)
         try:
             self.event_io.send_multipart([_QUIT])
             self.io_thread.join(timeout=2.0)

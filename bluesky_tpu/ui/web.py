@@ -16,10 +16,15 @@ Two backends plug in behind one ``WebUI`` facade:
     rendered from its ACDATA/ROUTEDATA nodeData mirror — the same
     client path the reference GUI consumes (screenio.py:18-21 streams).
 
-Threading: the HTTP server runs daemon threads; frame rendering reads
-immutable device arrays / the client's mirror dicts, and stack commands
-are queued to the owner loop (the sim thread calls ``pump()`` between
-chunks), so no state is mutated from a server thread.
+Threading: the HTTP server runs daemon threads, but host-side Traffic
+state (the ids list, routes, array replacement between chunks) is only
+consistent on the sim thread.  ``SimBackend.pump()`` therefore renders
+the frame *on the sim thread* between chunks and caches it; server
+threads serve the cached frame, so they never read sim state mid-
+mutation and N connected viewers cost one render, not N.  Stack
+commands are queued to the owner loop the same way.  When no loop is
+pumping (tests, ad-hoc embedding) ``frame()`` falls back to rendering
+directly, which is safe only because nothing else is stepping the sim.
 """
 import json
 import queue
@@ -83,13 +88,22 @@ class SimBackend:
     def __init__(self, sim):
         self.sim = sim
         self._pending = queue.Queue()
+        self._frame = None               # (svg, info) cached by pump()
+        self.render_period = 0.25        # cache refresh cap (s)
+        self._last_render = 0.0
 
-    def frame(self):
+    def _render(self):
         from . import radar
         svg = radar.render_sim(self.sim)
         return svg, (f"simt {float(self.sim.simt):8.1f} s   "
                      f"ntraf {self.sim.traf.ntraf}   "
                      f"state {self.sim.state_flag}")
+
+    def frame(self):
+        """Latest frame; served from the sim-thread cache when a loop is
+        pumping, rendered in place otherwise (idle sim only)."""
+        cached = self._frame
+        return cached if cached is not None else self._render()
 
     def command(self, line):
         """Queue a stack command; executed by the sim loop via pump()."""
@@ -101,16 +115,29 @@ class SimBackend:
             return "(queued)"
 
     def pump(self):
-        """Run queued commands on the sim thread (call between chunks)."""
+        """Run queued commands and refresh the frame cache — called on
+        the sim thread between chunks, the only place state is stable."""
+        ran_cmd = False
         while True:
             try:
                 line, done = self._pending.get_nowait()
             except queue.Empty:
-                return
+                break
             self.sim.scr.echobuf.clear()
             self.sim.stack.stack(line)
             self.sim.stack.process()
             done.put("\n".join(self.sim.scr.echobuf))
+            ran_cmd = True
+        now = time.monotonic()
+        # Refresh at most at render_period, but always right after a
+        # command — the user who just typed CRE expects to see it.
+        if ran_cmd or now - self._last_render >= self.render_period:
+            self._last_render = now
+            try:
+                self._frame = self._render()
+            except Exception:
+                pass     # keep the last good frame; a render bug must
+                         # not take down the sim loop it rides on
 
 
 class ClientBackend:
@@ -219,6 +246,7 @@ def serve_sim(sim, host="127.0.0.1", port=8080, fps=4.0, run=True):
     web equivalent of the reference's Qt event loop around the sim
     timer (``ui/qtgl/mainwindow.py``)."""
     backend = SimBackend(sim)
+    backend.pump()       # seed the frame cache before any server thread
     ui = WebUI(backend, host=host, port=port, fps=fps).start()
     print(f"bluesky_tpu web UI on http://{ui.host}:{ui.port}/")
     if not run:
