@@ -58,11 +58,10 @@ def _pick_backend(n_ac):
     on_tpu = jax.default_backend() in ("tpu", "axon")
     if n_ac <= 8192:
         return "dense"
-    if n_ac > 400_000:
-        # the TPU compiler crashes on the sparse scheduler's kernel
-        # somewhere above ~500k aircraft (BENCH_DETAIL records the
-        # failure); the plain pallas grid still runs at the 1M scale
-        return "pallas" if on_tpu else "tiled"
+    # The sparse scheduler covers every large-N size: past ~450k rows
+    # are split into <=_MAX_ROWS-row kernel invocations (cd_sched.py
+    # row split), which sidesteps the former tpu_compile_helper crash
+    # and keeps the segment schedule all the way to 1M+.
     return "sparse" if on_tpu else "tiled"
 
 
@@ -250,9 +249,11 @@ def detail():
     return rows
 
 
-def sharded(n_ac=4096, n_devices=8, nsteps=100):
-    """Multi-chip path: the scanned step with the blockwise 'tiled' CD
-    sharded over an aircraft-axis mesh (parallel/sharding.py).
+def sharded(n_ac=4096, n_devices=8, nsteps=100, backend="sparse"):
+    """Multi-chip path: the scanned step with the CD backend sharded
+    over an aircraft-axis mesh (parallel/sharding.py; 'sparse' runs the
+    headline segment-scheduled kernel's shard_map row split, 'tiled'
+    the GSPMD lax formulation).
 
     On a host with >= n_devices accelerators this measures real
     multi-chip throughput; on this single-TPU box it runs the SAME
@@ -297,12 +298,13 @@ def sharded(n_ac=4096, n_devices=8, nsteps=100):
     ndev = min(n_devices, len(jax.devices()))
     mesh = shard.make_mesh(ndev)
     traf = _make_traffic(n_ac, "continental", False, jnp.float32)
-    cfg = SimConfig(cd_backend="tiled", cd_block=256)
-    # Morton-sort once before sharding: on the identity layout every
-    # block's bounding box spans the airspace and the reachability skip
-    # does nothing, understating the blockwise rate.
+    cfg = SimConfig(cd_backend=backend, cd_block=256)
+    # Sort once before sharding: on the identity layout every block's
+    # bounding box spans the airspace and the reachability skip does
+    # nothing, understating the blockwise rate.
+    from bluesky_tpu.core.asas import impl_for_backend
     state = refresh_spatial_sort(traf.state, cfg.asas, block=cfg.cd_block,
-                                 impl="lax")
+                                 impl=impl_for_backend(backend))
     state = shard.shard_state(state, mesh)
     run = shard.sharded_step_fn(mesh, cfg, nsteps=nsteps)
     state = jax.block_until_ready(run(state))     # compile + warm
@@ -312,7 +314,7 @@ def sharded(n_ac=4096, n_devices=8, nsteps=100):
     rate = n_ac * nsteps / dt
     result = {
         "metric": (f"sharded aircraft-steps/s (N={n_ac}, {ndev}x "
-                   f"{jax.devices()[0].platform} mesh, tiled CD, "
+                   f"{jax.devices()[0].platform} mesh, {backend} CD, "
                    f"blocks/device="
                    f"{-(-n_ac // cfg.cd_block) / ndev:.1f})"),
         "value": round(rate, 1),
@@ -328,7 +330,8 @@ if __name__ == "__main__":
         detail()
     elif "--sharded" in sys.argv:
         args = [a for a in sys.argv[1:] if not a.startswith("--")]
-        sharded(n_ac=int(args[0]) if args else 4096)
+        sharded(n_ac=int(args[0]) if args else 4096,
+                backend=args[1] if len(args) > 1 else "sparse")
     else:
         n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
         main(n_ac=n)

@@ -40,6 +40,12 @@ def main(argv=None):
     parser.add_argument("--discoverable", action="store_true")
     parser.add_argument("--web-port", type=int, default=8080,
                         help="port for --web mode")
+    parser.add_argument("--node-id", default="",
+                        help="hex worker id assigned by the spawning "
+                             "server (crash tracking)")
+    parser.add_argument("--upstream", default="",
+                        help="chain this server under another: host:port "
+                             "of the upstream server's client event port")
     args = parser.parse_args(argv)
 
     settings.init(args.config_file)
@@ -62,8 +68,13 @@ def run_server(args):
         ports["event"] = args.event_port
     if args.stream_port:
         ports["stream"] = args.stream_port
+    upstream = None
+    if args.upstream:
+        host, _, port = args.upstream.rpartition(":")
+        upstream = (host or "127.0.0.1", int(port))
     server = Server(headless=True, discoverable=args.discoverable,
-                    ports=ports, max_nnodes=settings.max_nnodes)
+                    ports=ports, max_nnodes=settings.max_nnodes,
+                    upstream=upstream)
     print(f"bluesky_tpu server: clients on "
           f"{server.ports['event']}/{server.ports['stream']}, workers on "
           f"{server.ports['wevent']}/{server.ports['wstream']}")
@@ -95,7 +106,9 @@ def _start_telnet(sim):
 def run_sim(args):
     from .simulation.simnode import SimNode
     node = SimNode(event_port=args.event_port,
-                   stream_port=args.stream_port)
+                   stream_port=args.stream_port,
+                   node_id=bytes.fromhex(args.node_id)
+                   if args.node_id else None)
     _start_telnet(node.sim)
     if args.scenfile:
         node.sim.stack.ic(args.scenfile)

@@ -16,6 +16,7 @@ Resolver selection: MVP is the default (and currently only) device resolver;
 the registry hook mirrors the reference's CDmethods/CRmethods dicts
 (asas.py:41-55) for host-side extension.
 """
+import functools
 from typing import NamedTuple, Tuple
 
 import jax
@@ -212,6 +213,15 @@ def refresh_spatial_sort(state: SimState, cfg: AsasConfig,
         block = min(block, 256)
         thresh = cd_sched.reach_threshold_m(
             ac.gs, ac.active, cfg.dtlookahead, cfg.rpz)
+        # Altitude layering stays OFF: measured end-to-end on the v5e
+        # at N=100k it loses ~4% even on the dense 230 nm circle
+        # (1.74x vs 1.82x real-time) — the schedule-level 2.3x pair
+        # reduction is real, but the regional wall time is dominated by
+        # per-pair conflict tails (2.5M concurrent conflicts), and the
+        # real fleet's TAS spread fattens the layered blocks.  The
+        # mechanism remains available (stripe_sort_dest n_layers, incl.
+        # the on-device "auto" gate) for fleets with genuinely banded
+        # cruise altitudes.
         dest = cd_sched.stripe_sort_dest(
             ac.lat, ac.lon, ac.gs, ac.active, thresh, block, 32,
             alt=ac.alt, vs=ac.vs).astype(jnp.int32)
@@ -244,7 +254,8 @@ def refresh_spatial_sort(state: SimState, cfg: AsasConfig,
 
 
 def update_tiled(state: SimState, cfg: AsasConfig, block: int = 512,
-                 impl: str = "lax") -> Tuple[SimState, RowConflictData]:
+                 impl: str = "lax", mesh=None,
+                 mesh_axis: str = "ac") -> Tuple[SimState, RowConflictData]:
     """One ASAS interval via the blockwise large-N backend (ops/cd_tiled.py).
 
     Same pipeline as ``update`` — detect, resolve, bookkeep, resume
@@ -253,6 +264,11 @@ def update_tiled(state: SimState, cfg: AsasConfig, block: int = 512,
     partner table instead of the resopairs matrix.  ``impl`` selects the
     lax.scan formulation ('lax', runs everywhere) or the Pallas TPU kernel
     ('pallas', ops/cd_pallas.py).
+
+    ``mesh`` shards the Pallas kernels' row blocks over a device mesh
+    via ``shard_map`` (see ``ops/cd_sched.detect_resolve_sched``); the
+    lax backend needs no manual sharding (GSPMD partitions it from the
+    state shardings alone).
     """
     ac, asas = state.ac, state.asas
     k = asas.partners.shape[1]
@@ -299,11 +315,13 @@ def update_tiled(state: SimState, cfg: AsasConfig, block: int = 512,
             k_partners=asas.partners_s.shape[1], perm=perm,
             partners=asas.partners_s[:n_tot],
             resume_rpz_m=cfg.rpz * cfg.resofach,
-            tas=ac.tas if kern_reso == "eby" else None, reso=kern_reso)
+            tas=ac.tas if kern_reso == "eby" else None, reso=kern_reso,
+            mesh=mesh, mesh_axis=mesh_axis)
     else:
         if impl == "pallas":
             from ..ops import cd_pallas
-            detect_fn = cd_pallas.detect_resolve_pallas
+            detect_fn = functools.partial(cd_pallas.detect_resolve_pallas,
+                                          mesh=mesh, mesh_axis=mesh_axis)
         else:
             detect_fn = cd_tiled.detect_resolve_tiled
         extra = None
@@ -395,7 +413,7 @@ def update_tiled(state: SimState, cfg: AsasConfig, block: int = 512,
             partners_s=partners_s,
             active=act_new & cfg.reso_on,
             inconf=rd.inconf,
-            tcpamax=rd.tcpamax,
+            tcpamax=rd.tcpamax.astype(asas.tcpamax.dtype),
             nconf_cur=rd.nconf,
             nlos_cur=rd.nlos)
         return state.replace(asas=asas), rd
@@ -424,7 +442,7 @@ def update_tiled(state: SimState, cfg: AsasConfig, block: int = 512,
         partners=partners,
         active=act_tbl & cfg.reso_on,
         inconf=rd.inconf,
-        tcpamax=rd.tcpamax,
+        tcpamax=rd.tcpamax.astype(asas.tcpamax.dtype),
         nconf_cur=rd.nconf,
         nlos_cur=rd.nlos)
     return state.replace(asas=asas), rd

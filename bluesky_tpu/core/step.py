@@ -46,6 +46,12 @@ class SimConfig(NamedTuple):
     # fastest large-N path for spread-out fleets, exact-equal results.
     cd_backend: str = "dense"
     cd_block: int = 512
+    # Device mesh for the Pallas backends' shard_map row split (the lax
+    # and dense backends shard via GSPMD from state shardings alone and
+    # ignore this).  A jax.sharding.Mesh is hashable, so the config
+    # stays jit-static; parallel.sharding.sharded_step_fn fills it in.
+    cd_mesh: object = None
+    cd_mesh_axis: str = "ac"
 
 
 def step(state: SimState, cfg: SimConfig) -> SimState:
@@ -100,7 +106,9 @@ def step(state: SimState, cfg: SimConfig) -> SimState:
             if cfg.cd_backend in ("tiled", "pallas", "sparse"):
                 impl = asasmod.impl_for_backend(cfg.cd_backend)
                 s2, _cd = asasmod.update_tiled(s, cfg.asas,
-                                               block=cfg.cd_block, impl=impl)
+                                               block=cfg.cd_block, impl=impl,
+                                               mesh=cfg.cd_mesh,
+                                               mesh_axis=cfg.cd_mesh_axis)
             else:
                 s2, _cd = asasmod.update(s, cfg.asas)
             return s2.replace(

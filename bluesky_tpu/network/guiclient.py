@@ -28,6 +28,8 @@ class nodeData:
         self.siminfo = {}
         self.shapes = {}          # name -> (kind, coords)
         self.echo_text = []
+        self.custwpts = {}        # DEFWPT mirror: name -> (lat, lon)
+        self.flags = {}           # DISPLAYFLAG mirror: flag -> last args
         # Accumulated trail picture (ACDATA carries deltas)
         self.traillat0 = np.array([])
         self.traillon0 = np.array([])
@@ -84,11 +86,17 @@ class GuiClient(Client):
         if name == b"ECHO":
             nd.echo_text.append(data.get("text", ""))
         elif name == b"SHAPE":
-            if data.get("kind"):
-                nd.shapes[data["name"]] = (data["kind"],
-                                           data.get("coords"))
+            # Reference wire format (screenio.py:171 / guiclient.py:158):
+            # coordinates=None deletes the named shape.
+            if data.get("coordinates") is not None:
+                nd.shapes[data["name"]] = (data.get("shape"),
+                                           data.get("coordinates"))
             else:
                 nd.shapes.pop(data.get("name"), None)
+        elif name == b"DEFWPT":
+            nd.custwpts[data["name"]] = (data.get("lat"), data.get("lon"))
+        elif name == b"DISPLAYFLAG":
+            nd.flags[data.get("flag")] = data.get("args")
 
     def _on_stream(self, name, data, sender):
         nd = self.nodedata[sender]

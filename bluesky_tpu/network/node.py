@@ -30,8 +30,11 @@ class Node:
 
     def __init__(self, event_port: int = DEFAULT_PORTS["wevent"],
                  stream_port: int = DEFAULT_PORTS["wstream"],
-                 host: str = "127.0.0.1"):
-        self.node_id = make_id()
+                 host: str = "127.0.0.1", node_id: bytes = None):
+        # node_id may be assigned by the spawning server (so it can map
+        # its child process to the registered worker for crash
+        # detection); self-started nodes generate their own.
+        self.node_id = node_id or make_id()
         self.host_id = b""        # filled by REGISTER reply
         self.running = False
         ctx = zmq.Context.instance()
@@ -86,6 +89,10 @@ class Node:
             if name == b"REGISTER":
                 # handshake ack: payload carries the server id
                 self.host_id = data["host_id"]
+            elif name == b"PING":
+                # server liveness probe: echo the stamp back (the reply
+                # is protocol-level so every Node flavor is covered)
+                self.send_event(b"PONG", data)
             elif name == b"QUIT":
                 self.quit()
             else:

@@ -82,9 +82,9 @@ class MTNode(Node):
 
     def __init__(self, event_port: int = DEFAULT_PORTS["wevent"],
                  stream_port: int = DEFAULT_PORTS["wstream"],
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", node_id: bytes = None):
         super().__init__(event_port=event_port, stream_port=stream_port,
-                         host=host)
+                         host=host, node_id=node_id)
         # Replace the direct TCP sockets with inproc bridges; the thread
         # owns the network side.
         self.event_io.close()

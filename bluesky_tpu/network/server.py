@@ -10,15 +10,30 @@ sees are exactly the return route for its reply.  ``b'*'`` fans out to all
 workers.
 
 Server-directed events (empty route): REGISTER, ADDNODES, BATCH, QUIT,
-STATECHANGE.  BATCH splits a multi-SCEN scenario and farms the pieces out
-to idle workers, spawning more (up to max_nnodes) as needed — the
-reference's scenario-ensemble parallelism (§2.10), which on TPU pairs with
-the device-side ensemble axis in parallel/sharding.py.
+STATECHANGE, PONG.  BATCH splits a multi-SCEN scenario and farms the
+pieces out to idle workers, spawning more (up to max_nnodes) as needed —
+the reference's scenario-ensemble parallelism (§2.10), which on TPU pairs
+with the device-side ensemble axis in parallel/sharding.py.
+
+Hardening beyond the reference:
+* **Worker liveness**: spawned workers get their id assigned
+  (``--node-id``) so a dead child process maps straight back to its
+  registration; external workers are probed with PING/PONG.  A dead
+  worker's in-flight BATCH piece is requeued and a replacement is
+  spawned — kill -9 a worker mid-batch and the batch still completes.
+* **Server-to-server chaining** (reference server.py:213-225): a server
+  started with ``upstream=(host, port)`` registers at another server's
+  client port, mirrors that server's node table to its own clients
+  (NODESCHANGED merge), and routes events for remote nodes over the
+  link.  Multi-hop replies work because reply routes are the REVERSED
+  accumulated sender tail (single-hop routes are palindromes, so the
+  flat fabric is unaffected).
 """
 import os
 import subprocess
 import sys
 import threading
+import time
 
 import zmq
 
@@ -46,7 +61,9 @@ class Server(threading.Thread):
     """Runs the broker loop in a thread (reference: Server(Thread))."""
 
     def __init__(self, headless=False, discoverable=False,
-                 ports=None, max_nnodes=None, spawn_workers=True):
+                 ports=None, max_nnodes=None, spawn_workers=True,
+                 upstream=None, hb_interval=2.0, hb_timeout=30.0,
+                 restart_crashed=True):
         super().__init__(daemon=True)
         self.server_id = make_id()
         self.headless = headless
@@ -61,6 +78,19 @@ class Server(threading.Thread):
         self.scenarios = []                # pending BATCH pieces
         self.processes = []                # spawned worker Popen handles
         self._pending_spawns = 0           # spawned but not yet REGISTERed
+        # ----- liveness / restart
+        self.hb_interval = hb_interval
+        self.hb_timeout = hb_timeout
+        self.restart_crashed = restart_crashed
+        self.spawned = {}                  # worker_id -> Popen
+        self.inflight = {}                 # worker_id -> BATCH piece
+        self.last_seen = {}                # worker_id -> monotonic stamp
+        self._next_hb = 0.0
+        # ----- server-to-server chaining
+        self.upstream = upstream           # (host, event_port) or None
+        self.link = None                   # DEALER to the upstream server
+        self.link_id = b""                 # upstream host id (after ack)
+        self.remote_nodes = {}             # node_id -> upstream host id
         self.discovery = Discovery(self.server_id, is_client=False,
                                    port=self.ports["discovery"]) \
             if discoverable else None
@@ -78,15 +108,23 @@ class Server(threading.Thread):
 
     # ----------------------------------------------------------- lifecycle
     def addnodes(self, count=1):
-        """Spawn sim worker processes (parity: server.py:62-67)."""
+        """Spawn sim worker processes (parity: server.py:62-67).
+
+        The worker id is assigned HERE and passed down (--node-id) so a
+        child that dies without a goodbye (kill -9, OOM) maps straight
+        back to its registration for requeue + restart."""
         if not self.spawn_workers:
             return
         for _ in range(count):
             self._pending_spawns += 1
-            self.processes.append(subprocess.Popen(
+            wid = make_id()
+            proc = subprocess.Popen(
                 [sys.executable, "-m", "bluesky_tpu", "--sim",
                  "--event-port", str(self.ports["wevent"]),
-                 "--stream-port", str(self.ports["wstream"])]))
+                 "--stream-port", str(self.ports["wstream"]),
+                 "--node-id", wid.hex()])
+            self.processes.append(proc)
+            self.spawned[wid] = proc
 
     def stop(self):
         self._stop_requested = True
@@ -109,12 +147,24 @@ class Server(threading.Thread):
             return
         dest = route[0]
         tail = list(route[1:]) + [sender]
-        sock = self.be_event if dest in self.workers else self.fe_event
+        if dest in self.workers:
+            sock = self.be_event
+        elif self.link is not None and (dest in self.remote_nodes
+                                        or dest == self.link_id):
+            # chained node: hop over the upstream link (the DEALER's own
+            # identity is the implicit sender frame on the other side)
+            self.link.send_multipart([dest] + tail + [name, payload])
+            return
+        else:
+            sock = self.fe_event
         sock.send_multipart([dest] + tail + [name, payload])
 
     def _nodeschanged(self):
+        """Notify clients; chained remote nodes are merged in (reference
+        server.py:213-225 route-prefixed server table)."""
         data = packb({"host_id": self.server_id,
-                      "nodes": list(self.workers)})
+                      "nodes": list(self.workers)
+                      + list(self.remote_nodes)})
         for cid in self.clients:
             self.fe_event.send_multipart([cid, b"NODESCHANGED", data])
 
@@ -132,7 +182,8 @@ class Server(threading.Thread):
             sock.send_multipart(
                 [sender, b"REGISTER",
                  packb({"host_id": self.server_id,
-                        "nodes": list(self.workers)})])
+                        "nodes": list(self.workers)
+                        + list(self.remote_nodes)})])
         elif name == b"ADDNODES":
             count = unpackb(payload) if payload else 1
             self.addnodes(int(count or 1))
@@ -140,8 +191,15 @@ class Server(threading.Thread):
             state = unpackb(payload)
             if state == -1:
                 self.workers.pop(sender, None)
+                self.spawned.pop(sender, None)
+                self.last_seen.pop(sender, None)
                 if sender in self.avail_workers:
                     self.avail_workers.remove(sender)
+                # a worker that quit with a piece still running gives it
+                # back to the queue
+                piece = self.inflight.pop(sender, None)
+                if piece is not None:
+                    self.scenarios.insert(0, piece)
                 self._nodeschanged()
                 # keep the batch draining if pieces are still queued
                 if self.scenarios:
@@ -155,11 +213,14 @@ class Server(threading.Thread):
                 # busy workers must not receive BATCH pieces
                 # (parity: server.py:234-247)
                 if state < 2:
+                    self.inflight.pop(sender, None)   # piece completed
                     if sender not in self.avail_workers:
                         self.avail_workers.append(sender)
                         self._send_pending_scenario()
                 elif sender in self.avail_workers:
                     self.avail_workers.remove(sender)
+        elif name == b"PONG":
+            pass                           # last_seen already stamped
         elif name == b"BATCH":
             data = unpackb(payload)
             self.scenarios.extend(
@@ -183,10 +244,91 @@ class Server(threading.Thread):
     def _send_pending_scenario(self):
         if self.avail_workers and self.scenarios:
             wid = self.avail_workers.pop(0)
-            scentime, scencmd = self.scenarios.pop(0)
+            piece = self.scenarios.pop(0)
+            self.inflight[wid] = piece     # held until the worker leaves OP
+            scentime, scencmd = piece
             self.be_event.send_multipart(
                 [wid, b"BATCH", packb({"scentime": scentime,
                                        "scencmd": scencmd})])
+
+    # ------------------------------------------------- liveness / chaining
+    def _reap_dead_workers(self):
+        """PING registered workers and bury the dead: a spawned child
+        whose process exited, or any worker silent past hb_timeout.
+        The dead worker's in-flight piece is requeued and (for crashed
+        children) a replacement is spawned."""
+        now = time.monotonic()
+        dead = []
+        for wid in list(self.workers):
+            proc = self.spawned.get(wid)
+            # A worker mid-BATCH may be stuck in a long device chunk or
+            # a first-step JIT compile (minutes at large N) without a
+            # chance to pump events — give busy workers 10x the silence
+            # budget before declaring a pong-based death (process exit
+            # stays immediate for spawned children).
+            budget = self.hb_timeout * (10.0 if wid in self.inflight
+                                        or self.workers.get(wid, 0) >= 2
+                                        else 1.0)
+            if proc is not None and proc.poll() is not None:
+                dead.append(wid)           # child exited without goodbye
+            elif proc is None and now - self.last_seen.get(wid, now) \
+                    > budget:
+                dead.append(wid)           # external worker went silent
+            else:
+                self.be_event.send_multipart([wid, b"PING", packb(now)])
+        # Spawned children that died BEFORE ever registering (startup
+        # crash: import error, OOM) would otherwise leak their pending-
+        # spawn slot and shrink the headroom forever.
+        for wid, proc in list(self.spawned.items()):
+            if wid not in self.workers and proc.poll() is not None:
+                self.spawned.pop(wid, None)
+                self._pending_spawns = max(0, self._pending_spawns - 1)
+                print(f"server: spawned worker {wid.hex()} died before "
+                      f"registering (exit {proc.returncode})")
+                if self.restart_crashed and self.scenarios:
+                    headroom = self.max_nnodes - len(self.workers) \
+                        - self._pending_spawns
+                    if headroom > 0:
+                        self.addnodes(1)
+        for wid in dead:
+            print(f"server: worker {wid.hex()} died — "
+                  f"{'requeueing piece, ' if wid in self.inflight else ''}"
+                  f"removing from pool")
+            self.workers.pop(wid, None)
+            self.spawned.pop(wid, None)
+            self.last_seen.pop(wid, None)
+            if wid in self.avail_workers:
+                self.avail_workers.remove(wid)
+            piece = self.inflight.pop(wid, None)
+            if piece is not None:
+                self.scenarios.insert(0, piece)
+            if self.restart_crashed and self.spawn_workers:
+                headroom = self.max_nnodes - len(self.workers) \
+                    - self._pending_spawns
+                if headroom > 0:
+                    self.addnodes(1)
+            while self.avail_workers and self.scenarios:
+                self._send_pending_scenario()
+        if dead:
+            self._nodeschanged()
+
+    def _handle_link(self, frames):
+        """Events arriving over the upstream link (we are a client of
+        the upstream server there)."""
+        route, name, payload = split_envelope(frames)
+        data = unpackb(payload) if payload else None
+        if not route and name in (b"REGISTER", b"NODESCHANGED"):
+            # upstream node table: mirror it to our clients with the
+            # upstream as the routing hop (server.py:213-225)
+            self.link_id = data["host_id"]
+            self.remote_nodes = {bytes(nid): self.link_id
+                                 for nid in data["nodes"]
+                                 if bytes(nid) not in self.workers}
+            self._nodeschanged()
+        elif route:
+            # reply/event for one of our endpoints: forward with the
+            # upstream as the accumulated sender hop
+            self._forward(self.link_id or b"", route, name, payload)
 
     # ------------------------------------------------------------ main loop
     def run(self):
@@ -200,11 +342,30 @@ class Server(threading.Thread):
             poller.register(sock, zmq.POLLIN)
         if self.discovery:
             poller.register(self.discovery.handle, zmq.POLLIN)
+        if self.upstream:
+            ctx = zmq.Context.instance()
+            self.link = ctx.socket(zmq.DEALER)
+            self.link.setsockopt(zmq.IDENTITY, self.server_id)
+            self.link.setsockopt(zmq.LINGER, 0)
+            self.link.connect(
+                f"tcp://{self.upstream[0]}:{self.upstream[1]}")
+            self.link.send_multipart([b"REGISTER", packb(None)])
+            poller.register(self.link, zmq.POLLIN)
         self.running = not self._stop_requested
         if not self.headless:
             self.addnodes(1)
         while self.running:
             events = dict(poller.poll(100))
+            now = time.monotonic()
+            if now >= self._next_hb:
+                self._next_hb = now + self.hb_interval
+                self._reap_dead_workers()
+            if self.link is not None and self.link in events:
+                try:
+                    self._handle_link(self.link.recv_multipart())
+                except Exception as exc:
+                    print(f"server: dropped malformed link message: "
+                          f"{exc!r}")
             if self.be_stream in events:
                 self.fe_stream.send_multipart(
                     self.be_stream.recv_multipart())
@@ -225,6 +386,8 @@ class Server(threading.Thread):
                 # a malformed message from one peer must not kill the broker
                 try:
                     sender, rest = frames[0], frames[1:]
+                    if sock is self.be_event:
+                        self.last_seen[sender] = now   # any traffic counts
                     route, name, payload = split_envelope(rest)
                     if route:
                         self._forward(sender, route, name, payload)
@@ -245,5 +408,7 @@ class Server(threading.Thread):
         for sock in (self.fe_event, self.fe_stream, self.be_event,
                      self.be_stream):
             sock.close()
+        if self.link is not None:
+            self.link.close()
         if self.discovery:
             self.discovery.close()

@@ -68,13 +68,18 @@ def _init_accumulators(refs, block, kk):
     refs[9][0] = jnp.full((kk, block), _ACC_NEUTRAL[9], jnp.int32)
 
 
-def _kernel(reach_ref, own_ref, intr_ref,
+def _kernel(reach_ref, row0_ref, own_ref, intr_ref,
             inconf_ref, tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref,
             tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref, cidx_ref,
             *, block, kk, cpp, rpz, hpz, tlookahead, mvpcfg,
-            same_hemi=False, reso="mvp"):
+            same_hemi=False, reso="mvp", rstride=1):
     ib = pl.program_id(0)
     jp = pl.program_id(1)      # program handles cpp column tiles
+    # Global row id of local row i is row0 + i*rstride (0/1 except
+    # under shard_map, where each device owns a strided row subset of
+    # the global grid but column/partner ids stay global; the stride
+    # interleaves rows across devices for load balance).
+    row0 = row0_ref[0, 0]
 
     # Initialise the accumulators on the first intruder program; the
     # tile compute below is skipped entirely for unreachable tiles, so
@@ -104,14 +109,16 @@ def _kernel(reach_ref, own_ref, intr_ref,
                        tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref,
                        cidx_ref, block=block, kk=kk, rpz=rpz, hpz=hpz,
                        tlookahead=tlookahead, mvpcfg=mvpcfg,
-                       same_hemi=same_hemi, reso=reso)
+                       same_hemi=same_hemi, reso=reso, row_off=row0,
+                       row_stride=rstride)
 
 
 def _tile_body(ib, jb, ksub, own_ref, intr_ref,
                inconf_ref, tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref,
                tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref, cidx_ref,
                *, block, kk, rpz, hpz, tlookahead, mvpcfg,
-               same_hemi=False, resume_refs=None, rpz_m=None, reso="mvp"):
+               same_hemi=False, resume_refs=None, rpz_m=None, reso="mvp",
+               row_off=0, row_stride=1):
     oslab = own_ref[0]                                    # (_NF, block)
     islab_t = intr_ref[ksub].T                            # (block, _NF): ONE
     # lane->sublane relayout shared by all intruder columns
@@ -122,8 +129,9 @@ def _tile_body(ib, jb, ksub, own_ref, intr_ref,
     def intr(k):           # intruder operand, varies along sublanes
         return islab_t[:, _IDX[k]:_IDX[k] + 1]            # (block, 1)
 
-    gid_own = ib * block + jax.lax.broadcasted_iota(
-        jnp.int32, (1, block), 1)                         # ownships on lanes
+    gid_own = (row_off + ib * row_stride) * block \
+        + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block), 1)                     # ownships on lanes
     gid_int = jb * block + jax.lax.broadcasted_iota(
         jnp.int32, (block, 1), 0)                         # intruders sublanes
     act_o = own("active") > 0.5                           # (1, block)
@@ -384,18 +392,19 @@ def _merge_partners_block(pold_ref, keep_ref, ctin_ref, cidx_ref,
                           keepdims=True)
 
 
-def _kernel_resume(reach_ref, own_ref, intr_ref, pold_ref,
+def _kernel_resume(reach_ref, row0_ref, own_ref, intr_ref, pold_ref,
                    inconf_ref, tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref,
                    tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref, cidx_ref,
                    keep_ref, pnew_ref, pact_ref,
                    *, block, kk, cpp, rpz, hpz, tlookahead, mvpcfg,
-                   rpz_m, same_hemi=False, reso="mvp"):
+                   rpz_m, same_hemi=False, reso="mvp", rstride=1):
     """Full-grid kernel with in-kernel resume-nav (the sparse scheduler's
     overflow fallback): same tile sweep as ``_kernel`` plus the keep
     evaluation per visited tile and the partner merge on the last
     intruder program."""
     ib = pl.program_id(0)
     jp = pl.program_id(1)
+    row0 = row0_ref[0, 0]
 
     @pl.when(jp == 0)
     def _():
@@ -416,7 +425,7 @@ def _kernel_resume(reach_ref, own_ref, intr_ref, pold_ref,
                        tlookahead=tlookahead, mvpcfg=mvpcfg,
                        same_hemi=same_hemi,
                        resume_refs=(pold_ref, keep_ref), rpz_m=rpz_m,
-                       reso=reso)
+                       reso=reso, row_off=row0, row_stride=rstride)
 
     @pl.when(jp == pl.num_programs(1) - 1)
     def _finish():
@@ -551,72 +560,108 @@ def _build_candidates(lat, lon, gs, active, nb, block, c_cap, rpz,
     return cand, row_over
 
 
+def interleave_rows(nb, ndev):
+    """Device-major row interleave for the shard_map row split (device
+    d owns global rows d, d+D, 2D+d, ... — measured to cut the
+    contiguous split's 1.2-1.5x row-density imbalance to ~1.0-1.1x,
+    scripts/scaling_table.py).  Returns ``(rows_l, nbrp, rperm, rinv)``:
+    rows per device, the padded row count, the permutation placing
+    global row j*D+d at new index d*rows_l+j, and its inverse.  Shared
+    by cd_pallas.run_full_sharded and cd_sched's shard branch so the
+    two kernels' row<->device mapping can never drift apart."""
+    import numpy as onp
+    nbrp = -(-nb // ndev) * ndev
+    rows_l = nbrp // ndev
+    rperm = onp.arange(nbrp).reshape(rows_l, ndev).T.reshape(-1)
+    return rows_l, nbrp, rperm, onp.argsort(rperm)
+
+
 def full_grid_pass(packed, reach, *, block, kk, cpp, kern_kw,
-                   interpret=False, pold=None, rpz_m=None):
+                   interpret=False, pold=None, rpz_m=None,
+                   packed_own=None, row0=None, rstride=1):
     """Grid over ALL tile pairs; unreachable ones branch past the body.
 
     Several column tiles per grid program amortize the per-program
     overhead (grid steps + slab DMA) across the skipped tiles.  ``reach``
-    [nb, nb] restricts the pass to a tile subset (prefilter skip and the
-    mixed-mode / sparse-scheduler overflow rows — ops/cd_sched.py reuses
-    this as its exact fallback).  ``packed`` is the [nb, _NF, block] slab
-    array; returns the 10 accumulator outputs in standard order.
+    [nbr, nbc] restricts the pass to a tile subset (prefilter skip and
+    the mixed-mode / sparse-scheduler overflow rows — ops/cd_sched.py
+    reuses this as its exact fallback).  ``packed`` is the
+    [nbc, _NF, block] intruder slab array; returns the 10 accumulator
+    outputs in standard order.
 
-    With ``pold`` ([nb, kk, block] int32 partner table in the same slot
-    space as the pair ids) the kernel also evaluates in-kernel resume-nav
-    and appends 3 outputs: keep [nb, kk, block] f32, merged partners
-    [nb, kk, block] int32, active [nb, 1, block] f32.
+    ``packed_own``/``row0``/``rstride`` support a ROW SUBSET of the grid
+    (the per-device share under ``shard_map``): the ownship side reads
+    ``packed_own`` [nbr, _NF, block] whose local row i is GLOBAL row
+    ``row0 + i*rstride`` (``row0`` a traced int32 scalar, ``rstride``
+    static) — so pair exclusion and partner ids stay in the global slot
+    space, and an interleaved (strided) row assignment balances load
+    across devices.  Default (None/1): square grid over ``packed``
+    itself with identity row ids — the single-chip path, bit-identical
+    to before.
+
+    With ``pold`` ([nbr, kk, block] int32 partner table in the global
+    slot space) the kernel also evaluates in-kernel resume-nav and
+    appends 3 outputs: keep [nbr, kk, block] f32, merged partners
+    [nbr, kk, block] int32, active [nbr, 1, block] f32.
     """
-    nb = packed.shape[0]
+    nbc = packed.shape[0]
+    own_arr = packed if packed_own is None else packed_own
+    nbr = own_arr.shape[0]
+    assert reach.shape == (nbr, nbc), (reach.shape, nbr, nbc)
     dtype = packed.dtype
-    cpp = min(cpp, nb)
-    nbp = -(-nb // cpp) * cpp
-    nb8 = -(-nb // 8) * 8
+    cpp = min(cpp, nbc)
+    nbp = -(-nbc // cpp) * cpp
+    nb8 = -(-nbr // 8) * 8
     nw = -(-nbp // 32)
-    bits = jnp.zeros((nb8, nw * 32), jnp.uint32).at[:nb, :nb].set(
+    bits = jnp.zeros((nb8, nw * 32), jnp.uint32).at[:nbr, :nbc].set(
         reach.astype(jnp.uint32))
     reach_i = jnp.sum(
         bits.reshape(nb8, nw, 32)
         << jnp.arange(32, dtype=jnp.uint32)[None, None, :],
         axis=2, dtype=jnp.uint32).astype(jnp.int32)
+    row0_arr = jnp.asarray(0 if row0 is None else row0,
+                           jnp.int32).reshape(1, 1)
     packed_f = packed
-    if nbp != nb:
-        # One padded buffer serves BOTH inputs (the ownship grid
-        # dimension stays nb, so its padded rows are never read)
+    if nbp != nbc:
+        # Padded intruder buffer; the padded columns' reach bits are 0,
+        # so their tiles are never computed.
         packed_f = jnp.concatenate(
-            [packed, jnp.zeros((nbp - nb, _NF, block), dtype)], axis=0)
+            [packed, jnp.zeros((nbp - nbc, _NF, block), dtype)], axis=0)
 
     acc_spec = lambda: pl.BlockSpec(
         (1, 1, block), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM)
     cand_spec = lambda: pl.BlockSpec(
         (1, kk, block), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM)
-    acc = [jax.ShapeDtypeStruct((nb, 1, block), dtype)] * 8 + [
-        jax.ShapeDtypeStruct((nb, kk, block), dtype),       # ctin
-        jax.ShapeDtypeStruct((nb, kk, block), jnp.int32)]   # cidx
+    acc = [jax.ShapeDtypeStruct((nbr, 1, block), dtype)] * 8 + [
+        jax.ShapeDtypeStruct((nbr, kk, block), dtype),       # ctin
+        jax.ShapeDtypeStruct((nbr, kk, block), jnp.int32)]   # cidx
     in_specs = [
         pl.BlockSpec((8, nw), lambda i, j: (i // 8, 0),
                      memory_space=pltpu.SMEM),       # reach window
+        pl.BlockSpec((1, 1), lambda i, j: (0, 0),
+                     memory_space=pltpu.SMEM),       # global row offset
         pl.BlockSpec((1, _NF, block), lambda i, j: (i, 0, 0),
                      memory_space=pltpu.VMEM),       # ownship slab
         pl.BlockSpec((cpp, _NF, block), lambda i, j: (j, 0, 0),
                      memory_space=pltpu.VMEM),       # intruder slabs
     ]
     out_specs = [acc_spec() for _ in range(8)] + [cand_spec(), cand_spec()]
-    args = [reach_i, packed_f, packed_f]
+    args = [reach_i, row0_arr, own_arr, packed_f]
     if pold is None:
-        kern = functools.partial(_kernel, cpp=cpp, **kern_kw)
+        kern = functools.partial(_kernel, cpp=cpp, rstride=rstride,
+                                 **kern_kw)
     else:
-        kern = functools.partial(_kernel_resume, cpp=cpp,
+        kern = functools.partial(_kernel_resume, cpp=cpp, rstride=rstride,
                                  rpz_m=float(rpz_m), **kern_kw)
         in_specs.append(cand_spec())                 # pold
         args.append(pold)
         out_specs += [cand_spec(), cand_spec(), acc_spec()]
-        acc += [jax.ShapeDtypeStruct((nb, kk, block), dtype),      # keep
-                jax.ShapeDtypeStruct((nb, kk, block), jnp.int32),  # merged
-                jax.ShapeDtypeStruct((nb, 1, block), dtype)]       # active
+        acc += [jax.ShapeDtypeStruct((nbr, kk, block), dtype),      # keep
+                jax.ShapeDtypeStruct((nbr, kk, block), jnp.int32),  # merged
+                jax.ShapeDtypeStruct((nbr, 1, block), dtype)]       # active
     return list(pl.pallas_call(
         kern,
-        grid=(nb, nbp // cpp),
+        grid=(nbr, nbp // cpp),
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=acc,
@@ -624,12 +669,22 @@ def full_grid_pass(packed, reach, *, block, kk, cpp, kern_kw,
     )(*args))
 
 
+def interpret_default(interpret):
+    """Resolve ``interpret=None`` to the platform default: the Pallas
+    interpreter (loop-based, jit-friendly) off-TPU, the Mosaic compiler
+    on TPU — so the same SimConfig runs everywhere (CPU tests, the
+    virtual-mesh dryrun, the real chip)."""
+    if interpret is None:
+        return jax.default_backend() == "cpu"
+    return interpret
+
+
 def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                           active, noreso, rpz, hpz, tlookahead, mvpcfg,
-                          block=256, k_partners=8, interpret=False,
+                          block=256, k_partners=8, interpret=None,
                           spatial_sort=True, cols_per_prog=4,
                           cand_cap=0, perm=None, extra_cols=None,
-                          reso="mvp"):
+                          reso="mvp", mesh=None, mesh_axis="ac"):
     """Pallas-backed equivalent of ``cd_tiled.detect_resolve_tiled``.
 
     Returns a ``RowConflictData``; reductions match the lax formulation to
@@ -644,7 +699,15 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
     rpz + tlookahead*vrel physics radius, not by block granularity), so
     it stays off by default; it is exact at any capacity and may win for
     much sparser or larger-N fleets.
+
+    With ``mesh`` the full-grid pass runs under ``shard_map`` on the
+    ``mesh_axis`` dimension: each device owns a contiguous slice of row
+    blocks (one per-device Pallas program over its rows), the intruder
+    slab array replicates (the GSPMD all-gather over ICI), and row ids
+    are offset to the global slot space — SURVEY §5.7/5.8's
+    block-distributed CD for the Pallas backend.
     """
+    interpret = interpret_default(interpret)
     n = lat.shape[0]
     if spatial_sort and n > block:
         # Morton-order the slots (cd_tiled.run_spatially_sorted) so the
@@ -654,7 +717,8 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                               k_partners=k_partners, interpret=interpret,
                               spatial_sort=False,
                               cols_per_prog=cols_per_prog,
-                              cand_cap=cand_cap, reso=reso),
+                              cand_cap=cand_cap, reso=reso,
+                              mesh=mesh, mesh_axis=mesh_axis),
             lat, lon, trk, gs, alt, vs, gseast, gsnorth, active, noreso,
             rpz, hpz, tlookahead, mvpcfg, perm=perm, extra_cols=extra_cols)
     dtype = jnp.float32
@@ -711,6 +775,36 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                               block=block, kk=kk, cpp=cols_per_prog,
                               kern_kw=kern_kw, interpret=interpret)
 
+    def run_full_sharded():
+        """Row blocks INTERLEAVED over the mesh (device d owns global
+        rows d, d+D, d+2D, ... — measured to cut the contiguous split's
+        1.2-1.5x row-density imbalance to ~1.0-1.1x); each device sweeps
+        its rows against the replicated intruder slabs with GLOBAL row
+        ids via the row0 + i*rstride mapping."""
+        from jax.sharding import PartitionSpec as P
+        ndev = mesh.shape[mesh_axis]
+        rows_l, nbrp, rperm, inv = interleave_rows(nb, ndev)
+        own_p, reach_p = packed, reach
+        if nbrp != nb:
+            own_p = jnp.concatenate(
+                [packed, jnp.zeros((nbrp - nb, _NF, block), dtype)])
+            reach_p = jnp.concatenate(
+                [reach, jnp.zeros((nbrp - nb, nb), bool)])
+        own_p, reach_p = own_p[rperm], reach_p[rperm]
+
+        def body(own_l, reach_l, packed_g):
+            row0 = jax.lax.axis_index(mesh_axis)
+            return tuple(full_grid_pass(
+                packed_g, reach_l, block=block, kk=kk, cpp=cols_per_prog,
+                kern_kw=kern_kw, interpret=interpret,
+                packed_own=own_l, row0=row0, rstride=ndev))
+
+        outs = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(mesh_axis), P(mesh_axis), P()),
+            out_specs=P(mesh_axis), check_vma=False)(own_p, reach_p, packed)
+        return [o[inv][:nb] for o in outs]
+
     def run_cand(cand):
         """Grid over (ownship block, candidate sub-chunk): the intruder
         axis holds only aircraft that can possibly conflict with the
@@ -760,7 +854,9 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
     # full-grid pass and the row-disjoint outputs merged.  Identical
     # results either way — the split is purely a scheduling optimization.
     c_cap = -(-cand_cap // block) * block if cand_cap else 0
-    if nb >= 8 and 0 < c_cap < nb * block:
+    if mesh is not None and mesh.shape[mesh_axis] > 1:
+        outs = run_full_sharded()
+    elif nb >= 8 and 0 < c_cap < nb * block:
         cand, row_over = _build_candidates(
             pad(lat), pad(lon), pad(gs), fields["active"] > 0.5,
             nb, block, c_cap, float(rpz), float(tlookahead))

@@ -56,6 +56,17 @@ from .cd_tiled import RowConflictData, block_reachability, precompute_trig
 #: (16*block is a multiple of the (8, 128) vreg for block >= 128)
 _NFP = 16
 
+#: max grid rows per pallas_call — the TPU compiler dies without
+#: diagnostics somewhere above ~1700 rows (see the row-split note in
+#: detect_resolve_sched); 1408 rows = 360k aircraft stays well inside
+#: the measured-good range.
+_MAX_ROWS = 1408
+
+#: above this many rows, skip the cross-equator kernel specialization
+#: (one variant instead of two halves compile time; huge fleets
+#: usually straddle the equator anyway)
+_ONE_VARIANT_ROWS = 1024
+
 
 def padded_size(n, block=256, extra=32):
     """Total slots of the padded stripe-sorted layout for n aircraft."""
@@ -71,21 +82,57 @@ def reach_threshold_m(gs, active, tlookahead, rpz):
     return rpz + tlookahead * 2.0 * gsmax
 
 
-#: altitude layers per stripe (cruise bands); one extra "climber" bucket
-#: collects |vs| > _CLIMB_VS aircraft so they cannot poison a cruise
-#: block's vsmax in the vertical reachability bound.  Measured at N=100k
-#: continental the layering INCREASES scheduled pairs (5.4e8 vs 3.4e8:
-#: thinning the lat-lon buckets makes blocks longitude-fat, and the
-#: +block-span dilation outweighs the vertical selectivity), so it is
-#: disabled; the vertical term of block_reachability stays on — it can
-#: only remove tiles, and fleets with genuinely spatially-banded
-#: altitudes get the skip for free.
-_N_LAYERS = 0
+#: per-stripe altitude layering (cruise bands + one "climber" bucket
+#: collecting |vs| > _CLIMB_VS aircraft so they cannot poison a cruise
+#: block's vsmax in the vertical reachability bound).  Measured at
+#: N=100k CONTINENTAL the layering INCREASES scheduled pairs (5.4e8 vs
+#: 3.4e8: thinning the lat-lon buckets makes blocks longitude-fat and
+#: the +block-span dilation outweighs the vertical selectivity) — but
+#: in DENSE geometries (the reference's 230 nm circle) the horizontal
+#: windows are saturated anyway, so altitude-homogeneous blocks let the
+#: exact vertical term of block_reachability prune the tile set by the
+#: cruise-band fraction.  The caller (core/asas.refresh_spatial_sort)
+#: therefore passes ``n_layers > 0`` only when its density estimate
+#: says horizontal windows can no longer discriminate.
 _CLIMB_VS = 1.0     # [m/s]
 
 
 def stripe_sort_dest(lat, lon, gs, active, thresh_m, block, extra,
-                     alt=None, vs=None):
+                     alt=None, vs=None, n_layers=0):
+    """See module docstring; ``n_layers`` may be an int, or "auto" to
+    gate the per-stripe altitude layering ON DEVICE from the density
+    estimate (no host sync — the tunnel costs ~80 ms per pull)."""
+    return _stripe_sort_dest_impl(lat, lon, gs, active, thresh_m, block,
+                                  extra, alt, vs, n_layers)
+
+
+def _auto_layers(lat, lon, alt, active, thresh_m):
+    """Traced layering decision: mean reachable-neighbor count over the
+    active bounding box; dense (>3000 — horizontal windows saturated,
+    e.g. the 230 nm circle at 100k) -> ~500 m bands, else 0."""
+    act = active
+    big = jnp.asarray(1e9, lat.dtype)
+    n_act = jnp.sum(act)
+    lat_a = jnp.where(act, lat, jnp.nan)
+    lon_a = jnp.where(act, lon, jnp.nan)
+    alt_a = jnp.where(act, alt, jnp.nan)
+    ptp = lambda a: jnp.nanmax(a) - jnp.nanmin(a)
+    dlat_km = jnp.maximum(ptp(lat_a), 0.3) * 111.0
+    coslat = jnp.maximum(jnp.cos(jnp.radians(
+        jnp.nanmax(jnp.abs(lat_a)))), 0.05)
+    dlon_km = jnp.maximum(ptp(lon_a), 0.3) * 111.0 * coslat
+    reach_km = thresh_m / 1000.0
+    nbrs = n_act * jnp.pi * reach_km ** 2 / (dlat_km * dlon_km)
+    # ~500 m bands: above the cruise-block vertical reach (~340 m),
+    # thin enough that own+-1-band coverage prunes hard (measured 2.3x
+    # fewer scheduled pairs on the 230 nm circle at N=100k)
+    l0 = jnp.clip(ptp(alt_a) / 500.0, 0, 16).astype(jnp.int32)
+    use = (nbrs > 3000.0) & (l0 >= 2) & (n_act > 0)
+    return jnp.where(use, l0, 0)
+
+
+def _stripe_sort_dest_impl(lat, lon, gs, active, thresh_m, block, extra,
+                           alt=None, vs=None, n_layers=0):
     """Padded stripe-major sort: per-aircraft destination slots.
 
     Returns ``dest`` [n] int32: aircraft i occupies padded slot dest[i]
@@ -125,18 +172,22 @@ def stripe_sort_dest(lat, lon, gs, active, thresh_m, block, extra,
     s = jnp.clip(jnp.floor((lat - latmin) / h), 0, extra - 2).astype(jnp.int32)
     s = jnp.where(act, s, extra - 1)
 
-    if alt is None or _N_LAYERS == 0:
+    if alt is None or (n_layers != "auto" and int(n_layers) == 0):
+        nl = jnp.int32(0)
         layer = jnp.zeros((n,), jnp.int32)
     else:
+        nl = _auto_layers(lat, lon, alt, active, thresh_m) \
+            if n_layers == "auto" else jnp.int32(n_layers)
         amin = jnp.where(any_act, jnp.min(jnp.where(act, alt, big)), 0.0)
         amax = jnp.where(any_act, jnp.max(jnp.where(act, alt, -big)), 1.0)
-        lh = jnp.maximum((amax - amin) / _N_LAYERS, 1.0)
+        lh = jnp.maximum((amax - amin) / jnp.maximum(nl, 1), 1.0)
         layer = jnp.clip(jnp.floor((alt - amin) / lh), 0,
-                         _N_LAYERS - 1).astype(jnp.int32)
-        layer = jnp.where(jnp.abs(vs) > _CLIMB_VS, _N_LAYERS, layer)
+                         jnp.maximum(nl - 1, 0)).astype(jnp.int32)
+        layer = jnp.where(jnp.abs(vs) > _CLIMB_VS, nl, layer)
+        layer = jnp.where(nl > 0, layer, 0)
 
     qlon = jnp.clip((lon + 180.0) * (2 ** 19 / 360.0), 0, 2 ** 19 - 1)
-    key = (s * (_N_LAYERS + 1) + layer) * (2 ** 19) + qlon.astype(jnp.int32)
+    key = (s * (nl + 1) + layer) * (2 ** 19) + qlon.astype(jnp.int32)
     order = jnp.argsort(key)                       # sorted -> original
     ss = s[order]
 
@@ -206,7 +257,7 @@ def build_windows(reach, s_cap, wmax, pad_start):
 
 def _sched_kernel(wl_ref, own_ref, *rest,
                   block, kk, s_cap, wmax, rpz, hpz, tlookahead, mvpcfg,
-                  same_hemi=False, rpz_m=None, reso="mvp"):
+                  same_hemi=False, rpz_m=None, reso="mvp", rstride=1):
     resume = rpz_m is not None
     intr_refs = rest[:s_cap]
     rest = rest[s_cap:]
@@ -227,7 +278,12 @@ def _sched_kernel(wl_ref, own_ref, *rest,
     def own(k):
         return oslab[_IDX[k]:_IDX[k] + 1, :]
 
-    gid_own = i * block + jax.lax.broadcasted_iota(
+    # wl's trailing column carries the global row-block base: local row
+    # i is GLOBAL row row0 + i*rstride (0/1 except under shard_map,
+    # where each device owns an interleaved row subset for load balance
+    # but column and partner ids stay global).
+    row0 = wl_ref[i, s_cap]
+    gid_own = (row0 + i * rstride) * block + jax.lax.broadcasted_iota(
         jnp.int32, (1, block), 1)
     act_o = own("active") > 0.5
 
@@ -283,14 +339,23 @@ def _sched_kernel(wl_ref, own_ref, *rest,
 def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                          active, noreso, rpz, hpz, tlookahead, mvpcfg,
                          block=256, k_partners=8, s_cap=6, wmax=16,
-                         extra_blocks=32, interpret=False, perm=None,
+                         extra_blocks=32, interpret=None, perm=None,
                          cols_per_prog=4, partners=None, resume_rpz_m=None,
-                         tas=None, reso="mvp"):
+                         tas=None, reso="mvp", mesh=None, mesh_axis="ac"):
     """Sparse-scheduled equivalent of ``cd_pallas.detect_resolve_pallas``.
 
     ``perm`` is the cached ``stripe_sort_dest`` destination table (NOT a
     Morton permutation); recomputed when None.  Results match the other
     backends' reductions (same tile math, superset tile coverage).
+
+    With ``mesh``, the segment kernel and its overflow fallback run
+    under ``shard_map``: each device owns a contiguous slice of row
+    blocks (its own worklist, partner-table rows, and Pallas program),
+    the packed column slabs replicate over the mesh (one all-gather over
+    ICI per interval), and row ids carry a global offset — so results
+    are bit-identical to the single-device schedule.  The stripe sort,
+    reachability, and window build stay global GSPMD ops; the pair math
+    — the dominant cost — scales ~linearly with devices.
 
     With ``partners`` ([n_tot, K] int32, SORTED-space ids, -1 empty) the
     kernels also run in-kernel resume-nav (keep evaluation on every
@@ -306,15 +371,7 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
     n = lat.shape[0]
     dtype = jnp.float32
     block = min(block, 256)
-    if n > 400_000:
-        # The TPU compiler crashes (tpu_compile_helper exit 1, no
-        # diagnostics) on this kernel somewhere above ~500k aircraft —
-        # measured OK at 400k, failing at 700k; neither scalar-prefetch
-        # size, Element-dim size nor grid shape proved to be the
-        # variable.  The plain pallas grid covers the 1M scale
-        # (bench._pick_backend routes there); shrinking s_cap extends
-        # the sparse range a little.
-        s_cap = min(s_cap, 4)
+    interpret = cd_pallas.interpret_default(interpret)
     if partners is None and n <= 2 * block:
         # Too small to schedule — the plain kernel is already one tile.
         return cd_pallas.detect_resolve_pallas(
@@ -385,59 +442,66 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         jnp.zeros((wmax, _NFP, block), dtype)], axis=0)    # DMA pad region
 
     kk = k_partners
-    own_spec = pl.BlockSpec((1, _NFP, block), lambda i, wl: (i, 0, 0),
-                            memory_space=pltpu.VMEM)
-    intr_specs = [
-        pl.BlockSpec((pl.Element(wmax), pl.Element(_NFP),
-                      pl.Element(block)),
-                     functools.partial(
-                         lambda i, wl, s=0: (wl[i, s] & 0xFFFFF, 0, 0),
-                         s=s),
-                     memory_space=pltpu.VMEM)
-        for s in range(s_cap)]
-    acc_spec = lambda: pl.BlockSpec((1, 1, block),
-                                    lambda i, wl: (i, 0, 0),
-                                    memory_space=pltpu.VMEM)
-    cand_spec = lambda: pl.BlockSpec((1, kk, block),
-                                     lambda i, wl: (i, 0, 0),
-                                     memory_space=pltpu.VMEM)
-    out_shape = [jax.ShapeDtypeStruct((nb, 1, block), dtype)] * 8 + [
-        jax.ShapeDtypeStruct((nb, kk, block), dtype),
-        jax.ShapeDtypeStruct((nb, kk, block), jnp.int32)]
     pold = None
     if resume:
         pold = partners.reshape(nb, block, kk).transpose(0, 2, 1) \
             .astype(jnp.int32)                             # [nb, kk, block]
-        out_shape = out_shape + [
-            jax.ShapeDtypeStruct((nb, kk, block), dtype),       # keep
-            jax.ShapeDtypeStruct((nb, kk, block), jnp.int32),   # merged
-            jax.ShapeDtypeStruct((nb, 1, block), dtype)]        # active
     reach_f = reach & overflow[:, None]
-    rsel = overflow[:, None, None]
     neutral_vals = _ACC_NEUTRAL + ((0.0, -1, 0.0) if resume else ())
 
-    def run(same_hemi):
-        """Sched kernel + overflow fallback, specialised on the static
-        cross-equator-radius-branch elision (exact: only taken when no
-        active pair can straddle the equator)."""
+    def run_rows(wl_r, own16_r, packedown_r, pold_r, reachf_r, overflow_r,
+                 row0, same_hemi, intr16, intr, rstride=1):
+        """Sched kernel + overflow fallback over one row subset.
+
+        ``wl_r`` [rows, s_cap+1] carries (start|len) plus the global
+        row-block base in its last column (local row i = global row
+        row0 + i*rstride); ``own16_r``/``packedown_r`` are the subset's
+        ownship slabs; ``intr16``/``intr`` are the FULL column arrays
+        (global ids) — identical to the whole grid when row0 == 0 and
+        rstride == 1, the per-device share under ``shard_map``."""
+        rows = wl_r.shape[0]
+        own_spec = pl.BlockSpec((1, _NFP, block), lambda i, wl: (i, 0, 0),
+                                memory_space=pltpu.VMEM)
+        intr_specs = [
+            pl.BlockSpec((pl.Element(wmax), pl.Element(_NFP),
+                          pl.Element(block)),
+                         functools.partial(
+                             lambda i, wl, s=0: (wl[i, s] & 0xFFFFF, 0, 0),
+                             s=s),
+                         memory_space=pltpu.VMEM)
+            for s in range(s_cap)]
+        acc_spec = lambda: pl.BlockSpec((1, 1, block),
+                                        lambda i, wl: (i, 0, 0),
+                                        memory_space=pltpu.VMEM)
+        cand_spec = lambda: pl.BlockSpec((1, kk, block),
+                                         lambda i, wl: (i, 0, 0),
+                                         memory_space=pltpu.VMEM)
+        out_shape = [jax.ShapeDtypeStruct((rows, 1, block), dtype)] * 8 + [
+            jax.ShapeDtypeStruct((rows, kk, block), dtype),
+            jax.ShapeDtypeStruct((rows, kk, block), jnp.int32)]
+        if resume:
+            out_shape = out_shape + [
+                jax.ShapeDtypeStruct((rows, kk, block), dtype),     # keep
+                jax.ShapeDtypeStruct((rows, kk, block), jnp.int32),  # merged
+                jax.ShapeDtypeStruct((rows, 1, block), dtype)]      # active
         kern = functools.partial(
             _sched_kernel, block=block, kk=kk, s_cap=s_cap, wmax=wmax,
             rpz=float(rpz), hpz=float(hpz), tlookahead=float(tlookahead),
-            mvpcfg=mvpcfg, same_hemi=same_hemi,
+            mvpcfg=mvpcfg, same_hemi=same_hemi, rstride=rstride,
             rpz_m=float(resume_rpz_m) if resume else None, reso=reso)
         in_specs = [own_spec] + [intr_specs[s] for s in range(s_cap)]
         out_specs = [acc_spec() for _ in range(8)] \
             + [cand_spec(), cand_spec()]
-        args = [wl, packed16] + [packed16] * s_cap
+        args = [wl_r, own16_r] + [intr16] * s_cap
         if resume:
             in_specs.append(cand_spec())               # pold
-            args.append(pold)
+            args.append(pold_r)
             out_specs += [cand_spec(), cand_spec(), acc_spec()]
         outs_s = list(pl.pallas_call(
             kern,
             grid_spec=pltpu.PrefetchScalarGridSpec(
                 num_scalar_prefetch=1,
-                grid=(nb,),
+                grid=(rows,),
                 in_specs=in_specs,
                 out_specs=out_specs,
             ),
@@ -453,28 +517,101 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
 
         def fallback(rf):
             return cd_pallas.full_grid_pass(
-                packed, rf, block=block, kk=kk, cpp=cols_per_prog,
-                kern_kw=kern_kw, interpret=interpret, pold=pold,
-                rpz_m=resume_rpz_m)
+                intr, rf, block=block, kk=kk, cpp=cols_per_prog,
+                kern_kw=kern_kw, interpret=interpret, pold=pold_r,
+                rpz_m=resume_rpz_m, packed_own=packedown_r, row0=row0,
+                rstride=rstride)
 
         def neutral(_):
             return [jnp.full(o.shape, v, o.dtype)
                     for o, v in zip(outs_s, neutral_vals)]
 
-        outs_f = jax.lax.cond(jnp.any(overflow), fallback, neutral, reach_f)
-        return [jnp.where(rsel, f, s) for f, s in zip(outs_f, outs_s)]
+        outs_f = jax.lax.cond(jnp.any(overflow_r), fallback, neutral,
+                              reachf_r)
+        rsel = overflow_r[:, None, None]
+        return tuple(jnp.where(rsel, f, s) for f, s in zip(outs_f, outs_s))
 
-    if nb > 1024:
+    row0_col = lambda w, r0: jnp.concatenate(
+        [w, jnp.full((w.shape[0], 1), r0, jnp.int32)], axis=1)
+
+    if mesh is not None and mesh.shape[mesh_axis] > 1:
+        # shard_map over the row blocks: each device schedules and
+        # sweeps its own rows against the replicated column slabs (the
+        # all-gather rides ICI); row/partner ids stay global via the
+        # row0 + i*ndev mapping.  Rows are INTERLEAVED across devices
+        # (device d owns global rows d, d+D, ...) — measured to cut the
+        # contiguous split's 1.2-1.5x stripe-density imbalance to
+        # ~1.0-1.1x (scripts/scaling_table.py).  SURVEY §5.7/5.8
+        # block-distributed CD.
+        from jax.sharding import PartitionSpec as P
+        ndev = mesh.shape[mesh_axis]
+        rows_l, nbrp, rperm, rinv = cd_pallas.interleave_rows(nb, ndev)
+        pad_r = nbrp - nb
+
+        def prep(a, fill):
+            if pad_r:
+                a = jnp.concatenate(
+                    [a, jnp.full((pad_r,) + a.shape[1:], fill, a.dtype)])
+            return a[rperm]
+
+        # Padding rows: empty windows (start=sentinel, len=0) + inactive
+        # own slabs -> the kernel's whole-row skip; overflow=False.
+        wl_p = prep(wl, nb)                       # start=nb, ln=0
+        own16_p = prep(packed16[:nb], 0)
+        packedown_p = prep(packed, 0)
+        pold_p = prep(pold, -1) if resume else None
+        reachf_p = prep(reach_f, False)
+        overflow_p = prep(overflow, False)
+
+        def body(wl_l, own16_l, packedown_l, pold_l, reachf_l,
+                 overflow_l, intr16_g, intr_g):
+            row0 = jax.lax.axis_index(mesh_axis)
+            return run_rows(row0_col(wl_l, row0), own16_l, packedown_l,
+                            pold_l, reachf_l, overflow_l, row0,
+                            False, intr16_g, intr_g, rstride=ndev)
+
+        specs_in = (P(mesh_axis), P(mesh_axis), P(mesh_axis),
+                    P(mesh_axis) if resume else P(),
+                    P(mesh_axis), P(mesh_axis), P(), P())
+        outs = jax.shard_map(
+            body, mesh=mesh, in_specs=specs_in,
+            out_specs=P(mesh_axis), check_vma=False)(
+                wl_p, own16_p, packedown_p,
+                pold_p if resume else jnp.zeros((ndev,), jnp.int32),
+                reachf_p, overflow_p, packed16, packed)
+        outs = [o[rinv][:nb] for o in outs]
+    elif nb > _ONE_VARIANT_ROWS:
         # Large-N: compile a single kernel variant (both equator-branch
         # variants double compile time for a ~10% saving that huge
         # fleets, which usually straddle the equator, rarely get).
-        outs = run(False)
+        # ROW SPLIT: the TPU compiler crashes (tpu_compile_helper exit
+        # 1, no diagnostics) on this kernel somewhere above ~1700 grid
+        # rows (N ~ 450-500k) — measured OK at 400k, dead at 700k, and
+        # neither scalar-prefetch bytes, Element-dim size nor grid
+        # shape proved to be the variable.  Rows are independent, so
+        # slicing the grid into <=_MAX_ROWS-row pallas_call invocations
+        # keeps every compiled program inside the proven range while
+        # the concatenated outputs stay bit-identical; this is what
+        # lifts the sparse backend past the old 400k ceiling to 1M+.
+        chunks = []
+        for r0 in range(0, nb, _MAX_ROWS):
+            r1 = min(r0 + _MAX_ROWS, nb)
+            chunks.append(run_rows(
+                row0_col(wl[r0:r1], r0), packed16[r0:r1], packed[r0:r1],
+                None if pold is None else pold[r0:r1],
+                reach_f[r0:r1], overflow[r0:r1], r0, False,
+                packed16, packed))
+        outs = [parts[0] if len(chunks) == 1 else jnp.concatenate(parts)
+                for parts in zip(*chunks)]
     else:
         lat_a = jnp.where(act_b, padded["lat"], 0.0)
         cross = (jnp.min(lat_a) < 0.0) & (jnp.max(lat_a) > 0.0)
+        run = lambda sh: functools.partial(
+            run_rows, row0_col(wl, 0), packed16, packed, pold,
+            reach_f, overflow, 0, sh, packed16, packed)
         outs = jax.lax.cond(cross,
-                            functools.partial(run, False),
-                            functools.partial(run, True))
+                            lambda: run(False)(),
+                            lambda: run(True)())
 
     (inconf, tcpamax, sdve, sdvn, sdvv, tsolv, ncnt, lcnt,
      ctin, cidx) = outs[:10]

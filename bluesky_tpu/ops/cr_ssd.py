@@ -44,6 +44,16 @@ inherently sequential host geometry; this is a ground-up TPU redesign:
 SSD remains a dense-backend tool (it consumes the [N,N] qdr/dist
 matrices of ``ops/cd.py``), but the chunking lifts the memory ceiling to
 what the dense CD itself allows (~16k aircraft).
+
+Quantization bound (exact-certified in ``tests/test_cr_ssd_cert.py``
+against an independent float64 closed-interval VO formulation, since
+pyclipper is unavailable): the chosen velocity is (a) exactly
+conflict-free whenever any grid candidate is, (b) the free-set optimum
+of its grid, and (c) within the polar grid's covering radius
+``h = hypot(vmax * 2pi/ntrk, (vmax - vmin)/(nspd - 1))`` of the exact
+continuous optimum on a closed-form single-intruder cone — i.e. the
+discretization error is bounded by the grid pitch (defaults: ~100 kts;
+raise ntrk/nspd for finer resolutions, cost is linear).
 """
 from typing import NamedTuple
 

@@ -58,7 +58,16 @@ def shard_state(state: SimState, mesh: Mesh) -> SimState:
 
 
 def sharded_step_fn(mesh: Mesh, cfg: SimConfig, nsteps: int = 1):
-    """Compile the (scanned) step with explicit in/out shardings on mesh."""
+    """Compile the (scanned) step with explicit in/out shardings on mesh.
+
+    The dense/tiled backends shard purely via GSPMD from the state
+    shardings; the Pallas backends ('pallas', 'sparse') additionally
+    need the mesh itself for their shard_map row split, so it is filled
+    into the config here (see ``ops/cd_sched.detect_resolve_sched``).
+    """
+    if cfg.cd_backend in ("pallas", "sparse") and cfg.cd_mesh is None \
+            and "ac" in mesh.shape:
+        cfg = cfg._replace(cd_mesh=mesh, cd_mesh_axis="ac")
 
     def run(state):
         def body(s, _):

@@ -18,8 +18,11 @@ import sys
 # BLUESKY_TPU_NO_REF=1 pretends the read-only reference mount is absent
 # (standalone mode): navdata starts empty, performance falls back to the
 # BUILTIN coefficients, and the scenario library is the local dir only.
+# BLUESKY_TPU_DATA=/path points at a BlueSky data checkout (deployment
+# hook used by the Dockerfile; takes precedence over the dev mount).
 _NO_REF = os.environ.get("BLUESKY_TPU_NO_REF") == "1"
-_REF_DATA = "" if _NO_REF else "/root/reference/data"
+_REF_DATA = os.environ.get("BLUESKY_TPU_DATA") \
+    or ("" if _NO_REF else "/root/reference/data")
 
 # ----------------------------------------------------------------- defaults
 simdt = 0.05

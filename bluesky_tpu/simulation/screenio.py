@@ -61,21 +61,56 @@ class ScreenIO(DisplayState):
         """Shape registry + broadcast to GUI clients (the reference
         mirrors shapes through events, guiclient nodeData.update)."""
         super().objappend(objtype, objname, data)
+        # Wire format is the REFERENCE client's kwargs: nodeData
+        # .update_poly_data(name, shape, coordinates) — guiclient.py:158
+        # splats the event dict, so key names are API (coordinates=None
+        # deletes the shape).
         self.node.send_event(b"SHAPE", {
-            "name": objname, "kind": objtype,
-            "coords": list(data) if data is not None else None},
+            "name": objname, "shape": objtype,
+            "coordinates": list(data) if data is not None else None},
             [b"*"])
+        return True
+
+    # Display-flag mirrors (reference screenio.py:132-160): the Qt
+    # client's nodeData.setflag(**data) consumes these kwargs verbatim.
+    def symbol(self):
+        super().symbol()
+        self.node.send_event(b"DISPLAYFLAG", {"flag": "SYM"}, [b"*"])
+        return True
+
+    def feature(self, sw, arg=None):
+        super().feature(sw, arg)
+        self.node.send_event(b"DISPLAYFLAG",
+                             {"flag": sw, "args": arg}, [b"*"])
+        return True
+
+    def filteralt(self, flag, bottom=None, top=None):
+        super().filteralt(flag, bottom, top)
+        self.node.send_event(
+            b"DISPLAYFLAG",
+            {"flag": "FILTERALT",
+             "args": (flag, bottom, top) if flag else (False,)}, [b"*"])
+        return True
+
+    def addnavwpt(self, name, lat, lon):
+        """Custom-waypoint mirror (reference screenio.py:147-150): key
+        names are the reference nodeData.defwpt kwargs."""
+        super().addnavwpt(name, lat, lon)
+        self.node.send_event(b"DEFWPT", {"name": name, "lat": float(lat),
+                                         "lon": float(lon)}, [b"*"])
         return True
 
     def echo(self, text="", flags=0):
         self.echobuf.append(text)
         if len(self.echobuf) > 1000:      # bounded history
             del self.echobuf[:-500]
-        # ZMQ senders are hex route ids; non-hex senders (the TCP/telnet
-        # bridge uses 'tcpN') get their reply from the bridge's own
-        # echobuf capture, so the event is broadcast instead of routed.
+        # ZMQ senders are comma-joined hex reply routes (multi-hop for
+        # chained servers, see simnode STACKCMD); non-hex senders (the
+        # TCP/telnet bridge uses 'tcpN') get their reply from the
+        # bridge's own echobuf capture, so the event broadcasts instead.
         try:
-            route = [bytes.fromhex(self.current_sender)] \
+            route = [bytes.fromhex(p)
+                     for p in self.current_sender.split(",")] \
                 if self.current_sender else None
         except ValueError:
             route = None

@@ -74,6 +74,14 @@ class DisplayState:
             self.objdata[objname] = (objtype, data)
         return True
 
+    def addnavwpt(self, name, lat, lon):
+        """Mirror a user-defined waypoint to the display (reference
+        navdatabase.py:136 -> scr.addnavwpt; ScreenIO broadcasts it as
+        the DEFWPT event the Qt client consumes, guiclient.py:232)."""
+        self.custwpts = getattr(self, "custwpts", {})
+        self.custwpts[name] = (float(lat), float(lon))
+        return True
+
     def pan(self, lat, lon):
         self.ctrlat = float(lat)
         self.ctrlon = float(lon)

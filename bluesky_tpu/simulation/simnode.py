@@ -16,10 +16,12 @@ from .screenio import ScreenIO
 
 def _make_simnode_class(base):
     class _SimNode(base):
-        def __init__(self, event_port=None, stream_port=None, **simkw):
+        def __init__(self, event_port=None, stream_port=None, node_id=None,
+                     **simkw):
             super().__init__(
                 event_port=event_port or settings.wevent_port,
-                stream_port=stream_port or settings.wstream_port)
+                stream_port=stream_port or settings.wstream_port,
+                node_id=node_id)
             self.sim = Simulation(**simkw)
             self.sim.scr = ScreenIO(self.sim, self)
             self.sim.node = self
@@ -51,7 +53,11 @@ def _make_simnode_class(base):
             sim = self.sim
             if name == b"STACKCMD":
                 cmd = data["cmd"] if isinstance(data, dict) else str(data)
-                sender = sender_route[0].hex() if sender_route else ""
+                # Reply route = REVERSED accumulated sender tail (see
+                # network/server.py routing note); comma-joined hex so
+                # the stack's plain-string sender survives multi-hop.
+                sender = ",".join(f.hex() for f in reversed(sender_route)) \
+                    if sender_route else ""
                 sim.stack.stack(cmd, sender)
             elif name == b"STEP":
                 # lockstep: advance exactly dtmult seconds of sim time
@@ -63,7 +69,8 @@ def _make_simnode_class(base):
                         (t_target - sim.simt) / sim.simdt)))
                     sim.step(max_chunk=nsteps)
                 sim.pause()
-                self.send_event(b"STEP", None, list(sender_route) or None)
+                self.send_event(b"STEP", None,
+                                list(reversed(sender_route)) or None)
             elif name == b"BATCH":
                 sim.reset()
                 sim.stack.set_scendata(data["scentime"], data["scencmd"])
@@ -72,7 +79,7 @@ def _make_simnode_class(base):
                 self.send_event(b"SIMSTATE", {
                     "state": sim.state_flag, "simt": sim.simt,
                     "simdt": sim.simdt, "ntraf": sim.traf.ntraf},
-                    list(sender_route) or None)
+                    list(reversed(sender_route)) or None)
             elif name == b"QUIT":
                 sim.stop()
                 self.quit()

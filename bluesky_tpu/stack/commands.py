@@ -144,6 +144,8 @@ def register_all(stack):
     def defwpt(name, pos, wptype=None):
         """DEFWPT wpname,lat,lon[,type] (navdatabase.py defwpt)."""
         sim.navdb.defwpt(name, pos[0], pos[1], wptype or "DEF")
+        # GUI mirror (reference navdatabase.py:136 -> scr.addnavwpt)
+        sim.scr.addnavwpt(name.upper(), pos[0], pos[1])
         return True, f"Waypoint {name.upper()} defined at " \
                      f"{pos[0]:.4f}, {pos[1]:.4f}"
 

@@ -91,6 +91,7 @@ class SimBackend:
         self._frame = None               # (svg, info) cached by pump()
         self.render_period = 0.25        # cache refresh cap (s)
         self._last_render = 0.0
+        self._last_request = 0.0         # last frame() call (viewer pull)
 
     def _render(self):
         from . import radar
@@ -102,6 +103,7 @@ class SimBackend:
     def frame(self):
         """Latest frame; served from the sim-thread cache when a loop is
         pumping, rendered in place otherwise (idle sim only)."""
+        self._last_request = time.monotonic()
         cached = self._frame
         return cached if cached is not None else self._render()
 
@@ -129,9 +131,14 @@ class SimBackend:
             done.put("\n".join(self.sim.scr.echobuf))
             ran_cmd = True
         now = time.monotonic()
-        # Refresh at most at render_period, but always right after a
-        # command — the user who just typed CRE expects to see it.
-        if ran_cmd or now - self._last_render >= self.render_period:
+        # Refresh at most at render_period and only while a viewer is
+        # actually pulling frames (no browser connected -> the sim
+        # thread pays nothing); always refresh right after a command —
+        # the user who just typed CRE expects to see it.
+        wanted = self._frame is None \
+            or now - self._last_request < 3.0 * max(self.render_period, 1.0)
+        if ran_cmd or (wanted
+                       and now - self._last_render >= self.render_period):
             self._last_render = now
             try:
                 self._frame = self._render()

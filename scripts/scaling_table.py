@@ -1,0 +1,114 @@
+"""Measure the sparse CD schedule's multi-chip work division.
+
+Builds the ACTUAL round-4 sharded schedule (stripe sort ->
+block_reachability -> build_windows -> contiguous row-slice per device,
+exactly what `ops/cd_sched.detect_resolve_sched(mesh=...)` executes) for
+the benchmark geometries at N=100k, and reports per-device scheduled
+pair counts for mesh sizes 1..32 — the quantity that sets each chip's
+kernel time, since the pair math is >60% of the interval and scales
+linearly in scheduled pairs (measured ~108 ps/pair on v5e, see
+docs/PERF_ANALYSIS.md).
+
+This is schedule-measured on the real layout (not a model): imbalance
+shown here is imbalance the chips would see.  What it does NOT measure
+is the ICI all-gather of the replicated column slabs (reported as bytes
+per interval below) and XLA's collective overlap — one chip cannot
+measure those.
+
+Run: PYTHONPATH=. JAX_PLATFORMS=cpu python scripts/scaling_table.py
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_platforms", "cpu")
+
+from bluesky_tpu.ops import cd_sched
+from bluesky_tpu.ops.cd_tiled import block_reachability
+
+NM = 1852.0
+RPZ, TLOOK = 5 * NM, 300.0
+BLOCK, EXTRA, S_CAP, WMAX = 256, 32, 6, 16
+
+
+def make_fleet(n, geom, seed=0):
+    rng = np.random.default_rng(seed)
+    if geom == "continental":
+        lat = rng.uniform(35.0, 60.0, n)
+        lon = rng.uniform(-10.0, 30.0, n)
+    elif geom == "global":
+        lat = np.degrees(np.arcsin(rng.uniform(-0.94, 0.94, n)))
+        lon = rng.uniform(-180.0, 180.0, n)
+    else:  # regional: the reference's 230 nm circle
+        ang = rng.uniform(0, 2 * np.pi, n)
+        r = 3.8 * np.sqrt(rng.random(n))
+        lat = 52.6 + r * np.cos(ang)
+        lon = 5.4 + r * np.sin(ang) / 0.6
+    gs = rng.uniform(130.0, 240.0, n)
+    alt = rng.uniform(3000.0, 11000.0, n)
+    vs = rng.uniform(-15.0, 15.0, n)
+    return (jnp.asarray(lat, jnp.float32), jnp.asarray(lon, jnp.float32),
+            jnp.asarray(gs, jnp.float32), jnp.asarray(alt, jnp.float32),
+            jnp.asarray(vs, jnp.float32))
+
+
+def schedule_pairs_per_row(lat, lon, gs, alt, vs):
+    """[nb] scheduled block-granular pairs per row block, via the real
+    round-4 schedule (windows for covered rows, row-restricted full
+    grid for overflow rows)."""
+    n = lat.shape[0]
+    active = jnp.ones((n,), bool)
+    thresh = cd_sched.reach_threshold_m(gs, active, TLOOK, RPZ)
+    dest = cd_sched.stripe_sort_dest(lat, lon, gs, active, thresh,
+                                     BLOCK, EXTRA, alt=alt, vs=vs)
+    nb = -(-n // BLOCK) + EXTRA
+    n_tot = nb * BLOCK
+    plat, plon, pgs, palt, pvs, pact = cd_sched.scatter_padded(
+        [lat, lon, gs, alt, vs, active.astype(jnp.float32)], dest, n_tot)
+    reach = block_reachability(plat, plon, pgs, pact > 0.5, nb, BLOCK,
+                               RPZ, TLOOK, alt=palt, vs=pvs,
+                               hpz=1000 * 0.3048)
+    st, ln, overflow = cd_sched.build_windows(reach, S_CAP, WMAX,
+                                              pad_start=nb)
+    win_pairs = jnp.sum(ln, axis=1) * BLOCK * BLOCK
+    grid_pairs = jnp.sum(reach, axis=1) * BLOCK * BLOCK
+    per_row = jnp.where(overflow, grid_pairs, win_pairs)
+    return np.asarray(per_row), nb, int(jnp.sum(overflow))
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    ps_per_pair = 108e-12          # measured v5e pair cost (PERF_ANALYSIS)
+    print(f"N = {n}; block {BLOCK}, s_cap {S_CAP}, wmax {WMAX}; "
+          f"pair cost {ps_per_pair*1e12:.0f} ps (measured)")
+    for geom in ("continental", "global", "regional"):
+        per_row, nb, n_over = schedule_pairs_per_row(
+            *make_fleet(n, geom))
+        total = per_row.sum()
+        # Replicated column slabs: [nb+wmax, 16, block] f32 per interval
+        ag_mb = (nb + WMAX) * 16 * BLOCK * 4 / 1e6
+        print(f"\n[{geom}] rows={nb} overflow_rows={n_over} "
+              f"total scheduled pairs={total:.3e} "
+              f"column all-gather={ag_mb:.1f} MB/interval")
+        print(f"{'D':>3} {'rows/dev':>8} {'max pairs/dev':>14} "
+              f"{'mean pairs/dev':>14} {'imbalance':>9} "
+              f"{'kernel ms/dev':>13}")
+        for d in (1, 2, 4, 8, 16, 32):
+            nbp = -(-nb // d) * d
+            rows = np.pad(per_row, (0, nbp - nb))
+            # the INTERLEAVED assignment detect_resolve_sched uses
+            # (device d owns rows d, d+D, ...)
+            dev = rows.reshape(nbp // d, d).T.sum(axis=1)
+            mx, mean = dev.max(), dev.mean()
+            print(f"{d:>3} {nbp//d:>8} {mx:>14.3e} {mean:>14.3e} "
+                  f"{mx/max(mean,1):>9.2f} {mx*ps_per_pair*1e3:>13.2f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -13,6 +13,8 @@ import pytest
 
 from bluesky_tpu.ops import cd_pallas, cd_tiled, cr_mvp
 
+pytestmark = pytest.mark.slow    # multi-minute lane (see pyproject)
+
 NM, FT = 1852.0, 0.3048
 
 

@@ -16,6 +16,8 @@ import jax.numpy as jnp
 
 from bluesky_tpu.ops import cd_sched, cd_tiled, cr_mvp
 
+pytestmark = pytest.mark.slow    # multi-minute lane (see pyproject)
+
 NM, FT = 1852.0, 0.3048
 CFG = cr_mvp.MVPConfig(rpz_m=5 * NM * 1.05, hpz_m=1000 * FT * 1.05,
                        tlookahead=300.0)
@@ -93,6 +95,54 @@ def test_parity_with_inactive_and_climbers():
     args = make_args(n, "continental", seed=7, act_frac=0.7, vs_spread=16.0)
     out, ref = run_both(args)
     assert_match(out, ref, n)
+
+
+def test_row_split_path_is_exact(monkeypatch):
+    """The >400k row-split (multiple pallas_call invocations over row
+    slices, see _MAX_ROWS) must concatenate BIT-EXACTLY to the
+    single-call result — rows are independent, so per-row reductions
+    see identical operations in identical order.  Exercised at small N
+    by shrinking _MAX_ROWS (ragged final slice included), covering both
+    windowed rows and the per-slice overflow fallback, with and without
+    in-kernel resume.  (_ONE_VARIANT_ROWS is pinned low for BOTH runs
+    so the comparison isolates the split, not the same-hemisphere
+    kernel specialization.)"""
+    monkeypatch.setattr(cd_sched, "_ONE_VARIANT_ROWS", 4)
+
+    def run(args, **kw):
+        return cd_sched.detect_resolve_sched(
+            *args, 5 * NM, 1000 * FT, 300.0, CFG, block=256,
+            interpret=True, **kw)
+
+    for geom in ("continental", "regional"):
+        args = make_args(2600, geom, seed=11)
+        monkeypatch.setattr(cd_sched, "_MAX_ROWS", 7)   # 43 rows -> 7 calls
+        out = run(args)
+        monkeypatch.setattr(cd_sched, "_MAX_ROWS", 1408)  # single call
+        ref = run(args)
+        assert int(ref.nconf) > 0
+        for f in ("inconf", "nconf", "nlos", "tcpamax", "sum_dve",
+                  "sum_dvn", "sum_dvv", "tsolv", "topk_idx", "topk_tin"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(out, f)), np.asarray(getattr(ref, f)),
+                err_msg=f"{geom}:{f}")
+
+    # resume path across slice boundaries
+    n = 2600
+    args = make_args(n, "continental", seed=12)
+    n_tot = cd_sched.padded_size(n, 256)
+    thresh = cd_sched.reach_threshold_m(args[3], args[8], 300.0, 5 * NM)
+    perm = cd_sched.stripe_sort_dest(args[0], args[1], args[3], args[8],
+                                     thresh, 256, 32)
+    partners = jnp.full((n_tot, 8), -1, jnp.int32)
+    kw = dict(perm=perm, partners=partners, resume_rpz_m=5 * NM * 1.05)
+    monkeypatch.setattr(cd_sched, "_MAX_ROWS", 7)
+    rd_s, p_s, a_s = run(args, **kw)
+    monkeypatch.setattr(cd_sched, "_MAX_ROWS", 1408)
+    rd_r, p_r, a_r = run(args, **kw)
+    np.testing.assert_array_equal(np.asarray(p_s), np.asarray(p_r))
+    np.testing.assert_array_equal(np.asarray(a_s), np.asarray(a_r))
+    assert int(rd_s.nconf) == int(rd_r.nconf) > 0
 
 
 def test_all_inactive():

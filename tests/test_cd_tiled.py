@@ -17,6 +17,8 @@ from bluesky_tpu.core.step import SimConfig, run_steps
 from bluesky_tpu.core.traffic import Traffic
 from bluesky_tpu.ops import cd, cd_tiled, cr_mvp
 
+pytestmark = pytest.mark.slow    # multi-minute lane (see pyproject)
+
 NM = 1852.0
 FT = 0.3048
 RPZ = 5.0 * NM

@@ -1,0 +1,169 @@
+"""MVP resolver vs the ACTUAL reference code (VERDICT r3 weak #6).
+
+``tests/test_cr_mvp.py`` checks the pair math against an in-repo NumPy
+reimplementation — which could share a misunderstanding with the kernel
+it validates.  This file drives the real ``MVP.resolve`` from
+``/root/reference/bluesky/traffic/asas/MVP.py`` end-to-end (the
+ref_oracle stub-module treatment Eby already gets) on multi-conflict
+scenes and compares every output the reference assigns to the asas
+object — trk/tas/vs/alt commands and the asase/asasn resolution vector
+— including all five priority rulesets (MVP.py:235-300), the noreso and
+resooff exemptions (MVP.py:52-61), and the resolution-direction limits
+(MVP.py:82-101).
+"""
+from types import SimpleNamespace
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import ref_oracle
+from bluesky_tpu.ops import cd, cr_mvp
+
+NM = 1852.0
+FT = 0.3048
+RPZ = 5.0 * NM
+HPZ = 1000.0 * FT
+TLOOK = 300.0
+RM = RPZ * 1.05
+DHM = HPZ * 1.05
+VMIN, VMAX = 51.4, 92.6        # 100/180 kts in m/s
+VSMIN, VSMAX = -15.24, 15.24   # +-3000 fpm
+
+
+def _load_ref_mvp():
+    ref_oracle.load()
+    return ref_oracle._load(
+        "bluesky.traffic.asas.MVP",
+        f"{ref_oracle.REF_ROOT}/traffic/asas/MVP.py")
+
+
+def make_scene(n=24, seed=0):
+    """Clustered fleet with real multi-conflict geometry and a mix of
+    cruisers (|vs| < 0.1, the reference's priority-rule threshold) and
+    climbers/descenders so FF2/FF3/LAY1/LAY2 take every branch."""
+    rng = np.random.default_rng(seed)
+    lat = rng.uniform(51.95, 52.05, n)
+    lon = rng.uniform(3.95, 4.05, n)
+    trk = rng.uniform(0.0, 360.0, n)
+    gs = rng.uniform(140.0, 180.0, n)
+    alt = rng.uniform(4950.0, 5050.0, n)
+    vs = np.where(rng.random(n) < 0.5, 0.0,
+                  rng.uniform(4.0, 12.0, n) * rng.choice([-1, 1], n))
+    return lat, lon, trk, gs, alt, vs
+
+
+def run_both(scene, swprio=False, priocode="FF1", noreso_ids=(),
+             resooff_ids=(), swresohoriz=False, swresospd=False,
+             swresohdg=False, swresovert=False):
+    lat, lon, trk, gs, alt, vs = scene
+    n = len(lat)
+    f = lambda x: jnp.asarray(np.asarray(x, np.float64))
+    gse = gs * np.sin(np.radians(trk))
+    gsn = gs * np.cos(np.radians(trk))
+    selalt = alt + 300.0
+    ap_vs = np.full(n, 2.0)
+    prev_alt = alt - 50.0
+    cdout = cd.detect(f(lat), f(lon), f(trk), f(gs), f(alt), f(vs),
+                      jnp.ones(n, bool), RPZ, HPZ, TLOOK)
+    swconfl = np.asarray(cdout.swconfl)
+    assert swconfl.sum() >= 6, "scene must have several conflicts"
+
+    # ---- the REAL reference resolver on stub traf/asas objects ----
+    mvp = _load_ref_mvp()
+    ids = [f"AC{i:03d}" for i in range(n)]
+    ii, jj = np.where(swconfl)           # StateBasedCD.py:93 pair order
+    traf = SimpleNamespace(
+        ntraf=n, id=ids,
+        gseast=gse.copy(), gsnorth=gsn.copy(), vs=vs.copy(),
+        trk=trk.copy(), gs=gs.copy(), alt=alt.copy(),
+        selalt=selalt.copy(), ap=SimpleNamespace(vs=ap_vs.copy()))
+    asas = SimpleNamespace(
+        swasas=True, Rm=RM, dhm=DHM, dtlookahead=TLOOK,
+        confpairs=[(ids[i], ids[j]) for i, j in zip(ii, jj)],
+        qdr=np.asarray(cdout.qdr)[ii, jj],
+        dist=np.asarray(cdout.dist)[ii, jj],
+        tcpa=np.asarray(cdout.tcpa)[ii, jj],
+        tLOS=np.asarray(cdout.tinconf)[ii, jj],
+        swprio=swprio, priocode=priocode,
+        swnoreso=bool(noreso_ids), noresolst=[ids[i] for i in noreso_ids],
+        swresooff=bool(resooff_ids),
+        resoofflst=[ids[i] for i in resooff_ids],
+        swresohoriz=swresohoriz, swresospd=swresospd,
+        swresohdg=swresohdg, swresovert=swresovert,
+        vmin=VMIN, vmax=VMAX, vsmin=VSMIN, vsmax=VSMAX,
+        asaseval=False, alt=prev_alt.copy())
+    mvp.resolve(asas, traf)
+
+    # ---- our device resolver on the same ConflictData ----
+    cfg = cr_mvp.MVPConfig(
+        rpz_m=RM, hpz_m=DHM, tlookahead=TLOOK,
+        swresohoriz=swresohoriz, swresospd=swresospd,
+        swresohdg=swresohdg, swresovert=swresovert,
+        swprio=swprio, priocode=priocode)
+    noreso = jnp.zeros(n, bool)
+    for i in noreso_ids:
+        noreso = noreso.at[i].set(True)
+    resooff = jnp.zeros(n, bool)
+    for i in resooff_ids:
+        resooff = resooff.at[i].set(True)
+    newtrk, newgs, newvs, newalt, asase, asasn = cr_mvp.resolve(
+        cdout, f(alt), f(gse), f(gsn), f(vs), f(trk), f(gs),
+        f(selalt), f(ap_vs), f(prev_alt),
+        VMIN, VMAX, VSMIN, VSMAX, cfg, noreso=noreso, resooff=resooff)
+    ours = (np.asarray(newtrk), np.asarray(newgs), np.asarray(newvs),
+            np.asarray(newalt), np.asarray(asase), np.asarray(asasn))
+    inconf = np.asarray(cdout.inconf)
+    return asas, ours, inconf
+
+
+def assert_match(asas, ours, inconf):
+    """Compare everything the reference assigns, on in-conflict rows
+    (the only rows the coordinator consumes — core/asas.py:377)."""
+    newtrk, newgs, newvs, newalt, asase, asasn = ours
+    for name, ref_v, our_v, tol in (
+            ("trk", asas.trk, newtrk, 1e-6),
+            ("tas", asas.tas, newgs, 1e-8),
+            ("vs", asas.vs, newvs, 1e-8),
+            ("alt", asas.alt, newalt, 1e-6),
+            ("asase", asas.asase, asase, 1e-4),
+            ("asasn", asas.asasn, asasn, 1e-4)):
+        np.testing.assert_allclose(
+            np.asarray(ref_v)[inconf], our_v[inconf],
+            rtol=1e-7, atol=tol, err_msg=name)
+
+
+def test_multi_conflict_no_prio():
+    asas, ours, inconf = run_both(make_scene(seed=0))
+    assert inconf.sum() >= 4
+    assert_match(asas, ours, inconf)
+
+
+@pytest.mark.parametrize("priocode", ["FF1", "FF2", "FF3", "LAY1", "LAY2"])
+def test_priority_rules(priocode):
+    # seeds chosen so cruiser/climber mixes hit the rule branches
+    for seed in (1, 2):
+        asas, ours, inconf = run_both(make_scene(seed=seed),
+                                      swprio=True, priocode=priocode)
+        assert_match(asas, ours, inconf)
+
+
+def test_noreso_aircraft_are_not_avoided():
+    asas, ours, inconf = run_both(make_scene(seed=3), noreso_ids=(0, 2))
+    assert_match(asas, ours, inconf)
+
+
+def test_resooff_aircraft_do_not_resolve():
+    asas, ours, inconf = run_both(make_scene(seed=4), resooff_ids=(1, 3))
+    assert_match(asas, ours, inconf)
+
+
+@pytest.mark.parametrize("flags", [
+    dict(swresohoriz=True, swresospd=True),           # SPD only
+    dict(swresohoriz=True, swresohdg=True),           # HDG only
+    dict(swresohoriz=True, swresospd=True, swresohdg=True),
+    dict(swresovert=True),                            # vertical only
+])
+def test_resolution_direction_limits(flags):
+    asas, ours, inconf = run_both(make_scene(seed=5), **flags)
+    assert_match(asas, ours, inconf)

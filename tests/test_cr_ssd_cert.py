@@ -1,0 +1,184 @@
+"""Exact geometric certificates for the velocity-grid SSD (VERDICT r3
+item 8: pyclipper is unavailable, so the grid SSD had no ground truth).
+
+The certificate is an independent float64 host formulation: candidate
+velocity ``v`` conflicts with intruder ``j`` within the lookahead iff
+
+    min_{t in [0, tla]} | d + (v_j - v) t |  <  rpz
+
+and the minimum of that quadratic over a closed interval is attained at
+an endpoint or the unconstrained CPA — three closed-form evaluations,
+no discriminant algebra shared with the kernel's tin/tout derivation.
+
+Certified properties, on random multi-conflict scenes:
+  1. SAFETY — whenever some grid candidate is exactly free (with
+     margin), the resolver's chosen velocity is exactly conflict-free.
+  2. GRID OPTIMALITY — no exactly-free candidate is closer to the
+     rule's objective than the chosen one (RS1: current velocity,
+     RS5: the AP velocity).
+  3. QUANTIZATION BOUND — on a single-intruder cone whose continuous
+     optimum is known in closed form (distance from the cone's axis
+     point to its surface: |u| sin(asin(rpz/D))), the chosen velocity
+     satisfies   opt <= dist(chosen, v_own) <= opt + h   where h is
+     the polar grid's covering radius — an exact sandwich certifying
+     the discretization error is bounded by the grid pitch.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from bluesky_tpu.ops import cd, cr_ssd
+
+NM, FT = 1852.0, 0.3048
+RPZ = 5.0 * NM
+HPZ = 1000.0 * FT
+TLOOK = 300.0
+RPZ_M = RPZ * 1.05
+VMIN, VMAX = 60.0, 400.0
+
+
+def cert_min_dist(ve, vn, dx, dy, gse_j, gsn_j, tla=TLOOK):
+    """Exact min distance to intruder j over t in [0, tla] (float64)."""
+    wve, wvn = gse_j - ve, gsn_j - vn            # relative velocity
+    f = lambda t: np.hypot(dx + wve * t, dy + wvn * t)
+    w2 = wve * wve + wvn * wvn
+    ts = [0.0, tla]
+    if w2 > 0:
+        tstar = -(dx * wve + dy * wvn) / w2
+        ts.append(min(max(tstar, 0.0), tla))
+    return min(f(t) for t in ts)
+
+
+def scene(n=32, seed=0):
+    # ~130 x 135 km box: the 300 s lookahead (90 km closing reach)
+    # makes plenty of conflicts, while instantaneous spacing leaves
+    # open velocity space to certify (a tighter box is wall-to-wall
+    # LoS and nothing is free)
+    rng = np.random.default_rng(seed)
+    lat = rng.uniform(51.4, 52.6, n)
+    lon = rng.uniform(3.0, 5.0, n)
+    trk = rng.uniform(0.0, 360.0, n)
+    gs = rng.uniform(130.0, 250.0, n)
+    alt = np.full(n, 5000.0)                     # co-altitude: 2-D VO test
+    vs = np.zeros(n)
+    return lat, lon, trk, gs, alt, vs
+
+
+def run_ssd(sc, rule="RS1", ntrk=36, nspd=10):
+    lat, lon, trk, gs, alt, vs = sc
+    n = len(lat)
+    f = lambda x: jnp.asarray(np.asarray(x, np.float64))
+    out = cd.detect(f(lat), f(lon), f(trk), f(gs), f(alt), f(vs),
+                    jnp.ones(n, bool), RPZ, HPZ, TLOOK)
+    cfg = cr_ssd.SSDConfig(ntrk=ntrk, nspd=nspd, rpz_m=RPZ_M,
+                           tlookahead=TLOOK, priocode=rule)
+    gse = gs * np.sin(np.radians(trk))
+    gsn = gs * np.cos(np.radians(trk))
+    newtrk, newgs = cr_ssd.resolve(
+        out, f(lat), f(lon), f(alt), f(trk), f(gs), f(vs),
+        f(gse), f(gsn), jnp.ones(n, bool), VMIN, VMAX, cfg)
+    return out, np.asarray(newtrk), np.asarray(newgs), cfg
+
+
+def pair_geometry(out, n):
+    qdr = np.asarray(out.qdr)
+    dist = np.asarray(out.dist)
+    dx = dist * np.sin(np.radians(qdr))
+    dy = dist * np.cos(np.radians(qdr))
+    pairok = ~np.eye(n, dtype=bool) & (dist < cr_ssd.ADSB_MAX)
+    return dx, dy, pairok
+
+
+def grid_candidates(gse_i, gsn_i, cfg):
+    trks = np.linspace(0.0, 360.0, cfg.ntrk, endpoint=False)
+    spds = np.linspace(VMIN, VMAX, cfg.nspd)
+    ct = np.repeat(trks, cfg.nspd)
+    cs = np.tile(spds, cfg.ntrk)
+    ve = cs * np.sin(np.radians(ct))
+    vn = cs * np.cos(np.radians(ct))
+    return np.concatenate([ve, [gse_i]]), np.concatenate([vn, [gsn_i]])
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("rule", ["RS1", "RS5"])
+def test_safety_and_grid_optimality_certificates(seed, rule):
+    sc = scene(seed=seed)
+    out, newtrk, newgs, cfg = run_ssd(sc, rule)
+    lat, lon, trk, gs, alt, vs = sc
+    n = len(lat)
+    gse = gs * np.sin(np.radians(trk))
+    gsn = gs * np.cos(np.radians(trk))
+    dx, dy, pairok = pair_geometry(out, n)
+    inconf = np.asarray(out.inconf)
+    assert inconf.sum() >= 4, "scene must have conflicts"
+
+    checked = 0
+    for i in np.where(inconf)[0]:
+        js = np.where(pairok[i])[0]
+        mind = lambda ve, vn: min(
+            cert_min_dist(ve, vn, dx[i, j], dy[i, j], gse[j], gsn[j])
+            for j in js)
+        cves, cvns = grid_candidates(gse[i], gsn[i], cfg)
+        free_margin = np.array([mind(ve, vn) >= RPZ_M * (1 + 1e-4)
+                                for ve, vn in zip(cves, cvns)])
+        if not free_margin.any():
+            continue                     # resolver may only delay: skip
+        checked += 1
+        ve_c = newgs[i] * np.sin(np.radians(newtrk[i]))
+        vn_c = newgs[i] * np.cos(np.radians(newtrk[i]))
+        # 1. SAFETY: the chosen velocity is exactly conflict-free
+        assert mind(ve_c, vn_c) >= RPZ_M * (1 - 1e-6), (
+            f"ac {i}: chosen velocity intrudes "
+            f"({mind(ve_c, vn_c):.1f} m < {RPZ_M:.1f} m)")
+        # 2. GRID OPTIMALITY vs the rule's objective
+        ref_e, ref_n = gse[i], gsn[i]    # RS1 and (no AP given) RS5
+        d_chosen = np.hypot(ve_c - ref_e, vn_c - ref_n)
+        d_best = np.hypot(cves[free_margin] - ref_e,
+                          cvns[free_margin] - ref_n).min()
+        assert d_chosen <= d_best * (1 + 1e-5) + 1e-6, (
+            f"ac {i}: chosen {d_chosen:.2f} m/s from objective, an "
+            f"exactly-free candidate sits at {d_best:.2f}")
+    assert checked >= 3, "certificate must actually fire on conflicts"
+
+
+def test_quantization_bound_on_exact_cone():
+    """Single head-on intruder: continuous optimum in closed form.
+
+    Own at the origin flying east at 150 m/s; intruder D = 50 km due
+    east flying west at 150 m/s.  In relative-velocity space the VO is
+    a cone of half-angle asin(rpz/D) around the line of sight; own's
+    relative velocity u sits ON the axis, so the exact distance from
+    current velocity to the free region is |u| sin(asin(rpz/D)) — the
+    truncation (entry time ~160 s < 300 s lookahead) and the speed ring
+    are inactive at the tangent point.  The chosen velocity must land
+    within the grid covering radius of that optimum, and can never beat
+    it (the certificate sandwich)."""
+    D = 50_000.0
+    lat0 = 52.0
+    # place the intruder D meters due east
+    dlon = np.degrees(D / (6371000.0 * np.cos(np.radians(lat0))))
+    sc = (np.array([lat0, lat0]), np.array([4.0, 4.0 + dlon]),
+          np.array([90.0, 270.0]), np.array([150.0, 150.0]),
+          np.array([5000.0, 5000.0]), np.zeros(2))
+    out, newtrk, newgs, cfg = run_ssd(sc, "RS1", ntrk=72, nspd=24)
+    assert bool(out.inconf[0])
+
+    u = 300.0                                    # closing speed
+    opt = u * (RPZ_M / np.asarray(out.dist)[0, 1])   # |u| sin(asin(r/D))
+    ve_c = newgs[0] * np.sin(np.radians(newtrk[0]))
+    vn_c = newgs[0] * np.cos(np.radians(newtrk[0]))
+    gse, gsn = 150.0, 0.0
+    d_chosen = np.hypot(ve_c - gse, vn_c - gsn)
+    # grid covering radius: one track step at top speed + one speed step
+    h = np.hypot(VMAX * 2 * np.pi / cfg.ntrk,
+                 (VMAX - VMIN) / (cfg.nspd - 1))
+    assert d_chosen >= opt * (1 - 1e-3), (
+        f"chosen beats the exact continuous optimum: {d_chosen:.2f} < "
+        f"{opt:.2f} — the VO test must be leaking")
+    assert d_chosen <= opt + h, (
+        f"chosen {d_chosen:.2f} m/s exceeds optimum {opt:.2f} + grid "
+        f"covering radius {h:.2f} — quantization worse than its bound")
+    # and it is exactly safe
+    dx, dy, _ = pair_geometry(out, 2)
+    md = cert_min_dist(ve_c, vn_c, dx[0, 1], dy[0, 1], -150.0, 0.0)
+    assert md >= RPZ_M * (1 - 1e-6)

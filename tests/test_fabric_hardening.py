@@ -1,0 +1,164 @@
+"""Fabric hardening one notch past parity (VERDICT r3 item 9).
+
+* Worker crash-recovery: kill -9 a REAL spawned worker process mid-
+  BATCH — the server detects the dead child, requeues its scenario
+  piece, spawns a replacement, and the batch still completes.
+* Silent-worker reaping: an externally-registered worker that stops
+  answering PINGs is dropped from the pool and from NODESCHANGED.
+* Server-to-server chaining (reference network/server.py:213-225): a
+  downstream server mirrors the upstream's node table to its clients
+  and routes events for remote nodes over the link — a client on the
+  chained server runs a stack command on a worker two servers away and
+  gets the ECHO back.
+"""
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+zmq = pytest.importorskip("zmq")
+
+from bluesky_tpu.network.client import Client
+from bluesky_tpu.network.common import make_id
+from bluesky_tpu.network.server import Server
+from bluesky_tpu.simulation.simnode import SimNode
+from tests.test_network import free_ports, wait_for
+
+pytestmark = pytest.mark.slow    # multi-minute lane (see pyproject)
+
+
+def test_killed_worker_piece_requeued_and_batch_completes(tmp_path):
+    scn = tmp_path / "mc.scn"
+    scn.write_text(
+        "00:00:00.00>SCEN CASE_A\n"
+        "00:00:00.00>CRE AAA1 B744 52 4 90 FL200 250\n"
+        "00:00:00.00>FF\n"
+        "00:30:00.00>HOLD\n"
+        "00:00:00.00>SCEN CASE_B\n"
+        "00:00:00.00>CRE BBB1 B744 53 5 90 FL300 250\n"
+        "00:00:00.00>FF\n"
+        "00:05:00.00>HOLD\n")
+
+    ev, st, wev, wst = free_ports(4)
+    server = Server(headless=True,
+                    ports=dict(event=ev, stream=st, wevent=wev,
+                               wstream=wst),
+                    spawn_workers=True, max_nnodes=1,
+                    hb_interval=0.5)
+    server.start()
+    time.sleep(0.2)
+    client = Client()
+    try:
+        client.connect(event_port=ev, stream_port=st, timeout=5.0)
+        server.addnodes(1)                 # one real child process
+        assert wait_for(lambda: (client.receive(10),
+                                 len(server.workers) == 1)[1],
+                        timeout=240), "spawned worker never registered"
+        client.stack(f"BATCH {scn}")
+        assert wait_for(lambda: (client.receive(10),
+                                 bool(server.inflight))[1], timeout=120)
+        # kill -9 the worker while its piece is in flight
+        (wid, piece), = list(server.inflight.items())
+        victim = server.spawned[wid]
+        os.kill(victim.pid, signal.SIGKILL)
+        # the server must bury it, requeue the piece, and spawn a
+        # replacement that registers under a NEW id
+        assert wait_for(lambda: wid not in server.workers, timeout=15), \
+            "dead worker never reaped"
+        assert wait_for(lambda: (client.receive(10),
+                                 len(server.workers) == 1
+                                 and wid not in server.workers)[1],
+                        timeout=240), "replacement worker never came up"
+        # ...and the whole batch still completes (both pieces drain)
+        assert wait_for(lambda: (client.receive(10),
+                                 not server.scenarios
+                                 and not server.inflight)[1],
+                        timeout=480), "batch did not complete after crash"
+    finally:
+        server.stop()
+        server.join(timeout=10)
+        client.close()
+        for proc in server.processes:
+            if proc.poll() is None:
+                proc.kill()
+
+
+def test_silent_external_worker_is_reaped():
+    ev, st, wev, wst = free_ports(4)
+    server = Server(headless=True,
+                    ports=dict(event=ev, stream=st, wevent=wev,
+                               wstream=wst),
+                    spawn_workers=False, hb_interval=0.3, hb_timeout=2.0)
+    server.start()
+    time.sleep(0.2)
+    ctx = zmq.Context.instance()
+    zombie_id = make_id()
+    zombie = ctx.socket(zmq.DEALER)
+    zombie.setsockopt(zmq.IDENTITY, zombie_id)
+    zombie.setsockopt(zmq.LINGER, 0)
+    client = Client()
+    try:
+        zombie.connect(f"tcp://127.0.0.1:{wev}")
+        from bluesky_tpu.network.npcodec import packb
+        zombie.send_multipart([b"REGISTER", packb(None)])
+        client.connect(event_port=ev, stream_port=st, timeout=5.0)
+        assert wait_for(lambda: (client.receive(10),
+                                 zombie_id in client.nodes)[1])
+        # never answer PINGs -> reaped after hb_timeout
+        assert wait_for(lambda: (client.receive(10),
+                                 zombie_id not in server.workers
+                                 and zombie_id not in client.nodes)[1],
+                        timeout=15), "silent worker never reaped"
+    finally:
+        zombie.close()
+        server.stop()
+        server.join(timeout=5)
+        client.close()
+
+
+def test_server_chaining_routes_commands_and_echo():
+    uev, ust, uwev, uwst = free_ports(4)
+    dev, dst, dwev, dwst = free_ports(4)
+    upstream = Server(headless=True,
+                      ports=dict(event=uev, stream=ust, wevent=uwev,
+                                 wstream=uwst),
+                      spawn_workers=False)
+    upstream.start()
+    down = Server(headless=True,
+                  ports=dict(event=dev, stream=dst, wevent=dwev,
+                             wstream=dwst),
+                  spawn_workers=False, upstream=("127.0.0.1", uev))
+    down.start()
+    time.sleep(0.3)
+    node = SimNode(event_port=uwev, stream_port=uwst, nmax=16)
+    nthread = threading.Thread(target=node.run, daemon=True)
+    nthread.start()
+    client = Client()
+    try:
+        client.connect(event_port=dev, stream_port=dst, timeout=5.0)
+        # the downstream client sees the UPSTREAM's worker via the merge
+        assert wait_for(lambda: (client.receive(10),
+                                 node.node_id in client.nodes)[1],
+                        timeout=30), "remote node never mirrored"
+        assert node.node_id in down.remote_nodes
+        echoes = []
+        client.event_received.connect(
+            lambda n, d, s: echoes.append((d, s)) if n == b"ECHO" else None)
+        client.stack("ECHO chained-hello", target=node.node_id)
+        assert wait_for(
+            lambda: (client.receive(10),
+                     any("chained-hello" in str(d) for d, _ in echoes))[1],
+            timeout=60), f"no chained echo: {echoes}"
+        # the echo's sender is the remote worker itself
+        assert any(s == node.node_id for d, s in echoes
+                   if "chained-hello" in str(d))
+    finally:
+        node.quit()
+        nthread.join(timeout=5)
+        down.stop()
+        down.join(timeout=5)
+        upstream.stop()
+        upstream.join(timeout=5)
+        client.close()

@@ -17,6 +17,8 @@ import ref_numpy
 import ref_oracle
 from bluesky_tpu.ops import cd
 
+pytestmark = pytest.mark.slow    # multi-minute lane (see pyproject)
+
 NM = 1852.0
 FT = 0.3048
 RPZ = 5.0 * NM

@@ -85,6 +85,8 @@ class TestGuiClient:
                                      len(client.nodes) > 0)[1])
             client.stack("CRE KL204 B744 52 4 90 FL200 250")
             client.stack("BOX SECT 51 3 53 5")
+            client.stack("DEFWPT UIWPT 52.2 4.1")
+            client.stack("SWRAD SYM")
             client.stack("TRAIL ON 1")
             client.stack("POS KL204")
             client.stack("OP")
@@ -96,6 +98,13 @@ class TestGuiClient:
             nd = client.get_nodedata(list(client.nodes)[0])
             assert nd.acdata["id"] == ["KL204"]
             assert "SECT" in nd.shapes
+            # DEFWPT / DISPLAYFLAG mirrors (reference guiclient
+            # nodeData.defwpt/setflag consume the same events)
+            assert wait_for(
+                lambda: (client.receive(10),
+                         "UIWPT" in nd.custwpts and "SYM" in nd.flags)[1],
+                timeout=30)
+            assert nd.custwpts["UIWPT"] == (52.2, 4.1)
             assert nd.siminfo.get("ntraf", 0) >= 0
             # echo from POS routed back
             assert wait_for(
