@@ -20,6 +20,7 @@ RUN pip install --no-cache-dir -r requirements.txt
 
 COPY pyproject.toml README.md ./
 COPY bluesky_tpu ./bluesky_tpu
+COPY scenario ./scenario
 RUN pip install --no-cache-dir -e . \
     && (cd bluesky_tpu/src_cpp && python setup.py build_ext --inplace || \
         echo "cgeo build skipped — NumPy host-geo fallback is automatic")

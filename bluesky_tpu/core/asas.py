@@ -200,57 +200,75 @@ def impl_for_backend(cd_backend: str) -> str:
     return {"pallas": "pallas", "sparse": "sparse"}.get(cd_backend, "lax")
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("block", "tlookahead", "rpz"))
+def _sparse_sort_refresh(lat, lon, gs, alt, vs, active, old_perm,
+                         partners_s, *, block, tlookahead, rpz):
+    """The sparse refresh as ONE compiled program.  Measured eager on
+    the v5e tunnel this chain of ~30 host-dispatched ops cost 600 ms
+    per refresh (12 ms/sim-s amortized at the 1000-step protocol —
+    16% of the whole interval); jitted it is a single dispatch."""
+    from ..ops import cd_sched
+    thresh = cd_sched.reach_threshold_m(gs, active, tlookahead, rpz)
+    # Altitude layering stays OFF: measured end-to-end on the v5e at
+    # N=100k it loses ~4% even on the dense 230 nm circle (1.74x vs
+    # 1.82x real-time) — the schedule-level 2.3x pair reduction is
+    # real, but the regional wall time is dominated by per-pair
+    # conflict tails (2.5M concurrent conflicts), and the real fleet's
+    # TAS spread fattens the layered blocks.  The mechanism remains
+    # available (stripe_sort_dest n_layers, incl. the on-device "auto"
+    # gate) for fleets with genuinely banded cruise altitudes.
+    dest = cd_sched.stripe_sort_dest(
+        lat, lon, gs, active, thresh, block, 32,
+        alt=alt, vs=vs).astype(jnp.int32)
+    # Remap the sorted-space partner table old-layout -> new-layout:
+    # old slot -> caller slot (inverse of the old dest) -> new slot.
+    # Costs a few [n_tot,K] gathers ONCE per refresh — amortized over
+    # sort_every intervals, vs. per-interval gathers if the table
+    # lived in caller space.
+    n = lat.shape[0]
+    n_tot = cd_sched.padded_size(n, block)
+    ar = jnp.arange(n, dtype=jnp.int32)
+    inv_old = jnp.full((n_tot + 1,), -1, jnp.int32).at[
+        jnp.clip(old_perm, 0, n_tot)].set(ar)
+    pv = partners_s[:n_tot]
+    caller_vals = jnp.where(
+        pv >= 0, inv_old[jnp.clip(pv, 0, n_tot)], -1)
+    new_vals = jnp.where(
+        caller_vals >= 0,
+        dest[jnp.clip(caller_vals, 0, n - 1)], -1)
+    per_caller = new_vals[jnp.clip(old_perm, 0, n_tot - 1), :]   # [n, K]
+    spad = partners_s.shape[0]
+    new_partners = jnp.full((spad, pv.shape[1]), -1,
+                            jnp.int32).at[dest].set(per_caller)
+    return dest, new_partners
+
+
+_morton_perm_jit = jax.jit(
+    lambda lat, lon, active: cd_tiled.spatial_permutation(
+        lat, lon, active).astype(jnp.int32))
+
+
 def refresh_spatial_sort(state: SimState, cfg: AsasConfig,
                          block: int = 512, impl: str = "lax") -> SimState:
     """Recompute the cached spatial sort for the tiled/pallas/sparse
     backends.  HOST-called at chunk boundaries, deliberately outside the
     jitted step (see the note in ``update_tiled``); cadence is the
     caller's (Simulation refreshes every ``cfg.sort_every`` CD intervals
-    of sim time, bench once per scan chunk) — any staleness is exact."""
+    of sim time, bench once per scan chunk) — any staleness is exact.
+    The compute itself is one jitted program per flavor (an eager chain
+    here costs hundreds of ms through the TPU tunnel)."""
     ac = state.ac
     if impl == "sparse":
-        from ..ops import cd_sched
-        block = min(block, 256)
-        thresh = cd_sched.reach_threshold_m(
-            ac.gs, ac.active, cfg.dtlookahead, cfg.rpz)
-        # Altitude layering stays OFF: measured end-to-end on the v5e
-        # at N=100k it loses ~4% even on the dense 230 nm circle
-        # (1.74x vs 1.82x real-time) — the schedule-level 2.3x pair
-        # reduction is real, but the regional wall time is dominated by
-        # per-pair conflict tails (2.5M concurrent conflicts), and the
-        # real fleet's TAS spread fattens the layered blocks.  The
-        # mechanism remains available (stripe_sort_dest n_layers, incl.
-        # the on-device "auto" gate) for fleets with genuinely banded
-        # cruise altitudes.
-        dest = cd_sched.stripe_sort_dest(
-            ac.lat, ac.lon, ac.gs, ac.active, thresh, block, 32,
-            alt=ac.alt, vs=ac.vs).astype(jnp.int32)
-        # Remap the sorted-space partner table old-layout -> new-layout:
-        # old slot -> caller slot (inverse of the old dest) -> new slot.
-        # Costs a few [n_tot,K] gathers ONCE per refresh — amortized over
-        # sort_every intervals, vs. per-interval gathers if the table
-        # lived in caller space.
-        n = ac.lat.shape[0]
-        old = state.asas.sort_perm
-        n_tot = cd_sched.padded_size(n, block)
-        ar = jnp.arange(n, dtype=jnp.int32)
-        inv_old = jnp.full((n_tot + 1,), -1, jnp.int32).at[
-            jnp.clip(old, 0, n_tot)].set(ar)
-        pv = state.asas.partners_s[:n_tot]
-        caller_vals = jnp.where(
-            pv >= 0, inv_old[jnp.clip(pv, 0, n_tot)], -1)
-        new_vals = jnp.where(
-            caller_vals >= 0,
-            dest[jnp.clip(caller_vals, 0, n - 1)], -1)
-        per_caller = new_vals[jnp.clip(old, 0, n_tot - 1), :]   # [n, K]
-        spad = state.asas.partners_s.shape[0]
-        partners_s = jnp.full((spad, pv.shape[1]), -1,
-                              jnp.int32).at[dest].set(per_caller)
+        dest, partners_s = _sparse_sort_refresh(
+            ac.lat, ac.lon, ac.gs, ac.alt, ac.vs, ac.active,
+            state.asas.sort_perm, state.asas.partners_s,
+            block=min(block, 256), tlookahead=float(cfg.dtlookahead),
+            rpz=float(cfg.rpz))
         return state.replace(asas=state.asas.replace(
             sort_perm=dest, partners_s=partners_s))
-    perm = cd_tiled.spatial_permutation(ac.lat, ac.lon, ac.active)
-    return state.replace(asas=state.asas.replace(
-        sort_perm=perm.astype(jnp.int32)))
+    perm = _morton_perm_jit(ac.lat, ac.lon, ac.active)
+    return state.replace(asas=state.asas.replace(sort_perm=perm))
 
 
 def update_tiled(state: SimState, cfg: AsasConfig, block: int = 512,

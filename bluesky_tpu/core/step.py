@@ -63,7 +63,13 @@ def step(state: SimState, cfg: SimConfig) -> SimState:
     state = state.replace(ac=kinematics.update_atmosphere(state.ac))
 
     # ---------- ADS-B broadcast model (traffic.py:392) ----------
-    rng, k_adsb, k_turb = jax.random.split(state.rng, 3)
+    if cfg.noise.turb_active or cfg.noise.adsb_transnoise:
+        rng, k_adsb, k_turb = jax.random.split(state.rng, 3)
+    else:
+        # no noise consumer this step: skip the PRNG split entirely
+        # (the key is never read below; the stream stays untouched so
+        # toggling noise mid-run starts from the same key)
+        rng = k_adsb = k_turb = state.rng
     state = state.replace(
         rng=rng,
         adsb=noise.adsb_update(state.adsb, state.ac, k_adsb, simt, cfg.noise))

@@ -42,6 +42,7 @@ class DisplayState:
         self.ctrlat = 0.0
         self.ctrlon = 0.0
         self.scrzoom = 1.0
+        self.user_view = False  # True once PAN/ZOOM issued (radar.py)
         self.features = {}
         self.altfilter = None       # (bottom, top) in meters or None
         self.swsymbol = True
@@ -85,11 +86,13 @@ class DisplayState:
     def pan(self, lat, lon):
         self.ctrlat = float(lat)
         self.ctrlon = float(lon)
+        self.user_view = True       # radar stops auto-fitting
         return True
 
     def zoom(self, factor, absolute=False):
         self.scrzoom = float(factor) if absolute \
             else self.scrzoom * float(factor)
+        self.user_view = True
         return True
 
     def feature(self, sw, arg=None):

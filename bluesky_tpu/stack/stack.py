@@ -31,6 +31,7 @@ class Stack:
         self.scentime: List[float] = []
         self.scencmd: List[str] = []
         self.scenname = ""
+        self.scenfile = ""        # last IC path (bare-IC reload)
         # SAVEIC recording
         self.savefile = None
         self.saveict0 = 0.0
@@ -213,14 +214,20 @@ class Stack:
         """IC: reset and replay a scenario (stack.py:1139-1174)."""
         self.saveclose()
         if fname.upper() == "IC" or fname == "":
-            fname = self.scenname or "ic"
+            # bare IC reloads the last scenario — by its ORIGINAL path,
+            # which may live outside the search dirs
+            fname = self.scenfile or self.scenname or "ic"
         ok, msg = self.openfile(fname)
         if not ok:
             return False, msg
         scentime, scencmd = self.scentime, self.scencmd
         self.sim.reset()
         self.scentime, self.scencmd = scentime, scencmd
-        self.scenname = fname
+        # scenname is the STEM, never a path — it is spliced into log
+        # filenames (reference stack.py IC does the same strip);
+        # scenfile keeps the reload path.
+        self.scenfile = fname
+        self.scenname = os.path.splitext(os.path.basename(fname))[0]
         return True, f"IC: loaded {fname}"
 
     def scen(self, name: str, mergetime: Optional[float] = None):

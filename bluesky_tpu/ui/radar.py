@@ -10,6 +10,8 @@ objdata shape registry, a ROUTEDATA frame), so both the sim process
 (SCREENSHOT command) and a connected GuiClient (its nodeData mirror)
 render through this one code path.
 """
+from xml.sax.saxutils import quoteattr, escape as _esc
+
 import numpy as np
 
 W, H = 1000, 800
@@ -57,17 +59,26 @@ class _Proj:
         return x, y
 
 
-def render_svg(acdata=None, shapes=None, routedata=None, title=""):
+def render_svg(acdata=None, shapes=None, routedata=None, title="",
+               extent=None):
     """SVG text for one radar frame.
 
     acdata: dict with id/lat/lon/trk/alt (+ optional inconf,
     traillat0..) — the ACDATA schema; shapes: {name: (kind, coords)}
     — the objdata registry; routedata: the ROUTEDATA schema.
+    ``extent`` (lat0, lat1, lon0, lon1) fixes the view window (the
+    PAN/ZOOM state); default auto-fits the scene.  The extent rides on
+    the root element (``data-extent``) so an interactive frontend can
+    map clicks back to lat/lon, and each aircraft group carries its
+    callsign (``data-acid``) for click-to-command.
     """
-    proj = _Proj(_extent(acdata, shapes))
+    ext = extent if extent is not None else _extent(acdata, shapes)
+    proj = _Proj(ext)
     parts = [
         f'<svg xmlns="http://www.w3.org/2000/svg" width="{W}" '
-        f'height="{H}" viewBox="0 0 {W} {H}">',
+        f'height="{H}" viewBox="0 0 {W} {H}" '
+        f'data-extent="{ext[0]:.6f},{ext[1]:.6f},'
+        f'{ext[2]:.6f},{ext[3]:.6f}">',
         f'<rect width="{W}" height="{H}" fill="{BG}"/>',
     ]
     # Graticule each whole degree
@@ -103,7 +114,7 @@ def render_svg(acdata=None, shapes=None, routedata=None, title=""):
         x, y = proj.xy(la0, lo0)
         parts.append(f'<text x="{x + 4:.1f}" y="{y - 4:.1f}" '
                      f'fill="{COLORS["shape"]}" font-size="10">'
-                     f'{name}</text>')
+                     f'{_esc(str(name))}</text>')
 
     # Selected route
     if routedata and routedata.get("wplat"):
@@ -119,7 +130,7 @@ def render_svg(acdata=None, shapes=None, routedata=None, title=""):
                          f'fill="{COLORS["route"]}"/>')
             parts.append(f'<text x="{x + 4:.1f}" y="{y + 10:.1f}" '
                          f'fill="{COLORS["route"]}" font-size="9">'
-                         f'{nm_}</text>')
+                         f'{_esc(str(nm_))}</text>')
 
     if acdata:
         # Trails
@@ -147,19 +158,21 @@ def render_svg(acdata=None, shapes=None, routedata=None, title=""):
             color = COLORS["ac_conf"] if (len(inconf) > i
                                           and inconf[i]) \
                 else COLORS["ac"]
+            label = str(ids[i]) if i < len(ids) else ""
             parts.append(
                 f'<g transform="translate({x:.1f},{y:.1f}) '
-                f'rotate({float(trk[i]):.0f})">'
-                f'<path d="M0,-6 L4,6 L0,3 L-4,6 Z" fill="{color}"/></g>')
-            label = ids[i] if i < len(ids) else ""
+                f'rotate({float(trk[i]):.0f})" '
+                f'data-acid={quoteattr(label)}>'
+                f'<path d="M0,-6 L4,6 L0,3 L-4,6 Z" fill="{color}"/>'
+                f'<circle r="8" fill="transparent"/></g>')
             fl = int(round(float(alt[i]) / 0.3048 / 100.0))
             parts.append(f'<text x="{x + 6:.1f}" y="{y:.1f}" '
                          f'fill="{COLORS["label"]}" font-size="10">'
-                         f'{label} FL{fl:03d}</text>')
+                         f'{_esc(label)} FL{fl:03d}</text>')
 
     if title:
         parts.append(f'<text x="10" y="20" fill="#ccc" font-size="13">'
-                     f'{title}</text>')
+                     f'{_esc(str(title))}</text>')
     parts.append("</svg>")
     return "\n".join(parts)
 
@@ -190,9 +203,28 @@ def render_sim(sim, fname=None):
             r = sim.routes.route(i)
             routedata = {"wplat": list(r.lat), "wplon": list(r.lon),
                          "wpname": list(r.name)}
+    # Honor the PAN/ZOOM display state once the user has set it (the
+    # reference RadarWidget's pan/zoom); before any PAN/ZOOM command
+    # the view auto-fits the scene.
+    extent = None
+    if getattr(sim.scr, "user_view", False):
+        lat0, lat1, lon0, lon1 = sim.scr.getviewbounds()
+        # widen lon by the aspect ratio so degrees stay ~square
+        c = (lon0 + lon1) / 2.0
+        half = (lon1 - lon0) / 2.0 * (W / H)
+        extent = (lat0, lat1, c - half, c + half)
+    else:
+        # Sync the auto-fitted view into the display state, so the
+        # FIRST user ZOOM/PAN continues smoothly from what is on
+        # screen instead of jumping to the (0,0) default center.
+        a = _extent(acdata, sim.scr.objdata)
+        sim.scr.ctrlat = (a[0] + a[1]) / 2.0
+        sim.scr.ctrlon = (a[2] + a[3]) / 2.0
+        sim.scr.scrzoom = 1.0 / max((a[1] - a[0]) / 2.0, 1e-6)
     svg = render_svg(acdata, sim.scr.objdata, routedata,
                      title=f"simt {sim.simt:.1f} s — "
-                           f"{len(idx)} aircraft")
+                           f"{len(idx)} aircraft",
+                     extent=extent)
     if fname:
         with open(fname, "w") as f:
             f.write(svg)

@@ -37,7 +37,7 @@ _PAGE = """<!DOCTYPE html>
  body { background:#10141c; color:#9fd49f; font-family:monospace;
         margin:0; display:flex; flex-direction:column; height:100vh; }
  #radar { flex:1; display:flex; align-items:center;
-          justify-content:center; overflow:hidden; }
+          justify-content:center; overflow:hidden; cursor:crosshair; }
  #radar svg { max-width:100%; max-height:100%; }
  #bar { display:flex; padding:6px; background:#181e2a; }
  #cmd { flex:1; background:#0c0f16; color:#d0e8d0; border:1px solid
@@ -49,7 +49,8 @@ _PAGE = """<!DOCTYPE html>
  <div id="radar">connecting&hellip;</div>
  <div id="info"></div>
  <div id="bar"><input id="cmd" autofocus placeholder="stack command
- (CRE KL204 B744 52 4 90 FL200 250 / OP / FF 60 ...)"/></div>
+ (CRE KL204 B744 52 4 90 FL200 250 / OP / FF 60 ...) &mdash; click the
+ map to fill position/aircraft args, drag to pan, wheel to zoom"/></div>
  <div id="echo"></div>
 <script>
  const radar = document.getElementById('radar');
@@ -62,14 +63,20 @@ _PAGE = """<!DOCTYPE html>
    if (d.svg) radar.innerHTML = d.svg;
    if (d.info) info.textContent = d.info;
  };
+ function pushEcho(line, t) {
+   echo.textContent = '> ' + line + '\\n' + (t || '') + '\\n'
+     + echo.textContent;
+ }
+ async function sendCmd(line) {
+   const r = await fetch('/cmd', {method:'POST', body: line});
+   pushEcho(line, await r.text());
+ }
  const hist = []; let hidx = -1;
  cmd.addEventListener('keydown', async ev => {
    if (ev.key === 'Enter' && cmd.value.trim()) {
      const line = cmd.value.trim(); hist.unshift(line); hidx = -1;
      cmd.value = '';
-     const r = await fetch('/cmd', {method:'POST', body: line});
-     const t = await r.text();
-     echo.textContent = '> ' + line + '\\n' + t + '\\n' + echo.textContent;
+     await sendCmd(line);
    } else if (ev.key === 'ArrowUp') {
      hidx = Math.min(hidx + 1, hist.length - 1);
      if (hidx >= 0) cmd.value = hist[hidx];
@@ -78,6 +85,65 @@ _PAGE = """<!DOCTYPE html>
      cmd.value = hidx >= 0 ? hist[hidx] : '';
    }
  });
+
+ // ---- radar interaction: click-to-command, drag-pan, wheel-zoom ----
+ function svgEl() { return radar.querySelector('svg'); }
+ function extent() {
+   const s = svgEl(); if (!s) return null;
+   const e = (s.dataset.extent || '').split(',').map(Number);
+   return e.length === 4 && e.every(isFinite) ? e : null;
+ }
+ function toLatLon(ev) {
+   const s = svgEl(); const e = extent();
+   if (!s || !e) return null;
+   const r = s.getBoundingClientRect();
+   const fx = (ev.clientX - r.left) / r.width;
+   const fy = (ev.clientY - r.top) / r.height;
+   return [e[1] - fy * (e[1] - e[0]), e[2] + fx * (e[3] - e[2])];
+ }
+ let drag = null;
+ radar.addEventListener('mousedown', ev => {
+   drag = {x: ev.clientX, y: ev.clientY, moved: false};
+ });
+ radar.addEventListener('mousemove', ev => {
+   if (drag && Math.abs(ev.clientX - drag.x)
+             + Math.abs(ev.clientY - drag.y) > 6) drag.moved = true;
+ });
+ radar.addEventListener('mouseup', async ev => {
+   const d = drag; drag = null;
+   const s = svgEl(); const e = extent();
+   if (!s || !e) return;
+   const r = s.getBoundingClientRect();
+   if (d && d.moved) {           // drag -> PAN the view center
+     const clat = (e[0] + e[1]) / 2
+       + (ev.clientY - d.y) / r.height * (e[1] - e[0]);
+     const clon = (e[2] + e[3]) / 2
+       - (ev.clientX - d.x) / r.width * (e[3] - e[2]);
+     await sendCmd('PAN ' + clat.toFixed(4) + ',' + clon.toFixed(4));
+     return;
+   }
+   const ll = toLatLon(ev); if (!ll) return;
+   const resp = await fetch('/click', {method:'POST',
+     body: JSON.stringify({line: cmd.value, lat: ll[0], lon: ll[1]})});
+   const out = await resp.json();
+   if (out.tostack) pushEcho(out.tostack, out.echo);
+   const td = out.todisplay || '';
+   // a trailing newline means the command completed (it already ran
+   // server-side): clear the line instead of leaving stale text
+   if (td.endsWith('\\n')) cmd.value = '';
+   else cmd.value += td;
+   cmd.focus();
+ });
+ let wheelTimer = null, wheelDir = 0;
+ radar.addEventListener('wheel', ev => {
+   ev.preventDefault();
+   wheelDir = ev.deltaY < 0 ? 1 : -1;   // one ZOOM per gesture window
+   if (wheelTimer) return;
+   wheelTimer = setTimeout(() => {
+     wheelTimer = null;
+     sendCmd(wheelDir > 0 ? 'ZOOM IN' : 'ZOOM OUT');
+   }, 200);
+ }, {passive: false});
 </script></body></html>
 """
 
@@ -109,26 +175,49 @@ class SimBackend:
 
     def command(self, line):
         """Queue a stack command; executed by the sim loop via pump()."""
+        return self._submit("cmd", line, "(queued)")
+
+    def click(self, line, lat, lon):
+        """Radar click -> command completion (ui/radarclick.py), run on
+        the sim thread like any command (it reads live traffic state)."""
+        return self._submit("click", (line, lat, lon),
+                            {"tostack": "", "todisplay": "", "echo": ""})
+
+    def _submit(self, kind, payload, timeout_result):
         done = queue.Queue()
-        self._pending.put((line, done))
+        self._pending.put((kind, payload, done))
         try:
             return done.get(timeout=5.0)
         except queue.Empty:
-            return "(queued)"
+            return timeout_result
+
+    def _run_cmd(self, line):
+        self.sim.scr.echobuf.clear()
+        self.sim.stack.stack(line)
+        self.sim.stack.process()
+        return "\n".join(self.sim.scr.echobuf)
 
     def pump(self):
         """Run queued commands and refresh the frame cache — called on
         the sim thread between chunks, the only place state is stable."""
+        from . import radarclick
         ran_cmd = False
         while True:
             try:
-                line, done = self._pending.get_nowait()
+                kind, payload, done = self._pending.get_nowait()
             except queue.Empty:
                 break
-            self.sim.scr.echobuf.clear()
-            self.sim.stack.stack(line)
-            self.sim.stack.process()
-            done.put("\n".join(self.sim.scr.echobuf))
+            if kind == "cmd":
+                done.put(self._run_cmd(payload))
+            else:                           # radar click
+                line, lat, lon = payload
+                tostack, todisplay = radarclick.radarclick(
+                    line, lat, lon, self.sim)
+                out = {"tostack": tostack, "todisplay": todisplay,
+                       "echo": ""}
+                if tostack:
+                    out["echo"] = self._run_cmd(tostack)
+                done.put(out)
             ran_cmd = True
         now = time.monotonic()
         # Refresh at most at render_period and only while a viewer is
@@ -166,6 +255,12 @@ class ClientBackend:
         time.sleep(0.15)                     # ECHO arrives via the event
         self.client.receive()                # socket; pump it in
         return "\n".join(nd.echo_text[n0:])
+
+    def click(self, line, lat, lon):
+        """Client mode has no live Simulation for the full radarclick
+        logic; insert the clicked position (the most common argument)."""
+        return {"tostack": "", "echo": "",
+                "todisplay": f"{lat:.4f},{lon:.4f} "}
 
     def pump(self):
         self.client.receive()
@@ -225,6 +320,19 @@ class WebUI:
                     out = ui.backend.command(line)
                     self._send(200, "text/plain; charset=utf-8",
                                (out or "").encode())
+                elif self.path == "/click":
+                    n = int(self.headers.get("Content-Length", 0))
+                    try:
+                        req = json.loads(self.rfile.read(n).decode())
+                        out = ui.backend.click(
+                            str(req.get("line", "")),
+                            float(req["lat"]), float(req["lon"]))
+                    except (ValueError, KeyError, TypeError,
+                            AttributeError) as exc:
+                        out = {"tostack": "", "todisplay": "",
+                               "echo": f"click error: {exc}"}
+                    self._send(200, "application/json",
+                               json.dumps(out).encode())
                 else:
                     self._send(404, "text/plain", b"not found")
 
