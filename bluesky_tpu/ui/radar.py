@@ -153,6 +153,24 @@ def render_svg(acdata=None, shapes=None, routedata=None, title="",
         alt = np.atleast_1d(acdata.get("alt", np.zeros(len(lat))))
         inconf = np.atleast_1d(acdata.get("inconf",
                                           np.zeros(len(lat), bool)))
+        # CPA lines: in-conflict aircraft projected along track to the
+        # closest-point-of-approach time (reference radarwidget.py:754
+        # — lat1, lon1 = qdrpos(lat, lon, trk, tcpa*gs/nm))
+        tcpa = np.atleast_1d(acdata.get("tcpamax", []))
+        gs = np.atleast_1d(acdata.get("gs", []))
+        if len(tcpa) == len(lat) and len(gs) == len(lat):
+            from ..ops import hostgeo
+            for i in np.flatnonzero(np.asarray(inconf[:len(lat)],
+                                               bool)):
+                d_nm = max(0.0, float(tcpa[i]) * float(gs[i]) / 1852.0)
+                la1, lo1 = hostgeo.qdrpos(float(lat[i]), float(lon[i]),
+                                          float(trk[i]), d_nm)
+                x0, y0 = proj.xy(lat[i], lon[i])
+                x1, y1 = proj.xy(la1, lo1)
+                parts.append(
+                    f'<line x1="{x0:.1f}" y1="{y0:.1f}" x2="{x1:.1f}" '
+                    f'y2="{y1:.1f}" stroke="{COLORS["ac_conf"]}" '
+                    f'stroke-width="1" stroke-dasharray="3 3"/>')
         for i in range(len(lat)):
             x, y = proj.xy(lat[i], lon[i])
             color = COLORS["ac_conf"] if (len(inconf) > i
@@ -191,7 +209,9 @@ def render_sim(sim, fname=None):
         "lon": np.asarray(st.lon)[idx],
         "trk": np.asarray(st.trk)[idx],
         "alt": np.asarray(st.alt)[idx],
+        "gs": np.asarray(st.gs)[idx],
         "inconf": np.asarray(traf.state.asas.inconf)[idx],
+        "tcpamax": np.asarray(traf.state.asas.tcpamax)[idx],
         "traillat0": traf.trails.lat0, "traillon0": traf.trails.lon0,
         "traillat1": traf.trails.lat1, "traillon1": traf.trails.lon1,
     }
