@@ -249,3 +249,128 @@ def render_sim(sim, fname=None):
         with open(fname, "w") as f:
             f.write(svg)
     return svg
+
+
+# --------------------------------------------------------------------------
+# Navigation display: the reference's per-aircraft heading-up ND
+# (ui/qtgl/nd.py:55-282) as an SVG — ownship chevron, the +-60 deg
+# wedge with compass ticks, three intermediate range arcs, GS/TAS
+# readout, surrounding traffic with relative-altitude tags, and the
+# ownship route — selected with the SHOWND stack command.
+# --------------------------------------------------------------------------
+
+ND_W = ND_H = 400
+
+
+def render_nd(sim, acid=None, range_nm=40.0):
+    """SVG navigation display for one aircraft (default: SHOWND's)."""
+    from ..ops import hostgeo
+    acid = acid or getattr(sim.scr, "nd_acid", None)
+    traf = sim.traf
+    i = traf.id2idx(acid) if acid else -1
+    cx, cy = ND_W / 2.0, ND_H * 0.78
+    unit = (ND_H * 0.62) / 1.4          # 1.4 ND units = display range
+    parts = [
+        f'<svg xmlns="http://www.w3.org/2000/svg" width="{ND_W}" '
+        f'height="{ND_H}" viewBox="0 0 {ND_W} {ND_H}">',
+        f'<rect width="{ND_W}" height="{ND_H}" fill="#000"/>',
+    ]
+    if not isinstance(i, (int, np.integer)) or i < 0:
+        parts.append('<text x="20" y="30" fill="#888" font-size="13">'
+                     'ND: no aircraft selected (SHOWND acid)</text>'
+                     '</svg>')
+        return "\n".join(parts)
+
+    st = traf.state.ac
+    olat, olon = float(st.lat[i]), float(st.lon[i])
+    otrk = float(st.trk[i])
+    ogs, otas = float(st.gs[i]), float(st.tas[i])
+    oalt = float(st.alt[i])
+
+    def arc(rad_units, lo=-60, hi=60, color="#ccc"):
+        pts = []
+        for a in range(lo, hi + 1, 2):
+            r = rad_units * unit
+            pts.append(f"{cx + r * np.sin(np.radians(a)):.1f},"
+                       f"{cy - r * np.cos(np.radians(a)):.1f}")
+        return (f'<polyline points="{" ".join(pts)}" fill="none" '
+                f'stroke="{color}"/>')
+
+    # wedge edge + intermediate range arcs (nd.py:99-113)
+    parts.append(arc(1.4))
+    for k in (1, 2, 3):
+        parts.append(arc(k * 0.35, color="#444"))
+    # compass ticks every 5 deg, heading labels every 30 (nd.py:124-152)
+    for a in range(-60, 61, 5):
+        hdg = (otrk + a) % 360.0
+        big = abs(round(hdg)) % 30 < 2.5
+        r0, r1 = 1.4 * unit, (1.46 if big else 1.42) * unit
+        sa, ca = np.sin(np.radians(a)), np.cos(np.radians(a))
+        parts.append(f'<line x1="{cx + r0 * sa:.1f}" '
+                     f'y1="{cy - r0 * ca:.1f}" x2="{cx + r1 * sa:.1f}" '
+                     f'y2="{cy - r1 * ca:.1f}" stroke="#ccc"/>')
+        if big:
+            parts.append(
+                f'<text x="{cx + 1.52 * unit * sa:.1f}" '
+                f'y="{cy - 1.5 * unit * ca:.1f}" fill="#ccc" '
+                f'font-size="11" text-anchor="middle">'
+                f'{int(round(hdg / 10.0)) % 36:02d}</text>')
+    # GS/TAS readout (nd.py:158-159) + range note
+    parts.append(f'<text x="8" y="16" fill="#ccc" font-size="11">GS'
+                 f'<tspan fill="#3c3" dx="4">{ogs * 1.94384:.0f}'
+                 f'</tspan>  TAS<tspan fill="#3c3" dx="4">'
+                 f'{otas * 1.94384:.0f}</tspan></text>')
+    parts.append(f'<text x="{ND_W - 8}" y="16" fill="#888" '
+                 f'font-size="11" text-anchor="end">{_esc(str(acid))} '
+                 f'rng {range_nm:.0f} nm</text>')
+
+    def to_xy(lat, lon):
+        qdr, dist = hostgeo.qdrdist(olat, olon, float(lat), float(lon))
+        rel = np.radians(float(qdr) - otrk)
+        r = float(dist) / range_nm * 1.4 * unit
+        return cx + r * np.sin(rel), cy - r * np.cos(rel), float(dist)
+
+    # ownship route, heading-up (the reference copies the route buffers)
+    acid_r = getattr(sim.scr, "route_acid", "")
+    if acid_r == acid:
+        r = sim.routes.route(i)
+        pts = []
+        for la, lo in zip(r.lat, r.lon):
+            x, y, d = to_xy(la, lo)
+            if d < range_nm * 1.6:
+                pts.append(f"{x:.1f},{y:.1f}")
+        if pts:
+            parts.append(f'<polyline points="{" ".join(pts)}" '
+                         f'fill="none" stroke="{COLORS["route"]}" '
+                         f'stroke-dasharray="5 4"/>')
+
+    # surrounding traffic (diamonds + relative altitude, TCAS-style)
+    active = np.asarray(st.active)
+    inconf = np.asarray(traf.state.asas.inconf)
+    for j in np.flatnonzero(active):
+        if j == i:
+            continue
+        x, y, d = to_xy(st.lat[j], st.lon[j])
+        if d > range_nm * 1.5:
+            continue
+        color = COLORS["ac_conf"] if inconf[j] else "#fff"
+        parts.append(f'<path d="M{x:.1f},{y - 5:.1f} l5,5 l-5,5 '
+                     f'l-5,-5 Z" fill="none" stroke="{color}"/>')
+        dalt_fl = (float(st.alt[j]) - oalt) / 0.3048 / 100.0
+        parts.append(f'<text x="{x + 7:.1f}" y="{y + 4:.1f}" '
+                     f'fill="{color}" font-size="9">'
+                     f'{_esc(str(traf.ids[j]))} '
+                     f'{"+" if dalt_fl >= 0 else "-"}'
+                     f'{abs(dalt_fl):03.0f}</text>')
+
+    # ownship symbol (nd.py:155 vown), fixed heading-up at the focus
+    s = unit * 0.09
+    parts.append(
+        f'<g transform="translate({cx},{cy})" stroke="#ff0" fill="none">'
+        f'<line x1="0" y1="0" x2="0" y2="{1.33 * s:.1f}"/>'
+        f'<line x1="{-0.72 * s:.1f}" y1="{0.33 * s:.1f}" '
+        f'x2="{0.72 * s:.1f}" y2="{0.33 * s:.1f}"/>'
+        f'<line x1="{-0.24 * s:.1f}" y1="{1.11 * s:.1f}" '
+        f'x2="{0.24 * s:.1f}" y2="{1.11 * s:.1f}"/></g>')
+    parts.append("</svg>")
+    return "\n".join(parts)

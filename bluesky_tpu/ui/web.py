@@ -45,8 +45,12 @@ _PAGE = """<!DOCTYPE html>
  #echo { height:9em; overflow-y:auto; background:#0c0f16;
          padding:4px 8px; font-size:12px; white-space:pre-wrap; }
  #info { padding:2px 8px; color:#678; font-size:12px; }
+ #nd { position:fixed; top:8px; right:8px; width:280px; height:280px;
+       display:none; border:1px solid #334; background:#000; }
+ #nd svg { width:100%; height:100%; }
 </style></head><body>
  <div id="radar">connecting&hellip;</div>
+ <div id="nd"></div>
  <div id="info"></div>
  <div id="bar"><input id="cmd" autofocus placeholder="stack command
  (CRE KL204 B744 52 4 90 FL200 250 / OP / FF 60 ...) &mdash; click the
@@ -57,11 +61,14 @@ _PAGE = """<!DOCTYPE html>
  const info = document.getElementById('info');
  const echo = document.getElementById('echo');
  const cmd = document.getElementById('cmd');
+ const nd = document.getElementById('nd');
  const es = new EventSource('/events');
  es.onmessage = ev => {
    const d = JSON.parse(ev.data);
    if (d.svg) radar.innerHTML = d.svg;
    if (d.info) info.textContent = d.info;
+   if (d.nd) { nd.innerHTML = d.nd; nd.style.display = 'block'; }
+   else nd.style.display = 'none';
  };
  function pushEcho(line, t) {
    echo.textContent = '> ' + line + '\\n' + (t || '') + '\\n'
@@ -155,6 +162,7 @@ class SimBackend:
         self.sim = sim
         self._pending = queue.Queue()
         self._frame = None               # (svg, info) cached by pump()
+        self._nd = None                  # ND svg when SHOWND active
         self.render_period = 0.25        # cache refresh cap (s)
         self._last_render = 0.0
         self._last_request = 0.0         # last frame() call (viewer pull)
@@ -162,9 +170,15 @@ class SimBackend:
     def _render(self):
         from . import radar
         svg = radar.render_sim(self.sim)
+        # per-aircraft navigation display when SHOWND selected one
+        self._nd = radar.render_nd(self.sim) \
+            if getattr(self.sim.scr, "nd_acid", None) else None
         return svg, (f"simt {float(self.sim.simt):8.1f} s   "
                      f"ntraf {self.sim.traf.ntraf}   "
                      f"state {self.sim.state_flag}")
+
+    def nd_frame(self):
+        return self._nd
 
     def frame(self):
         """Latest frame; served from the sim-thread cache when a loop is
@@ -262,6 +276,9 @@ class ClientBackend:
         return {"tostack": "", "echo": "",
                 "todisplay": f"{lat:.4f},{lon:.4f} "}
 
+    def nd_frame(self):
+        return None                      # ND needs the embedded sim
+
     def pump(self):
         self.client.receive()
 
@@ -294,6 +311,13 @@ class WebUI:
                 elif self.path == "/frame.svg":
                     svg, _ = ui.backend.frame()
                     self._send(200, "image/svg+xml", svg.encode())
+                elif self.path == "/nd.svg":
+                    nd = ui.backend.nd_frame()
+                    if nd:
+                        self._send(200, "image/svg+xml", nd.encode())
+                    else:
+                        self._send(404, "text/plain",
+                                   b"no ND selected (SHOWND acid)")
                 elif self.path == "/events":
                     self.send_response(200)
                     self.send_header("Content-Type", "text/event-stream")
@@ -302,7 +326,11 @@ class WebUI:
                     try:
                         while True:
                             svg, inf = ui.backend.frame()
-                            payload = json.dumps({"svg": svg, "info": inf})
+                            d = {"svg": svg, "info": inf}
+                            nd = ui.backend.nd_frame()
+                            if nd:
+                                d["nd"] = nd
+                            payload = json.dumps(d)
                             self.wfile.write(
                                 f"data: {payload}\n\n".encode())
                             self.wfile.flush()

@@ -118,6 +118,25 @@ def test_radar_click_to_command(served_sim):
     assert abs((lat0 + lat1) / 2 - 51.8) < 0.2   # PAN center honored
 
 
+def test_nd_inset_flows_when_selected(served_sim):
+    """ND acid selects a navigation display: /nd.svg serves it and the
+    SSE payload carries it for the inset (reference ui/qtgl/nd.py)."""
+    sim, ui = served_sim
+    _post(ui, "/cmd", "CRE OWN B744 52 4 45 FL200 250")
+    _post(ui, "/cmd", "CRE TFC1 A320 52.2 4.2 225 FL210 230")
+    # not selected yet -> 404
+    import urllib.error
+    try:
+        _get(ui, "/nd.svg")
+        assert False, "expected 404 before ND selection"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    _post(ui, "/cmd", "ND OWN")
+    time.sleep(0.4)
+    nd = _get(ui, "/nd.svg").decode()
+    assert "<svg" in nd and "TFC1" in nd and "GS" in nd
+
+
 def test_client_backend_interface():
     """ClientBackend against a stub with the GuiClient surface it uses
     (get_nodedata().echo_text, stack, receive, render_svg, act)."""
