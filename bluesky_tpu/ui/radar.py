@@ -374,3 +374,56 @@ def render_nd(sim, acid=None, range_nm=40.0):
         f'x2="{0.24 * s:.1f}" y2="{1.11 * s:.1f}"/></g>')
     parts.append("</svg>")
     return "\n".join(parts)
+
+
+def render_plots(sim, width=640, row_h=160):
+    """SVG chart sheet for the live PLOT registry — the headless
+    analogue of the reference's matplotlib InfoWindow plot tabs
+    (ui/qtgl/infowindow.py:34-109): one panel per PLOT command, drawn
+    from the plotter's buffered series."""
+    plots = [p for p in getattr(sim.plotter, "plots", [])
+             if len(p.series[0]) >= 2]
+    h = max(1, len(plots)) * row_h
+    parts = [
+        f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" '
+        f'height="{h}" viewBox="0 0 {width} {h}">',
+        f'<rect width="{width}" height="{h}" fill="{BG}"/>',
+    ]
+    if not plots:
+        parts.append('<text x="16" y="28" fill="#888" font-size="12">'
+                     'no plots — use e.g. PLOT simt ac.tas[0] 1'
+                     '</text></svg>')
+        return "\n".join(parts)
+    m = 36                                   # panel margin
+    for k, p in enumerate(plots):
+        xs = np.asarray(p.series[0], float)
+        ys = np.asarray(p.series[1], float)
+        y0 = k * row_h
+        x_lo, x_hi = float(xs.min()), float(xs.max())
+        y_lo, y_hi = float(ys.min()), float(ys.max())
+        xs_n = (xs - x_lo) / max(x_hi - x_lo, 1e-9)
+        ys_n = (ys - y_lo) / max(y_hi - y_lo, 1e-9)
+        px = m + xs_n * (width - 2 * m)
+        py = y0 + row_h - m - ys_n * (row_h - 2 * m)
+        pts = " ".join(f"{x:.1f},{y:.1f}" for x, y in zip(px, py))
+        color = p.color or "#3c3"
+        parts += [
+            f'<rect x="{m}" y="{y0 + m}" width="{width - 2 * m}" '
+            f'height="{row_h - 2 * m}" fill="none" stroke="#334"/>',
+            f'<polyline points="{pts}" fill="none" stroke="{color}" '
+            f'stroke-width="1.5"/>',
+            f'<text x="{m}" y="{y0 + m - 6}" fill="#9fd49f" '
+            f'font-size="11">fig {p.fig}: '
+            f'{_esc(p.y.varname)} vs {_esc(p.x.varname)}</text>',
+            f'<text x="{m}" y="{y0 + row_h - m + 14}" fill="#678" '
+            f'font-size="9">{x_lo:.4g}</text>',
+            f'<text x="{width - m}" y="{y0 + row_h - m + 14}" '
+            f'fill="#678" font-size="9" text-anchor="end">'
+            f'{x_hi:.4g}</text>',
+            f'<text x="{m - 4}" y="{y0 + row_h - m}" fill="#678" '
+            f'font-size="9" text-anchor="end">{y_lo:.4g}</text>',
+            f'<text x="{m - 4}" y="{y0 + m + 10}" fill="#678" '
+            f'font-size="9" text-anchor="end">{y_hi:.4g}</text>',
+        ]
+    parts.append("</svg>")
+    return "\n".join(parts)

@@ -163,6 +163,7 @@ class SimBackend:
         self._pending = queue.Queue()
         self._frame = None               # (svg, info) cached by pump()
         self._nd = None                  # ND svg when SHOWND active
+        self._plots = None               # plot sheet when PLOTs exist
         self.render_period = 0.25        # cache refresh cap (s)
         self._last_render = 0.0
         self._last_request = 0.0         # last frame() call (viewer pull)
@@ -173,6 +174,9 @@ class SimBackend:
         # per-aircraft navigation display when SHOWND selected one
         self._nd = radar.render_nd(self.sim) \
             if getattr(self.sim.scr, "nd_acid", None) else None
+        # live plot sheet (the InfoWindow analogue), only when plots run
+        self._plots = radar.render_plots(self.sim) \
+            if getattr(self.sim.plotter, "plots", None) else None
         return svg, (f"simt {float(self.sim.simt):8.1f} s   "
                      f"ntraf {self.sim.traf.ntraf}   "
                      f"state {self.sim.state_flag}")
@@ -318,6 +322,13 @@ class WebUI:
                     else:
                         self._send(404, "text/plain",
                                    b"no ND selected (SHOWND acid)")
+                elif self.path == "/plots.svg":
+                    pl = getattr(ui.backend, "_plots", None)
+                    if pl:
+                        self._send(200, "image/svg+xml", pl.encode())
+                    else:
+                        self._send(404, "text/plain",
+                                   b"no plots (PLOT x,y,dt)")
                 elif self.path == "/events":
                     self.send_response(200)
                     self.send_header("Content-Type", "text/event-stream")

@@ -137,6 +137,23 @@ def test_nd_inset_flows_when_selected(served_sim):
     assert "<svg" in nd and "TFC1" in nd and "GS" in nd
 
 
+def test_plot_sheet_flows(served_sim):
+    """PLOT commands surface as the live chart sheet at /plots.svg (the
+    reference InfoWindow's plot tabs, headless)."""
+    sim, ui = served_sim
+    _post(ui, "/cmd", "CRE P1 B744 52 4 90 FL200 150")
+    _post(ui, "/cmd", "SPD P1 290")
+    _post(ui, "/cmd", "PLOT simt ac.tas[0] 0.1")
+    # advance sim time so samples accumulate (pumper runs pump only;
+    # drive steps through the sim object directly)
+    for _ in range(30):
+        sim.step(max_chunk=4)
+    _get(ui, "/frame.svg")        # mark viewer interest -> pump renders
+    time.sleep(0.5)
+    svg = _get(ui, "/plots.svg").decode()
+    assert "<svg" in svg and "polyline" in svg and "tas" in svg
+
+
 def test_client_backend_interface():
     """ClientBackend against a stub with the GuiClient surface it uses
     (get_nodedata().echo_text, stack, receive, render_svg, act)."""
