@@ -30,8 +30,27 @@ from ..core.state import SimState
 from ..core.step import SimConfig, step
 
 
+def init_multihost(coordinator_address=None, num_processes=None,
+                   process_id=None):
+    """Join a multi-host mesh (the reference's MPI/NCCL scale-out role,
+    SURVEY §5.8, as jax.distributed over DCN).
+
+    Call ONCE per host process before any other JAX use; afterwards
+    ``jax.devices()`` lists every chip in the job, so ``make_mesh()``
+    and the sharded step below span hosts with no further changes —
+    GSPMD routes intra-host collectives over ICI and cross-host ones
+    over DCN.  On Cloud TPU pods the arguments default from the
+    environment (``jax.distributed.initialize()`` with none needed).
+    Single-host (and this repo's one-chip CI) skips this entirely.
+    """
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
 def make_mesh(n_devices=None, devices=None):
-    """1-D mesh over the aircraft axis."""
+    """1-D mesh over the aircraft axis (all JOB devices after
+    ``init_multihost`` — i.e. every chip on every host)."""
     devices = devices if devices is not None else jax.devices()
     if n_devices is not None:
         devices = devices[:n_devices]

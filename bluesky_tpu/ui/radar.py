@@ -395,10 +395,25 @@ def render_plots(sim, width=640, row_h=160):
                      '</text></svg>')
         return "\n".join(parts)
     m = 36                                   # panel margin
+
+    def as_curve(samples):
+        """Robust per-sample scalarization: unindexed PLOT variables
+        buffer a (possibly ragged) vector per sample — chart the mean."""
+        return np.array([float(np.mean(np.asarray(v, float)))
+                         if np.size(v) else np.nan for v in samples])
+
     for k, p in enumerate(plots):
-        xs = np.asarray(p.series[0], float)
-        ys = np.asarray(p.series[1], float)
+        xs = as_curve(p.series[0])
+        ys = as_curve(p.series[1])
+        keep = np.isfinite(xs) & np.isfinite(ys)
+        xs, ys = xs[keep], ys[keep]
         y0 = k * row_h
+        if len(xs) < 2:
+            continue
+        # more than ~2 samples per pixel is invisible: stride-downsample
+        # so an hours-long fast-time run cannot bloat the sheet
+        stride = max(1, len(xs) // (2 * (width - 2 * m)))
+        xs, ys = xs[::stride], ys[::stride]
         x_lo, x_hi = float(xs.min()), float(xs.max())
         y_lo, y_hi = float(ys.min()), float(ys.max())
         xs_n = (xs - x_lo) / max(x_hi - x_lo, 1e-9)
@@ -406,11 +421,11 @@ def render_plots(sim, width=640, row_h=160):
         px = m + xs_n * (width - 2 * m)
         py = y0 + row_h - m - ys_n * (row_h - 2 * m)
         pts = " ".join(f"{x:.1f},{y:.1f}" for x, y in zip(px, py))
-        color = p.color or "#3c3"
+        color = quoteattr(str(p.color or "#3c3"))
         parts += [
             f'<rect x="{m}" y="{y0 + m}" width="{width - 2 * m}" '
             f'height="{row_h - 2 * m}" fill="none" stroke="#334"/>',
-            f'<polyline points="{pts}" fill="none" stroke="{color}" '
+            f'<polyline points="{pts}" fill="none" stroke={color} '
             f'stroke-width="1.5"/>',
             f'<text x="{m}" y="{y0 + m - 6}" fill="#9fd49f" '
             f'font-size="11">fig {p.fig}: '

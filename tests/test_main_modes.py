@@ -1,0 +1,46 @@
+"""The console entry point's mode dispatch, driven as a real user
+would: ``python -m bluesky_tpu --detached --scenfile ...`` must run a
+scenario to completion and exit cleanly (the reference BlueSky.py
+headless workflow)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow    # spawns a fresh JAX process
+
+
+def test_detached_scenfile_runs_to_quit(tmp_path):
+    scn = tmp_path / "run.scn"
+    # the SCREENSHOT at t=10 proves the scenario actually ran to its
+    # end (exit code alone would pass even if --scenfile were ignored)
+    scn.write_text(
+        "00:00:00.00>CRE KL1 B744 52 4 90 FL200 250\n"
+        "00:00:00.00>FF\n"
+        "00:00:10.00>SCREENSHOT finished.svg\n"
+        "00:00:10.00>QUIT\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BLUESKY_TPU_NO_REF="1",
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))))
+    out = subprocess.run(
+        [sys.executable, "-m", "bluesky_tpu", "--detached",
+         "--scenfile", str(scn)],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=tmp_path)
+    assert out.returncode == 0, out.stderr[-2000:]
+    marker = tmp_path / "finished.svg"
+    assert marker.exists() and b"KL1" in marker.read_bytes(), \
+        "scenario did not run to its t=10s SCREENSHOT"
+
+
+def test_help_lists_all_modes():
+    out = subprocess.run(
+        [sys.executable, "-m", "bluesky_tpu", "--help"],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0
+    for mode in ("--headless", "--sim", "--detached", "--client",
+                 "--web", "--upstream", "--node-id"):
+        assert mode in out.stdout
