@@ -279,3 +279,26 @@ class TestOfflineNetworkPlugins:
         do(sim, "PLUGINS LOAD WINDGFS")
         out = do(sim, "WINDGFS")
         assert "pygrib" in out
+
+
+class TestEnsemble:
+    """Device-side Monte-Carlo (plugins/ensemble.py): replicas of the
+    CURRENT scene, jittered and vmapped as one SPMD program — the
+    TPU-first counterpart of the reference's BATCH process farm."""
+
+    def test_ensemble_reports_statistics(self, sim):
+        out = do(sim, "PLUGINS LOAD ENSEMBLE",
+                 # a converging pair so conflicts exist in most replicas
+                 "CRE E1 B744 52.0 3.8 090 FL200 250",
+                 "CRE E2 B744 52.0 4.2 270 FL200 250",
+                 "ENSEMBLE 4 30 800")
+        assert "conflicts" in out and "+-" in out, out
+        assert "4 x 30s" in out
+
+    def test_ensemble_requires_traffic_and_replicas(self, sim):
+        do(sim, "PLUGINS LOAD ENSEMBLE")
+        out = do(sim, "ENSEMBLE 4 10")
+        assert "no traffic" in out
+        do(sim, "CRE X1 B744 52 4 90 FL200 250")
+        out = do(sim, "ENSEMBLE 1 10")
+        assert "at least 2" in out
