@@ -45,6 +45,8 @@ class Ensemble:
     def __init__(self, sim):
         self.sim = sim
         self.last = None         # stats dict of the last run
+        self._runs = 0           # per-call entropy for the jitter keys
+        self._cache = {}         # (cfg, nreps, nmax, nsteps) -> runner
 
     def run(self, nreps, tend, spread=500.0):
         import jax
@@ -76,13 +78,20 @@ class Ensemble:
         # Per-replica initial-condition jitter: gaussian position noise
         # of ``spread`` meters (and ~1 kt speed noise) on active slots —
         # the classic MC-over-uncertainty setup the reference runs as
-        # BATCH process replicas.
-        key = jax.random.PRNGKey(int(np.asarray(base.rng)[-1]))
+        # BATCH process replicas.  A run counter folds into the key so
+        # repeated ENSEMBLE calls draw fresh replicas.
+        self._runs += 1
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(int(np.asarray(base.rng)[-1])), self._runs)
         keys = jax.random.split(key, nreps)
         act = base.ac.active
 
         def jitter(state_key):
-            k1, k2, k3, k4 = jax.random.split(state_key, 4)
+            # 5-way split: four noise draws + a FRESH stream for the
+            # replica's in-sim rng (split is prefix-stable, so reusing
+            # state_key would alias the first step's noise keys onto
+            # the jitter draws)
+            k1, k2, k3, k4, knew = jax.random.split(state_key, 5)
             dtype = base.ac.lat.dtype
             mlat = spread / 111_000.0
             mlon = mlat / jnp.maximum(
@@ -98,11 +107,9 @@ class Ensemble:
                               base.ac.tas),
                 gs=jnp.where(act, base.ac.gs + noise(k4, 0.5),
                              base.ac.gs))
-            return base.replace(ac=ac, rng=state_key)
+            return base.replace(ac=ac, rng=knew)
 
         states = jax.vmap(jitter)(keys)
-        mesh = sharding.make_ensemble_mesh(
-            min(nreps, len(jax.devices())))
         # Inherit the sim's FULL config (simdt, noise, ASAS settings);
         # only the replica-hostile pieces change: dense CD above a size
         # threshold becomes tiled, and any aircraft-axis mesh is
@@ -111,24 +118,52 @@ class Ensemble:
         if backend == "dense" and nmax > 4096:
             backend = "tiled"
         cfg = sim.cfg._replace(cd_backend=backend, cd_mesh=None)
-        nsteps = max(1, int(round(float(tend) / cfg.simdt)))
-        run = sharding.ensemble_step_fn(mesh, cfg, nsteps=nsteps)
-        out = jax.block_until_ready(run(states))
 
-        nconf = np.asarray(out.asas.nconf_cur)
-        nlos = np.asarray(out.asas.nlos_cur)
+        # Step in CD-interval chunks, accumulating per-replica peak and
+        # time-mean counts — sampling only the final step would miss
+        # every conflict that resolves before tend.  The compiled chunk
+        # runner is cached across calls (a fresh jit closure per call
+        # would recompile the scan every time).
+        chunk = max(1, int(round(cfg.asas.dtasas / cfg.simdt)))
+        nchunks = max(1, int(round(float(tend) / cfg.simdt / chunk)))
+        ck = (cfg, nreps, nmax, chunk)
+        runner = self._cache.get(ck)
+        if runner is None:
+            mesh = sharding.make_ensemble_mesh(
+                min(nreps, len(jax.devices())))
+            runner = sharding.ensemble_step_fn(mesh, cfg, nsteps=chunk)
+            self._cache = {ck: runner}      # keep only the latest
+            self._ndev = mesh.devices.size
+        peak_conf = np.zeros(nreps)
+        peak_los = np.zeros(nreps)
+        sum_conf = np.zeros(nreps)
+        sum_los = np.zeros(nreps)
+        for _ in range(nchunks):
+            states = runner(states)
+            nconf = np.asarray(states.asas.nconf_cur) / 2.0  # pairs
+            nlos = np.asarray(states.asas.nlos_cur) / 2.0
+            peak_conf = np.maximum(peak_conf, nconf)
+            peak_los = np.maximum(peak_los, nlos)
+            sum_conf += nconf
+            sum_los += nlos
+        mean_conf = sum_conf / nchunks
+        mean_los = sum_los / nchunks
+
         self.last = dict(nreps=nreps, tend=float(tend),
                          spread=float(spread),
-                         nconf_mean=float(nconf.mean()),
-                         nconf_std=float(nconf.std()),
-                         nconf_min=int(nconf.min()),
-                         nconf_max=int(nconf.max()),
-                         nlos_mean=float(nlos.mean()),
-                         nlos_std=float(nlos.std()))
+                         peak_conf_mean=float(peak_conf.mean()),
+                         peak_conf_std=float(peak_conf.std()),
+                         mean_conf_mean=float(mean_conf.mean()),
+                         peak_los_mean=float(peak_los.mean()),
+                         mean_los_mean=float(mean_los.mean()))
         return True, (
             f"ENSEMBLE {nreps} x {float(tend):.0f}s (jitter "
-            f"{float(spread):.0f} m) on "
-            f"{mesh.devices.size} device(s):\n"
-            f"  conflicts {nconf.mean():.1f} +- {nconf.std():.1f} "
-            f"(min {nconf.min()}, max {nconf.max()})\n"
-            f"  LoS       {nlos.mean():.1f} +- {nlos.std():.1f}")
+            f"{float(spread):.0f} m) on {self._ndev} device(s), "
+            f"conflict PAIRS sampled each CD interval:\n"
+            f"  peak conflicts {peak_conf.mean():.1f} "
+            f"+- {peak_conf.std():.1f} "
+            f"(min {peak_conf.min():.0f}, max {peak_conf.max():.0f})\n"
+            f"  mean conflicts {mean_conf.mean():.2f} "
+            f"+- {mean_conf.std():.2f}\n"
+            f"  peak LoS       {peak_los.mean():.1f} "
+            f"+- {peak_los.std():.1f}")
