@@ -30,6 +30,9 @@ class nodeData:
         self.echo_text = []
         self.custwpts = {}        # DEFWPT mirror: name -> (lat, lon)
         self.flags = {}           # DISPLAYFLAG mirror: flag -> last args
+        self.ssd_all = False      # SSD disc selection mirror
+        self.ssd_conflicts = False   # (reference guiclient.py:138-140)
+        self.ssd_ownship = set()
         # Accumulated trail picture (ACDATA carries deltas)
         self.traillat0 = np.array([])
         self.traillon0 = np.array([])
@@ -37,6 +40,20 @@ class nodeData:
         self.traillon1 = np.array([])
 
     MAX_TRAIL_SEGMENTS = 20000
+
+    def show_ssd(self, arg):
+        """SSD selection update (reference guiclient.py:283-296)."""
+        arg = {str(a).upper() for a in (arg or [])}
+        if "ALL" in arg:
+            self.ssd_all, self.ssd_conflicts = True, False
+        elif "CONFLICTS" in arg:
+            self.ssd_all, self.ssd_conflicts = False, True
+        elif "OFF" in arg:
+            self.ssd_all, self.ssd_conflicts = False, False
+            self.ssd_ownship = set()
+        else:
+            remove = self.ssd_ownship.intersection(arg)
+            self.ssd_ownship = self.ssd_ownship.union(arg) - remove
 
     def setacdata(self, data):
         self.acdata = data
@@ -97,6 +114,8 @@ class GuiClient(Client):
             nd.custwpts[data["name"]] = (data.get("lat"), data.get("lon"))
         elif name == b"DISPLAYFLAG":
             nd.flags[data.get("flag")] = data.get("args")
+            if data.get("flag") == "SSD":
+                nd.show_ssd(data.get("args"))
 
     def _on_stream(self, name, data, sender):
         nd = self.nodedata[sender]
@@ -120,7 +139,10 @@ class GuiClient(Client):
         title = (f"simt {info.get('simt', 0):.1f} s — "
                  f"{info.get('ntraf', 0)} aircraft — "
                  f"{info.get('speed', 0):.1f}x") if info else ""
-        svg = radar.render_svg(acdata, nd.shapes, nd.routedata, title)
+        svg = radar.render_svg(acdata, nd.shapes, nd.routedata, title,
+                               ssd=radar.compute_ssd_discs_acdata(
+                                   nd.acdata, nd.ssd_all,
+                                   nd.ssd_conflicts, nd.ssd_ownship))
         if fname:
             with open(fname, "w") as f:
                 f.write(svg)

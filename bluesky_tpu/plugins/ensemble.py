@@ -125,29 +125,41 @@ class Ensemble:
         # runner is cached across calls (a fresh jit closure per call
         # would recompile the scan every time).
         chunk = max(1, int(round(cfg.asas.dtasas / cfg.simdt)))
-        nchunks = max(1, int(round(float(tend) / cfg.simdt / chunk)))
-        ck = (cfg, nreps, nmax, chunk)
-        runner = self._cache.get(ck)
-        if runner is None:
-            mesh = sharding.make_ensemble_mesh(
-                min(nreps, len(jax.devices())))
-            runner = sharding.ensemble_step_fn(mesh, cfg, nsteps=chunk)
-            self._cache = {ck: runner}      # keep only the latest
-            self._ndev = mesh.devices.size
+        # Cover tend exactly: whole CD-interval chunks plus one
+        # remainder chunk (rounding tend to whole chunks could silently
+        # simulate up to half a CD interval more or less than asked).
+        total = max(1, int(round(float(tend) / cfg.simdt)))
+        nchunks, rem = divmod(total, chunk)
+        plan = [chunk] * nchunks + ([rem] if rem else [])
+
+        def get_runner(nsteps):
+            ck = (cfg, nreps, nmax, nsteps)
+            runner = self._cache.get(ck)
+            if runner is None:
+                mesh = sharding.make_ensemble_mesh(
+                    min(nreps, len(jax.devices())))
+                runner = sharding.ensemble_step_fn(mesh, cfg,
+                                                   nsteps=nsteps)
+                if len(self._cache) > 2:    # keep the latest plan only
+                    self._cache = {}
+                self._cache[ck] = runner
+                self._ndev = mesh.devices.size
+            return runner
+
         peak_conf = np.zeros(nreps)
         peak_los = np.zeros(nreps)
         sum_conf = np.zeros(nreps)
         sum_los = np.zeros(nreps)
-        for _ in range(nchunks):
-            states = runner(states)
+        for nsteps in plan:
+            states = get_runner(nsteps)(states)
             nconf = np.asarray(states.asas.nconf_cur) / 2.0  # pairs
             nlos = np.asarray(states.asas.nlos_cur) / 2.0
             peak_conf = np.maximum(peak_conf, nconf)
             peak_los = np.maximum(peak_los, nlos)
             sum_conf += nconf
             sum_los += nlos
-        mean_conf = sum_conf / nchunks
-        mean_los = sum_los / nchunks
+        mean_conf = sum_conf / len(plan)
+        mean_los = sum_los / len(plan)
 
         self.last = dict(nreps=nreps, tend=float(tend),
                          spread=float(spread),

@@ -84,6 +84,15 @@ class ScreenIO(DisplayState):
                              {"flag": sw, "args": arg}, [b"*"])
         return True
 
+    def show_ssd(self, *args):
+        """SSD disc selection, mirrored to clients the reference way
+        (stack.py:697-700 feature('SSD', args) -> guiclient.py:270
+        show_ssd)."""
+        super().show_ssd(*args)
+        self.node.send_event(b"DISPLAYFLAG",
+                             {"flag": "SSD", "args": list(args)}, [b"*"])
+        return True
+
     def filteralt(self, flag, bottom=None, top=None):
         super().filteralt(flag, bottom, top)
         self.node.send_event(

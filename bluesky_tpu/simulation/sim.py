@@ -49,6 +49,9 @@ class DisplayState:
         self.editline = ""
         self.nd_acid = None
         self.route_acid = ""        # ROUTEDATA selection (showroute)
+        self.ssd_all = False        # SSD disc selection (reference
+        self.ssd_conflicts = False  # guiclient.py:283-296 show_ssd)
+        self.ssd_ownship = set()
 
     def showroute(self, acid=""):
         """Select the aircraft whose route streams in ROUTEDATA
@@ -116,6 +119,23 @@ class DisplayState:
 
     def shownd(self, acid=None):
         self.nd_acid = acid
+        return True
+
+    def show_ssd(self, *args):
+        """Select which aircraft draw their solution-space disc on the
+        radar (reference guiclient.py:283-296: ALL / CONFLICTS / OFF or
+        a toggled set of callsigns)."""
+        arg = {str(a).upper() for a in args}
+        if "ALL" in arg:
+            self.ssd_all, self.ssd_conflicts = True, False
+        elif "CONFLICTS" in arg:
+            self.ssd_all, self.ssd_conflicts = False, True
+        elif "OFF" in arg:
+            self.ssd_all, self.ssd_conflicts = False, False
+            self.ssd_ownship = set()
+        else:
+            remove = self.ssd_ownship.intersection(arg)
+            self.ssd_ownship = self.ssd_ownship.union(arg) - remove
         return True
 
 

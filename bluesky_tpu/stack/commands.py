@@ -1024,36 +1024,42 @@ def register_all(stack):
             return snap.load(sim, fname)
         return False, "SNAPSHOT SAVE/LOAD filename"
 
-    def ssdcmd(acid_txt=None):
-        """SSD [acid]: report the solution-space occupancy for an
-        aircraft (headless stand-in for the GUI's SSD view — the same
-        velocity-grid mask ops/cr_ssd.py resolves on)."""
-        if acid_txt is None:
-            return True, "SSD acid: show solution-space occupancy"
-        i = traf.id2idx(acid_txt.upper())
-        if not isinstance(i, int) or i < 0:
-            return False, f"{acid_txt}: aircraft not found"
-        if sim.cfg.cd_backend != "dense" or traf.nmax > 2000:
-            # the [N, C, N] velocity-obstacle tensor is a small-N tool
-            return False, ("SSD view needs the dense backend and "
-                           "nmax <= 2000")
-        from ..ops import cd as cdops, cr_ssd
-        ac = st().ac
-        c = sim.cfg.asas
-        cdout = cdops.detect(ac.lat, ac.lon, ac.trk, ac.gs, ac.alt,
-                             ac.vs, ac.active, c.rpz, c.hpz,
-                             c.dtlookahead)
-        ssdcfg = cr_ssd.SSDConfig(rpz_m=c.rpz_m,
-                                  tlookahead=c.dtlookahead)
-        newtrk, newgs = cr_ssd.resolve(
-            cdout, ac.lat, ac.lon, ac.alt, ac.trk, ac.gs, ac.vs,
-            ac.gseast, ac.gsnorth, ac.active,
-            c.vmin, c.vmax, ssdcfg)
-        inconf = bool(cdout.inconf[i])
-        txt = (f"{acname(i)}: {'IN CONFLICT' if inconf else 'clear'}; "
-               f"SSD resolution trk {float(newtrk[i]):.0f} deg, "
-               f"spd {float(newgs[i]) / aero.kts:.0f} kts")
-        return True, txt
+    def ssdcmd(*args):
+        """SSD ALL/CONFLICTS/OFF or SSD acid0,acid1,...: select which
+        aircraft draw their solution-space disc on the radar (reference
+        stack.py:697-700 -> scr.feature('SSD', args) -> the
+        radarwidget.py:290-302 SSD view; here ui/radar.py renders the
+        same velocity-obstacle annulus into the SVG/web frame).  A
+        single named aircraft additionally gets a textual occupancy
+        report, so the view also works headless."""
+        if not args:
+            return True, "SSD ALL/CONFLICTS/OFF or SSD acid0,acid1,..."
+        words = [str(a).upper() for a in args]
+        # validate callsigns before toggling (keywords pass through)
+        acids = [w for w in words
+                 if w not in ("ALL", "CONFLICTS", "OFF")]
+        for a in acids:
+            i = traf.id2idx(a)
+            if not isinstance(i, int) or i < 0:
+                return False, f"{a}: aircraft not found"
+        sim.scr.show_ssd(*words)
+        if len(acids) == 1 and len(words) == 1:
+            from ..ui import radar
+            ac = st().ac
+            c = sim.cfg.asas
+            i = traf.id2idx(acids[0])
+            conf = radar.ssd_disc(
+                i, np.asarray(ac.lat), np.asarray(ac.lon),
+                np.asarray(ac.gseast), np.asarray(ac.gsnorth),
+                np.asarray(ac.active), c.vmin, c.vmax, c.rpz_m,
+                c.dtlookahead)
+        else:
+            return True, f"SSD: {' '.join(words)}"
+        occ = 100.0 * float(np.mean(conf))
+        inconf = bool(np.asarray(st().asas.inconf)[i])
+        return True, (f"{acname(i)}: "
+                      f"{'IN CONFLICT' if inconf else 'clear'}; "
+                      f"{occ:.0f}% of the velocity envelope blocked")
 
     def doccmd(cmd=None):
         """DOC [command]: extended help (scr.show_cmd_doc)."""
@@ -1193,7 +1199,8 @@ def register_all(stack):
                      "Schedule a command at a sim time"],
         "SEED": ["SEED value", "int", seed, "Set random seed"],
         "SPD": ["SPD acid,spd", "acid,spd", selspd, "Speed select command"],
-        "SSD": ["SSD [acid]", "[txt]", ssdcmd,
+        "SSD": ["SSD ALL/CONFLICTS/OFF or SSD acid0,acid1,...",
+                "[txt,...]", ssdcmd,
                 "Show solution space diagram"],
         "SYN": ["SYN subcmd,args", "[txt,string,...]", syn,
                 "Synthetic conflict geometries (SUPER/WALL/MATRIX/...)"],

@@ -59,8 +59,122 @@ class _Proj:
         return x, y
 
 
+# ------------------------------------------------------------------
+# SSD velocity-space discs (the reference RadarWidget's SSD view:
+# radarwidget.py:290-302, 593-598 — a per-aircraft disc whose pixels
+# are colored by a conflict test against every intruder, selected with
+# the SSD stack command).  Here each selected aircraft gets an annular
+# polar grid of candidate velocities (the vmin..vmax envelope ring of
+# SSD.py:131-141), each cell colored red when flying that velocity
+# would intrude within rpz_m inside the lookahead — the same VO
+# predicate ops/cr_ssd.py resolves on, sampled host-side in NumPy so
+# the overlay works on every CD backend and any fleet size (cost is
+# O(intruders-in-ADS-B-range) per selected disc).
+# ------------------------------------------------------------------
+
+SSD_R_PX = 46          # disc outer radius on screen [px]
+SSD_MAX_DISCS = 16     # drawing cap (ALL/CONFLICTS at large N)
+_ADSB_MAX_M = 65.0 * 1852.0     # reference SSD.py:110 adsbmax
+
+
+def ssd_disc(i, lat, lon, gseast, gsnorth, active, vmin, vmax, rpz_m,
+             tlookahead, ntrk=36, nspd=5):
+    """Sample ownship ``i``'s solution space: conf [ntrk, nspd] bool.
+
+    Cell (t, s) covers track sector t of the annulus ring s between
+    vmin and vmax; True = that candidate velocity conflicts with at
+    least one intruder within ADS-B range (the cr_ssd._vo_masks CPA
+    predicate, NumPy edition)."""
+    from ..ops import hostgeo
+    lat = np.asarray(lat, float)
+    lon = np.asarray(lon, float)
+    mask = np.asarray(active, bool).copy()
+    mask[i] = False
+    idx = np.flatnonzero(mask)
+    trk_c = (np.arange(ntrk) + 0.5) * (360.0 / ntrk)
+    spd_c = vmin + (np.arange(nspd) + 0.5) * ((vmax - vmin) / nspd)
+    cve = (spd_c[None, :] * np.sin(np.radians(trk_c))[:, None]).ravel()
+    cvn = (spd_c[None, :] * np.cos(np.radians(trk_c))[:, None]).ravel()
+    if len(idx) == 0:
+        return np.zeros((ntrk, nspd), bool)
+    qdr, dist_nm = hostgeo.qdrdist(
+        np.full(len(idx), lat[i]), np.full(len(idx), lon[i]),
+        lat[idx], lon[idx])
+    dist = np.asarray(dist_nm, float) * 1852.0
+    near = dist < _ADSB_MAX_M
+    if not near.any():
+        return np.zeros((ntrk, nspd), bool)
+    qdr = np.asarray(qdr, float)[near]
+    dist = dist[near]
+    dx = dist * np.sin(np.radians(qdr))        # ownship -> intruder east
+    dy = dist * np.cos(np.radians(qdr))
+    ge = np.asarray(gseast, float)[idx][near]
+    gn = np.asarray(gsnorth, float)[idx][near]
+    # w = v_j - u_candidate (StateBasedCD.py:39-40 convention)
+    wve = ge[None, :] - cve[:, None]           # [C, M]
+    wvn = gn[None, :] - cvn[:, None]
+    dv2 = np.maximum(wve * wve + wvn * wvn, 1e-6)
+    tcpa = -(wve * dx[None, :] + wvn * dy[None, :]) / dv2
+    dcpa2 = (dx * dx + dy * dy)[None, :] - tcpa * tcpa * dv2
+    r2 = rpz_m * rpz_m
+    dtin = np.sqrt(np.maximum(0.0, r2 - dcpa2) / dv2)
+    conf = (dcpa2 < r2) & (tcpa + dtin > 0.0) \
+        & (tcpa - dtin < tlookahead)
+    return np.any(conf, axis=1).reshape(ntrk, nspd)
+
+
+def _ssd_disc_svg(x, y, conf, ve, vn, vmax, acid="", vmin=None):
+    """One SSD disc as an SVG group at screen position (x, y)."""
+    ntrk, nspd = conf.shape
+    r0 = SSD_R_PX * 0.35               # vmin ring radius (fixed fraction)
+    if vmin is None:
+        vmin = 0.35 * vmax
+
+    def vrad(v):
+        """Speed -> radius with the SAME mapping as the annulus cells
+        (vmin..vmax onto r0..R), linear from 0 below vmin — so the
+        own-velocity vector tip lands in its true speed ring."""
+        if v <= vmin:
+            return r0 * v / max(vmin, 1.0)
+        return r0 + (SSD_R_PX - r0) * min(
+            (v - vmin) / max(vmax - vmin, 1.0), 1.15)
+
+    v = float(np.hypot(ve, vn))
+    scale = vrad(v) / max(v, 1.0)
+    parts = [f'<g class="ssd" data-acid={quoteattr(str(acid))} '
+             f'transform="translate({x:.1f},{y:.1f})" opacity="0.75">']
+
+    def pt(ang_deg, r):
+        a = np.radians(ang_deg)
+        return f"{r * np.sin(a):.1f},{-r * np.cos(a):.1f}"
+
+    step = 360.0 / ntrk
+    for t in range(ntrk):
+        a0, a1 = t * step, (t + 1) * step
+        for s in range(nspd):
+            ra = r0 + (SSD_R_PX - r0) * s / nspd
+            rb = r0 + (SSD_R_PX - r0) * (s + 1) / nspd
+            color = "#b03028" if conf[t, s] else "#1f7a2f"
+            parts.append(
+                f'<path d="M{pt(a0, ra)} L{pt(a0, rb)} '
+                f'A{rb:.1f},{rb:.1f} 0 0 1 {pt(a1, rb)} '
+                f'L{pt(a1, ra)} A{ra:.1f},{ra:.1f} 0 0 0 {pt(a0, ra)} Z" '
+                f'fill="{color}" stroke="none"/>')
+    # envelope rings + own velocity vector (radarwidget draws the
+    # ownship speed vector over the disc)
+    parts.append(f'<circle r="{SSD_R_PX:.1f}" fill="none" '
+                 f'stroke="#889" stroke-width="0.8"/>')
+    parts.append(f'<circle r="{r0:.1f}" fill="none" stroke="#889" '
+                 f'stroke-width="0.8"/>')
+    parts.append(f'<line x1="0" y1="0" x2="{ve * scale:.1f}" '
+                 f'y2="{-vn * scale:.1f}" stroke="#fff" '
+                 f'stroke-width="1.6"/>')
+    parts.append("</g>")
+    return "".join(parts)
+
+
 def render_svg(acdata=None, shapes=None, routedata=None, title="",
-               extent=None):
+               extent=None, ssd=None):
     """SVG text for one radar frame.
 
     acdata: dict with id/lat/lon/trk/alt (+ optional inconf,
@@ -131,6 +245,13 @@ def render_svg(acdata=None, shapes=None, routedata=None, title="",
             parts.append(f'<text x="{x + 4:.1f}" y="{y + 10:.1f}" '
                          f'fill="{COLORS["route"]}" font-size="9">'
                          f'{_esc(str(nm_))}</text>')
+
+    # SSD velocity-space discs (under the chevrons)
+    for d in (ssd or []):
+        x, y = proj.xy(d["lat"], d["lon"])
+        parts.append(_ssd_disc_svg(x, y, d["conf"], d["ve"], d["vn"],
+                                   d["vmax"], d.get("acid", ""),
+                                   vmin=d.get("vmin")))
 
     if acdata:
         # Trails
@@ -244,11 +365,101 @@ def render_sim(sim, fname=None):
     svg = render_svg(acdata, sim.scr.objdata, routedata,
                      title=f"simt {sim.simt:.1f} s — "
                            f"{len(idx)} aircraft",
-                     extent=extent)
+                     extent=extent, ssd=compute_ssd_discs(sim))
     if fname:
         with open(fname, "w") as f:
             f.write(svg)
     return svg
+
+
+def compute_ssd_discs_acdata(acdata, ssd_all, ssd_conflicts, ssd_ownship,
+                             vmin=None, vmax=None, rpz_m=None,
+                             tlookahead=None):
+    """SSD disc data from an ACDATA-shaped mirror (the GuiClient path:
+    the reference's GL client computes its discs from the same streamed
+    arrays, radarwidget.py:728-765).  ASAS parameters default to the
+    AsasConfig defaults — the stream does not carry them, exactly like
+    the reference client's asas_vmin/vmax display constants."""
+    if not (ssd_all or ssd_conflicts or ssd_ownship):
+        return None
+    lat = np.atleast_1d(acdata.get("lat", []))
+    if not len(lat):
+        return None
+    from ..core.asas import AsasConfig
+    _c = AsasConfig()
+    vmin = _c.vmin if vmin is None else vmin
+    vmax = _c.vmax if vmax is None else vmax
+    rpz_m = _c.rpz_m if rpz_m is None else rpz_m
+    tlookahead = _c.dtlookahead if tlookahead is None else tlookahead
+    lon = np.atleast_1d(acdata["lon"])
+    trk = np.radians(np.atleast_1d(acdata.get("trk",
+                                              np.zeros(len(lat)))))
+    gs = np.atleast_1d(acdata.get("gs", np.zeros(len(lat))))
+    gse, gsn = gs * np.sin(trk), gs * np.cos(trk)
+    ids = list(acdata.get("id", []))
+    inconf = np.atleast_1d(acdata.get("inconf", np.zeros(len(lat), bool)))
+    active = np.ones(len(lat), bool)
+    if ssd_all:
+        sel = list(range(len(lat)))
+    else:
+        sel = []
+        if ssd_conflicts:
+            sel += list(np.flatnonzero(
+                np.asarray(inconf[:len(lat)], bool)))
+        sel += [i for i, a in enumerate(ids)
+                if a in ssd_ownship and i not in sel]
+    sel = sel[:SSD_MAX_DISCS]
+    if not sel:
+        return None
+    return [{
+        "lat": float(lat[i]), "lon": float(lon[i]),
+        "conf": ssd_disc(int(i), lat, lon, gse, gsn, active,
+                         vmin, vmax, rpz_m, tlookahead),
+        "ve": float(gse[i]), "vn": float(gsn[i]),
+        "vmin": vmin, "vmax": vmax,
+        "acid": ids[i] if i < len(ids) else "",
+    } for i in sel]
+
+
+def compute_ssd_discs(sim):
+    """SSD disc data for the aircraft selected by the SSD command
+    (scr.ssd_all / ssd_conflicts / ssd_ownship — reference
+    radarwidget.py:751-765 selssd logic), capped at SSD_MAX_DISCS."""
+    scr = sim.scr
+    if not (getattr(scr, "ssd_all", False)
+            or getattr(scr, "ssd_conflicts", False)
+            or getattr(scr, "ssd_ownship", None)):
+        return None
+    traf = sim.traf
+    st = traf.state.ac
+    active = np.asarray(st.active)
+    if scr.ssd_all:
+        sel = list(np.flatnonzero(active))
+    else:
+        # conflicts and named ownships COMBINE (reference
+        # radarwidget.py:751-762 sets selssd for either condition)
+        sel = []
+        if scr.ssd_conflicts:
+            sel += list(np.flatnonzero(
+                active & np.asarray(traf.state.asas.inconf)))
+        sel += [i for i in (traf.id2idx(a)
+                            for a in sorted(scr.ssd_ownship))
+                if isinstance(i, (int, np.integer)) and i >= 0
+                and i not in sel]
+    sel = sel[:SSD_MAX_DISCS]
+    if not sel:
+        return None
+    c = sim.cfg.asas
+    lat, lon = np.asarray(st.lat), np.asarray(st.lon)
+    gse, gsn = np.asarray(st.gseast), np.asarray(st.gsnorth)
+    return [{
+        "lat": float(lat[i]), "lon": float(lon[i]),
+        "conf": ssd_disc(int(i), lat, lon, gse, gsn, active,
+                         c.vmin, c.vmax, c.rpz_m, c.dtlookahead),
+        "ve": float(gse[i]), "vn": float(gsn[i]),
+        "vmin": c.vmin, "vmax": c.vmax,
+        "acid": traf.ids[int(i)],
+    } for i in sel]
 
 
 # --------------------------------------------------------------------------

@@ -44,6 +44,93 @@ class TestRenderer:
         svg = radar.render_svg({}, {}, None)
         assert svg.startswith("<svg") and svg.endswith("</svg>")
 
+    def test_ssd_discs(self):
+        """SSD ALL/CONFLICTS/acid/OFF draws/clears the velocity-space
+        discs on the radar frame (reference radarwidget.py:290-302 SSD
+        view; guiclient.py:283-296 selection semantics), and the disc
+        sampler marks a head-on intruder's velocity obstacle."""
+        from bluesky_tpu.simulation.sim import Simulation
+        sim = Simulation(nmax=16)
+        for line in ("CRE AC1 B744 52 4.0 90 FL200 250",
+                     "CRE AC2 B744 52 4.8 270 FL200 250",
+                     "OP", "FF 5"):
+            sim.stack.stack(line)
+            sim.stack.process()
+        sim.run(until_simt=5.0)
+
+        sim.stack.stack("SSD AC1")
+        sim.stack.process()
+        assert "velocity envelope blocked" in sim.scr.echobuf[-1]
+        assert sim.scr.ssd_ownship == {"AC1"}
+        svg = radar.render_sim(sim)
+        assert svg.count('class="ssd"') == 1
+
+        sim.stack.stack("SSD CONFLICTS")
+        sim.stack.process()
+        svg = radar.render_sim(sim)
+        # the head-on pair is in conflict: both draw, with at least one
+        # blocked (red) cell each
+        assert svg.count('class="ssd"') == 2
+        assert svg.count("#b03028") > 0
+
+        sim.stack.stack("SSD OFF")
+        sim.stack.process()
+        assert not sim.scr.ssd_conflicts and not sim.scr.ssd_ownship
+        assert 'class="ssd"' not in radar.render_sim(sim)
+
+        sim.stack.stack("SSD NOSUCH")
+        sim.stack.process()
+        assert any("not found" in l for l in sim.scr.echobuf)
+
+    def test_ssd_disc_sampler_geometry(self):
+        """The VO predicate blocks candidates toward a close head-on
+        intruder and frees the reciprocal direction."""
+        lat = np.array([52.0, 52.0])
+        lon = np.array([4.0, 4.3])
+        gse = np.array([0.0, -120.0])     # intruder flying west at own
+        gsn = np.array([0.0, 0.0])
+        conf = radar.ssd_disc(0, lat, lon, gse, gsn,
+                              np.array([True, True]),
+                              vmin=51.4, vmax=92.6, rpz_m=9260.0,
+                              tlookahead=300.0, ntrk=36, nspd=5)
+        ntrk = conf.shape[0]
+        east = int(90.0 / (360.0 / ntrk))         # sector facing 090
+        west = int(270.0 / (360.0 / ntrk))
+        assert conf[east].all()                   # toward the intruder
+        # fleeing west: slow rings are overtaken (closing 120-51 m/s
+        # over ~20 km within the 300 s lookahead) but the fastest ring
+        # outruns the pursuit long enough to stay clear
+        assert conf[west, 0] and not conf[west, -1]
+
+    def test_ssd_discs_acdata_mirror(self):
+        """The GuiClient path: discs computed from an ACDATA-shaped
+        frame + the DISPLAYFLAG-mirrored selection (reference client
+        computes its SSD from the same streamed arrays)."""
+        from bluesky_tpu.network.guiclient import nodeData
+        nd = nodeData()
+        nd.acdata = {
+            "id": ["AC1", "AC2"],
+            "lat": np.array([52.0, 52.0]),
+            "lon": np.array([4.0, 4.3]),
+            "trk": np.array([90.0, 270.0]),
+            "gs": np.array([120.0, 120.0]),
+            "inconf": np.array([True, True]),
+        }
+        nd.show_ssd(["AC1"])
+        assert nd.ssd_ownship == {"AC1"}
+        discs = radar.compute_ssd_discs_acdata(
+            nd.acdata, nd.ssd_all, nd.ssd_conflicts, nd.ssd_ownship)
+        assert len(discs) == 1 and discs[0]["acid"] == "AC1"
+        assert discs[0]["conf"].any()          # head-on blocks cells
+        svg = radar.render_svg(nd.acdata, {}, None, ssd=discs)
+        assert svg.count('class="ssd"') == 1
+        nd.show_ssd(["AC1"])                   # toggle off
+        assert not nd.ssd_ownship
+        nd.show_ssd(["CONFLICTS"])
+        discs = radar.compute_ssd_discs_acdata(
+            nd.acdata, nd.ssd_all, nd.ssd_conflicts, nd.ssd_ownship)
+        assert len(discs) == 2
+
     def test_screenshot_command(self, tmp_path):
         from bluesky_tpu.simulation.sim import Simulation
         sim = Simulation(nmax=8, dtype=jnp.float64)
