@@ -34,13 +34,21 @@ class Navdatabase:
 
     def reset(self):
         have = self.navdata_path and os.path.isdir(self.navdata_path)
-        if not have and not getattr(Navdatabase, "_warned_empty", False):
-            Navdatabase._warned_empty = True
-            print(f"navdb: no navigation data at "
-                  f"{self.navdata_path or '(unset)'} — starting with an "
-                  "empty database (DEFWPT/DEFRWY can define positions; "
-                  "see docs/DATA.md for the expected layout)")
-        d = load_navdata(self.navdata_path, self.cache_path) if have else {}
+        if have:
+            d = load_navdata(self.navdata_path, self.cache_path)
+        else:
+            # Standalone fallback: the compact self-authored world set
+            # (builtin_data.py) instead of an empty database, so CRE/
+            # DEST/ADDWPT by name work out of the box.
+            from .builtin_data import load_builtin
+            d = load_builtin()
+            if not getattr(Navdatabase, "_warned_empty", False):
+                Navdatabase._warned_empty = True
+                print(f"navdb: no navigation data at "
+                      f"{self.navdata_path or '(unset)'} — using the "
+                      f"built-in minimal world set ({len(d['aptid'])} "
+                      f"airports, {len(d['wpid'])} waypoints; "
+                      "approximate positions, see docs/DATA.md)")
         self.wpid = list(d.get("wpid", []))
         self.wplat = np.asarray(d.get("wplat", np.zeros(0)), float)
         self.wplon = np.asarray(d.get("wplon", np.zeros(0)), float)

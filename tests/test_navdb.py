@@ -88,6 +88,56 @@ def test_defwpt(synth_navdb):
     assert ndb.wpid.count("MYWP") == 1
 
 
+def test_builtin_fallback():
+    """With no navdata directory the database falls back to the
+    built-in world set (builtin_data.py) instead of starting empty:
+    major airports and enroute VORs resolve by name."""
+    db = Navdatabase(navdata_path="/nonexistent/navdata", cache_path="")
+    assert len(db.aptid) > 150 and len(db.wpid) >= 20
+    i = db.getaptidx("EHAM")
+    assert i >= 0
+    assert abs(db.aptlat[i] - 52.31) < 0.2
+    assert abs(db.aptlon[i] - 4.76) < 0.2
+    assert db.getaptidx("KJFK") >= 0 and db.getaptidx("YSSY") >= 0
+    j = db.getwpidx("SPY", 52.0, 4.0)
+    assert j >= 0 and abs(db.wplat[j] - 52.54) < 0.2
+    # txt2pos resolves both kinds (the stack's position argument path)
+    pos = db.txt2pos("EGLL", 52.0, 4.0)
+    assert pos is not None and abs(pos[0] - 51.47) < 0.2
+    # runtime definitions still layer on top
+    db.defwpt("MYWPT", 10.0, 20.0)
+    assert db.getwpidx("MYWPT") >= 0
+
+
+def test_builtin_data_sane():
+    """Every built-in record is well-formed: unique ids, lat/lon in
+    range, elevations/runways plausible."""
+    import ast
+    import bluesky_tpu.navdb.builtin_data as bd
+    from bluesky_tpu.navdb.builtin_data import (AIRPORTS, WAYPOINTS,
+                                                load_builtin)
+    # duplicate keys in the SOURCE dict literals would be silently
+    # collapsed by Python — scan the AST, not the built dict
+    tree = ast.parse(open(bd.__file__).read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Dict):
+            keys = [k.value for k in node.keys
+                    if isinstance(k, ast.Constant)]
+            assert len(keys) == len(set(keys)), (
+                f"duplicate literal keys: "
+                f"{sorted(k for k in keys if keys.count(k) > 1)}")
+    for icao, (lat, lon, elev, maxrwy, cc, name) in AIRPORTS.items():
+        assert 2 <= len(icao) <= 4 and icao == icao.upper()
+        assert -90 <= lat <= 90 and -180 <= lon <= 180
+        assert -100 <= elev <= 3000 and 1000 <= maxrwy <= 6000
+        assert len(cc) == 2 and name
+    for wp, (lat, lon, typ) in WAYPOINTS.items():
+        assert -90 <= lat <= 90 and -180 <= lon <= 180 and typ
+    d = load_builtin()
+    assert len(d["aptid"]) == len(AIRPORTS)
+    assert len(d["wpid"]) == len(WAYPOINTS)
+
+
 def test_cache_roundtrip(tmp_path):
     (tmp_path / "data").mkdir()
     (tmp_path / "fix.dat").write_text(" 52.0  4.0 AAA\n")
