@@ -1,0 +1,46 @@
+# Developer entry points (parity: /root/reference/Makefile — test/lint/
+# build/dist/clean/install; bench and check are this framework's own).
+.PHONY: all test test-fast lint build dist clean install uninstall \
+	bench check ext
+
+PYTHON=python3
+
+all: build
+
+test:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PYTHON) -m pytest tests/ -q
+
+test-fast:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PYTHON) -m pytest tests/ -q -m 'not slow'
+
+lint:
+	@$(PYTHON) -m pyflakes bluesky_tpu tests 2>/dev/null \
+	|| $(PYTHON) -m flake8 --select=F bluesky_tpu tests 2>/dev/null \
+	|| { $(PYTHON) -m compileall -q bluesky_tpu tests && \
+	     echo "pyflakes/flake8 not installed — ran compileall only"; }
+
+check:
+	$(PYTHON) check.py
+
+bench:
+	$(PYTHON) bench.py
+
+ext:
+	cd bluesky_tpu/src_cpp && $(PYTHON) setup.py build_ext --inplace
+
+build: pyproject.toml
+	$(PYTHON) -m pip install -e . --no-deps
+
+dist:
+	$(PYTHON) -m build
+
+clean:
+	rm -rf dist/ build/ *egg-info*
+	find . -type d -name '__pycache__' -prune -exec rm -rf {} +
+
+install: build
+
+uninstall:
+	$(PYTHON) -m pip uninstall -y bluesky-tpu

@@ -1,0 +1,86 @@
+"""SO6 flight-plan converter tests (utils/so6.py — the scenario-creator
+tooling role of /root/reference/utils/Scenario-creator/so6_to_scn.py)."""
+from bluesky_tpu.utils import so6
+
+# two flights, three m1 segments (lat/lon in minutes, FL, HHMMSS)
+SO6 = """\
+SEG1 EHAM EGLL B744 100000 100500 200 240 0 KL101 250731 250731 3138.6 285.6 3132.0 270.0 12345 1 45.0
+SEG2 EHAM EGLL B744 100500 101200 240 240 0 KL101 250731 250731 3132.0 270.0 3120.0 240.0 12345 2 60.0
+SEG3 LFPG EDDF A320 100200 100800 180 220 0 AF202 250731 250731 2940.6 153.0 2952.0 180.0 67890 1 50.0
+"""
+
+
+def test_parse():
+    flights = so6.parse_so6(SO6.splitlines())
+    assert set(flights) == {"KL101:12345", "AF202:67890"}
+    kl = flights["KL101:12345"]
+    assert kl.actype == "B744" and len(kl.segs) == 2
+    assert kl.t0 == 10 * 3600
+    # minutes -> degrees
+    assert abs(kl.segs[0][5] - 3138.6 / 60.0) < 1e-9
+    # malformed lines are skipped, not fatal
+    assert so6.parse_so6(["garbage", "# comment", ""]) == {}
+
+
+def test_midnight_rollover_across_segments():
+    """A flight whose later segments start after midnight keeps a
+    monotonic timeline (no ~24h-early creation)."""
+    so6_txt = (
+        "S1 A B B744 235000 235900 200 200 0 NITE1 250731 250731 "
+        "3138.6 285.6 3132.0 270.0 1 1 45.0\n"
+        "S2 A B B744 000500 001200 200 200 0 NITE1 250731 250801 "
+        "3132.0 270.0 3120.0 240.0 1 2 60.0\n")
+    flights = so6.parse_so6(so6_txt.splitlines())
+    fl = flights["NITE1:1"]
+    assert fl.t0 == 23 * 3600 + 50 * 60              # 23:50, not 00:05
+    assert fl.segs[1][1] == 86400 + 5 * 60           # next-day 00:05
+    assert fl.segs[1][2] > fl.segs[1][1]             # te stays after tb
+    # and the converted timeline rebases 23:50 to t=0
+    scn = so6.convert(so6_txt.splitlines())
+    assert scn[0].startswith("00:00:00") and ">CRE NITE1" in scn[0]
+    last_wp = [l for l in scn if ">ADDWPT" in l][-1]
+    assert last_wp.startswith("00:00:00")            # same flight t0
+
+
+def test_convert_shape():
+    scn = so6.convert(SO6.splitlines())
+    cre = [l for l in scn if ">CRE " in l]
+    wpts = [l for l in scn if ">ADDWPT " in l]
+    assert len(cre) == 2 and len(wpts) == 3
+    assert scn[0].startswith("00:00:00")           # rebased to t=0
+    # AF202 starts 2 min after KL101
+    af = next(l for l in cre if "AF202" in l)
+    assert af.startswith("00:02:00")
+    # FL constraints ride the waypoints
+    assert all("FL" in w for w in wpts)
+    # LNAV/VNAV engage per flight
+    assert sum(1 for l in scn if ">LNAV " in l) == 2
+
+
+def test_cli(tmp_path, capsys):
+    src = tmp_path / "fl.so6"
+    src.write_text(SO6)
+    assert so6.main([str(src)]) == 0
+    out = (tmp_path / "fl.scn").read_text()
+    assert "CRE KL101" in out and "ADDWPT AF202" in out
+    assert "2 flights" in capsys.readouterr().out
+
+
+def test_convert_and_fly(tmp_path):
+    """The converted scenario runs: flights spawn at their offsets and
+    fly the segment route under LNAV/VNAV."""
+    from bluesky_tpu.simulation.sim import Simulation
+    p = tmp_path / "conv.scn"
+    p.write_text("\n".join(so6.convert(SO6.splitlines())) + "\n")
+    sim = Simulation(nmax=16)
+    sim.stack.stack(f"IC {p}")
+    sim.stack.process()
+    sim.stack.stack("OP; FF 300")
+    sim.stack.process()
+    sim.run(until_simt=300.0)
+    assert sim.traf.ntraf == 2
+    i = sim.traf.id2idx("KL101")
+    lat = float(sim.traf.state.ac.lat[i])
+    lon = float(sim.traf.state.ac.lon[i])
+    # route heads west-southwest from 52.31N 4.76E toward 52.0N 4.0E
+    assert lon < 4.76 and 51.5 < lat < 52.6, (lat, lon)
