@@ -94,6 +94,14 @@ class Stack:
         cmd = self.synonyms.get(cmd, cmd)
         entry = self.cmddict.get(cmd)
         if entry is None:
+            # zoom shorthand: '+++'/'--' zoom by sqrt(2)^(n+ - n-),
+            # '=' counts as '+' (same key) — reference stack.py:1436-1443
+            if cmd[0] in "+=-" and set(cmd) <= set("+=-"):
+                nplus = cmd.count("+") + cmd.count("=")
+                self.sim.scr.zoom(2.0 ** (0.5 * (nplus - cmd.count("-"))))
+                if self.savefile is not None and "ZOOM" not in SAVEIC_EXCLUDE:
+                    self.savecmd(cmdline)
+                return
             echo(f"Unknown command: {cmd}")
             return
 
@@ -301,6 +309,11 @@ class Stack:
         self.scentime, self.scencmd = [], []
 
 
-# Commands never recorded by SAVEIC (reference stack.py:129-131)
+# Commands never recorded by SAVEIC (reference stack.py:129-131
+# defexcl: display commands and aircraft creation — the saveic snapshot
+# already reconstructs the live fleet, and the reference additionally
+# skips later CRE/MCRE/TRAFGEN by default)
 SAVEIC_EXCLUDE = {"SAVEIC", "IC", "RESET", "QUIT", "STOP", "OP", "HOLD",
-                  "PAUSE", "FF", "BENCHMARK", "SCEN", "PCALL"}
+                  "PAUSE", "FF", "BENCHMARK", "SCEN", "PCALL",
+                  "PAN", "ZOOM", "POS", "INSEDIT", "CALC",
+                  "CRE", "MCRE", "TRAFGEN"}

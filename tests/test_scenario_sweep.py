@@ -1,0 +1,124 @@
+"""Whole-library scenario sweep: every reference .scn parses, and every
+command it issues either resolves in this stack (incl. plugin commands
+and the acid-first/zoom shorthands) or is on the documented stale list
+— commands from ancient BlueSky versions that the REFERENCE's own
+current stack rejects identically (its scenario library has drifted
+from its code; SURVEY.md §4 test-drift warning).  This pins command
+coverage against the entire corpus, not just the replayed samples in
+test_scenario_library.py."""
+import glob
+
+import pytest
+
+from bluesky_tpu import settings
+
+pytestmark = pytest.mark.skipif(
+    not settings.ref_scenario_path,
+    reason="reference scenario library not mounted")
+
+#: In the reference's scenario corpus but NOT in the reference's own
+#: current command dictionary (verified: tests/test_command_coverage.py
+#: enforces full parity with the reference stack.py cmddict, and these
+#: resolve in neither) — pre-2015 commands and experiment one-offs.
+STALE_REFERENCE_COMMANDS = {
+    # ancient display/FMS-era commands (EHAM-TAXI.SCN, CIRCLE12.SCN...)
+    # (TAXI itself is NOT here: the AREA plugin registers a real TAXI
+    # command, so it resolves once plugins load)
+    "SNAV", "COLOR", "FR", "CRZALT", "CRZSPD", "SWTAXI",
+    "NAVTYPE", "NAVDT", "RADARDT", "RECONACTRTE", "INTENT",
+    "LABEL", "DELALT", "ROUTE", "RRING", "LIMPERF",
+    # ancient ASAS-experiment knobs (SIM-0x.scn, CIRCLE12.SCN,
+    # INTENT.scn: reaction-time/zone/filter parameters of a removed
+    # conflict-prediction study)
+    "ASA_ASAS", "ASA_RESO", "ASA_ZONER", "ASA_ZONEDH", "RESONR",
+    "DTREACT", "TREACTNO", "DTREACTNO", "DZONER", "DZONEDH",
+    "DTLOOKINT", "DTCPRED", "DTCPAMBR", "DTCPCYAN", "FILTRED",
+    "FILTAMB", "PZ", "SWSTOPRESO",
+    # removed logger/telemetry toggles (SSDLOG.scn, SIM-0x.scn)
+    "DATALOG", "CFLLOG", "EVTLOG", "INTRLOG", "TRAFLOG", "SELSNAP",
+    # misc bit-rot: an ADS-B study command, a test hook, fast-forward
+    # variants, broken PCALL templates calling files with no args
+    "ADSB", "TEST", "FF_SNAP", "FF_ISOALT", "%0",
+}
+
+
+def _known(stack, line):
+    """Does this scenario line resolve like the runtime would?"""
+    from bluesky_tpu.stack.argparser import cmdsplit
+    args = cmdsplit(line)
+    if not args:
+        return True, None
+    tok = args[0].upper()
+    # zoom shorthand: a run of +/- is a ZOOM gesture (stack.py:1379)
+    if set(tok) <= {"+", "-", "="}:
+        return True, None
+    name = stack.synonyms.get(tok, tok)
+    if name in stack.cmddict:
+        return True, None
+    # acid-first syntax: second token is the command
+    if len(args) > 1:
+        n2 = stack.synonyms.get(args[1].upper(), args[1].upper())
+        if n2 in stack.cmddict:
+            return True, None
+    # a bare callsign line is POS shorthand (stack.py:1390-1396);
+    # whether the aircraft exists is runtime state.  Require a digit
+    # (KL204, HV196...) so unknown zero-arg COMMANDS still get flagged
+    # instead of hiding behind this rule.
+    if len(args) == 1 and tok.isalnum() \
+            and any(c.isdigit() for c in tok):
+        return True, None
+    return False, name
+
+
+def test_whole_library_parses_and_commands_resolve():
+    from bluesky_tpu.simulation.sim import Simulation
+    sim = Simulation(nmax=16)
+    stack = sim.stack
+    # plugin commands register at load exactly like the runtime
+    for p in ("TRAFGEN", "GEOVECTOR", "AREA"):
+        stack.stack(f"PLUGINS LOAD {p}")
+    stack.process()
+
+    files = sorted(set(
+        glob.glob(settings.ref_scenario_path + "/**/*.scn",
+                  recursive=True)
+        + glob.glob(settings.ref_scenario_path + "/**/*.SCN",
+                    recursive=True)))
+    assert len(files) > 60, f"library not found ({len(files)} files)"
+
+    unknown = {}
+    nlines = 0
+    for path in files:
+        ok, msg = stack.openfile(path)
+        assert ok, f"{path}: {msg}"
+        for cmdline in stack.scencmd:
+            # runtime splits on ';' before dispatch (stack.stack)
+            for piece in cmdline.split(";"):
+                piece = piece.strip()
+                if not piece:
+                    continue
+                nlines += 1
+                known, name = _known(stack, piece)
+                if not known:
+                    unknown.setdefault(name, (path, piece))
+
+    assert nlines > 8000          # the corpus is genuinely exercised
+    unexpected = {k: v for k, v in unknown.items()
+                  if k not in STALE_REFERENCE_COMMANDS}
+    assert not unexpected, (
+        "commands in the reference scenario corpus that neither this "
+        f"stack nor the stale list covers: {unexpected}")
+
+
+def test_stale_list_is_really_stale():
+    """Guard the allowlist itself: if one of these ever becomes a real
+    command here (or a synonym, or a plugin command the sweep loads),
+    it must leave the stale list."""
+    from bluesky_tpu.simulation.sim import Simulation
+    stack = Simulation(nmax=8).stack
+    for p in ("TRAFGEN", "GEOVECTOR", "AREA"):
+        stack.stack(f"PLUGINS LOAD {p}")
+    stack.process()
+    leaked = {c for c in STALE_REFERENCE_COMMANDS
+              if stack.synonyms.get(c, c) in stack.cmddict}
+    assert not leaked, f"no longer stale, remove from list: {leaked}"

@@ -116,6 +116,23 @@ def test_dest_engages_lnav_vnav(sim):
     assert r.nwp == 1 and r.name[0] == "DEST"
 
 
+def test_zoom_shorthand(sim):
+    """'+++'/'--' lines zoom by sqrt(2)^(n+ - n-), '=' counts as '+'
+    (reference stack.py:1436-1443) — used all over the scenario
+    library (CIRCLE12.SCN, EHAM-TAXI.SCN...)."""
+    z0 = sim.scr.scrzoom
+    sim.stack.stack("+++")
+    sim.stack.process()
+    assert sim.scr.scrzoom == pytest.approx(z0 * 2.0 ** 1.5)
+    sim.stack.stack("--")
+    sim.stack.process()
+    assert sim.scr.scrzoom == pytest.approx(z0 * 2.0 ** 0.5)
+    sim.stack.stack("=")                     # same key as '+'
+    sim.stack.process()
+    assert sim.scr.scrzoom == pytest.approx(z0 * 2.0)
+    assert not any("Unknown" in l for l in sim.scr.echobuf)
+
+
 def test_asas_settings(sim):
     do(sim, "ZONER 3")
     assert sim.cfg.asas.rpz == pytest.approx(3 * aero.nm)
