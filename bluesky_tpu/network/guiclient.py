@@ -33,6 +33,7 @@ class nodeData:
         self.ssd_all = False      # SSD disc selection mirror
         self.ssd_conflicts = False   # (reference guiclient.py:138-140)
         self.ssd_ownship = set()
+        self.nd_acid = None       # SHOWND selection mirror
         # Accumulated trail picture (ACDATA carries deltas)
         self.traillat0 = np.array([])
         self.traillon0 = np.array([])
@@ -116,6 +117,8 @@ class GuiClient(Client):
             nd.flags[data.get("flag")] = data.get("args")
             if data.get("flag") == "SSD":
                 nd.show_ssd(data.get("args"))
+            elif data.get("flag") == "SHOWND":
+                nd.nd_acid = data.get("args")
 
     def _on_stream(self, name, data, sender):
         nd = self.nodedata[sender]

@@ -84,6 +84,14 @@ class ScreenIO(DisplayState):
                              {"flag": sw, "args": arg}, [b"*"])
         return True
 
+    def shownd(self, acid=None):
+        """ND selection, mirrored to clients (the reference toggles the
+        client-side ND via the SHOWND display event, screenio.py:132)."""
+        super().shownd(acid)
+        self.node.send_event(b"DISPLAYFLAG",
+                             {"flag": "SHOWND", "args": acid}, [b"*"])
+        return True
+
     def show_ssd(self, *args):
         """SSD disc selection, mirrored to clients the reference way
         (stack.py:697-700 feature('SSD', args) -> guiclient.py:270

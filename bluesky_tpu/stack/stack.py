@@ -99,8 +99,7 @@ class Stack:
             if cmd[0] in "+=-" and set(cmd) <= set("+=-"):
                 nplus = cmd.count("+") + cmd.count("=")
                 self.sim.scr.zoom(2.0 ** (0.5 * (nplus - cmd.count("-"))))
-                if self.savefile is not None and "ZOOM" not in SAVEIC_EXCLUDE:
-                    self.savecmd(cmdline)
+                # never SAVEIC-recorded: ZOOM is in SAVEIC_EXCLUDE
                 return
             echo(f"Unknown command: {cmd}")
             return

@@ -474,11 +474,68 @@ ND_W = ND_H = 400
 
 
 def render_nd(sim, acid=None, range_nm=40.0):
-    """SVG navigation display for one aircraft (default: SHOWND's)."""
-    from ..ops import hostgeo
+    """SVG navigation display for one aircraft (default: SHOWND's) —
+    rendered from live Simulation state."""
     acid = acid or getattr(sim.scr, "nd_acid", None)
     traf = sim.traf
     i = traf.id2idx(acid) if acid else -1
+    if not isinstance(i, (int, np.integer)) or i < 0:
+        return _render_nd_data(acid, None, None, None, range_nm)
+    st = traf.state.ac
+    own = dict(lat=float(st.lat[i]), lon=float(st.lon[i]),
+               trk=float(st.trk[i]), gs=float(st.gs[i]),
+               tas=float(st.tas[i]), alt=float(st.alt[i]))
+    active = np.asarray(st.active).copy()
+    active[i] = False
+    idx = np.flatnonzero(active)
+    traffic = dict(
+        id=[traf.ids[j] for j in idx],
+        lat=np.asarray(st.lat)[idx], lon=np.asarray(st.lon)[idx],
+        alt=np.asarray(st.alt)[idx],
+        inconf=np.asarray(traf.state.asas.inconf)[idx])
+    route = None
+    if getattr(sim.scr, "route_acid", "") == acid:
+        r = sim.routes.route(i)
+        route = (list(r.lat), list(r.lon))
+    return _render_nd_data(acid, own, traffic, route, range_nm)
+
+
+def render_nd_acdata(nd, acid=None, range_nm=40.0):
+    """ND from a GuiClient nodeData mirror (the networked-client path —
+    the reference ND draws from the same streamed buffers,
+    ui/qtgl/nd.py consuming the radarwidget's ACDATA state)."""
+    acid = acid or getattr(nd, "nd_acid", None)
+    ac = nd.acdata or {}
+    ids = list(ac.get("id", []))
+    if not acid or acid not in ids:
+        return _render_nd_data(acid, None, None, None, range_nm)
+    i = ids.index(acid)
+    lat = np.atleast_1d(ac["lat"])
+    lon = np.atleast_1d(ac["lon"])
+    trk = np.atleast_1d(ac.get("trk", np.zeros(len(lat))))
+    gs = np.atleast_1d(ac.get("gs", np.zeros(len(lat))))
+    tas = np.atleast_1d(ac.get("tas", gs))
+    alt = np.atleast_1d(ac.get("alt", np.zeros(len(lat))))
+    inconf = np.atleast_1d(ac.get("inconf", np.zeros(len(lat), bool)))
+    own = dict(lat=float(lat[i]), lon=float(lon[i]), trk=float(trk[i]),
+               gs=float(gs[i]), tas=float(tas[i]), alt=float(alt[i]))
+    keep = [j for j in range(len(lat)) if j != i]
+    traffic = dict(id=[ids[j] for j in keep],
+                   lat=lat[keep], lon=lon[keep], alt=alt[keep],
+                   inconf=np.asarray(inconf)[keep])
+    route = None
+    rd = getattr(nd, "routedata", None) or {}
+    if rd.get("wplat") and rd.get("acid", acid) == acid:
+        route = (list(rd["wplat"]), list(rd["wplon"]))
+    return _render_nd_data(acid, own, traffic, route, range_nm)
+
+
+def _render_nd_data(acid, own, traffic, route, range_nm=40.0):
+    """The ND picture from plain data (shared by the embedded and
+    client paths).  ``own``: dict lat/lon/trk/gs/tas/alt; ``traffic``:
+    dict of arrays id/lat/lon/alt/inconf (ownship already excluded);
+    ``route``: (lats, lons) or None."""
+    from ..ops import hostgeo
     cx, cy = ND_W / 2.0, ND_H * 0.78
     unit = (ND_H * 0.62) / 1.4          # 1.4 ND units = display range
     parts = [
@@ -486,17 +543,16 @@ def render_nd(sim, acid=None, range_nm=40.0):
         f'height="{ND_H}" viewBox="0 0 {ND_W} {ND_H}">',
         f'<rect width="{ND_W}" height="{ND_H}" fill="#000"/>',
     ]
-    if not isinstance(i, (int, np.integer)) or i < 0:
+    if own is None:
         parts.append('<text x="20" y="30" fill="#888" font-size="13">'
                      'ND: no aircraft selected (SHOWND acid)</text>'
                      '</svg>')
         return "\n".join(parts)
 
-    st = traf.state.ac
-    olat, olon = float(st.lat[i]), float(st.lon[i])
-    otrk = float(st.trk[i])
-    ogs, otas = float(st.gs[i]), float(st.tas[i])
-    oalt = float(st.alt[i])
+    olat, olon = own["lat"], own["lon"]
+    otrk = own["trk"]
+    ogs, otas = own["gs"], own["tas"]
+    oalt = own["alt"]
 
     def arc(rad_units, lo=-60, hi=60, color="#ccc"):
         pts = []
@@ -542,11 +598,9 @@ def render_nd(sim, acid=None, range_nm=40.0):
         return cx + r * np.sin(rel), cy - r * np.cos(rel), float(dist)
 
     # ownship route, heading-up (the reference copies the route buffers)
-    acid_r = getattr(sim.scr, "route_acid", "")
-    if acid_r == acid:
-        r = sim.routes.route(i)
+    if route is not None:
         pts = []
-        for la, lo in zip(r.lat, r.lon):
+        for la, lo in zip(*route):
             x, y, d = to_xy(la, lo)
             if d < range_nm * 1.6:
                 pts.append(f"{x:.1f},{y:.1f}")
@@ -556,21 +610,20 @@ def render_nd(sim, acid=None, range_nm=40.0):
                          f'stroke-dasharray="5 4"/>')
 
     # surrounding traffic (diamonds + relative altitude, TCAS-style)
-    active = np.asarray(st.active)
-    inconf = np.asarray(traf.state.asas.inconf)
-    for j in np.flatnonzero(active):
-        if j == i:
-            continue
-        x, y, d = to_xy(st.lat[j], st.lon[j])
+    t_ids = traffic["id"] if traffic else []
+    t_inconf = np.atleast_1d(traffic["inconf"]) if traffic else []
+    for j in range(len(t_ids)):
+        x, y, d = to_xy(traffic["lat"][j], traffic["lon"][j])
         if d > range_nm * 1.5:
             continue
-        color = COLORS["ac_conf"] if inconf[j] else "#fff"
+        color = COLORS["ac_conf"] if (len(t_inconf) > j
+                                      and t_inconf[j]) else "#fff"
         parts.append(f'<path d="M{x:.1f},{y - 5:.1f} l5,5 l-5,5 '
                      f'l-5,-5 Z" fill="none" stroke="{color}"/>')
-        dalt_fl = (float(st.alt[j]) - oalt) / 0.3048 / 100.0
+        dalt_fl = (float(traffic["alt"][j]) - oalt) / 0.3048 / 100.0
         parts.append(f'<text x="{x + 7:.1f}" y="{y + 4:.1f}" '
                      f'fill="{color}" font-size="9">'
-                     f'{_esc(str(traf.ids[j]))} '
+                     f'{_esc(str(t_ids[j]))} '
                      f'{"+" if dalt_fl >= 0 else "-"}'
                      f'{abs(dalt_fl):03.0f}</text>')
 

@@ -281,7 +281,13 @@ class ClientBackend:
                 "todisplay": f"{lat:.4f},{lon:.4f} "}
 
     def nd_frame(self):
-        return None                      # ND needs the embedded sim
+        """Client-side ND from the nodeData mirror (SHOWND selection
+        arrives over DISPLAYFLAG; traffic/route from the streams)."""
+        from . import radar
+        nd = self.client.get_nodedata()
+        if not getattr(nd, "nd_acid", None):
+            return None
+        return radar.render_nd_acdata(nd)
 
     def pump(self):
         self.client.receive()

@@ -131,6 +131,30 @@ class TestRenderer:
             nd.acdata, nd.ssd_all, nd.ssd_conflicts, nd.ssd_ownship)
         assert len(discs) == 2
 
+    def test_nd_acdata_mirror(self):
+        """Client-mode ND: rendered from an ACDATA-shaped mirror with
+        the SHOWND selection (reference ND consumes the same streamed
+        state)."""
+        from bluesky_tpu.network.guiclient import nodeData
+        nd = nodeData()
+        nd.acdata = {
+            "id": ["AC1", "AC2"],
+            "lat": np.array([52.0, 52.1]),
+            "lon": np.array([4.0, 4.1]),
+            "trk": np.array([90.0, 270.0]),
+            "gs": np.array([120.0, 120.0]),
+            "tas": np.array([130.0, 130.0]),
+            "alt": np.array([6000.0, 6600.0]),
+            "inconf": np.array([False, True]),
+        }
+        assert radar.render_nd_acdata(nd) .count("SHOWND") == 1  # none
+        nd.nd_acid = "AC1"
+        svg = radar.render_nd_acdata(nd)
+        assert "AC1" in svg and "rng 40" in svg
+        assert "AC2 +020" in svg          # intruder at +2000 ft
+        nd.nd_acid = "GONE"
+        assert "no aircraft selected" in radar.render_nd_acdata(nd)
+
     def test_screenshot_command(self, tmp_path):
         from bluesky_tpu.simulation.sim import Simulation
         sim = Simulation(nmax=8, dtype=jnp.float64)
