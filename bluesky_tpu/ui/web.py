@@ -90,6 +90,12 @@ _PAGE = """<!DOCTYPE html>
    } else if (ev.key === 'ArrowDown') {
      hidx = Math.max(hidx - 1, -1);
      cmd.value = hidx >= 0 ? hist[hidx] : '';
+   } else if (ev.key === 'Tab') {
+     ev.preventDefault();              // command/filename completion
+     const r = await fetch('/complete', {method:'POST', body: cmd.value});
+     const out = await r.json();
+     if (out.line) cmd.value = out.line;
+     if (out.hint) pushEcho('?', out.hint);
    }
  });
 
@@ -155,6 +161,37 @@ _PAGE = """<!DOCTYPE html>
 """
 
 
+def _complete_line(line, stack=None):
+    """Shared Tab-completion: {"line": completed, "hint": candidates}.
+
+    First word incomplete -> command-name completion against the stack
+    dictionary (when available); IC/BATCH -> scenario filename cycling
+    via ui/console.Autocomplete."""
+    from . import console
+    words = line.split()
+    # filename completion only while the filename is being typed; a
+    # line that already has a filename + further args passes through
+    if words and words[0].upper() in ("IC", "BATCH") and len(words) <= 2:
+        from .. import settings
+        ac = console.Autocomplete(settings.scenario_path)
+        newline, hint = ac.complete(line)
+        return {"line": newline, "hint": hint}
+    if stack is not None and line and " " not in line:
+        frag = line.upper()
+        # snapshot: the sim thread may register/remove plugin commands
+        # concurrently (stack.append_commands/remove_commands)
+        names = sorted(n for n in list(stack.cmddict)
+                       if n.startswith(frag))
+        if not names:
+            return {"line": line, "hint": ""}
+        if len(names) == 1:
+            return {"line": names[0] + " ", "hint": ""}
+        import os
+        prefix = os.path.commonprefix(names)
+        return {"line": prefix, "hint": ", ".join(names[:20])}
+    return {"line": line, "hint": ""}
+
+
 class SimBackend:
     """Frame/command adapter over an embedded Simulation."""
 
@@ -214,6 +251,14 @@ class SimBackend:
         self.sim.stack.stack(line)
         self.sim.stack.process()
         return "\n".join(self.sim.scr.echobuf)
+
+    def complete(self, line):
+        """Tab completion: command names from the live dictionary,
+        IC/BATCH scenario filenames through the console's Autocomplete
+        engine (ui/console.py — the reference console's Tab behavior).
+        Reads only stable dicts/the filesystem, so it is safe off the
+        sim thread."""
+        return _complete_line(line, self.sim.stack)
 
     def pump(self):
         """Run queued commands and refresh the frame cache — called on
@@ -279,6 +324,9 @@ class ClientBackend:
         logic; insert the clicked position (the most common argument)."""
         return {"tostack": "", "echo": "",
                 "todisplay": f"{lat:.4f},{lon:.4f} "}
+
+    def complete(self, line):
+        return _complete_line(line)       # filename completion only
 
     def nd_frame(self):
         """Client-side ND from the nodeData mirror (SHOWND selection
@@ -365,6 +413,15 @@ class WebUI:
                     out = ui.backend.command(line)
                     self._send(200, "text/plain; charset=utf-8",
                                (out or "").encode())
+                elif self.path == "/complete":
+                    n = int(self.headers.get("Content-Length", 0))
+                    line = self.rfile.read(n).decode()
+                    try:
+                        out = ui.backend.complete(line)
+                    except Exception as exc:  # completion must not 500
+                        out = {"line": line, "hint": f"error: {exc}"}
+                    self._send(200, "application/json",
+                               json.dumps(out).encode())
                 elif self.path == "/click":
                     n = int(self.headers.get("Content-Length", 0))
                     try:

@@ -154,6 +154,24 @@ def test_plot_sheet_flows(served_sim):
     assert "<svg" in svg and "polyline" in svg and "tas" in svg
 
 
+def test_tab_completion(served_sim):
+    """/complete: command-name prefix completion from the live
+    dictionary + IC/BATCH filename completion via the console engine."""
+    sim, ui = served_sim
+    out = json.loads(_post(ui, "/complete", "CR"))
+    assert out["line"] == "CRE" and "CRECONFS" in out["hint"]
+    out = json.loads(_post(ui, "/complete", "ZOO"))
+    assert out["line"] == "ZOOM "           # unique -> ready for args
+    out = json.loads(_post(ui, "/complete", "IC demo-s"))
+    assert "demo-super8.scn" in out["hint"]
+    # mid-command lines pass through untouched
+    out = json.loads(_post(ui, "/complete", "CRE KL1 B744"))
+    assert out["line"] == "CRE KL1 B744"
+    # an IC line that already has its filename + args is not clobbered
+    out = json.loads(_post(ui, "/complete", "IC demo-wall.scn 60"))
+    assert out["line"] == "IC demo-wall.scn 60"
+
+
 def test_client_backend_interface():
     """ClientBackend against a stub with the GuiClient surface it uses
     (get_nodedata().echo_text, stack, receive, render_svg, act)."""
