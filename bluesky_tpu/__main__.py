@@ -40,6 +40,11 @@ def main(argv=None):
     parser.add_argument("--discoverable", action="store_true")
     parser.add_argument("--web-port", type=int, default=8080,
                         help="port for --web mode")
+    parser.add_argument("--attach", action="store_true",
+                        help="with --web: attach the browser UI to a "
+                             "running server (GuiClient mirror) instead "
+                             "of embedding a sim; --host/--event-port/"
+                             "--stream-port select the server")
     parser.add_argument("--node-id", default="",
                         help="hex worker id assigned by the spawning "
                              "server (crash tracking)")
@@ -47,6 +52,9 @@ def main(argv=None):
                         help="chain this server under another: host:port "
                              "of the upstream server's client event port")
     args = parser.parse_args(argv)
+    if args.attach and not args.web:
+        parser.error("--attach only applies to --web "
+                     "(use: bluesky-tpu --web --attach [--host H])")
 
     settings.init(args.config_file)
 
@@ -127,8 +135,34 @@ def run_detached(args):
 
 
 def run_web(args):
-    """Embedded sim + the live browser radar (ui/web.py): the headless
-    replacement for the reference's Qt radar window."""
+    """Live browser radar (ui/web.py): embedded sim by default, or —
+    with --attach — a GuiClient mirror of a running server (the same
+    split as the reference's embedded pygame vs networked Qt radar)."""
+    if args.attach:
+        import time
+        from .network.guiclient import GuiClient
+        from .ui.web import ClientBackend, WebUI
+        client = GuiClient()
+        client.connect(host=args.host,
+                       event_port=args.event_port or settings.event_port,
+                       stream_port=args.stream_port
+                       or settings.stream_port)
+        backend = ClientBackend(client, pumped=True)
+        backend.pump()           # seed the frame cache pre-serving
+        ui = WebUI(backend, host="127.0.0.1",
+                   port=args.web_port).start()
+        print(f"bluesky_tpu web UI (attached to {args.host}) on "
+              f"http://{ui.host}:{ui.port}/")
+        try:
+            while True:
+                backend.pump()               # drain streams/events
+                time.sleep(0.02)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            ui.stop()
+            client.close()
+        return 0
     from .simulation.sim import Simulation
     from .ui.web import serve_sim
     sim = Simulation()

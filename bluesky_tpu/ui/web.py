@@ -300,23 +300,69 @@ class SimBackend:
 
 
 class ClientBackend:
-    """Frame/command adapter over a connected GuiClient."""
+    """Frame/command adapter over a connected GuiClient.
 
-    def __init__(self, client):
+    Threading: ZMQ sockets are not thread-safe, so ONLY the thread
+    calling ``pump()`` may touch the client socket.  HTTP threads queue
+    commands here exactly like SimBackend; ``pump()`` (the attach
+    loop's thread) executes them and drains the streams.  When nothing
+    is pumping (ad-hoc embedding/tests) ``command()`` falls back to
+    running inline, which is safe only single-threaded."""
+
+    #: gesture/flow commands that succeed silently — don't hold the
+    #: pump thread waiting for an ECHO that never comes
+    _SILENT = {"PAN", "ZOOM", "OP", "HOLD", "PAUSE", "FF", "DTMULT"}
+
+    def __init__(self, client, pumped=False):
+        """``pumped=True`` declares up front that a pump loop will own
+        the socket (run_web --attach), closing the startup window where
+        an early HTTP command could race the loop on the ZMQ socket."""
         self.client = client
+        self._pending = queue.Queue()
+        self._pumping = pumped
+        self._frame = None               # cached by pump()
+        self.render_period = 0.25
+        self._last_render = 0.0
 
-    def frame(self):
+    def _render(self):
         svg = self.client.render_svg()
         nd = self.client.get_nodedata()
         n = len(nd.acdata.get("id", [])) if nd.acdata else 0
         return svg, f"ntraf {n}   node {self.client.act or '-'}"
 
+    def frame(self):
+        """Serve the pump-thread frame cache (nodeData mutates on the
+        pump thread mid-receive; rendering there keeps reads
+        consistent).  Inline render only when nothing is pumping."""
+        cached = self._frame
+        if cached is not None:
+            return cached
+        return self._render()
+
     def command(self, line):
+        if not self._pumping:
+            return self._run_cmd(line)
+        done = queue.Queue()
+        self._pending.put((line, done))
+        try:
+            return done.get(timeout=8.0)
+        except queue.Empty:
+            return "(queued)"
+
+    def _run_cmd(self, line):
+        """Execute on the socket-owning thread only."""
         nd = self.client.get_nodedata()
         n0 = len(nd.echo_text)
         self.client.stack(line)
-        time.sleep(0.15)                     # ECHO arrives via the event
-        self.client.receive()                # socket; pump it in
+        # ECHO rides the event socket; the node replies between scan
+        # chunks, which can lag while a chunk computes/compiles.  Known
+        # no-echo gestures only get a token wait so drag-pan/zoom stay
+        # snappy; anything else waits long enough to catch its reply.
+        word = line.split()[0].upper() if line.split() else ""
+        wait = 0.2 if word in self._SILENT else 2.5
+        deadline = time.monotonic() + wait
+        while time.monotonic() < deadline and len(nd.echo_text) == n0:
+            self.client.receive(20)
         return "\n".join(nd.echo_text[n0:])
 
     def click(self, line, lat, lon):
@@ -338,7 +384,27 @@ class ClientBackend:
         return radar.render_nd_acdata(nd)
 
     def pump(self):
+        self._pumping = True
+        ran = False
+        while True:
+            try:
+                line, done = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            try:
+                done.put(self._run_cmd(line))
+            except Exception as exc:  # surface, don't kill the loop
+                done.put(f"command failed: {exc}")
+            ran = True
         self.client.receive()
+        now = time.monotonic()
+        if ran or self._frame is None \
+                or now - self._last_render >= self.render_period:
+            self._last_render = now
+            try:
+                self._frame = self._render()
+            except Exception:
+                pass                 # keep the last good frame
 
 
 class WebUI:

@@ -172,6 +172,61 @@ def test_tab_completion(served_sim):
     assert out["line"] == "IC demo-wall.scn 60"
 
 
+def test_web_attach_over_fabric():
+    """--web --attach: the browser UI served from a GuiClient mirror of
+    a running server — frames show streamed traffic and commands
+    round-trip through the pump thread (ZMQ sockets are single-thread;
+    HTTP threads must queue)."""
+    import threading as th
+    from bluesky_tpu.network.guiclient import GuiClient
+    from bluesky_tpu.network.server import Server
+    from bluesky_tpu.simulation.simnode import SimNode
+    from bluesky_tpu.ui.web import ClientBackend
+    from tests.test_network import free_ports, wait_for
+
+    ev, st, wev, wst = free_ports(4)
+    server = Server(headless=True,
+                    ports=dict(event=ev, stream=st, wevent=wev,
+                               wstream=wst),
+                    spawn_workers=False)
+    server.start()
+    time.sleep(0.2)
+    node = SimNode(event_port=wev, stream_port=wst, nmax=16)
+    nt = th.Thread(target=node.run, daemon=True)
+    nt.start()
+    client = GuiClient()
+    client.connect(event_port=ev, stream_port=st, timeout=5.0)
+    assert wait_for(lambda: (client.receive(10),
+                             len(client.nodes) > 0)[1])
+    backend = ClientBackend(client, pumped=True)
+    backend.pump()
+    ui = WebUI(backend, port=0).start()
+    stop = th.Event()
+
+    def pump():
+        while not stop.is_set():
+            backend.pump()
+            time.sleep(0.02)
+
+    pt = th.Thread(target=pump, daemon=True)
+    pt.start()
+    try:
+        _post(ui, "/cmd", "CRE AC1 B744 52 4 90 FL200 250")
+        _post(ui, "/cmd", "OP")
+        assert wait_for(
+            lambda: b"AC1" in _get(ui, "/frame.svg"), timeout=90)
+        echo = _post(ui, "/cmd", "POS AC1", timeout=20)
+        assert "Info on AC1" in echo
+    finally:
+        stop.set()
+        ui.stop()
+        node.quit()
+        nt.join(timeout=5)
+        server.stop()
+        server.join(timeout=5)
+        client.close()
+
+
 def test_client_backend_interface():
     """ClientBackend against a stub with the GuiClient surface it uses
     (get_nodedata().echo_text, stack, receive, render_svg, act)."""
