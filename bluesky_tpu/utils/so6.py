@@ -116,7 +116,7 @@ def convert(lines, rel_time: bool = True) -> List[str]:
         gs_kts = (length if length > 0 else float(dist_nm)) * 3600.0 / dur
         t = fl.t0 - base
         out.append((t, f"CRE {acid} {fl.actype} {lat0:.6f} {lon0:.6f} "
-                       f"{float(qdr):.1f} FL{fl0:03d} "
+                       f"{float(qdr) % 360.0:.1f} FL{fl0:03d} "
                        f"{min(gs_kts, 600.0):.0f}"))
         # route: every segment END becomes a waypoint with its FL (and
         # the segment speed), so VNAV/LNAV fly the profile

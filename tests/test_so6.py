@@ -66,6 +66,19 @@ def test_cli(tmp_path, capsys):
     assert "2 flights" in capsys.readouterr().out
 
 
+def test_bundled_sample_converts():
+    """The shipped scenario/sample.so6 converts cleanly (3 flights)."""
+    with open("scenario/sample.so6") as f:
+        scn = so6.convert(f.readlines())
+    cre = [l for l in scn if ">CRE " in l]
+    assert len(cre) == 3
+    assert {l.split()[0].split(">CRE")[-1] or l.split()[1] for l in cre}
+    # headings normalized to [0, 360)
+    for l in cre:
+        hdg = float(l.split()[3])
+        assert 0.0 <= hdg < 360.0
+
+
 def test_convert_and_fly(tmp_path):
     """The converted scenario runs: flights spawn at their offsets and
     fly the segment route under LNAV/VNAV."""
