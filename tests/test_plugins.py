@@ -295,6 +295,24 @@ class TestEnsemble:
         assert "conflicts" in out and "+-" in out, out
         assert "4 x 30s" in out
 
+    def test_ensemble_covers_tend_exactly(self, sim):
+        """A tend that is not a whole number of CD intervals still runs
+        to the requested horizon via the remainder chunk (the old
+        rounding silently simulated up to half a chunk off)."""
+        do(sim, "PLUGINS LOAD ENSEMBLE",
+           "CRE E1 B744 52.0 3.8 090 FL200 250",
+           "CRE E2 B744 52.0 4.2 270 FL200 250")
+        out = do(sim, "ENSEMBLE 2 10.5 500")
+        # the stack fn is a bound method of the live Ensemble instance
+        ens = sim.stack.cmddict["ENSEMBLE"][2].__self__
+        assert ens.last["tend"] == 10.5
+        # plan covered exactly round(10.5/simdt)=210 steps: 10 whole
+        # 1s chunks + one 10-step remainder at simdt=0.05 — two
+        # compiled runners cached (chunk + remainder)
+        assert len(ens._cache) == 2
+        assert {k[3] for k in ens._cache} == {20, 10}
+        assert "2 x 10s" in out or "2 x 11s" in out
+
     def test_ensemble_requires_traffic_and_replicas(self, sim):
         do(sim, "PLUGINS LOAD ENSEMBLE")
         out = do(sim, "ENSEMBLE 4 10")
