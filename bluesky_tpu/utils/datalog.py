@@ -84,11 +84,25 @@ class CSVLogger:
             self.file.write(", ".join(vals) + "\n")
 
     # -------------------------------------------------------- stack cmd
-    def stackio(self, sim, flag=None, dt=None):
-        if flag is None:
-            return True, f"{self.name} is {'ON' if self.active else 'OFF'}"
-        f = str(flag).upper()
+    def stackio(self, sim, *args):
+        """``NAME`` / ``NAME ON [dt]`` / ``NAME OFF`` / ``LISTVARS`` /
+        ``SELECTVARS var1,...,varn`` (reference datalog.py:216-242)."""
+        if not args:
+            return True, (f"{self.name} is "
+                          f"{'ON' if self.active else 'OFF'}\nUsage: "
+                          f"{self.name} ON/OFF,[dt] or LISTVARS or "
+                          f"SELECTVARS var1,...,varn")
+        f = str(args[0]).upper()
         if f in ("ON", "TRUE", "1"):
+            dt = None
+            if len(args) > 1:
+                try:
+                    dt = float(args[1])
+                except (TypeError, ValueError):
+                    return False, (f"Turn {self.name} on with an "
+                                   "optional numeric dt")
+            if self.active:
+                self.stop()           # ON while ON: rotate the file
             fname = self.start(sim, dt)
             return True, f"{self.name} logging to {fname}"
         if f in ("OFF", "FALSE", "0"):
@@ -97,8 +111,30 @@ class CSVLogger:
         if f == "LISTVARS":
             return True, "Variables: " + ", ".join(self.getters.keys())
         if f == "SELECTVARS":
-            return False, f"{self.name} SELECTVARS var,... (not yet selected)"
-        return False, f"{self.name}: unknown argument {flag}"
+            if not self.getters:
+                return False, (f"{self.name}: event logger, columns "
+                               "are fixed by its producer")
+            if self.active and len(args) > 1:
+                # the open file's column header is already written
+                return False, (f"{self.name} is logging — OFF first, "
+                               "then SELECTVARS (the header is fixed "
+                               "per file)")
+            if len(args) == 1:
+                return True, (f"{self.name} selected: "
+                              + ", ".join(self.selvars))
+            bykey = {k.upper(): k for k in self.getters}
+            want, unknown = [], []
+            for a in args[1:]:
+                k = bykey.get(str(a).upper())
+                (want if k else unknown).append(k or str(a))
+            if unknown:
+                return False, (f"{self.name}: unknown variable(s) "
+                               f"{', '.join(unknown)} (LISTVARS shows "
+                               "the choices)")
+            self.selvars = want
+            return True, (f"{self.name} now logs: "
+                          + ", ".join(self.selvars))
+        return False, f"{self.name}: unknown argument {args[0]}"
 
 
 class EventLogger(CSVLogger):
@@ -187,8 +223,8 @@ def register_stack_commands(sim):
     cmds = {}
     for name, lg in _loggers.items():
         cmds[name] = [
-            f"{name} [ON/OFF/LISTVARS] [dt]", "[txt,float]",
-            (lambda l: lambda flag=None, dt=None:
-             l.stackio(sim, flag, dt))(lg),
+            f"{name} ON/OFF,[dt] or LISTVARS or SELECTVARS var1,...",
+            "[txt,...]",
+            (lambda l: lambda *args: l.stackio(sim, *args))(lg),
             lg.header]
     sim.stack.append_commands(cmds)

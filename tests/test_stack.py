@@ -259,6 +259,31 @@ def test_snaplog_logger(sim, tmp_path, monkeypatch):
     assert "KL204" in content
 
 
+def test_snaplog_selectvars(sim, tmp_path, monkeypatch):
+    """SELECTVARS restricts the logged columns (reference
+    datalog.py:216-242); unknown variables are rejected."""
+    from bluesky_tpu import settings
+    monkeypatch.setattr(settings, "log_path", str(tmp_path))
+    do(sim, "CRE KL204 B744 52 4 90 FL200 250")
+    out = do(sim, "SNAPLOG SELECTVARS id alt bogus")
+    assert "unknown variable" in out and "BOGUS" in out
+    do(sim, "SNAPLOG SELECTVARS id alt", "SNAPLOG ON 1")
+    sim.run(until_simt=2.0, max_iters=100)
+    do(sim, "SNAPLOG OFF")
+    content = list(tmp_path.glob("SNAPLOG*"))[0].read_text()
+    assert "# simt, id, alt" in content
+    datarow = content.splitlines()[2]          # first sample row
+    assert len(datarow.split(", ")) == 3       # simt, id, alt only
+    assert "KL204" in datarow
+    out = do(sim, "SNAPLOG SELECTVARS")
+    assert "id, alt" in out
+    # selection is locked while the file is open
+    do(sim, "SNAPLOG ON 1")
+    out = do(sim, "SNAPLOG SELECTVARS id")
+    assert "OFF first" in out
+    do(sim, "SNAPLOG OFF")
+
+
 def test_seed_reproducibility(sim):
     do(sim, "SEED 42", "MCRE 3")
     lats1 = np.asarray(sim.traf.state.ac.lat)[:3].copy()
