@@ -7,13 +7,17 @@ PYTHON=python3
 
 all: build
 
+# 4 xdist workers when pytest-xdist is installed (the suite is
+# parallel-safe: per-test ports/tmp dirs, per-process JAX/ZMQ state)
+XDIST := $(shell $(PYTHON) -c "import xdist" 2>/dev/null && echo "-n 4")
+
 test:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-	$(PYTHON) -m pytest tests/ -q
+	$(PYTHON) -m pytest tests/ -q $(XDIST)
 
 test-fast:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-	$(PYTHON) -m pytest tests/ -q -m 'not slow'
+	$(PYTHON) -m pytest tests/ -q -m 'not slow' $(XDIST)
 
 lint:
 	@$(PYTHON) -m pyflakes bluesky_tpu tests 2>/dev/null \
