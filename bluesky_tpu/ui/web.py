@@ -321,6 +321,7 @@ class ClientBackend:
         self._pending = queue.Queue()
         self._pumping = pumped
         self._frame = None               # cached by pump()
+        self._nd = None                  # ND cache (when SHOWND active)
         self.render_period = 0.25
         self._last_render = 0.0
 
@@ -375,8 +376,14 @@ class ClientBackend:
         return _complete_line(line)       # filename completion only
 
     def nd_frame(self):
-        """Client-side ND from the nodeData mirror (SHOWND selection
-        arrives over DISPLAYFLAG; traffic/route from the streams)."""
+        """Client-side ND: served from the pump-thread cache like
+        frame() (nodeData mutates on the pump thread); inline render
+        only when nothing is pumping."""
+        if self._pumping:
+            return self._nd
+        return self._render_nd()
+
+    def _render_nd(self):
         from . import radar
         nd = self.client.get_nodedata()
         if not getattr(nd, "nd_acid", None):
@@ -405,6 +412,10 @@ class ClientBackend:
                 self._frame = self._render()
             except Exception:
                 pass                 # keep the last good frame
+            try:
+                self._nd = self._render_nd()
+            except Exception:
+                self._nd = None      # never show a silently-stale ND
 
 
 class WebUI:
