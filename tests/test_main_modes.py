@@ -34,6 +34,18 @@ def test_detached_scenfile_runs_to_quit(tmp_path):
         "scenario did not run to its t=10s SCREENSHOT"
 
 
+def test_attach_requires_web():
+    """--attach without --web is a usage error, not a silently-started
+    stray server."""
+    out = subprocess.run(
+        [sys.executable, "-m", "bluesky_tpu", "--attach"],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 2
+    assert "--attach only applies to --web" in out.stderr
+
+
 def test_help_lists_all_modes():
     out = subprocess.run(
         [sys.executable, "-m", "bluesky_tpu", "--help"],
