@@ -189,6 +189,39 @@ def test_stripe_sort_dest_is_injective_and_padded():
     assert dest.max() < n + 32 * 256            # inside padded layout
 
 
+def test_layered_schedule_is_exact():
+    """The altitude-layered sort + wider segment budget (the dense-
+    geometry mode kept available behind n_layers/s_cap — see the
+    PERF_ANALYSIS dead-end addendum) stays bit-compatible: layering
+    only reorders slots and the vertical term only skips provably-empty
+    tiles."""
+    n = 3000
+    args = make_args(n, "regional", seed=7)
+    thresh = cd_sched.reach_threshold_m(args[3], args[8], 300.0, 5 * NM)
+    perm = cd_sched.stripe_sort_dest(
+        args[0], args[1], args[3], args[8], thresh, 256, 32,
+        alt=args[4], vs=args[5], n_layers=16)
+    dest = np.asarray(perm)
+    assert len(np.unique(dest)) == n            # layered sort injective
+    out, ref = run_both(args, perm=perm, s_cap=12)
+    assert int(ref.nconf) > 0
+    assert_match(out, ref, n)
+
+
+def test_auto_layer_gate_traces():
+    """n_layers='auto' (the on-device density gate) produces a valid
+    injective destination table for both sparse and dense scenes."""
+    for geom in ("continental", "regional"):
+        args = make_args(1500, geom, seed=3)
+        thresh = cd_sched.reach_threshold_m(args[3], args[8], 300.0,
+                                            5 * NM)
+        dest = np.asarray(cd_sched.stripe_sort_dest(
+            args[0], args[1], args[3], args[8], thresh, 256, 32,
+            alt=args[4], vs=args[5], n_layers="auto"))
+        assert len(np.unique(dest)) == 1500
+        assert dest.max() < 1500 + 32 * 256
+
+
 def test_vertical_reach_term_never_drops_conflicts():
     """Pure-vertical-crossing geometry: co-located columns of aircraft at
     different altitudes with strong climb/descent — the vertical bound
