@@ -7,9 +7,11 @@ PYTHON=python3
 
 all: build
 
-# 4 xdist workers when pytest-xdist is installed (the suite is
-# parallel-safe: per-test ports/tmp dirs, per-process JAX/ZMQ state)
-XDIST := $(shell $(PYTHON) -c "import xdist" 2>/dev/null && echo "-n 4")
+# 4 xdist workers when pytest-xdist is installed.  loadscope keeps each
+# module on one worker: module-scoped fixtures with stateful command
+# chains (test_command_coverage SMOKE) need in-module ordering.
+XDIST := $(shell $(PYTHON) -c "import xdist" 2>/dev/null \
+	&& echo "-n 4 --dist loadscope")
 
 test:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
