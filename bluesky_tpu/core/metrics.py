@@ -225,6 +225,7 @@ class Metrics:
             return True, f"METRICS {state} (dt={self.dt})"
         if isinstance(flag, str) and flag.upper() in ("OFF", "0"):
             self.metric_number = -1
+            self.logger.stop()       # flush + close our METLOG file
             return True, "Metrics OFF"
         try:
             num = int(float(flag))
@@ -232,6 +233,7 @@ class Metrics:
             return False, "METRICS OFF or METRICS 1/2 [dt]"
         if num <= 0:
             self.metric_number = -1
+            self.logger.stop()
             return True, "Metrics OFF"
         if num > len(self.NAMES):
             return False, "No such metric"
@@ -239,8 +241,15 @@ class Metrics:
             self.dt = float(dt)
         self.metric_number = num - 1
         self.tnext = self.sim.simt
-        if not self.logger.active:
-            self.logger.start(self.sim)
+        # (Re)open OUR file on every activation: the METLOG logger is
+        # process-global (datalog registry), so "already active" may be
+        # a different Simulation's leftover file — rotating guarantees
+        # this sim's rows land in a file under the current log_path.
+        # (Two sims logging METRICS concurrently in one process share
+        # the registry entry and the later activation wins the file —
+        # the reference's global datalog has the same property.)
+        self.logger.stop()
+        self.logger.start(self.sim)
         return True, (f"Activated {self.NAMES[self.metric_number]} "
                       f"({num}), dt={self.dt:.2f}")
 

@@ -51,6 +51,13 @@ class CSVLogger:
         scen = sim.stack.scenname or "untitled"
         stamp = time.strftime("%Y%m%d_%H-%M-%S")
         fname = os.path.join(log_dir(), f"{self.name}_{scen}_{stamp}.log")
+        # never truncate an existing log (two starts in the same
+        # wall-clock second would share the timestamped name)
+        k = 1
+        while os.path.exists(fname):
+            fname = os.path.join(
+                log_dir(), f"{self.name}_{scen}_{stamp}_{k}.log")
+            k += 1
         self.file = open(fname, "w")
         self.file.write(f"# {self.header}\n")
         self.file.write("# simt, " + ", ".join(self.selvars) + "\n")

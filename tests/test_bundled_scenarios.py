@@ -24,7 +24,14 @@ def test_bundled_scenario_runs_clean(path, tmp_path, monkeypatch):
     sim = Simulation(nmax=64, dtype=jnp.float64)
     ok, msg = sim.stack.ic(path)
     assert ok, msg
-    sim.run(until_simt=4.0)
+    try:
+        sim.run(until_simt=4.0)
+    finally:
+        # close any loggers a scenario started (METRIC, SNAPLOG...):
+        # the datalog registry is process-global and a leaked open
+        # logger poisons later tests in the same worker
+        from bluesky_tpu.utils import datalog
+        datalog.reset()
     echo = "\n".join(sim.scr.echobuf)
     for marker in BAD_MARKERS:
         assert marker.lower() not in echo.lower(), (
