@@ -228,9 +228,7 @@ def _sparse_sort_refresh(lat, lon, gs, alt, vs, active, old_perm,
     # lived in caller space.
     n = lat.shape[0]
     n_tot = cd_sched.padded_size(n, block)
-    ar = jnp.arange(n, dtype=jnp.int32)
-    inv_old = jnp.full((n_tot + 1,), -1, jnp.int32).at[
-        jnp.clip(old_perm, 0, n_tot)].set(ar)
+    inv_old = cd_sched.slot_inverse(old_perm, n, n_tot)
     pv = partners_s[:n_tot]
     caller_vals = jnp.where(
         pv >= 0, inv_old[jnp.clip(pv, 0, n_tot)], -1)
@@ -306,35 +304,41 @@ def update_tiled(state: SimState, cfg: AsasConfig, block: int = 512,
     perm = asas.sort_perm
 
     # Resolver mode: the blockwise kernels accumulate per-pair sums for
-    # MVP or Eby (both are additive row reductions — reference
-    # MVP.py:149-231, Eby.py:73-138); SWARM/SSD still need the dense
-    # matrices (core/step.py enforces).
+    # MVP or Eby (additive row reductions — reference MVP.py:149-231,
+    # Eby.py:73-138); Swarm adds 7 neighbour sums (all backends); SSD
+    # runs the MVP kernels for detection/partner bookkeeping and
+    # resolves from the gathered partner table afterwards
+    # (cr_ssd.resolve_from_partners — reference asas.py:41-55 keeps CD
+    # and CR orthogonal, so any resolver must run at any N).
     reso_m = cfg.reso_method.upper()
     kern_reso = "mvp"
     if cfg.reso_on and reso_m == "EBY":
         kern_reso = "eby"
-    elif cfg.reso_on and reso_m == "SWARM" and impl == "lax":
-        # Swarm = MVP sums + 7 neighbour sums; carried by the lax tiled
-        # backend (cd_tiled) — the Pallas kernels stay MVP/EBY-only.
+    elif cfg.reso_on and reso_m == "SWARM":
         kern_reso = "swarm"
-    elif cfg.reso_on and reso_m != "MVP":
+    elif cfg.reso_on and reso_m not in ("MVP", "SSD"):
         raise ValueError(
-            f"Resolver {cfg.reso_method!r} is not available on the "
-            f"{impl!r} blockwise backend (MVP/EBY everywhere, SWARM on "
-            "'lax'; SSD needs the dense path).")
+            f"Unknown AsasConfig.reso_method {cfg.reso_method!r}; "
+            "expected MVP, EBY, SWARM or SSD.")
+    swarm_sums = None
     if impl == "sparse":
         from ..ops import cd_sched
         block = min(block, 256)
         n_tot = cd_sched.padded_size(ac.lat.shape[0], block)
-        rd, partners_s, act_new = cd_sched.detect_resolve_sched(
+        out = cd_sched.detect_resolve_sched(
             ac.lat, ac.lon, ac.trk, ac.gs, ac.alt, ac.vs,
             ac.gseast, ac.gsnorth, ac.active, asas.noreso,
             cfg.rpz, cfg.hpz, cfg.dtlookahead, mvpcfg, block=block,
             k_partners=asas.partners_s.shape[1], perm=perm,
             partners=asas.partners_s[:n_tot],
             resume_rpz_m=cfg.rpz * cfg.resofach,
-            tas=ac.tas if kern_reso == "eby" else None, reso=kern_reso,
-            mesh=mesh, mesh_axis=mesh_axis)
+            tas=ac.tas if kern_reso == "eby" else None,
+            cas=ac.cas if kern_reso == "swarm" else None,
+            reso=kern_reso, mesh=mesh, mesh_axis=mesh_axis)
+        if kern_reso == "swarm":
+            rd, partners_s, act_new, swarm_sums = out
+        else:
+            rd, partners_s, act_new = out
     else:
         if impl == "pallas":
             from ..ops import cd_pallas
@@ -352,7 +356,6 @@ def update_tiled(state: SimState, cfg: AsasConfig, block: int = 512,
             ac.gseast, ac.gsnorth, ac.active, asas.noreso,
             cfg.rpz, cfg.hpz, cfg.dtlookahead, mvpcfg, block=block,
             k_partners=k, perm=perm, reso=kern_reso, extra_cols=extra)
-        swarm_sums = None
         if kern_reso == "swarm":
             rd, swarm_sums = out
         else:
@@ -403,7 +406,7 @@ def update_tiled(state: SimState, cfg: AsasConfig, block: int = 512,
             alt=jnp.where(upd, newalt, asas.alt),
             asase=jnp.where(upd, asase, asas.asase),
             asasn=jnp.where(upd, asasn, asas.asasn))
-    elif cfg.reso_on:
+    elif cfg.reso_on and reso_m == "MVP":
         newtrk, newgs, newvs, newalt, asase, asasn = cr_mvp.resolve_from_sums(
             rd.sum_dve, rd.sum_dvn, rd.sum_dvv, rd.tsolv,
             ac.alt, ac.gseast, ac.gsnorth, ac.vs, ac.trk, ac.gs,
@@ -419,7 +422,44 @@ def update_tiled(state: SimState, cfg: AsasConfig, block: int = 512,
             asase=jnp.where(upd, asase, asas.asase),
             asasn=jnp.where(upd, asasn, asas.asasn))
 
+    def ssd_resolve(cur_asas, ptable):
+        """SSD from the [N, P] partner table (cr_ssd.resolve_from_partners
+        docstring records the K-truncation semantics).  Horizontal-only,
+        like the dense path (SSD.py:99-104)."""
+        from ..ops import cr_ssd
+        rs = cfg.priocode.upper() if cfg.swprio \
+            and cfg.priocode.upper().startswith("RS") else "RS1"
+        ssdcfg = cr_ssd.SSDConfig(rpz_m=cfg.rpz_m,
+                                  tlookahead=cfg.dtlookahead, priocode=rs)
+        newtrk, newgs = cr_ssd.resolve_from_partners(
+            ptable, rd.inconf, ac.lat, ac.lon, ac.alt, ac.trk, ac.gs,
+            ac.vs, ac.gseast, ac.gsnorth, ac.active,
+            cfg.vmin, cfg.vmax, ssdcfg, hdg=ac.hdg,
+            ap_trk=state.ap.trk, ap_tas=state.ap.tas)
+        upd = rd.inconf
+        return cur_asas.replace(
+            trk=jnp.where(upd, newtrk, cur_asas.trk),
+            tas=jnp.where(upd, newgs, cur_asas.tas),
+            asase=jnp.where(upd, newgs * jnp.sin(jnp.radians(newtrk)),
+                            cur_asas.asase),
+            asasn=jnp.where(upd, newgs * jnp.cos(jnp.radians(newtrk)),
+                            cur_asas.asasn))
+
     if impl == "sparse":
+        if cfg.reso_on and reso_m == "SSD":
+            # The in-kernel-merged table is SORTED-space; translate to
+            # caller slots for the gathered VO construction (one scatter
+            # + two [N, K] gathers per interval).
+            n = ac.lat.shape[0]
+            inv = cd_sched.slot_inverse(perm, n, n_tot)
+            pc = jnp.where(partners_s >= 0,
+                           inv[jnp.clip(partners_s, 0, n_tot)], -1)
+            ptable = pc[jnp.clip(perm, 0, n_tot - 1), :]
+            asas = ssd_resolve(asas, ptable)
+        if cfg.reso_on and kern_reso == "swarm":
+            # Whole swarm follows ASAS once any conflict triggered a
+            # resolve (asas.py:487 gate + Swarm.py:101-102)
+            act_new = jnp.where(rd.nconf > 0, ac.active, act_new)
         # Resume-nav already happened IN-KERNEL (keep + merge on the
         # sorted-space table) — just store the new table + flags.
         spad = asas.partners_s.shape[0] - partners_s.shape[0]
@@ -450,6 +490,11 @@ def update_tiled(state: SimState, cfg: AsasConfig, block: int = 512,
     merged = cd_tiled.merge_partners(new_idx, asas.partners,
                                      prune(asas.partners))
     partners = jnp.where(prune(merged), merged, -1)
+
+    if cfg.reso_on and reso_m == "SSD":
+        # SSD resolves from the freshly merged table (fresh top-K
+        # conflicts first + still-engaged partners — caller space here)
+        asas = ssd_resolve(asas, partners)
 
     act_tbl = jnp.any(partners >= 0, axis=1)
     if cfg.reso_on and kern_reso == "swarm":

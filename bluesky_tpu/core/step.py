@@ -98,14 +98,12 @@ def step(state: SimState, cfg: SimConfig) -> SimState:
                 "allocate Traffic(pair_matrix=True).")
         if cfg.cd_backend != "dense" and cfg.asas.reso_on:
             rm = cfg.asas.reso_method.upper()
-            allowed = ("MVP", "EBY", "SWARM") \
-                if cfg.cd_backend == "tiled" else ("MVP", "EBY")
-            if rm not in allowed:
+            if rm not in ("MVP", "EBY", "SWARM", "SSD"):
                 raise ValueError(
-                    f"Resolver {cfg.asas.reso_method} is not available on "
-                    f"cd_backend='{cfg.cd_backend}' (large-N paths carry "
-                    "the MVP/Eby pair sums; SWARM additionally needs the "
-                    "lax 'tiled' backend; SSD needs 'dense').")
+                    f"Unknown resolver {cfg.asas.reso_method!r}; every "
+                    "backend carries MVP/EBY (pair sums), SWARM "
+                    "(neighbour sums) and SSD (partner-table VOs) — "
+                    "reference asas.py:41-55 keeps CD and CR orthogonal.")
         asas_due = simt >= state.asas_tnext
 
         def run_asas(s):

@@ -47,11 +47,23 @@ from .cd_tiled import RowConflictData, TRIG_FIELDS, block_reachability, \
 # columns, the track angle (resume-nav "bouncing" predicate), the
 # tas/gs ratio (Eby builds its velocity from TAS: ve = tr*u), then the
 # active and noreso masks.
+#
+# The "tr" row is OVERLOADED per resolver: Eby reads it as the tas/gs
+# ratio, Swarm reads it as the calibrated airspeed (its alignment term,
+# Swarm.py:75-84) — the two resolvers never combine, and reusing the
+# slot keeps the slab at 16 rows (a 17th would break the whole-vreg
+# alignment of the sched kernel's Element-indexed slabs and cost ~25%
+# more slab DMA in every mode).
 _FIELDS = TRIG_FIELDS + ("u", "v", "alt", "vs", "gse", "gsn", "trk",
                          "tr", "active", "noreso")
 _NF = len(_FIELDS)
 _IDX = {k: i for i, k in enumerate(_FIELDS)}
 _BIG = 1e9
+
+#: number of per-ownship Swarm neighbour-sum accumulators appended to
+#: the kernel outputs when reso == "swarm": w, w*cas, w*vs, w*dtrk,
+#: w*dx, w*dy, w*alt (cr_swarm.resolve_from_sums input order).
+_N_SWARM = 7
 
 #: Identity elements of the 10 accumulator outputs, in output-tuple order:
 #: inconf, tcpamax, sdve, sdvn, sdvv, tsolv, ncnt, lcnt, ctin, cidx.
@@ -71,7 +83,7 @@ def _init_accumulators(refs, block, kk):
 def _kernel(reach_ref, row0_ref, own_ref, intr_ref,
             inconf_ref, tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref,
             tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref, cidx_ref,
-            *, block, kk, cpp, rpz, hpz, tlookahead, mvpcfg,
+            *swarm_refs, block, kk, cpp, rpz, hpz, tlookahead, mvpcfg,
             same_hemi=False, reso="mvp", rstride=1):
     ib = pl.program_id(0)
     jp = pl.program_id(1)      # program handles cpp column tiles
@@ -90,6 +102,8 @@ def _kernel(reach_ref, row0_ref, own_ref, intr_ref,
         _init_accumulators((inconf_ref, tcpamax_ref, sdve_ref, sdvn_ref,
                             sdvv_ref, tsolv_ref, ncnt_ref, lcnt_ref,
                             ctin_ref, cidx_ref), block, kk)
+        for ref in swarm_refs:
+            ref[0] = jnp.zeros((1, block), jnp.float32)
 
     # Exact block-level reachability skip (cd_tiled.block_reachability):
     # a scalar-predicated branch in Mosaic, so unreachable tiles cost no
@@ -110,7 +124,7 @@ def _kernel(reach_ref, row0_ref, own_ref, intr_ref,
                        cidx_ref, block=block, kk=kk, rpz=rpz, hpz=hpz,
                        tlookahead=tlookahead, mvpcfg=mvpcfg,
                        same_hemi=same_hemi, reso=reso, row_off=row0,
-                       row_stride=rstride)
+                       row_stride=rstride, swarm_refs=swarm_refs or None)
 
 
 def _tile_body(ib, jb, ksub, own_ref, intr_ref,
@@ -118,7 +132,7 @@ def _tile_body(ib, jb, ksub, own_ref, intr_ref,
                tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref, cidx_ref,
                *, block, kk, rpz, hpz, tlookahead, mvpcfg,
                same_hemi=False, resume_refs=None, rpz_m=None, reso="mvp",
-               row_off=0, row_stride=1):
+               row_off=0, row_stride=1, swarm_refs=None):
     oslab = own_ref[0]                                    # (_NF, block)
     islab_t = intr_ref[ksub].T                            # (block, _NF): ONE
     # lane->sublane relayout shared by all intruder columns
@@ -148,14 +162,15 @@ def _tile_body(ib, jb, ksub, own_ref, intr_ref,
                     lcnt_ref, ctin_ref, cidx_ref, kk=kk, rpz=rpz, hpz=hpz,
                     tlookahead=tlookahead, mvpcfg=mvpcfg,
                     same_hemi=same_hemi, jb=jb, resume_refs=resume_refs,
-                    rpz_m=rpz_m, reso=reso)
+                    rpz_m=rpz_m, reso=reso, swarm_refs=swarm_refs)
 
 
 def _tile_pairs(pairmask, gid_int, own, intr,
                 inconf_ref, tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref,
                 tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref, cidx_ref,
                 *, kk, rpz, hpz, tlookahead, mvpcfg, same_hemi=False,
-                jb=None, resume_refs=None, rpz_m=None, reso="mvp"):
+                jb=None, resume_refs=None, rpz_m=None, reso="mvp",
+                swarm_refs=None):
     block = pairmask.shape[1]
     excl = jnp.where(pairmask, 0.0, _BIG)
 
@@ -247,6 +262,27 @@ def _tile_pairs(pairmask, gid_int, own, intr,
         tsolv_ref[0] = jnp.minimum(tsolv_ref[0], t_tsolv)
         ncnt_ref[0] = ncnt_ref[0] + t_ncnt
         lcnt_ref[0] = lcnt_ref[0] + t_lcnt
+
+    if reso == "swarm":
+        # Swarm neighbour sums (reference Swarm.py:47-66 via
+        # cr_swarm.pair_weight — the same predicate the lax tiled and
+        # dense paths use, so the three backends cannot drift).  The
+        # neighbourhood (7.5 nm / 1500 ft / <90 deg track) is far rarer
+        # than reachability, so the whole accumulation is predicated on
+        # one any-neighbour flag.  The "tr" slab row carries cas in
+        # swarm mode (see the _FIELDS note).
+        from . import cr_swarm
+        dtrk = (intr("trk") - own("trk") + 180.0) % 360.0 - 180.0
+        dalt_raw = intr("alt") - own("alt")
+        w_mask = cr_swarm.pair_weight(dx, dy, dalt_raw, dtrk, pairmask)
+
+        @pl.when(jnp.any(w_mask))
+        def _swarm_sums():
+            wf = w_mask.astype(dist.dtype)
+            terms = (wf, wf * intr("tr"), wf * intr("vs"), wf * dtrk,
+                     wf * dx, wf * dy, wf * intr("alt"))
+            for ref, t in zip(swarm_refs, terms):
+                ref[0] = ref[0] + jnp.sum(t, axis=0, keepdims=True)
 
     # In-kernel resume-nav: evaluate the keep predicate for every OLD
     # partner pair this tile visits (reference asas.py:426-455 — the
@@ -396,8 +432,8 @@ def _kernel_resume(reach_ref, row0_ref, own_ref, intr_ref, pold_ref,
                    inconf_ref, tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref,
                    tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref, cidx_ref,
                    keep_ref, pnew_ref, pact_ref,
-                   *, block, kk, cpp, rpz, hpz, tlookahead, mvpcfg,
-                   rpz_m, same_hemi=False, reso="mvp", rstride=1):
+                   *swarm_refs, block, kk, cpp, rpz, hpz, tlookahead,
+                   mvpcfg, rpz_m, same_hemi=False, reso="mvp", rstride=1):
     """Full-grid kernel with in-kernel resume-nav (the sparse scheduler's
     overflow fallback): same tile sweep as ``_kernel`` plus the keep
     evaluation per visited tile and the partner merge on the last
@@ -412,6 +448,8 @@ def _kernel_resume(reach_ref, row0_ref, own_ref, intr_ref, pold_ref,
                             sdvv_ref, tsolv_ref, ncnt_ref, lcnt_ref,
                             ctin_ref, cidx_ref), block, kk)
         keep_ref[0] = jnp.zeros((kk, block), jnp.float32)
+        for ref in swarm_refs:
+            ref[0] = jnp.zeros((1, block), jnp.float32)
 
     for k in range(cpp):
         jb = jp * cpp + k
@@ -425,7 +463,8 @@ def _kernel_resume(reach_ref, row0_ref, own_ref, intr_ref, pold_ref,
                        tlookahead=tlookahead, mvpcfg=mvpcfg,
                        same_hemi=same_hemi,
                        resume_refs=(pold_ref, keep_ref), rpz_m=rpz_m,
-                       reso=reso, row_off=row0, row_stride=rstride)
+                       reso=reso, row_off=row0, row_stride=rstride,
+                       swarm_refs=swarm_refs or None)
 
     @pl.when(jp == pl.num_programs(1) - 1)
     def _finish():
@@ -659,6 +698,10 @@ def full_grid_pass(packed, reach, *, block, kk, cpp, kern_kw,
         acc += [jax.ShapeDtypeStruct((nbr, kk, block), dtype),      # keep
                 jax.ShapeDtypeStruct((nbr, kk, block), jnp.int32),  # merged
                 jax.ShapeDtypeStruct((nbr, 1, block), dtype)]       # active
+    if kern_kw.get("reso") == "swarm":
+        # Swarm neighbour-sum accumulators ride as trailing outputs
+        out_specs += [acc_spec() for _ in range(_N_SWARM)]
+        acc += [jax.ShapeDtypeStruct((nbr, 1, block), dtype)] * _N_SWARM
     return list(pl.pallas_call(
         kern,
         grid=(nbr, nbp // cpp),
@@ -745,8 +788,11 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         "alt": pad(alt), "vs": pad(vs), "gse": pad(gseast),
         "gsn": pad(gsnorth), "trk": pad(trk),
         # tas/gs ratio: Eby's velocity basis (ve = tr*u = tas*sin(trk));
-        # 1.0 when no tas given (MVP never reads it; no-wind tas == gs)
-        "tr": pad(jnp.ones_like(gs.astype(dtype))
+        # 1.0 when no tas given (MVP never reads it; no-wind tas == gs).
+        # In swarm mode the slot carries cas instead (see _FIELDS note).
+        "tr": pad((extra_cols or {}).get("cas", gs).astype(dtype)
+                  if reso == "swarm"
+                  else jnp.ones_like(gs.astype(dtype))
                   if not extra_cols or "tas" not in extra_cols
                   else extra_cols["tas"].astype(dtype)
                   / jnp.maximum(gs.astype(dtype), 0.5)),
@@ -757,10 +803,17 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
     packed = jnp.stack([fields[k] for k in _FIELDS]).reshape(
         _NF, nb, block).transpose(1, 0, 2)
 
-    # Exact tile-skip flags (shared bound with the lax backend)
+    # Exact tile-skip flags (shared bound with the lax backend); swarm
+    # widens the bound to its 7.5 nm neighbourhood (short lookaheads
+    # must not skip genuine non-conflicting swarm neighbours)
+    if reso == "swarm":
+        from . import cr_swarm
+        min_reach = cr_swarm.R_SWARM
+    else:
+        min_reach = 0.0
     reach = block_reachability(
         pad(lat), pad(lon), pad(gs), fields["active"] > 0.5,
-        nb, block, float(rpz), float(tlookahead))
+        nb, block, float(rpz), float(tlookahead), min_reach_m=min_reach)
 
     kk = k_partners
     kern_kw = dict(block=block, kk=kk, rpz=float(rpz), hpz=float(hpz),
@@ -854,6 +907,9 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
     # full-grid pass and the row-disjoint outputs merged.  Identical
     # results either way — the split is purely a scheduling optimization.
     c_cap = -(-cand_cap // block) * block if cand_cap else 0
+    if reso == "swarm" and c_cap:
+        raise ValueError("cand_cap mixed mode does not carry the swarm "
+                         "neighbour sums; use cand_cap=0 with RESO SWARM")
     if mesh is not None and mesh.shape[mesh_axis] > 1:
         outs = run_full_sharded()
     elif nb >= 8 and 0 < c_cap < nb * block:
@@ -874,7 +930,7 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         outs = run_full()
 
     (inconf, tcpamax, sdve, sdvn, sdvv, tsolv, ncnt, lcnt,
-     ctin, cidx) = outs
+     ctin, cidx) = outs[:10]
 
     unb = lambda a: a.reshape(nb * block)[:n]
     # Candidates: [nb, kk, block] -> [N, kk], already urgency-sorted
@@ -882,7 +938,7 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
     topk_idx = cidx.transpose(0, 2, 1).reshape(nb * block, kk)[:n]
     topk_idx = jnp.where(topk_tin < _BIG, topk_idx, -1)
 
-    return RowConflictData(
+    rd = RowConflictData(
         inconf=unb(inconf) > 0.5,
         tcpamax=unb(tcpamax),
         sum_dve=unb(sdve), sum_dvn=unb(sdvn), sum_dvv=unb(sdvv),
@@ -892,3 +948,6 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         nconf=jnp.sum(ncnt.astype(jnp.int32), dtype=jnp.int32),
         nlos=jnp.sum(lcnt.astype(jnp.int32), dtype=jnp.int32),
         topk_idx=topk_idx, topk_tin=topk_tin)
+    if reso == "swarm":
+        return rd, tuple(unb(a) for a in outs[10:10 + _N_SWARM])
+    return rd

@@ -74,6 +74,17 @@ def padded_size(n, block=256, extra=32):
     return (-(-n // block) + extra) * block
 
 
+def slot_inverse(perm, n, n_tot, fill=-1):
+    """[n_tot + 1] int32 lookup: padded-slot id -> caller index
+    (``fill`` for empty slots).  ``perm`` is the ``stripe_sort_dest``
+    destination table (caller i -> slot perm[i]); the +1 row makes
+    clipped sentinel lookups safe.  Single source of truth for the
+    sorted-space -> caller-space translation (partner-table remaps in
+    core/asas)."""
+    return jnp.full((n_tot + 1,), fill, jnp.int32).at[
+        jnp.clip(perm, 0, n_tot)].set(jnp.arange(n, dtype=jnp.int32))
+
+
 def reach_threshold_m(gs, active, tlookahead, rpz):
     """Worst-case reach radius [m]: the exact conservative CD bound at
     fleet-max closing speed (used to size stripes; per-block thresholds
@@ -264,14 +275,20 @@ def _sched_kernel(wl_ref, own_ref, *rest,
     if resume:
         pold_ref = rest[0]
         out_refs = rest[1:11]
-        keep_ref, pnew_ref, pact_ref = rest[11:]
+        keep_ref, pnew_ref, pact_ref = rest[11:14]
+        rest = rest[14:]
     else:
         pold_ref = keep_ref = pnew_ref = pact_ref = None
-        out_refs = rest
+        out_refs = rest[:10]
+        rest = rest[10:]
+    swarm_refs = rest if reso == "swarm" else None
     i = pl.program_id(0)
     _init_accumulators(out_refs, block, kk)
     if resume:
         keep_ref[0] = jnp.zeros((kk, block), jnp.float32)
+    if swarm_refs:
+        for ref in swarm_refs:
+            ref[0] = jnp.zeros((1, block), jnp.float32)
 
     oslab = own_ref[0]                                     # (_NFP, block)
 
@@ -323,7 +340,8 @@ def _sched_kernel(wl_ref, own_ref, *rest,
                         kk=kk, rpz=rpz, hpz=hpz, tlookahead=tlookahead,
                         mvpcfg=mvpcfg, same_hemi=same_hemi, jb=jb,
                         resume_refs=(pold_ref, keep_ref) if resume
-                        else None, rpz_m=rpz_m, reso=reso)
+                        else None, rpz_m=rpz_m, reso=reso,
+                        swarm_refs=swarm_refs)
                 return 0
 
             jax.lax.fori_loop(0, jnp.minimum(ln, wmax), body, 0)
@@ -341,7 +359,8 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                          block=256, k_partners=8, s_cap=6, wmax=16,
                          extra_blocks=32, interpret=None, perm=None,
                          cols_per_prog=4, partners=None, resume_rpz_m=None,
-                         tas=None, reso="mvp", mesh=None, mesh_axis="ac"):
+                         tas=None, cas=None, reso="mvp", mesh=None,
+                         mesh_axis="ac"):
     """Sparse-scheduled equivalent of ``cd_pallas.detect_resolve_pallas``.
 
     ``perm`` is the cached ``stripe_sort_dest`` destination table (NOT a
@@ -349,13 +368,20 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
     backends' reductions (same tile math, superset tile coverage).
 
     With ``mesh``, the segment kernel and its overflow fallback run
-    under ``shard_map``: each device owns a contiguous slice of row
+    under ``shard_map``: each device owns an interleaved subset of row
     blocks (its own worklist, partner-table rows, and Pallas program),
-    the packed column slabs replicate over the mesh (one all-gather over
-    ICI per interval), and row ids carry a global offset — so results
-    are bit-identical to the single-device schedule.  The stripe sort,
-    reachability, and window build stay global GSPMD ops; the pair math
-    — the dominant cost — scales ~linearly with devices.
+    the packed column slabs replicate over the mesh, and row ids carry a
+    global offset — so results are bit-identical to the single-device
+    schedule (asserted bit-for-bit in tests/test_sharding.py, and across
+    a real 2-process jax.distributed boundary in tests/test_multihost.py).
+    Communication structure per interval, verified on the compiled HLO
+    (tests/test_hlo_collectives.py): GSPMD all-gathers the RAW O(N)
+    per-aircraft columns (~90 B/aircraft total over ICI) and every
+    device recomputes the padded layout/trig/reachability/windows
+    locally — cheaper than shipping the [nb, 16, block] slab — plus one
+    O(N*K) all-reduce for the partner back-permute; no all-to-alls, no
+    per-tile collectives.  The pair math — the dominant cost — scales
+    ~linearly with devices.
 
     With ``partners`` ([n_tot, K] int32, SORTED-space ids, -1 empty) the
     kernels also run in-kernel resume-nav (keep evaluation on every
@@ -374,11 +400,16 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
     interpret = cd_pallas.interpret_default(interpret)
     if partners is None and n <= 2 * block:
         # Too small to schedule — the plain kernel is already one tile.
+        extra = None
+        if tas is not None:
+            extra = {"tas": tas}
+        if reso == "swarm":
+            extra = {"cas": gs if cas is None else cas}
         return cd_pallas.detect_resolve_pallas(
             lat, lon, trk, gs, alt, vs, gseast, gsnorth, active, noreso,
             rpz, hpz, tlookahead, mvpcfg, block=block,
             k_partners=k_partners, interpret=interpret, reso=reso,
-            extra_cols=None if tas is None else {"tas": tas})
+            extra_cols=extra)
     resume = partners is not None
 
     thresh = reach_threshold_m(gs.astype(dtype), active,
@@ -395,8 +426,11 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         "lat": lat, "lon": lon, "trk": trk, "gs": gs, "alt": alt,
         "vs": vs, "gse": gseast, "gsn": gsnorth,
         # tas/gs ratio: Eby's velocity basis (ve = tr*u); 1.0 when no
-        # tas given (MVP never reads it)
-        "tr": (jnp.ones_like(gs.astype(dtype)) if tas is None
+        # tas given (MVP never reads it).  Swarm overloads the slot
+        # with cas (see cd_pallas._FIELDS note).
+        "tr": ((gs if cas is None else cas).astype(dtype)
+               if reso == "swarm"
+               else jnp.ones_like(gs.astype(dtype)) if tas is None
                else tas.astype(dtype)
                / jnp.maximum(gs.astype(dtype), 0.5)),
         "active": active.astype(dtype), "noreso": noreso.astype(dtype),
@@ -418,10 +452,17 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         len(_FIELDS), nb, block).transpose(1, 0, 2)        # [nb, _NF, block]
 
     act_b = padded["active"] > 0.5
+    if reso == "swarm":
+        from . import cr_swarm
+        min_reach, min_vreach = cr_swarm.R_SWARM, cr_swarm.DH_SWARM
+    else:
+        min_reach = min_vreach = 0.0
     reach = block_reachability(padded["lat"], padded["lon"], padded["gs"],
                                act_b, nb, block, float(rpz),
                                float(tlookahead), alt=padded["alt"],
-                               vs=padded["vs"], hpz=float(hpz))
+                               vs=padded["vs"], hpz=float(hpz),
+                               min_reach_m=min_reach,
+                               min_vreach_m=min_vreach)
 
     # Segment windows + the Wmax-block pad region the sentinel slots
     # point at (slots are clamped so every DMA stays in bounds); start
@@ -447,7 +488,8 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         pold = partners.reshape(nb, block, kk).transpose(0, 2, 1) \
             .astype(jnp.int32)                             # [nb, kk, block]
     reach_f = reach & overflow[:, None]
-    neutral_vals = _ACC_NEUTRAL + ((0.0, -1, 0.0) if resume else ())
+    neutral_vals = _ACC_NEUTRAL + ((0.0, -1, 0.0) if resume else ()) \
+        + ((0.0,) * cd_pallas._N_SWARM if reso == "swarm" else ())
 
     def run_rows(wl_r, own16_r, packedown_r, pold_r, reachf_r, overflow_r,
                  row0, same_hemi, intr16, intr, rstride=1):
@@ -484,6 +526,10 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                 jax.ShapeDtypeStruct((rows, kk, block), dtype),     # keep
                 jax.ShapeDtypeStruct((rows, kk, block), jnp.int32),  # merged
                 jax.ShapeDtypeStruct((rows, 1, block), dtype)]      # active
+        if reso == "swarm":
+            out_shape = out_shape + [
+                jax.ShapeDtypeStruct((rows, 1, block), dtype)
+            ] * cd_pallas._N_SWARM
         kern = functools.partial(
             _sched_kernel, block=block, kk=kk, s_cap=s_cap, wmax=wmax,
             rpz=float(rpz), hpz=float(hpz), tlookahead=float(tlookahead),
@@ -497,6 +543,8 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
             in_specs.append(cand_spec())               # pold
             args.append(pold_r)
             out_specs += [cand_spec(), cand_spec(), acc_spec()]
+        if reso == "swarm":
+            out_specs += [acc_spec() for _ in range(cd_pallas._N_SWARM)]
         outs_s = list(pl.pallas_call(
             kern,
             grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -622,15 +670,17 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
     rows = [inconf, tcpamax, sdve, sdvn, sdvv, tsolv]
     if resume:
         rows.append(outs[12])                              # active
+    sw_start = 13 if resume else 10
+    if reso == "swarm":
+        rows.extend(outs[sw_start:sw_start + cd_pallas._N_SWARM])
     stacked = jnp.stack([o.reshape(n_tot) for o in rows])
-    backed = stacked[:, perm]                              # [6|7, n]
+    backed = stacked[:, perm]                              # [6|7|+7, n]
     topk_tin = ctin.transpose(0, 2, 1).reshape(n_tot, kk)[perm]
     topk_idx = cidx.transpose(0, 2, 1).reshape(n_tot, kk)[perm]
     if not resume:
         # Translate sorted-space partner ids to caller slots via the
         # inverse scatter (sentinel-filled with n -> invalid -> -1).
-        inv = jnp.full((n_tot + 1,), n, jnp.int32).at[perm].set(
-            jnp.arange(n, dtype=jnp.int32))
+        inv = slot_inverse(perm, n, n_tot, fill=n)
         topk_idx = inv[jnp.clip(topk_idx, 0, n_tot)]
     topk_idx = jnp.where((topk_tin < cd_pallas._BIG) & (topk_idx < n_tot),
                          topk_idx, -1)
@@ -643,9 +693,14 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         nconf=jnp.sum(ncnt.astype(jnp.int32), dtype=jnp.int32),
         nlos=jnp.sum(lcnt.astype(jnp.int32), dtype=jnp.int32),
         topk_idx=topk_idx, topk_tin=topk_tin)
+    nfix = 7 if resume else 6
+    sw = tuple(backed[nfix:nfix + cd_pallas._N_SWARM]) \
+        if reso == "swarm" else None
     if not resume:
-        return rd
+        return (rd, sw) if sw is not None else rd
     pmerged = outs[11]
     partners_new = pmerged.transpose(0, 2, 1).reshape(n_tot, kk)
     active_caller = backed[6] > 0.5
+    if sw is not None:
+        return rd, partners_new, active_caller, sw
     return rd, partners_new, active_caller

@@ -272,7 +272,8 @@ def run_spatially_sorted(kernel, lat, lon, trk, gs, alt, vs, gseast,
 
 
 def block_reachability(lat, lon, gs, active, nb, block, rpz, tlookahead,
-                       alt=None, vs=None, hpz=None, min_reach_m=0.0):
+                       alt=None, vs=None, hpz=None, min_reach_m=0.0,
+                       min_vreach_m=0.0):
     """[nb, nb] bool: which block pairs can possibly contain a conflict
     or LoS.
 
@@ -354,6 +355,10 @@ def block_reachability(lat, lon, gs, active, nb, block, rpz, tlookahead,
             altmin[:, None] - altmax[None, :],
             altmin[None, :] - altmax[:, None]))
         vthresh = hpz + tlookahead * (vsmax[:, None] + vsmax[None, :])
+        # min_vreach_m: vertical analogue of min_reach_m (the Swarm
+        # 1500 ft neighbourhood exceeds hpz, so the conflict bound alone
+        # would skip genuine co-cruising neighbours one band up)
+        vthresh = jnp.maximum(vthresh, min_vreach_m)
         reach = reach & (altgap <= vthresh * 1.05)
     return reach
 

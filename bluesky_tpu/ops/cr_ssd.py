@@ -139,6 +139,92 @@ def _pick(free, allowed, dist2, min_tin):
     return jnp.where(has_f, best_free, best_delay), has_f
 
 
+def _candidate_grid(n, rule, cfg, dtype, hdg, ap_tas, ap_ve, ap_vn,
+                    gseast, gsnorth, vmin, vmax):
+    """[N, C] candidate velocities: polar product + the two specials
+    ([C-2] = current velocity, [C-1] = AP velocity).  Shared by the
+    dense and partner-table paths so the grids cannot drift."""
+    if rule == "RS3":
+        # heading-only: every track at the AP speed (SSD.py:388-391 ring)
+        ctrk = jnp.linspace(0.0, 360.0, cfg.ntrk, endpoint=False,
+                            dtype=dtype)[None, :].repeat(n, 0)
+        cspd = jnp.clip(ap_tas, vmin, vmax)[:, None].repeat(cfg.ntrk, 1)
+    elif rule == "RS4":
+        # speed-only: the own-heading wedge (SSD.py:392-398)
+        cspd = jnp.linspace(vmin, vmax, cfg.nspd,
+                            dtype=dtype)[None, :].repeat(n, 0)
+        ctrk = hdg[:, None].repeat(cfg.nspd, 1)
+    else:
+        trks = jnp.linspace(0.0, 360.0, cfg.ntrk, endpoint=False,
+                            dtype=dtype)
+        spds = jnp.linspace(vmin, vmax, cfg.nspd, dtype=dtype)
+        ctrk = jnp.repeat(trks, cfg.nspd)[None, :].repeat(n, 0)
+        cspd = jnp.tile(spds, cfg.ntrk)[None, :].repeat(n, 0)
+    cve = cspd * jnp.sin(jnp.radians(ctrk))
+    cvn = cspd * jnp.cos(jnp.radians(ctrk))
+    cve = jnp.concatenate([cve, gseast[:, None], ap_ve[:, None]], axis=1)
+    cvn = jnp.concatenate([cvn, gsnorth[:, None], ap_vn[:, None]], axis=1)
+    return cve, cvn, ctrk
+
+
+def _select_best(rule, cve, cvn, ctrk, hdg, free, min_tin, masks_near,
+                 ap_ve, ap_vn, gseast, gsnorth):
+    """Rule-restricted pick + the sequential (RS7/RS8) near layer + the
+    RS5 AP override — the decision tail shared by both VO-mask sources.
+    ``masks_near`` is a thunk returning (anyconf, min_tin) for the
+    half-ADS-B-range layer, only called for RS7/RS8."""
+    n = cve.shape[0]
+    i_cur = cve.shape[1] - 2
+    i_ap = cve.shape[1] - 1
+
+    if rule in ("RS5", "RS8"):
+        ref_e, ref_n = ap_ve, ap_vn
+    else:
+        ref_e, ref_n = gseast, gsnorth
+    dist2 = (cve - ref_e[:, None]) ** 2 + (cvn - ref_n[:, None]) ** 2
+
+    allowed = jnp.ones(cve.shape, bool)
+    if rule in ("RS2", "RS6"):
+        rel = _wrap180(ctrk - hdg[:, None])
+        allowed = allowed.at[:, :-2].set(rel >= 0.0)   # right half-plane
+    elif rule == "RS9":
+        rel = _wrap180(ctrk - hdg[:, None])
+        allowed = allowed.at[:, :-2].set(rel <= 0.0)   # left half-plane
+    # the specials only participate where the reference consults them
+    allowed = allowed.at[:, i_cur].set(False)
+    allowed = allowed.at[:, i_ap].set(rule in ("RS5", "RS8"))
+
+    best, has_f = _pick(free, allowed, dist2, min_tin)
+
+    if rule in ("RS7", "RS8"):
+        # Second, nearer layer: intruders within HALF the ADS-B range
+        # (SSD.py:113-114); inconf2 = current velocity inside a near VO.
+        anyc2, mint2 = masks_near()
+        free2 = ~anyc2
+        inconf2 = anyc2[:, i_cur]
+        best2, has_f2 = _pick(free2, allowed, dist2, mint2)
+        # Prefer the near-layer solution when the current velocity
+        # conflicts nearby and the two solutions genuinely differ
+        # (SSD.py:515-545; the <1 m/s^2 sameness test), tie-broken
+        # toward the later earliest-LoS via _pick's dist2 objective.
+        d12 = (cve[jnp.arange(n), best] - cve[jnp.arange(n), best2]) ** 2 \
+            + (cvn[jnp.arange(n), best] - cvn[jnp.arange(n), best2]) ** 2
+        use2 = inconf2 & has_f2 & (d12 >= 1.0)
+        best = jnp.where(use2, best2, best)
+
+    if rule == "RS5":
+        # AP setting wins when it is conflict-free (SSD.py:446-453)
+        best = jnp.where(free[:, i_ap], i_ap, best)
+
+    btrk = jnp.degrees(jnp.arctan2(
+        jnp.take_along_axis(cve, best[:, None], 1)[:, 0],
+        jnp.take_along_axis(cvn, best[:, None], 1)[:, 0])) % 360.0
+    bspd = jnp.sqrt(
+        jnp.take_along_axis(cve, best[:, None], 1)[:, 0] ** 2
+        + jnp.take_along_axis(cvn, best[:, None], 1)[:, 0] ** 2)
+    return btrk, bspd
+
+
 def resolve(cd, lat, lon, alt, trk, gs, vs, gseast, gsnorth, active,
             vmin, vmax, cfg: SSDConfig, hdg=None, ap_trk=None,
             ap_tas=None):
@@ -158,30 +244,9 @@ def resolve(cd, lat, lon, alt, trk, gs, vs, gseast, gsnorth, active,
     ap_ve = ap_tas * jnp.sin(jnp.radians(ap_trk))
     ap_vn = ap_tas * jnp.cos(jnp.radians(ap_trk))
 
-    # ---- Candidate grid [N, C]: polar product + the two specials ----
-    if rule == "RS3":
-        # heading-only: every track at the AP speed (SSD.py:388-391 ring)
-        ctrk = jnp.linspace(0.0, 360.0, cfg.ntrk, endpoint=False,
-                            dtype=dtype)[None, :].repeat(n, 0)
-        cspd = jnp.clip(ap_tas, vmin, vmax)[:, None].repeat(cfg.ntrk, 1)
-    elif rule == "RS4":
-        # speed-only: the own-heading wedge (SSD.py:392-398)
-        cspd = jnp.linspace(vmin, vmax, cfg.nspd,
-                            dtype=dtype)[None, :].repeat(n, 0)
-        ctrk = hdg[:, None].repeat(cfg.nspd, 1)
-    else:
-        trks = jnp.linspace(0.0, 360.0, cfg.ntrk, endpoint=False,
-                            dtype=dtype)
-        spds = jnp.linspace(vmin, vmax, cfg.nspd, dtype=dtype)
-        ctrk = jnp.repeat(trks, cfg.nspd)[None, :].repeat(n, 0)
-        cspd = jnp.tile(spds, cfg.ntrk)[None, :].repeat(n, 0)
-    cve = cspd * jnp.sin(jnp.radians(ctrk))
-    cvn = cspd * jnp.cos(jnp.radians(ctrk))
-    # specials: [C] = current velocity, [C+1] = AP velocity
-    cve = jnp.concatenate([cve, gseast[:, None], ap_ve[:, None]], axis=1)
-    cvn = jnp.concatenate([cvn, gsnorth[:, None], ap_vn[:, None]], axis=1)
-    i_cur = cve.shape[1] - 2
-    i_ap = cve.shape[1] - 1
+    cve, cvn, ctrk = _candidate_grid(n, rule, cfg, dtype, hdg, ap_tas,
+                                     ap_ve, ap_vn, gseast, gsnorth,
+                                     vmin, vmax)
 
     # ---- Pair geometry from the CD output ----
     qdrrad = jnp.radians(cd.qdr)
@@ -205,56 +270,135 @@ def resolve(cd, lat, lon, alt, trk, gs, vs, gseast, gsnorth, active,
 
     anyconf, min_tin = _vo_masks(cve, cvn, dxm, dym, gseast, gsnorth,
                                  pairok, cfg)
-    free = ~anyconf
 
-    # ---- Objective + candidate restriction per rule ----
-    if rule in ("RS5", "RS8"):
-        ref_e, ref_n = ap_ve, ap_vn
-    else:
-        ref_e, ref_n = gseast, gsnorth
-    dist2 = (cve - ref_e[:, None]) ** 2 + (cvn - ref_n[:, None]) ** 2
+    def masks_near():
+        return _vo_masks(cve, cvn, dxm, dym, gseast, gsnorth,
+                         pairok & (cd.dist < ADSB_MAX / 2.0), cfg)
 
-    allowed = jnp.ones(cve.shape, bool)
-    if rule in ("RS2", "RS6"):
-        rel = _wrap180(ctrk - hdg[:, None])
-        allowed = allowed.at[:, :-2].set(rel >= 0.0)   # right half-plane
-    elif rule == "RS9":
-        rel = _wrap180(ctrk - hdg[:, None])
-        allowed = allowed.at[:, :-2].set(rel <= 0.0)   # left half-plane
-    # the specials only participate where the reference consults them
-    allowed = allowed.at[:, i_cur].set(False)
-    allowed = allowed.at[:, i_ap].set(rule in ("RS5", "RS8"))
-
-    best, has_f = _pick(free, allowed, dist2, min_tin)
-
-    if rule in ("RS7", "RS8"):
-        # Second, nearer layer: intruders within HALF the ADS-B range
-        # (SSD.py:113-114); inconf2 = current velocity inside a near VO.
-        pairok2 = pairok & (cd.dist < ADSB_MAX / 2.0)
-        anyc2, mint2 = _vo_masks(cve, cvn, dxm, dym, gseast, gsnorth,
-                                 pairok2, cfg)
-        free2 = ~anyc2
-        inconf2 = anyc2[:, i_cur]
-        best2, has_f2 = _pick(free2, allowed, dist2, mint2)
-        # Prefer the near-layer solution when the current velocity
-        # conflicts nearby and the two solutions genuinely differ
-        # (SSD.py:515-545; the <1 m/s^2 sameness test), tie-broken
-        # toward the later earliest-LoS via _pick's dist2 objective.
-        d12 = (cve[jnp.arange(n), best] - cve[jnp.arange(n), best2]) ** 2 \
-            + (cvn[jnp.arange(n), best] - cvn[jnp.arange(n), best2]) ** 2
-        use2 = inconf2 & has_f2 & (d12 >= 1.0)
-        best = jnp.where(use2, best2, best)
-
-    if rule == "RS5":
-        # AP setting wins when it is conflict-free (SSD.py:446-453)
-        best = jnp.where(free[:, i_ap], i_ap, best)
-
-    btrk = jnp.degrees(jnp.arctan2(
-        jnp.take_along_axis(cve, best[:, None], 1)[:, 0],
-        jnp.take_along_axis(cvn, best[:, None], 1)[:, 0])) % 360.0
-    bspd = jnp.sqrt(
-        jnp.take_along_axis(cve, best[:, None], 1)[:, 0] ** 2
-        + jnp.take_along_axis(cvn, best[:, None], 1)[:, 0] ** 2)
+    btrk, bspd = _select_best(rule, cve, cvn, ctrk, hdg, ~anyconf,
+                              min_tin, masks_near, ap_ve, ap_vn,
+                              gseast, gsnorth)
     newtrk = jnp.where(cd.inconf, btrk, trk)
     newgs = jnp.where(cd.inconf, bspd, gs)
+    return newtrk, newgs
+
+
+def _vo_masks_pairs(cve, cvn, dx, dy, vje, vjn, ok, cfg, chunk=16):
+    """VO-mask reduction over a GATHERED [N, P] partner set.
+
+    Same CPA predicate as ``_vo_masks`` but the intruder axis is the
+    per-ownship partner table, not the whole fleet; the candidate axis
+    is chunked (``lax.map``) so peak memory is [N, chunk, P] instead of
+    [N, C, P].  Returns (anyconf [N, C], min_tin [N, C])."""
+    n, c = cve.shape
+    p = dx.shape[1]
+    dtype = cve.dtype
+    r2 = cfg.rpz_m * cfg.rpz_m
+    big = jnp.asarray(1e18, dtype)
+    nch = -(-c // chunk)
+    cpad = nch * chunk - c
+
+    cvep = jnp.pad(cve, ((0, 0), (0, cpad)))
+    cvnp = jnp.pad(cvn, ((0, 0), (0, cpad)))
+
+    def slab(ci):
+        s = ci * chunk
+        ce = jax.lax.dynamic_slice_in_dim(cvep, s, chunk, 1)[:, :, None]
+        cn = jax.lax.dynamic_slice_in_dim(cvnp, s, chunk, 1)[:, :, None]
+        # w = v_j - u_c (StateBasedCD.py:39-40 convention)
+        wve = vje[:, None, :] - ce                       # [N, chunk, P]
+        wvn = vjn[:, None, :] - cn
+        dv2 = wve * wve + wvn * wvn
+        dv2 = jnp.where(dv2 < 1e-6, 1e-6, dv2)
+        dxc = dx[:, None, :]
+        dyc = dy[:, None, :]
+        tcpa = -(wve * dxc + wvn * dyc) / dv2
+        dcpa2 = dxc * dxc + dyc * dyc - tcpa * tcpa * dv2
+        dtinhor = jnp.sqrt(jnp.maximum(0.0, r2 - dcpa2) / dv2)
+        tin = tcpa - dtinhor
+        conf = (dcpa2 < r2) & (tcpa + dtinhor > 0.0) \
+            & (tin < cfg.tlookahead) & ok[:, None, :]
+        return (jnp.any(conf, axis=2),
+                jnp.min(jnp.where(conf, jnp.maximum(tin, 0.0), big),
+                        axis=2))
+
+    anyc, mint = jax.lax.map(slab, jnp.arange(nch))
+    anyc = anyc.transpose(1, 0, 2).reshape(n, nch * chunk)[:, :c]
+    mint = mint.transpose(1, 0, 2).reshape(n, nch * chunk)[:, :c]
+    return anyc, mint
+
+
+def resolve_from_partners(partners, inconf, lat, lon, alt, trk, gs, vs,
+                          gseast, gsnorth, active, vmin, vmax,
+                          cfg: SSDConfig, hdg=None, ap_trk=None,
+                          ap_tas=None):
+    """SSD resolution from an [N, P] partner table — the large-N path.
+
+    The blockwise CD backends never materialise [N, N] matrices; what
+    they do produce is the per-ownship partner table: the K most urgent
+    currently-conflicting intruders merged with the still-engaged
+    partners of previous intervals (``cd_tiled.topk_partners`` /
+    the sparse backend's in-kernel merge).  This resolver builds the
+    velocity obstacles from exactly that set.
+
+    **K-truncation semantics** (the documented delta vs the dense path,
+    reference SSD.py:110-141 which draws a VO for EVERY intruder within
+    ADS-B range): only the tabled intruders contribute VOs, so the
+    chosen velocity is guaranteed conflict-free against the K most
+    urgent threats (and all held partners), but may conflict with an
+    untabled neighbour — such a pair is surfaced by the very next CD
+    interval (it becomes a most-urgent conflict itself) and resolved
+    then.  Scenes whose per-ownship conflict count stays within K are
+    bit-equivalent to the dense path.
+
+    ``partners`` holds caller-space intruder indices, -1 = empty.
+    Returns (newtrk, newgs); non-conflicting aircraft keep trk/gs.
+    """
+    n = lat.shape[0]
+    dtype = gs.dtype
+    rule = cfg.priocode.upper()
+    hdg = trk if hdg is None else hdg
+    ap_trk = trk if ap_trk is None else ap_trk
+    ap_tas = gs if ap_tas is None else ap_tas
+    ap_ve = ap_tas * jnp.sin(jnp.radians(ap_trk))
+    ap_vn = ap_tas * jnp.cos(jnp.radians(ap_trk))
+
+    cve, cvn, ctrk = _candidate_grid(n, rule, cfg, dtype, hdg, ap_tas,
+                                     ap_ve, ap_vn, gseast, gsnorth,
+                                     vmin, vmax)
+
+    # ---- Gathered pair geometry (own -> partner), [N, P] ----
+    from . import cd_tiled
+    valid = partners >= 0
+    j = jnp.clip(partners, 0, n - 1)
+    trig = cd_tiled.precompute_trig(lat, lon)
+    own_t = {k: v[:, None] for k, v in trig.items()}
+    intr_t = {k: v[j] for k, v in trig.items()}
+    dist, sinqdr, cosqdr = cd_tiled.tile_geometry(own_t, intr_t)
+    dx = dist * sinqdr
+    dy = dist * cosqdr
+    vje = gseast[j]
+    vjn = gsnorth[j]
+    ok = valid & active[:, None] & active[j] & (dist < ADSB_MAX)
+
+    if rule == "RS6":
+        # Rules-of-the-air gates on the gathered bearings (SSD.py:296-302)
+        qdr = jnp.degrees(jnp.arctan2(sinqdr, cosqdr))
+        brg_own = _wrap180(qdr - hdg[:, None])
+        brg_oth = _wrap180(qdr + 180.0 - hdg[j])
+        must_avoid = ((brg_own >= -20.0) & (brg_own <= 110.0)) \
+            | (brg_oth <= -110.0) | (brg_oth >= 110.0)
+        ok = ok & must_avoid
+
+    anyconf, min_tin = _vo_masks_pairs(cve, cvn, dx, dy, vje, vjn, ok, cfg)
+
+    def masks_near():
+        return _vo_masks_pairs(cve, cvn, dx, dy, vje, vjn,
+                               ok & (dist < ADSB_MAX / 2.0), cfg)
+
+    btrk, bspd = _select_best(rule, cve, cvn, ctrk, hdg, ~anyconf,
+                              min_tin, masks_near, ap_ve, ap_vn,
+                              gseast, gsnorth)
+    newtrk = jnp.where(inconf, btrk, trk)
+    newgs = jnp.where(inconf, bspd, gs)
     return newtrk, newgs
