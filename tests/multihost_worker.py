@@ -1,0 +1,86 @@
+"""Worker process for the 2-process jax.distributed test
+(tests/test_multihost.py) — VERDICT r4 #2: execute
+``parallel.sharding.init_multihost`` for real.
+
+Each of the two processes owns 4 virtual CPU devices; after
+``init_multihost`` the job-wide mesh has 8 devices spanning both
+processes, and the sharded SPARSE step (shard_map row split + GSPMD
+collectives, here over the gloo DCN-analogue transport) runs as one
+SPMD program.  Process 0 writes the gathered results to ``--out`` for
+the parent to compare against its single-process run.
+
+Usage: python multihost_worker.py <process_id> <coord_port> <out.npz>
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+
+def main():
+    pid, port, outfile = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+    from bluesky_tpu.parallel import sharding
+    # The line under test: jax.distributed.initialize through the
+    # framework's own entry point (SURVEY §5.8 scale-out role).
+    sharding.init_multihost(coordinator_address=f"127.0.0.1:{port}",
+                            num_processes=2, process_id=pid)
+    assert len(jax.devices()) == 8, "job mesh must span both processes"
+    assert len(jax.local_devices()) == 4
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    from bluesky_tpu.core.step import SimConfig
+    from test_sharding import make_mixed_scene
+
+    cfg = SimConfig(cd_backend="sparse", cd_block=256)
+    nsteps = 25
+
+    mesh = sharding.make_mesh()          # all 8 job devices
+    scene = make_mixed_scene()
+    # Every process builds the identical host state; place it onto the
+    # global mesh shard-by-shard (each process materialises only the
+    # shards its local devices own).
+    shardings = sharding.state_shardings(scene, mesh)
+
+    def put(leaf, sh):
+        host = np.asarray(leaf)
+        return jax.make_array_from_callback(host.shape, sh,
+                                            lambda idx: host[idx])
+
+    st = jax.tree.map(put, scene, shardings)
+    out = jax.block_until_ready(
+        sharding.sharded_step_fn(mesh, cfg, nsteps=nsteps)(st))
+
+    gathered = {
+        name: np.asarray(multihost_utils.process_allgather(
+            getattr(out.ac, name), tiled=True))
+        for name in ("lat", "lon", "alt", "hdg", "trk", "tas", "gs", "vs")
+    }
+    gathered["inconf"] = np.asarray(multihost_utils.process_allgather(
+        out.asas.inconf, tiled=True))
+    gathered["active"] = np.asarray(multihost_utils.process_allgather(
+        out.asas.active, tiled=True))
+    gathered["nconf"] = np.asarray(int(out.asas.nconf_cur))
+    gathered["nlos"] = np.asarray(int(out.asas.nlos_cur))
+    gathered["simt"] = np.asarray(float(out.simt))
+    if pid == 0:
+        np.savez(outfile, **gathered)
+    # Keep both processes alive until the save completes (the job tears
+    # down collectively).
+    multihost_utils.sync_global_devices("done")
+    print(f"worker {pid} done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,111 @@
+"""Mechanical verification of the sharded sparse backend's communication
+structure (VERDICT r4 #1): compile one CD interval on the 8-device mesh
+and assert on the HLO itself which collectives GSPMD inserted.
+
+Measured structure (the numbers PERF_ANALYSIS §multi-chip quotes):
+
+* ~21 all-gathers, every one O(N): the raw per-aircraft state columns
+  (f32[n]/s32[n,1]) are gathered and the padded stripe-sorted layout +
+  trig columns are recomputed on every device — XLA chooses this over
+  gathering the [nb, 16, block] slab because the columns are smaller
+  (~84 B/aircraft total vs the ~16 rows x 4 B slab) and the rebuild is
+  trivial elementwise work.  Either way the wire cost per interval is
+  O(N) bytes, independent of the O(N^2/D) pair work.
+* ONE O(N*K) all-reduce: the sorted-space partner-table back-permute
+  (outs[rinv]) lowered as one-hot scatter-add.
+* ZERO all-to-alls, reduce-scatters or collective-permutes — the global
+  stripe-sort / reachability / window-build ops do NOT get sharded (they
+  are recomputed per device from the gathered columns), so no stray
+  collectives appear around them.
+
+The assertions are structural (op kinds + per-result element bounds +
+total byte bound), not exact-count, so compiler-version noise in how
+many columns fuse cannot flake the test while any O(N^2)-scale or
+per-tile collective still fails it loudly.
+"""
+import re
+
+import jax
+import numpy as np
+import pytest
+
+from bluesky_tpu.core import asas as asasmod
+from bluesky_tpu.core.asas import AsasConfig
+from bluesky_tpu.ops import cd_sched
+from bluesky_tpu.parallel import sharding
+
+from test_sharding import make_mixed_scene
+
+pytestmark = pytest.mark.slow
+
+_COLL = re.compile(
+    r'=\s*([a-z0-9]+)\[([\d,]*)\][^ ]*\s+'
+    r'(all-gather|all-to-all|all-reduce|reduce-scatter|'
+    r'collective-permute)\(')
+
+_BYTES = {"f32": 4, "s32": 4, "f64": 8, "s64": 8, "pred": 1, "u32": 4,
+          "bf16": 2, "s8": 1, "u8": 1}
+
+
+def _collectives(hlo_text):
+    out = []
+    for line in hlo_text.splitlines():
+        m = _COLL.search(line)
+        if m:
+            dtype, dims, op = m.group(1), m.group(2), m.group(3)
+            shape = tuple(int(d) for d in dims.split(",") if d)
+            elems = int(np.prod(shape)) if shape else 1
+            out.append((op, dtype, shape,
+                        elems * _BYTES.get(dtype, 4)))
+    return out
+
+
+def test_sharded_sparse_interval_collectives():
+    mesh = sharding.make_mesh(8)
+    st = sharding.shard_state(make_mixed_scene(), mesh)
+    cfg = AsasConfig()
+
+    def one_interval(s):
+        s2, _ = asasmod.update_tiled(s, cfg, block=256, impl="sparse",
+                                     mesh=mesh)
+        return s2
+
+    comp = jax.jit(one_interval).lower(st).compile()
+    colls = _collectives(comp.as_text())
+    assert colls, "sharded program must contain collectives"
+
+    n = st.ac.lat.shape[0]
+    n_tot = cd_sched.padded_size(n, 256)
+    kk = st.asas.partners_s.shape[1]
+
+    by_op = {}
+    for op, dtype, shape, nbytes in colls:
+        by_op.setdefault(op, []).append((dtype, shape, nbytes))
+
+    # No stray collectives around the global stripe-sort/window-build:
+    # those ops are recomputed per device, never resharded.
+    for op in ("all-to-all", "reduce-scatter", "collective-permute"):
+        assert op not in by_op, by_op.get(op)
+
+    # Every all-gather is an O(N) column gather: its result holds at
+    # most one padded column (n_tot elements, 2nd dim <= 1) — never a
+    # slab, a tile, or anything O(N^2/D)-scaled.
+    ags = by_op.get("all-gather", [])
+    assert ags, "column gathers must exist"
+    for dtype, shape, nbytes in ags:
+        assert len(shape) <= 2, (dtype, shape)
+        assert shape[0] <= n_tot, (dtype, shape)
+        if len(shape) == 2:
+            assert shape[1] <= 1, (dtype, shape)
+
+    # The partner back-permute is the only all-reduce, O(N*K).
+    ars = by_op.get("all-reduce", [])
+    assert len(ars) <= 2, ars
+    for dtype, shape, nbytes in ars:
+        assert int(np.prod(shape)) <= 2 * n_tot * kk, (dtype, shape)
+
+    # Total wire bytes per interval stay O(N): generously < 256 B per
+    # padded slot (measured ~90), i.e. ~8 MB/interval at N=100k — vs
+    # the ~2 GB the [N, N] pair space would cost.
+    total = sum(nbytes for _, _, _, nbytes in colls)
+    assert total < 256 * n_tot, total

@@ -1,0 +1,72 @@
+"""2-process jax.distributed test for ``parallel.sharding.init_multihost``
+(VERDICT r4 #2: the one untested line of the distributed story).
+
+Two OS processes (coordinator + worker), 4 virtual CPU devices each,
+join an 8-device multi-host mesh through ``init_multihost``; the sharded
+SPARSE step runs as one SPMD program whose cross-process collectives ride
+the gloo transport (the CPU stand-in for DCN).  The gathered result must
+be BIT-IDENTICAL to this process's single-device run — same contract the
+single-process 8-device mesh test already proves, now across a real
+process boundary.
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from bluesky_tpu.core.step import SimConfig, run_steps
+
+from test_sharding import FIELDS, make_mixed_scene
+
+pytestmark = pytest.mark.slow    # spawns two fresh JAX processes
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_init_multihost_two_process_sparse_step(tmp_path):
+    here = os.path.dirname(os.path.abspath(__file__))
+    outfile = tmp_path / "mh_out.npz"
+    port = _free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=4")
+
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.join(here, "multihost_worker.py"),
+         str(pid), str(port), str(outfile)],
+        env=env, cwd=here, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True) for pid in (0, 1)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=900)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-4000:]
+    assert outfile.is_file(), outs[0][-4000:]
+
+    got = np.load(outfile)
+    cfg = SimConfig(cd_backend="sparse", cd_block=256)
+    ref = run_steps(make_mixed_scene(), cfg, 25)
+
+    assert float(got["simt"]) == pytest.approx(25 * cfg.simdt)
+    assert int(got["nconf"]) == int(ref.asas.nconf_cur)
+    assert int(got["nconf"]) > 0, "scene must produce conflicts"
+    assert int(got["nlos"]) == int(ref.asas.nlos_cur)
+    for name in FIELDS:
+        np.testing.assert_array_equal(
+            got[name], np.asarray(getattr(ref.ac, name)), err_msg=name)
+    np.testing.assert_array_equal(got["inconf"],
+                                  np.asarray(ref.asas.inconf))
+    np.testing.assert_array_equal(got["active"],
+                                  np.asarray(ref.asas.active))
+    assert got["active"].sum() > 0, "resolution must engage"
