@@ -13,6 +13,7 @@ Example headless session:
   > OP
 """
 import argparse
+import os
 import sys
 
 from . import settings
@@ -51,6 +52,15 @@ def main(argv=None):
     parser.add_argument("--upstream", default="",
                         help="chain this server under another: host:port "
                              "of the upstream server's client event port")
+    parser.add_argument("--import-navdata", default="", metavar="DIR",
+                        help="import a reference-format navdata directory "
+                             "(fix.dat/nav.dat/airports.dat/awy.dat/fir/"
+                             "apt.zip) into the local cache and exit; the "
+                             "imported set is used automatically whenever "
+                             "no navdata mount is configured")
+    parser.add_argument("--dest", default="",
+                        help="with --import-navdata: destination directory "
+                             "(default: <cache>/navdata)")
     args = parser.parse_args(argv)
     if args.attach and not args.web:
         parser.error("--attach only applies to --web "
@@ -58,6 +68,8 @@ def main(argv=None):
 
     settings.init(args.config_file)
 
+    if args.import_navdata:
+        return run_import_navdata(args)
     if args.sim:
         return run_sim(args)
     if args.detached:
@@ -67,6 +79,66 @@ def main(argv=None):
     if args.web:
         return run_web(args)
     return run_server(args)
+
+
+def run_import_navdata(args):
+    """Import a reference-format navdata tree into the local cache
+    (VERDICT r4 #9: one-command full-world data for standalone
+    deployments; source format per the reference
+    navdatabase/load_navdata_txt.py — see navdb/loaders.py).
+
+    Copies the recognized sources to ``--dest`` (default
+    settings.imported_navdata_path), parses them once to warm the
+    pickle cache, and prints what was loaded.  settings picks the
+    imported tree up automatically when no mount is configured."""
+    import shutil
+    from .navdb.loaders import load_navdata
+
+    src = args.import_navdata
+    if not os.path.isdir(src):
+        print(f"--import-navdata: {src!r} is not a directory",
+              file=sys.stderr)
+        return 1
+    names = ("fix.dat", "nav.dat", "airports.dat", "awy.dat",
+             "icao-countries.dat", "apt.zip")
+    present = [n for n in names if os.path.isfile(os.path.join(src, n))]
+    has_fir = os.path.isdir(os.path.join(src, "fir"))
+    if not present and not has_fir:
+        print(f"--import-navdata: no recognized navdata files under "
+              f"{src!r} (expected any of {', '.join(names)} or fir/)",
+              file=sys.stderr)
+        return 1
+
+    dest = args.dest or settings.imported_navdata_path
+    os.makedirs(dest, exist_ok=True)
+    # A re-import REPLACES the previous one: recognized files/dirs the
+    # new source does not provide are removed, so the destination is
+    # always a faithful copy of ONE source (a silent A+B mix would make
+    # the summary counts, and the sim's world, represent neither).
+    for n in names:
+        if n not in present and os.path.isfile(os.path.join(dest, n)):
+            os.remove(os.path.join(dest, n))
+            print(f"  removed stale {n}")
+    if os.path.isdir(os.path.join(dest, "fir")):
+        shutil.rmtree(os.path.join(dest, "fir"))
+    for n in present:
+        shutil.copy2(os.path.join(src, n), os.path.join(dest, n))
+        print(f"  copied {n}")
+    if has_fir:
+        shutil.copytree(os.path.join(src, "fir"),
+                        os.path.join(dest, "fir"))
+        print("  copied fir/")
+
+    data = load_navdata(dest, cache_path=settings.cache_path)
+    print(f"imported navdata -> {dest}: "
+          f"{len(data['wpid'])} waypoints, {len(data['aptid'])} airports, "
+          f"{len(data['awid'])} airway legs, {len(data['firs'])} FIRs, "
+          f"{len(data.get('rwythresholds', {}))} airports with runway "
+          "thresholds (cache warmed)")
+    if dest != settings.imported_navdata_path:
+        print(f"note: set `navdata_path = {dest!r}` in your settings file "
+              "to use a non-default destination")
+    return 0
 
 
 def run_server(args):

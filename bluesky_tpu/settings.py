@@ -29,9 +29,14 @@ simdt = 0.05
 performance_model = "openap"
 prefer_compiled = True            # use the C host extension when built
 data_path = _REF_DATA if os.path.isdir(_REF_DATA) else "data"
-navdata_path = os.path.join(data_path, "navdata")
-perf_path = os.path.join(data_path, "performance")
 cache_path = os.path.join(os.path.expanduser("~"), ".cache", "bluesky_tpu")
+navdata_path = os.path.join(data_path, "navdata")
+# `bluesky-tpu --import-navdata <dir>` copies a reference-format navdata
+# tree here; it backs standalone deployments when no mount is configured
+imported_navdata_path = os.path.join(cache_path, "navdata")
+if not os.path.isdir(navdata_path) and os.path.isdir(imported_navdata_path):
+    navdata_path = imported_navdata_path
+perf_path = os.path.join(data_path, "performance")
 log_path = "output"
 scenario_path = "scenario"
 # the reference's ~90-file scenario library, searched after the local
