@@ -200,6 +200,12 @@ class ScreenIO(DisplayState):
         data["nlos_tot"] = self._nlos_tot
         data["vmin"] = sim.cfg.asas.vmin
         data["vmax"] = sim.cfg.asas.vmax
+        # ASAS conflict geometry, so networked clients draw their SSD
+        # discs with the server's ACTUAL ZONER/DTLOOK instead of the
+        # defaults (the reference client hard-codes display constants —
+        # a silent divergence this stream field closes)
+        data["asasrpz"] = sim.cfg.asas.rpz_m
+        data["asasdtlook"] = sim.cfg.asas.dtlookahead
         # Trails: only the segments added since the last send
         # (screenio.py:216-227)
         trails = traf.trails

@@ -307,19 +307,10 @@ def register_all(stack):
             _setasas(reso_on=True)
             return True
         if m in ("MVP", "EBY", "SWARM", "SSD"):
-            # Resolver x backend availability (mirrors core/step.py):
-            # MVP/EBY run on every backend (pair-sum kernels), SWARM
-            # additionally on the lax 'tiled' backend, SSD dense-only.
-            backend = sim.cfg.cd_backend
-            allowed = {"dense": ("MVP", "EBY", "SWARM", "SSD"),
-                       "tiled": ("MVP", "EBY", "SWARM")}.get(
-                backend, ("MVP", "EBY"))
-            if m not in allowed:
-                return False, (f"RESO {m} is not available on CD backend "
-                               f"'{backend}' (supported there: "
-                               f"{'/'.join(allowed)}); use CDMETHOD "
-                               "DENSE" + ("/TILED" if m == "SWARM" else "")
-                               + f" for RESO {m}")
+            # Every resolver runs on every CD backend (reference
+            # asas.py:41-55 keeps CD and CR orthogonal): MVP/EBY via
+            # pair sums, SWARM via in-kernel neighbour sums, SSD from
+            # the partner table (cr_ssd.resolve_from_partners).
             _setasas(reso_on=True, reso_method=m)
             return True
         if m in ("OFF", "NONE", "DONOTHING"):
@@ -1035,19 +1026,28 @@ def register_all(stack):
         if not args:
             return True, "SSD ALL/CONFLICTS/OFF or SSD acid0,acid1,..."
         words = [str(a).upper() for a in args]
-        # validate callsigns before toggling (keywords pass through)
+        # validate callsigns before toggling (keywords pass through);
+        # a callsign already holding a disc may always be toggled OFF,
+        # even after the aircraft was deleted — otherwise only SSD OFF
+        # could ever clear its stale disc.
         acids = [w for w in words
                  if w not in ("ALL", "CONFLICTS", "OFF")]
+        selected = getattr(sim.scr, "ssd_ownship", set())
         for a in acids:
             i = traf.id2idx(a)
-            if not isinstance(i, int) or i < 0:
+            if (not isinstance(i, int) or i < 0) and a not in selected:
                 return False, f"{a}: aircraft not found"
         sim.scr.show_ssd(*words)
         if len(acids) == 1 and len(words) == 1:
+            a = acids[0]
+            if a not in getattr(sim.scr, "ssd_ownship", set()):
+                # toggle DEselected the disc: no occupancy report (it
+                # would imply the disc is still active)
+                return True, f"{a}: SSD disc deselected"
             from ..ui import radar
             ac = st().ac
             c = sim.cfg.asas
-            i = traf.id2idx(acids[0])
+            i = traf.id2idx(a)
             conf = radar.ssd_disc(
                 i, np.asarray(ac.lat), np.asarray(ac.lon),
                 np.asarray(ac.gseast), np.asarray(ac.gsnorth),
@@ -1057,7 +1057,7 @@ def register_all(stack):
             return True, f"SSD: {' '.join(words)}"
         occ = 100.0 * float(np.mean(conf))
         inconf = bool(np.asarray(st().asas.inconf)[i])
-        return True, (f"{acname(i)}: "
+        return True, (f"{acname(i)}: SSD disc selected; "
                       f"{'IN CONFLICT' if inconf else 'clear'}; "
                       f"{occ:.0f}% of the velocity envelope blocked")
 

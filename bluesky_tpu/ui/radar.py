@@ -377,9 +377,11 @@ def compute_ssd_discs_acdata(acdata, ssd_all, ssd_conflicts, ssd_ownship,
                              tlookahead=None):
     """SSD disc data from an ACDATA-shaped mirror (the GuiClient path:
     the reference's GL client computes its discs from the same streamed
-    arrays, radarwidget.py:728-765).  ASAS parameters default to the
-    AsasConfig defaults — the stream does not carry them, exactly like
-    the reference client's asas_vmin/vmax display constants."""
+    arrays, radarwidget.py:728-765).  ASAS parameters come from the
+    stream itself (ACDATA carries vmin/vmax/asasrpz/asasdtlook, so a
+    server-side ZONER/DTLOOK change is mirrored — unlike the reference
+    client's hard-coded display constants); explicit arguments override,
+    and AsasConfig defaults back an old producer without the fields."""
     if not (ssd_all or ssd_conflicts or ssd_ownship):
         return None
     lat = np.atleast_1d(acdata.get("lat", []))
@@ -387,10 +389,11 @@ def compute_ssd_discs_acdata(acdata, ssd_all, ssd_conflicts, ssd_ownship,
         return None
     from ..core.asas import AsasConfig
     _c = AsasConfig()
-    vmin = _c.vmin if vmin is None else vmin
-    vmax = _c.vmax if vmax is None else vmax
-    rpz_m = _c.rpz_m if rpz_m is None else rpz_m
-    tlookahead = _c.dtlookahead if tlookahead is None else tlookahead
+    vmin = acdata.get("vmin", _c.vmin) if vmin is None else vmin
+    vmax = acdata.get("vmax", _c.vmax) if vmax is None else vmax
+    rpz_m = acdata.get("asasrpz", _c.rpz_m) if rpz_m is None else rpz_m
+    tlookahead = acdata.get("asasdtlook", _c.dtlookahead) \
+        if tlookahead is None else tlookahead
     lon = np.atleast_1d(acdata["lon"])
     trk = np.radians(np.atleast_1d(acdata.get("trk",
                                               np.zeros(len(lat)))))

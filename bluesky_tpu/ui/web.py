@@ -161,19 +161,23 @@ _PAGE = """<!DOCTYPE html>
 """
 
 
-def _complete_line(line, stack=None):
+def _complete_line(line, stack=None, fileac=None):
     """Shared Tab-completion: {"line": completed, "hint": candidates}.
 
     First word incomplete -> command-name completion against the stack
     dictionary (when available); IC/BATCH -> scenario filename cycling
-    via ui/console.Autocomplete."""
+    via ui/console.Autocomplete.  ``fileac`` carries the caller's
+    Autocomplete instance so repeated Tab presses CYCLE (its _previous
+    glob state must survive between requests — a fresh instance per
+    request would re-complete the same common prefix forever)."""
     from . import console
     words = line.split()
     # filename completion only while the filename is being typed; a
     # line that already has a filename + further args passes through
     if words and words[0].upper() in ("IC", "BATCH") and len(words) <= 2:
         from .. import settings
-        ac = console.Autocomplete(settings.scenario_path)
+        ac = fileac if fileac is not None \
+            else console.Autocomplete(settings.scenario_path)
         newline, hint = ac.complete(line)
         return {"line": newline, "hint": hint}
     if stack is not None and line and " " not in line:
@@ -190,6 +194,38 @@ def _complete_line(line, stack=None):
         prefix = os.path.commonprefix(names)
         return {"line": prefix, "hint": ", ".join(names[:20])}
     return {"line": line, "hint": ""}
+
+
+_FILEAC_INIT_LOCK = threading.Lock()
+
+
+def _backend_complete(backend, line, stack=None):
+    """Per-backend completion holding ONE Autocomplete across requests
+    (reset when the typed line is not the one we last emitted, so a
+    fresh user edit restarts the cycle — reference autocomplete.py
+    semantics).  complete() runs on ThreadingHTTPServer handler
+    threads, so the shared cycling state is lock-guarded; like the
+    reference console there is ONE completion context per backend —
+    two browsers Tab-completing different lines at once take turns
+    resetting it, which is harmless (each reset just restarts that
+    line's cycle)."""
+    from . import console
+    from .. import settings
+    with _FILEAC_INIT_LOCK:
+        lock = getattr(backend, "_fileac_lock", None)
+        if lock is None:
+            lock = backend._fileac_lock = threading.Lock()
+    with lock:
+        ac = getattr(backend, "_fileac", None)
+        if ac is None:
+            ac = console.Autocomplete(settings.scenario_path)
+            backend._fileac = ac
+            backend._fileac_last = None
+        if line != backend._fileac_last:
+            ac.reset()
+        res = _complete_line(line, stack, fileac=ac)
+        backend._fileac_last = res["line"]
+        return res
 
 
 class SimBackend:
@@ -256,9 +292,9 @@ class SimBackend:
         """Tab completion: command names from the live dictionary,
         IC/BATCH scenario filenames through the console's Autocomplete
         engine (ui/console.py — the reference console's Tab behavior).
-        Reads only stable dicts/the filesystem, so it is safe off the
-        sim thread."""
-        return _complete_line(line, self.sim.stack)
+        Reads stable dicts/the filesystem plus the lock-guarded
+        completion-cycle state, so it is safe off the sim thread."""
+        return _backend_complete(self, line, self.sim.stack)
 
     def pump(self):
         """Run queued commands and refresh the frame cache — called on
@@ -373,7 +409,7 @@ class ClientBackend:
                 "todisplay": f"{lat:.4f},{lon:.4f} "}
 
     def complete(self, line):
-        return _complete_line(line)       # filename completion only
+        return _backend_complete(self, line)   # filename completion only
 
     def nd_frame(self):
         """Client-side ND: served from the pump-thread cache like

@@ -108,8 +108,26 @@ def convert(lines, rel_time: bool = True) -> List[str]:
         return []
     base = min(fl.t0 for fl in flights.values()) if rel_time else 0
     out: List[Tuple[float, str]] = []
+    # SO6 repeats callsigns across flight ids (that is why flights are
+    # keyed callsign:flightid) — but CRE needs a unique acid, or the
+    # second flight's aircraft silently fails to spawn at replay time.
+    # Repeated callsigns get a _2/_3... suffix, first occurrence keeps
+    # the bare name; suffixes are checked against BOTH already-emitted
+    # acids and every genuine callsign in the file, so a synthetic AB_2
+    # can never collide with a real flight named AB_2.
+    all_base = {k.split(":")[0] for k in flights}
+    used: set = set()
     for key, fl in flights.items():
-        acid = key.split(":")[0]
+        cs = key.split(":")[0]
+        acid = cs
+        k = 2
+        while acid in used or (acid != cs and acid in all_base):
+            acid = f"{cs}_{k}"
+            k += 1
+        used.add(acid)
+        if acid != cs:
+            print(f"so6: duplicate callsign {cs!r} — emitting as "
+                  f"{acid}", file=sys.stderr)
         _, tb, te, fl0, fl1, lat0, lon0, lat1, lon1, length = fl.segs[0]
         qdr, dist_nm = hostgeo.qdrdist(lat0, lon0, lat1, lon1)
         dur = max(te - tb, 1)
