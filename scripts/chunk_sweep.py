@@ -1,0 +1,34 @@
+"""VERDICT r4 #6: measure the 100k-continental headline's sensitivity to
+the scan-chunk length (refresh + dispatch amortization vs chunk).
+
+Runs the exact bench.run_one protocol at chunk = 20 / 100 / 400 / 1000
+steps (20 is the production Simulation default, 1000 the FF/BATCH
+headline protocol) and prints one JSON line per row; the table lands in
+docs/PERF_ANALYSIS.md and the protocol fields in BENCH_DETAIL rows.
+
+Usage: python scripts/chunk_sweep.py [N]
+"""
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+import bench  # noqa: E402
+
+
+def main(n_ac=100_000):
+    rows = []
+    for nsteps in (20, 100, 400, 1000):
+        r = bench.run_one(n_ac, backend=None, geometry="continental",
+                          nsteps=nsteps, reps=3)
+        r["nsteps_chunk"] = nsteps
+        r["protocol"] = "best-of-3, host re-sort per chunk"
+        rows.append(r)
+        print(json.dumps(r), flush=True)
+    with open("output/chunk_sweep.json", "w") as f:
+        json.dump(rows, f, indent=1)
+    return rows
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 100_000)
