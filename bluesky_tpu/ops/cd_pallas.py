@@ -90,8 +90,13 @@ def _kernel(reach_ref, row0_ref, own_ref, intr_ref,
     # Global row id of local row i is row0 + i*rstride (0/1 except
     # under shard_map, where each device owns a strided row subset of
     # the global grid but column/partner ids stay global; the stride
-    # interleaves rows across devices for load balance).
+    # interleaves rows across devices for load balance).  col0 offsets
+    # intruder ids the same way when the COLUMN slabs are a local halo
+    # window rather than the full grid (the domain-decomposition mesh
+    # mode of ops/cd_sched.py): DMA/reach indices stay local, global
+    # ids = (col0 + local block) * block + lane.
     row0 = row0_ref[0, 0]
+    col0 = row0_ref[0, 1]
 
     # Initialise the accumulators on the first intruder program; the
     # tile compute below is skipped entirely for unreachable tiles, so
@@ -118,7 +123,7 @@ def _kernel(reach_ref, row0_ref, own_ref, intr_ref,
 
         @pl.when(((reach_ref[ib % 8, jb // 32] >> (jb % 32)) & 1) > 0)
         def _compute(k=k, jb=jb):
-            _tile_body(ib, jb, k, own_ref, intr_ref, inconf_ref,
+            _tile_body(ib, col0 + jb, k, own_ref, intr_ref, inconf_ref,
                        tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref,
                        tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref,
                        cidx_ref, block=block, kk=kk, rpz=rpz, hpz=hpz,
@@ -441,6 +446,7 @@ def _kernel_resume(reach_ref, row0_ref, own_ref, intr_ref, pold_ref,
     ib = pl.program_id(0)
     jp = pl.program_id(1)
     row0 = row0_ref[0, 0]
+    col0 = row0_ref[0, 1]
 
     @pl.when(jp == 0)
     def _():
@@ -456,7 +462,7 @@ def _kernel_resume(reach_ref, row0_ref, own_ref, intr_ref, pold_ref,
 
         @pl.when(((reach_ref[ib % 8, jb // 32] >> (jb % 32)) & 1) > 0)
         def _compute(k=k, jb=jb):
-            _tile_body(ib, jb, k, own_ref, intr_ref, inconf_ref,
+            _tile_body(ib, col0 + jb, k, own_ref, intr_ref, inconf_ref,
                        tcpamax_ref, sdve_ref, sdvn_ref, sdvv_ref,
                        tsolv_ref, ncnt_ref, lcnt_ref, ctin_ref,
                        cidx_ref, block=block, kk=kk, rpz=rpz, hpz=hpz,
@@ -617,7 +623,7 @@ def interleave_rows(nb, ndev):
 
 def full_grid_pass(packed, reach, *, block, kk, cpp, kern_kw,
                    interpret=False, pold=None, rpz_m=None,
-                   packed_own=None, row0=None, rstride=1):
+                   packed_own=None, row0=None, rstride=1, col0=None):
     """Grid over ALL tile pairs; unreachable ones branch past the body.
 
     Several column tiles per grid program amortize the per-program
@@ -658,8 +664,12 @@ def full_grid_pass(packed, reach, *, block, kk, cpp, kern_kw,
         bits.reshape(nb8, nw, 32)
         << jnp.arange(32, dtype=jnp.uint32)[None, None, :],
         axis=2, dtype=jnp.uint32).astype(jnp.int32)
-    row0_arr = jnp.asarray(0 if row0 is None else row0,
-                           jnp.int32).reshape(1, 1)
+    # [row0, col0] ride one SMEM scalar pair; col0 offsets intruder ids
+    # when ``packed`` is a local halo window of the global grid (the
+    # cd_sched domain-decomposition mode) instead of the whole grid.
+    row0_arr = jnp.stack([
+        jnp.asarray(0 if row0 is None else row0, jnp.int32),
+        jnp.asarray(0 if col0 is None else col0, jnp.int32)]).reshape(1, 2)
     packed_f = packed
     if nbp != nbc:
         # Padded intruder buffer; the padded columns' reach bits are 0,
@@ -677,8 +687,8 @@ def full_grid_pass(packed, reach, *, block, kk, cpp, kern_kw,
     in_specs = [
         pl.BlockSpec((8, nw), lambda i, j: (i // 8, 0),
                      memory_space=pltpu.SMEM),       # reach window
-        pl.BlockSpec((1, 1), lambda i, j: (0, 0),
-                     memory_space=pltpu.SMEM),       # global row offset
+        pl.BlockSpec((1, 2), lambda i, j: (0, 0),
+                     memory_space=pltpu.SMEM),       # global row/col offsets
         pl.BlockSpec((1, _NF, block), lambda i, j: (i, 0, 0),
                      memory_space=pltpu.VMEM),       # ownship slab
         pl.BlockSpec((cpp, _NF, block), lambda i, j: (j, 0, 0),

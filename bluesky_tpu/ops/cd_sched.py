@@ -228,15 +228,18 @@ def build_windows(reach, s_cap, wmax, pad_start):
     """Cover each row's reachable columns with <= s_cap segments of
     <= wmax blocks.
 
-    ``reach`` [nb, nb] bool.  Returns ``(start, ln, overflow)``:
-    ``start``/``ln`` [nb, s_cap] int32 (unused slots: start=pad_start,
-    ln=0), ``overflow`` [nb] bool marking rows whose reachable set needs
-    more segments than s_cap — the caller covers those with the
+    ``reach`` [nbr, nbc] bool (square [nb, nb] on the single-grid
+    paths; rectangular when the rows are a subset of the columns, e.g.
+    a device's own rows against its halo window in the
+    domain-decomposition mesh mode).  Returns ``(start, ln, overflow)``:
+    ``start``/``ln`` [nbr, s_cap] int32 (unused slots: start=pad_start,
+    ln=0), ``overflow`` [nbr] bool marking rows whose reachable set
+    needs more segments than s_cap — the caller covers those with the
     full-grid fallback.  Covering a SUPERSET of reachable columns is
     always exact (extra tiles just compute provably-empty pairs), so the
     segmentation never needs to be tight, only sufficient.
     """
-    nb = reach.shape[0]
+    nb = reach.shape[1]
     col = jnp.arange(nb, dtype=jnp.int32)
     prev = jnp.pad(reach[:, :-1], ((0, 0), (1, 0)))
     nxt = jnp.pad(reach[:, 1:], ((0, 0), (0, 1)))

@@ -1,0 +1,227 @@
+"""Eby / Swarm / SSD resolvers on the blockwise CD backends vs the
+dense [N,N] oracle (split from test_cd_sched.py so pytest-xdist's
+loadscope distribution balances the two compile-heavy module groups
+across workers).
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from bluesky_tpu.ops import cd_sched, cd_tiled, cr_mvp
+
+pytestmark = pytest.mark.slow    # multi-minute lane (see pyproject)
+
+NM, FT = 1852.0, 0.3048
+
+
+def _clump_traffic(n, seed, spread=1.5, pair_matrix=True):
+    from bluesky_tpu.core.traffic import Traffic
+    rng = np.random.default_rng(seed)
+    traf = Traffic(nmax=n, dtype=jnp.float32, pair_matrix=pair_matrix)
+    lat = rng.uniform(52.6 - spread, 52.6 + spread, n)
+    lon = rng.uniform(5.4 - spread * 2, 5.4 + spread * 2, n)
+    traf.create(n, "B744", rng.uniform(3000.0, 11000.0, n),
+                rng.uniform(130.0, 240.0, n), None, lat, lon,
+                rng.uniform(0.0, 360.0, n))
+    traf.flush()
+    return traf
+
+
+def test_eby_large_n_backends_match_dense():
+    """RESO EBY on the lax-tiled and sparse backends vs the dense [N,N]
+    path (VERDICT r2 #5: large-N runs were MVP-only).  Eby's grazing
+    pairs amplify f32 input noise (scale = intrusion/(dstar*tstar) with
+    tstar -> 0 in LoS), so the commanded-track comparison is p99-based
+    with a loose max; the two blockwise backends must agree closely."""
+    import functools
+    from unittest import mock
+    from bluesky_tpu.core import asas as asasmod
+    from bluesky_tpu.core.asas import AsasConfig
+
+    traf = _clump_traffic(800, seed=21)
+    cfg = AsasConfig(reso_method="EBY")
+    st_dense, _ = asasmod.update(traf.state, cfg)
+    st_lax, _ = asasmod.update_tiled(traf.state, cfg, block=256, impl="lax")
+    with mock.patch.object(
+            cd_sched, "detect_resolve_sched",
+            functools.partial(cd_sched.detect_resolve_sched,
+                              interpret=True)):
+        st_sp0 = asasmod.refresh_spatial_sort(traf.state, cfg, block=256,
+                                              impl="sparse")
+        st_sp, _ = asasmod.update_tiled(st_sp0, cfg, block=256,
+                                        impl="sparse")
+
+    for st in (st_lax, st_sp):
+        assert bool(jnp.all(st.asas.inconf == st_dense.asas.inconf))
+        for f, p99tol, maxtol in (("trk", 0.3, 5.0), ("tas", 0.05, 1.0)):
+            d = np.abs(np.asarray(getattr(st.asas, f), np.float64)
+                       - np.asarray(getattr(st_dense.asas, f), np.float64))
+            if f == "trk":
+                d = np.minimum(d, 360.0 - d)
+            assert np.percentile(d, 99) < p99tol, (f, np.percentile(d, 99))
+            assert d.max() < maxtol, (f, d.max())
+    # The two blockwise backends share the tile math; only the tile
+    # REDUCTION ORDER differs (stripe-window vs sequential scan), which
+    # Eby's grazing-pair amplification can blow up on a few rows.
+    for f in ("trk", "tas"):
+        d = np.abs(np.asarray(getattr(st_lax.asas, f), np.float64)
+                   - np.asarray(getattr(st_sp.asas, f), np.float64))
+        if f == "trk":
+            d = np.minimum(d, 360.0 - d)
+        assert np.percentile(d, 99) < 0.3, (f, np.percentile(d, 99))
+        assert d.max() < 5.0, (f, d.max())
+
+
+def test_eby_no_nan_at_airspace_scale():
+    """The Eby quadratic overflowed f32 for pairs a few hundred km apart
+    (b^2 ~ 1e38) and the NaN leaked through masked sums; the rpz-unit
+    rescale must keep every command finite at continental separations."""
+    from bluesky_tpu.core import asas as asasmod
+    from bluesky_tpu.core.asas import AsasConfig
+    from bluesky_tpu.core.traffic import Traffic
+    rng = np.random.default_rng(3)
+    n = 400
+    traf = Traffic(nmax=n, dtype=jnp.float32, pair_matrix=True)
+    traf.create(n, "B744", rng.uniform(3000, 11000, n),
+                rng.uniform(130, 240, n), None,
+                rng.uniform(40.0, 60.0, n), rng.uniform(-10.0, 30.0, n),
+                rng.uniform(0, 360, n))
+    traf.flush()
+    st, _ = asasmod.update(traf.state, AsasConfig(reso_method="EBY"))
+    for f in ("trk", "tas", "vs", "alt"):
+        assert not np.isnan(np.asarray(getattr(st.asas, f))).any(), f
+
+
+def test_swarm_tiled_matches_dense():
+    """RESO SWARM on the lax tiled backend (MVP sums + 7 neighbour sums
+    accumulated blockwise, blended by cr_swarm.resolve_from_sums) vs the
+    dense matrix path."""
+    from bluesky_tpu.core import asas as asasmod
+    from bluesky_tpu.core.asas import AsasConfig
+
+    traf = _clump_traffic(700, seed=22)
+    cfg = AsasConfig(reso_method="SWARM")
+    st_dense, _ = asasmod.update(traf.state, cfg)
+    st_lax, _ = asasmod.update_tiled(traf.state, cfg, block=256, impl="lax")
+    assert bool(jnp.all(st_lax.asas.active == st_dense.asas.active))
+    for f in ("trk", "tas", "vs", "alt"):
+        d = np.abs(np.asarray(getattr(st_lax.asas, f), np.float64)
+                   - np.asarray(getattr(st_dense.asas, f), np.float64))
+        if f == "trk":
+            d = np.minimum(d, 360.0 - d)
+        assert d.max() < 0.1, (f, d.max())
+
+
+def test_swarm_pallas_sparse_match_dense():
+    """RESO SWARM on the Pallas and sparse kernels (VERDICT r4 #3: the
+    CR registry must be orthogonal to CD at any N — reference
+    asas.py:41-55).  The kernels accumulate the 7 neighbour sums in-tile
+    (cr_swarm.pair_weight traced into _tile_pairs, cas riding the 'tr'
+    slab slot) and the shared resolve_from_sums tail blends them, so
+    both must track the dense matrix path to f32 tolerance."""
+    from bluesky_tpu.core import asas as asasmod
+    from bluesky_tpu.core.asas import AsasConfig
+
+    traf = _clump_traffic(700, seed=22)
+    cfg = AsasConfig(reso_method="SWARM")
+    st_dense, _ = asasmod.update(traf.state, cfg)
+    st_pal, _ = asasmod.update_tiled(traf.state, cfg, block=256,
+                                     impl="pallas")
+    st_sp0 = asasmod.refresh_spatial_sort(traf.state, cfg, block=256,
+                                          impl="sparse")
+    st_sp, _ = asasmod.update_tiled(st_sp0, cfg, block=256, impl="sparse")
+    for name, st in (("pallas", st_pal), ("sparse", st_sp)):
+        assert bool(jnp.all(st.asas.active == st_dense.asas.active)), name
+        for f in ("trk", "tas", "vs", "alt"):
+            d = np.abs(np.asarray(getattr(st.asas, f), np.float64)
+                       - np.asarray(getattr(st_dense.asas, f), np.float64))
+            if f == "trk":
+                d = np.minimum(d, 360.0 - d)
+            assert d.max() < 0.1, (name, f, d.max())
+
+
+def _pairs_scene(m=12, alt=8000.0, sep_deg=3.0):
+    """m isolated head-on conflict pairs, clusters far beyond ADS-B
+    range of each other — scenes where the partner table provably covers
+    every VO contributor, so blockwise SSD must equal the dense path."""
+    from bluesky_tpu.core.traffic import Traffic
+    n = 2 * m
+    traf = Traffic(nmax=n, dtype=jnp.float32, pair_matrix=True)
+    lats, lons, hdgs = [], [], []
+    for i in range(m):
+        lat0 = 40.0 + sep_deg * i
+        lats += [lat0, lat0]
+        lons += [5.0, 5.2]
+        hdgs += [90.0, 270.0]
+    traf.create(n, "B744", [alt] * n, [140.0] * n, None, lats, lons, hdgs)
+    traf.flush()
+    return traf
+
+
+@pytest.mark.parametrize("rule", ["RS1", "RS2", "RS5", "RS6", "RS7", "RS9"])
+def test_ssd_blockwise_matches_dense(rule):
+    """RESO SSD on every blockwise backend vs the dense path (VERDICT r4
+    #3).  The partner-table VO construction (cr_ssd.resolve_from_partners)
+    is exact whenever the table covers all in-range intruders — which
+    isolated conflict pairs guarantee — so tracks/speeds must match the
+    dense resolver bit-for-bit up to the f32 pair geometry."""
+    from bluesky_tpu.core import asas as asasmod
+    from bluesky_tpu.core.asas import AsasConfig
+
+    traf = _pairs_scene()
+    cfg = AsasConfig(reso_method="SSD", swprio=rule != "RS1",
+                     priocode=rule)
+    st_dense, _ = asasmod.update(traf.state, cfg)
+    inconf = np.asarray(st_dense.asas.inconf)
+    assert inconf.sum() == 24        # every pair in conflict
+    st_lax, _ = asasmod.update_tiled(traf.state, cfg, block=256,
+                                     impl="lax")
+    st_pal, _ = asasmod.update_tiled(traf.state, cfg, block=256,
+                                     impl="pallas")
+    st_sp0 = asasmod.refresh_spatial_sort(traf.state, cfg, block=256,
+                                          impl="sparse")
+    st_sp, _ = asasmod.update_tiled(st_sp0, cfg, block=256, impl="sparse")
+    for name, st in (("lax", st_lax), ("pallas", st_pal),
+                     ("sparse", st_sp)):
+        assert bool(jnp.all(st.asas.inconf == st_dense.asas.inconf)), name
+        dtrk = np.abs(np.asarray(st.asas.trk)
+                      - np.asarray(st_dense.asas.trk))
+        dtrk = np.minimum(dtrk, 360.0 - dtrk)[inconf]
+        dtas = np.abs(np.asarray(st.asas.tas)
+                      - np.asarray(st_dense.asas.tas))[inconf]
+        assert dtrk.max() < 0.05, (name, rule, dtrk.max())
+        assert dtas.max() < 0.1, (name, rule, dtas.max())
+
+
+def test_ssd_sparse_cluster_and_scale():
+    """SSD on the sparse backend in a multi-conflict clump: commands
+    must stay finite, in-conflict aircraft must get VO-clear velocities
+    against their tabled partners, and repeated intervals must not
+    diverge (the partner table is the in-kernel merged fresh+engaged
+    set).  Also exercises n in the multi-block schedule regime."""
+    from bluesky_tpu.core import asas as asasmod
+    from bluesky_tpu.core.asas import AsasConfig
+
+    traf = _clump_traffic(1500, seed=7, spread=0.8, pair_matrix=False)
+    cfg = AsasConfig(reso_method="SSD")
+    st = asasmod.refresh_spatial_sort(traf.state, cfg, block=256,
+                                      impl="sparse")
+    for _ in range(3):
+        st, rd = asasmod.update_tiled(st, cfg, block=256, impl="sparse")
+    assert int(rd.nconf) > 0
+    inconf = np.asarray(st.asas.inconf)
+    assert inconf.any()
+    for f in ("trk", "tas"):
+        v = np.asarray(getattr(st.asas, f))[inconf]
+        assert np.isfinite(v).all(), f
+    # Commanded speeds live in the candidate set: the [vmin, vmax] polar
+    # grid plus the two per-aircraft specials (current / AP velocity,
+    # which may sit outside the envelope — same as the dense resolver).
+    tas = np.asarray(st.asas.tas)[inconf]
+    own = np.asarray(st.ac.gs)[inconf]
+    ap = np.asarray(st.ap.tas)[inconf]
+    hi = np.maximum(float(cfg.vmax), np.maximum(own, ap))
+    lo = np.minimum(float(cfg.vmin), np.minimum(own, ap))
+    assert (tas >= lo - 1e-3).all()
+    assert (tas <= hi + 1e-3).all()
