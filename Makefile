@@ -1,7 +1,7 @@
 # Developer entry points (parity: /root/reference/Makefile — test/lint/
 # build/dist/clean/install; bench and check are this framework's own).
 .PHONY: all test test-fast lint build dist clean install uninstall \
-	bench check ext
+	bench check ext chaos
 
 PYTHON=python3
 
@@ -20,6 +20,14 @@ test:
 test-fast:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	$(PYTHON) -m pytest tests/ -q -m 'not slow' $(XDIST)
+
+# Fault-injection lane: the full chaos suite (tests/test_chaos.py,
+# docs/FAULT_TOLERANCE.md recovery matrix) plus the slow fabric cases
+# (kill -9 a real worker mid-BATCH, silent-worker reaping).
+chaos:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PYTHON) -m pytest tests/test_chaos.py tests/test_fabric_hardening.py \
+	-q $(XDIST)
 
 lint:
 	@$(PYTHON) -m pyflakes bluesky_tpu tests 2>/dev/null \

@@ -121,6 +121,10 @@ def run_import_navdata(args):
             print(f"  removed stale {n}")
     if os.path.isdir(os.path.join(dest, "fir")):
         shutil.rmtree(os.path.join(dest, "fir"))
+        if not has_fir:
+            # match the per-file removal messages: a re-import from a
+            # source without fir/ must say it dropped the old FIRs
+            print("  removed stale fir/")
     for n in present:
         shutil.copy2(os.path.join(src, n), os.path.join(dest, n))
         print(f"  copied {n}")

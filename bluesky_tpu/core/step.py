@@ -174,4 +174,51 @@ def run_steps(state: SimState, cfg: SimConfig, nsteps: int) -> SimState:
     return state
 
 
+#: Per-aircraft fields the in-scan integrity guard watches.  A non-finite
+#: value anywhere in the pipeline reaches one of these within a step or
+#: two (vs -> alt, trk/gsnorth/gseast -> lat/lon, thrust/drag -> tas), so
+#: guarding the kinematic outputs bounds detection latency to ~one step
+#: while keeping the check to a single fused reduce.
+GUARD_FIELDS = ("lat", "lon", "alt", "tas", "gs", "vs")
+
+
+def state_finite(state: SimState) -> jnp.ndarray:
+    """Scalar bool: every guarded field is finite on the live rows.
+
+    Padding rows are excluded: they hold whatever the freeze preserved
+    and are masked everywhere downstream, so only live-row corruption
+    counts as a trip.
+    """
+    ac = state.ac
+    bad = jnp.zeros_like(ac.active)
+    for f in GUARD_FIELDS:
+        bad |= ~jnp.isfinite(getattr(ac, f))
+    return ~jnp.any(bad & ac.active)
+
+
+@partial(jax.jit, static_argnames=("cfg", "nsteps"), donate_argnums=0)
+def run_steps_checked(state: SimState, cfg: SimConfig, nsteps: int):
+    """``run_steps`` with the state-integrity guard folded into the scan
+    carry: returns ``(state, bad_step)`` where ``bad_step`` is the index
+    of the FIRST step (0-based within the chunk) whose post-step state
+    had a non-finite guarded value on a live row, or -1 for a clean
+    chunk.  The per-step cost is one fused isfinite all-reduce over the
+    guarded [N] columns — measured < 2% of the full pipeline at N=100k
+    (BENCH_GUARD.json) — and the step index gives the host the bisection
+    for free: the fault is pinned to one simdt without re-running the
+    chunk.
+    """
+    def body(carry, i):
+        s, bad = carry
+        s = step(s, cfg)
+        bad = jnp.where(bad >= 0, bad,
+                        jnp.where(state_finite(s), -1, i))
+        return (s, bad), None
+
+    (state, bad), _ = jax.lax.scan(
+        body, (state, jnp.full((), -1, jnp.int32)),
+        jnp.arange(nsteps, dtype=jnp.int32))
+    return state, bad
+
+
 step_jit = jax.jit(step, static_argnames=("cfg",))

@@ -1,0 +1,25 @@
+"""Fault-injection harness + state-integrity guard (chaos engineering).
+
+A production fleet must survive in-sim corruption (NaN/Inf propagating
+through the vmapped step), poison-pill scenarios that crash workers in a
+loop, and flaky transport.  This package provides both sides of that
+story:
+
+* ``guard``     — the IntegrityGuard the Simulation consults at chunk
+                  edges: detect (in-scan isfinite carry, core/step.py),
+                  then quarantine the poisoned aircraft or roll the
+                  whole state back to a snapshot-ring checkpoint.
+* ``injectors`` — the chaos toolbox: NaN/Inf-in-state, dropped/delayed/
+                  duplicated ZMQ frames, kill -9 the worker, stalled
+                  event loops, truncated snapshot files.
+* ``harness``   — the FAULT stack command binding the injectors to a
+                  running sim/worker, driving the chaos test suite
+                  (tests/test_chaos.py, ``make chaos``).
+
+The recovery matrix (fault x detection x response x test) is documented
+in docs/FAULT_TOLERANCE.md.
+"""
+from .guard import IntegrityGuard                      # noqa: F401
+from .injectors import (FlakySocket, inject_nonfinite,  # noqa: F401
+                        truncate_file)
+from .harness import fault_command                     # noqa: F401

@@ -1,0 +1,154 @@
+"""The FAULT stack command: chaos injection on a running sim/worker.
+
+Usage (stack/commands.py registers it):
+
+  FAULT                      status: guard, ring, transport faults, trips
+  FAULT NAN [acid]           poison an aircraft's state with NaN
+  FAULT INF [acid]           poison an aircraft's state with +Inf
+  FAULT GUARD ON/OFF         enable/disable the integrity guard
+  FAULT GUARD QUARANTINE/ROLLBACK/HALT   set the recovery policy
+  FAULT RING [depth] [dt]    report / configure the snapshot ring
+  FAULT DROP p               drop outgoing event frames with prob p
+  FAULT DUP p                duplicate outgoing event frames with prob p
+  FAULT DELAY sec            delay outgoing event frames by sec
+  FAULT NETOFF               remove transport faults
+  FAULT STALL sec            stall this worker's event loop for sec
+  FAULT KILL                 SIGKILL this worker (no goodbye)
+  FAULT SNAPTRUNC fname [keep]  truncate a snapshot file (torn write)
+  FAULT LIST                 guard trip history
+
+Transport faults need a networked worker (``sim.node``); on a detached
+sim they return a command error instead of injecting nothing silently.
+"""
+from . import injectors
+
+
+def _node(sim):
+    """The sim's network endpoint, or None when there is no event
+    socket to degrade (detached/embedded sims)."""
+    node = getattr(sim, "node", None)
+    return node if getattr(node, "event_io", None) is not None else None
+
+
+def _status(sim):
+    g = sim.guard
+    lines = [f"guard: {'ON' if g.enabled else 'OFF'} "
+             f"(policy {g.policy}), trips: {len(g.trips)}",
+             f"ring: {len(sim.snap_ring)}/{sim.snap_ring.depth} "
+             f"snapshots, dt={sim.snap_ring.dt:g} s"]
+    node = _node(sim)
+    sock = getattr(node, "event_io", None)
+    if isinstance(sock, injectors.FlakySocket):
+        lines.append(f"transport: drop={sock.p_drop:g} dup={sock.p_dup:g} "
+                     f"delay={sock.delay_s:g}s (sent {sock.n_sent}, "
+                     f"dropped {sock.n_dropped}, duped {sock.n_duped}, "
+                     f"delayed {sock.n_delayed})")
+    else:
+        lines.append("transport: clean")
+    return True, "\n".join(lines)
+
+
+def fault_command(sim, *args):
+    if not args:
+        return _status(sim)
+    sub = str(args[0]).upper()
+    rest = [str(a) for a in args[1:]]
+
+    if sub in ("NAN", "INF"):
+        value = float("nan") if sub == "NAN" else float("inf")
+        try:
+            slot, acid = injectors.inject_nonfinite(
+                sim, rest[0] if rest else None, value)
+        except ValueError as e:
+            return False, str(e)
+        return True, (f"FAULT: injected {sub} into {acid} (slot {slot}) — "
+                      f"guard {'armed' if sim.guard.enabled else 'OFF'}")
+
+    if sub == "GUARD":
+        if not rest:
+            return True, (f"guard is {'ON' if sim.guard.enabled else 'OFF'}"
+                          f" (policy {sim.guard.policy})")
+        arg = rest[0].upper()
+        if arg in ("ON", "TRUE", "1"):
+            sim.guard.enabled = True
+            return True, "guard ON"
+        if arg in ("OFF", "FALSE", "0"):
+            sim.guard.enabled = False
+            return True, "guard OFF"
+        if sim.guard.set_policy(arg):
+            return True, f"guard policy {sim.guard.policy}"
+        return False, "FAULT GUARD ON/OFF/QUARANTINE/ROLLBACK/HALT"
+
+    if sub == "RING":
+        ring = sim.snap_ring
+        if rest:
+            try:
+                depth = int(float(rest[0]))
+                if len(rest) > 1:
+                    ring.dt = float(rest[1])
+            except ValueError:
+                return False, "FAULT RING [depth] [dt]"
+            if depth != ring.depth:
+                import collections
+                ring.depth = max(1, depth)
+                ring._ring = collections.deque(ring._ring,
+                                               maxlen=ring.depth)
+        ts = ", ".join(f"{t:.1f}" for t in ring.simts) or "-"
+        return True, (f"ring: depth {ring.depth}, dt {ring.dt:g} s, "
+                      f"held simt [{ts}]")
+
+    if sub in ("DROP", "DUP", "DELAY"):
+        node = _node(sim)
+        if node is None:
+            return False, f"FAULT {sub}: no network node (detached sim)"
+        try:
+            p = float(rest[0]) if rest else 0.0
+        except ValueError:
+            return False, f"FAULT {sub} value"
+        kw = {"DROP": "p_drop", "DUP": "p_dup", "DELAY": "delay_s"}[sub]
+        from .. import settings
+        flaky = injectors.install_flaky(
+            node, seed=int(getattr(settings, "fault_seed", 0)), **{kw: p})
+        return True, (f"FAULT: event transport drop={flaky.p_drop:g} "
+                      f"dup={flaky.p_dup:g} delay={flaky.delay_s:g}s")
+
+    if sub in ("NETOFF", "OFF"):
+        node = _node(sim)
+        if node is not None and injectors.remove_flaky(node):
+            return True, "FAULT: transport faults removed"
+        return True, "FAULT: transport already clean"
+
+    if sub == "STALL":
+        try:
+            sec = float(rest[0]) if rest else 1.0
+        except ValueError:
+            return False, "FAULT STALL seconds"
+        injectors.stall(sec)
+        return True, f"FAULT: stalled {sec:g} s"
+
+    if sub == "KILL":
+        injectors.kill_self()          # no return: SIGKILL
+
+    if sub == "SNAPTRUNC":
+        if not rest:
+            return False, "FAULT SNAPTRUNC filename [keep_fraction]"
+        import os
+        fname = rest[0]
+        if not fname.lower().endswith(".snap"):
+            fname += ".snap"
+        if not os.path.isfile(fname):
+            return False, f"{fname}: not found"
+        keep = float(rest[1]) if len(rest) > 1 else 0.5
+        size = injectors.truncate_file(fname, keep)
+        return True, f"FAULT: truncated {fname} to {size} bytes"
+
+    if sub == "LIST":
+        if not sim.guard.trips:
+            return True, "no guard trips"
+        return True, "\n".join(
+            f"simt {t['simt']:.2f}: step {t['bad_step']}/{t['chunk']} "
+            f"{t['action']} [{','.join(t['ids']) or '-'}]"
+            for t in sim.guard.trips)
+
+    return False, ("FAULT NAN/INF [acid] | GUARD .. | RING .. | DROP/DUP/"
+                   "DELAY p | NETOFF | STALL s | KILL | SNAPTRUNC f | LIST")

@@ -1,0 +1,155 @@
+"""Chaos injectors: state poisoning, flaky transport, process faults.
+
+Each injector models ONE fault class from the failure model in
+docs/FAULT_TOLERANCE.md; the FAULT stack command (harness.py) binds them
+to a running sim, and tests/test_chaos.py drives them directly.  All are
+deterministic under a seeded RNG so chaos runs replay.
+"""
+import os
+import signal
+import time
+
+import numpy as np
+
+
+# --------------------------------------------------------- state poisoning
+def inject_nonfinite(sim, acid=None, value=float("nan"), fields=None):
+    """Poison guarded state fields of one aircraft with NaN/Inf.
+
+    Models silent device-state corruption (bad wind data, a kernel bug,
+    a bitflip): the value is written straight into the device pytree, so
+    the ONLY thing that can catch it is the in-scan integrity guard.
+    Returns (slot, acid) of the poisoned aircraft.
+    """
+    traf = sim.traf
+    traf.flush()
+    if acid:
+        slot = traf.id2idx(str(acid))
+        if not isinstance(slot, int) or slot < 0:
+            raise ValueError(f"{acid}: aircraft not found")
+    else:
+        live = [i for i, v in enumerate(traf.ids) if v is not None]
+        if not live:
+            raise ValueError("no aircraft to poison")
+        slot = live[0]
+    from ..core.step import GUARD_FIELDS
+    fields = tuple(fields or GUARD_FIELDS[:1] + ("tas",))
+    st = traf.state
+    ac = st.ac
+    upd = {f: getattr(ac, f).at[slot].set(value) for f in fields}
+    traf.state = st.replace(ac=ac.replace(**upd))
+    return slot, traf.ids[slot]
+
+
+# --------------------------------------------------------- flaky transport
+class FlakySocket:
+    """Transport-fault wrapper over a ZMQ socket: drop / duplicate /
+    delay outgoing multipart frames with seeded probabilities.
+
+    Installed over a Node/Client event socket by ``FAULT DROP/DUP/
+    DELAY``; everything except ``send_multipart`` delegates to the
+    wrapped socket, so the endpoint code never knows.  Delayed frames
+    are buffered and released by the next send (or an explicit
+    ``flush``), modelling reordering-free late delivery.  Counters
+    (``n_sent/n_dropped/n_duped/n_delayed``) make the chaos observable.
+    """
+
+    def __init__(self, sock, p_drop=0.0, p_dup=0.0, delay_s=0.0, seed=0):
+        self._sock = sock
+        self.p_drop = float(p_drop)
+        self.p_dup = float(p_dup)
+        self.delay_s = float(delay_s)
+        self._rng = np.random.default_rng(seed)
+        self._held = []            # [(release_time, frames, kwargs)]
+        self.n_sent = 0
+        self.n_dropped = 0
+        self.n_duped = 0
+        self.n_delayed = 0
+
+    def __getattr__(self, name):
+        return getattr(self._sock, name)
+
+    @property
+    def wrapped(self):
+        return self._sock
+
+    def flush(self, force=False):
+        """Release every held frame whose delay has expired (all of
+        them with ``force`` — the uninstall path must not lose frames
+        that were merely late)."""
+        now = time.monotonic()
+        due = [h for h in self._held if force or h[0] <= now]
+        self._held = [] if force else [h for h in self._held
+                                       if h[0] > now]
+        for _, frames, kwargs in due:
+            self._sock.send_multipart(frames, **kwargs)
+            self.n_sent += 1
+
+    def send_multipart(self, frames, **kwargs):
+        self.flush()
+        if self.p_drop > 0 and self._rng.random() < self.p_drop:
+            self.n_dropped += 1
+            return
+        if self.delay_s > 0:
+            self._held.append((time.monotonic() + self.delay_s,
+                               list(frames), kwargs))
+            self.n_delayed += 1
+            return
+        self._sock.send_multipart(frames, **kwargs)
+        self.n_sent += 1
+        if self.p_dup > 0 and self._rng.random() < self.p_dup:
+            self._sock.send_multipart(frames, **kwargs)
+            self.n_duped += 1
+
+
+def install_flaky(endpoint, attr="event_io", **kw):
+    """Wrap ``endpoint.<attr>`` in a FlakySocket (idempotent: re-wrapping
+    updates the probabilities on the existing wrapper)."""
+    sock = getattr(endpoint, attr)
+    if isinstance(sock, FlakySocket):
+        sock.p_drop = float(kw.get("p_drop", sock.p_drop))
+        sock.p_dup = float(kw.get("p_dup", sock.p_dup))
+        sock.delay_s = float(kw.get("delay_s", sock.delay_s))
+        return sock
+    flaky = FlakySocket(sock, **kw)
+    setattr(endpoint, attr, flaky)
+    return flaky
+
+
+def remove_flaky(endpoint, attr="event_io"):
+    """Undo ``install_flaky``: flush ALL held frames (even not-yet-due
+    ones — restoring the transport must not lose them), restore the
+    raw socket."""
+    sock = getattr(endpoint, attr)
+    if isinstance(sock, FlakySocket):
+        sock.delay_s = 0.0
+        sock.flush(force=True)
+        setattr(endpoint, attr, sock.wrapped)
+        return True
+    return False
+
+
+# ----------------------------------------------------------- process faults
+def kill_self():
+    """SIGKILL the current process — the poison-pill / OOM-killer model.
+    No goodbye, no linger: the server must detect the death via child
+    exit / PING silence and requeue this worker's BATCH piece."""
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def stall(seconds: float):
+    """Block the calling thread — the stuck-event-loop model (GC pause,
+    NFS hang, a runaway host callback).  The node watchdog
+    (network/node.py) is the detector."""
+    time.sleep(float(seconds))
+
+
+# ------------------------------------------------------------- file faults
+def truncate_file(fname: str, keep_fraction: float = 0.5) -> int:
+    """Truncate a file (snapshot, log) to a fraction of its size —
+    the torn-write / disk-full model.  Returns the new size."""
+    size = os.path.getsize(fname)
+    new = int(size * float(keep_fraction))
+    with open(fname, "r+b") as f:
+        f.truncate(new)
+    return new

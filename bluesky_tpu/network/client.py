@@ -37,22 +37,51 @@ class Client:
 
     # ----------------------------------------------------------- connection
     def connect(self, host="127.0.0.1", event_port=DEFAULT_PORTS["event"],
-                stream_port=DEFAULT_PORTS["stream"], timeout=5.0):
+                stream_port=DEFAULT_PORTS["stream"], timeout=5.0,
+                backoff_base=None, backoff_cap=None):
+        """REGISTER handshake with exponential backoff + jitter.
+
+        A dropped or late server (not yet bound, restarting, a dropped
+        REGISTER frame) is survived by re-sending REGISTER with the
+        per-attempt wait growing ``backoff_base * 2^k`` up to
+        ``backoff_cap``, plus 0-25% random jitter so a fleet of clients
+        re-registering after a server restart does not stampede in sync.
+        Total wall time stays bounded by ``timeout``; attempts are
+        counted in ``self.connect_attempts``.
+        """
+        from .. import settings
+        import random
+        base = backoff_base if backoff_base is not None \
+            else getattr(settings, "connect_backoff_base", 0.25)
+        cap = backoff_cap if backoff_cap is not None \
+            else getattr(settings, "connect_backoff_cap", 4.0)
         self.event_io.connect(f"tcp://{host}:{event_port}")
         self.stream_in.connect(f"tcp://{host}:{stream_port}")
-        self.send_event(b"REGISTER", target=b"")
-        t0 = time.perf_counter()
-        while time.perf_counter() - t0 < timeout:
-            if self.event_io.poll(100):
-                route, name, payload = split_envelope(
-                    self.event_io.recv_multipart())
-                if name == b"REGISTER":
-                    data = unpackb(payload)
-                    self.host_id = data["host_id"]
-                    self._set_nodes(data["nodes"])
-                    return
-                self._dispatch(route, name, payload)
-        raise TimeoutError("no REGISTER reply from server")
+        deadline = time.perf_counter() + timeout
+        delay = max(1e-3, float(base))
+        self.connect_attempts = 0
+        while time.perf_counter() < deadline:
+            self.connect_attempts += 1
+            self.send_event(b"REGISTER", target=b"")
+            # wait one backoff interval (bounded by the deadline) for
+            # the handshake ack before re-sending
+            t_end = min(deadline,
+                        time.perf_counter() + delay * (1.0
+                                                       + 0.25 * random.random()))
+            while time.perf_counter() < t_end:
+                if self.event_io.poll(50):
+                    route, name, payload = split_envelope(
+                        self.event_io.recv_multipart())
+                    if name == b"REGISTER":
+                        data = unpackb(payload)
+                        self.host_id = data["host_id"]
+                        self._set_nodes(data["nodes"])
+                        return
+                    self._dispatch(route, name, payload)
+            delay = min(delay * 2.0, float(cap))
+        raise TimeoutError(
+            f"no REGISTER reply from server after "
+            f"{self.connect_attempts} attempts in {timeout:.1f} s")
 
     def close(self):
         self.event_io.close()
@@ -119,7 +148,10 @@ class Client:
 
     def _dispatch(self, route, name, payload):
         data = unpackb(payload) if payload else None
-        if name == b"NODESCHANGED":
+        if name in (b"NODESCHANGED", b"REGISTER"):
+            # REGISTER here is the late ack of a retried handshake
+            # (backoff re-sends): absorb it as a node-table refresh
+            # instead of surfacing a duplicate handshake event
             self.host_id = data["host_id"]
             self._set_nodes(data["nodes"])
         else:

@@ -9,11 +9,67 @@ route (see server.py for the rotation rule).  Streams are PUB frames
 ``[name + node_id, payload]`` so SUB prefix-matching selects by stream name
 (and optionally by node).
 """
+import os
+import threading
+import time
+
 import zmq
 
 from ..utils.timer import Timer
 from .common import DEFAULT_PORTS, make_id
 from .npcodec import packb, unpackb
+
+
+class EventLoopWatchdog(threading.Thread):
+    """Detects a stalled worker event loop (GC pause, NFS hang, runaway
+    host callback, FAULT STALL): the run loop ``beat()``s every
+    iteration; if no beat lands for ``warn_after`` seconds the watchdog
+    prints a warning and records the stall, and — when ``kill_after`` is
+    set — exits the process with code 70 after that long, so the server
+    reaps the silent worker, requeues its BATCH piece and respawns.
+
+    ``kill_after`` defaults OFF: a first-compile of the big sharded
+    programs can legitimately block the loop for minutes, and the
+    server's busy-worker PING budget (10x hb_timeout, server.py) already
+    covers pong-silence — the kill switch is for deployments that prefer
+    fail-fast workers (settings.node_watchdog_kill).
+    """
+
+    def __init__(self, warn_after=30.0, kill_after=0.0, name=""):
+        super().__init__(daemon=True)
+        self.warn_after = float(warn_after)
+        self.kill_after = float(kill_after)
+        self.tag = name
+        self.stalls = []             # [(stamp, silence_s)] observed stalls
+        self._beat = time.monotonic()
+        self._stop = threading.Event()
+        self._warned = False
+
+    def beat(self):
+        self._beat = time.monotonic()
+        self._warned = False
+
+    def stop(self):
+        self._stop.set()
+
+    def run(self):
+        ref = self.warn_after if self.warn_after > 0 else self.kill_after
+        interval = max(0.1, min(1.0, ref / 4.0))
+        while not self._stop.wait(interval):
+            silence = time.monotonic() - self._beat
+            if self.kill_after > 0 and silence > self.kill_after:
+                print(f"watchdog{self.tag}: event loop silent "
+                      f"{silence:.1f} s > kill_after="
+                      f"{self.kill_after:.1f} s — exiting 70 so the "
+                      "server respawns this worker", flush=True)
+                os._exit(70)
+            if self.warn_after > 0 and silence > self.warn_after \
+                    and not self._warned:
+                self._warned = True
+                self.stalls.append((time.monotonic(), silence))
+                print(f"watchdog{self.tag}: event loop stalled "
+                      f"{silence:.1f} s (> {self.warn_after:.1f} s)",
+                      flush=True)
 
 
 def split_envelope(frames):
@@ -30,13 +86,20 @@ class Node:
 
     def __init__(self, event_port: int = DEFAULT_PORTS["wevent"],
                  stream_port: int = DEFAULT_PORTS["wstream"],
-                 host: str = "127.0.0.1", node_id: bytes = None):
+                 host: str = "127.0.0.1", node_id: bytes = None,
+                 watchdog_warn: float = None, watchdog_kill: float = None):
         # node_id may be assigned by the spawning server (so it can map
         # its child process to the registered worker for crash
         # detection); self-started nodes generate their own.
         self.node_id = node_id or make_id()
         self.host_id = b""        # filled by REGISTER reply
         self.running = False
+        from .. import settings
+        self._wd_warn = watchdog_warn if watchdog_warn is not None \
+            else getattr(settings, "node_watchdog_warn", 30.0)
+        self._wd_kill = watchdog_kill if watchdog_kill is not None \
+            else getattr(settings, "node_watchdog_kill", 0.0)
+        self.watchdog = None      # started by run()
         ctx = zmq.Context.instance()
         self.event_io = ctx.socket(zmq.DEALER)
         self.event_io.setsockopt(zmq.IDENTITY, self.node_id)
@@ -67,6 +130,25 @@ class Node:
 
     def send_stream(self, name: bytes, data):
         self.stream_out.send_multipart([name + self.node_id, packb(data)])
+
+    # ----------------------------------------------------------- watchdog
+    def _watchdog_start(self):
+        # either knob arms the thread: warn=0 + kill>0 is the
+        # "fail-fast quietly" deployment and must still exit on a stall
+        if (self._wd_warn > 0 or self._wd_kill > 0) \
+                and self.watchdog is None:
+            self.watchdog = EventLoopWatchdog(
+                self._wd_warn, self._wd_kill,
+                name=f"[{self.node_id.hex()[:8]}]")
+            self.watchdog.start()
+
+    def _watchdog_beat(self):
+        if self.watchdog is not None:
+            self.watchdog.beat()
+
+    def _watchdog_stop(self):
+        if self.watchdog is not None:
+            self.watchdog.stop()
 
     # ------------------------------------------------------------ overrides
     def event(self, name: bytes, data, sender_route):
@@ -99,13 +181,28 @@ class Node:
                 self.event(name, data, route)
 
     def run(self):
-        """Blocking loop: events -> step -> wall-clock timers (node.py:55-80)."""
+        """Blocking loop: events -> step -> wall-clock timers (node.py:55-80).
+
+        The loop beats the event-loop watchdog every iteration; a stall
+        anywhere in events/step (FAULT STALL, a wedged host callback)
+        is detected and reported — and, when node_watchdog_kill is set,
+        turned into a clean exit(70) the server recovers from.
+        """
         self.running = True
         self.connect()
-        while self.running:
-            self.process_events(timeout_ms=1)
-            self.step()
-            Timer.update_timers()
+        self._watchdog_start()
+        try:
+            while self.running:
+                self._watchdog_beat()
+                self.process_events(timeout_ms=1)
+                self.step()
+                Timer.update_timers()
+        finally:
+            # the watchdog must die with the loop even on an exception:
+            # with kill_after armed, an orphaned watchdog would
+            # os._exit(70) the process mid-traceback (or kill an
+            # embedding host that had caught and recovered)
+            self._watchdog_stop()
         # tell the server we are gone, then tear down
         self.send_event(b"STATECHANGE", -1)
         self.close()

@@ -142,9 +142,14 @@ class MTNode(Node):
         reachable, which is the point of the threaded flavor."""
         self.running = True
         self.connect()
-        while self.running:
-            self.process_events(timeout_ms=1)
-            self.step()
-            Timer.update_timers()
+        self._watchdog_start()
+        try:
+            while self.running:
+                self._watchdog_beat()
+                self.process_events(timeout_ms=1)
+                self.step()
+                Timer.update_timers()
+        finally:
+            self._watchdog_stop()   # see Node.run: must not outlive loop
         self.send_event(b"STATECHANGE", -1)
         self.close()

@@ -63,7 +63,7 @@ class Server(threading.Thread):
     def __init__(self, headless=False, discoverable=False,
                  ports=None, max_nnodes=None, spawn_workers=True,
                  upstream=None, hb_interval=2.0, hb_timeout=30.0,
-                 restart_crashed=True):
+                 restart_crashed=True, max_piece_crashes=None):
         super().__init__(daemon=True)
         self.server_id = make_id()
         self.headless = headless
@@ -86,6 +86,16 @@ class Server(threading.Thread):
         self.inflight = {}                 # worker_id -> BATCH piece
         self.last_seen = {}                # worker_id -> monotonic stamp
         self._next_hb = 0.0
+        # ----- per-scenario circuit breaker: a piece that loses its
+        # worker K consecutive times is poison (NaN bomb, OOM bait,
+        # FAULT KILL) — quarantine + report it instead of requeueing it
+        # into a crash loop that eats the whole worker pool forever.
+        from .. import settings as _settings
+        self.max_piece_crashes = max_piece_crashes \
+            if max_piece_crashes is not None \
+            else getattr(_settings, "batch_max_crashes", 3)
+        self.piece_crashes = {}            # piece key -> consecutive losses
+        self.quarantined = []              # circuit-broken pieces
         # ----- server-to-server chaining
         self.upstream = upstream           # (host, event_port) or None
         self.link = None                   # DEALER to the upstream server
@@ -159,6 +169,56 @@ class Server(threading.Thread):
             sock = self.fe_event
         sock.send_multipart([dest] + tail + [name, payload])
 
+    # --------------------------------------------------- circuit breaker
+    @staticmethod
+    def _piece_key(piece):
+        scentime, scencmd = piece
+        return (tuple(scentime), tuple(scencmd))
+
+    @staticmethod
+    def _piece_name(piece):
+        for cmd in piece[1]:
+            c = cmd.strip()
+            if c.upper().startswith("SCEN"):
+                parts = c.split(None, 1)
+                return parts[1] if len(parts) > 1 else c
+        return f"<{len(piece[1])}-command piece>"
+
+    def _report_clients(self, text, name=b"ECHO", data=None):
+        """Fan a server-originated event out to every connected client
+        (ECHO payload format matches ScreenIO's)."""
+        payload = packb(data if data is not None
+                        else {"text": text, "flags": 0})
+        for cid in self.clients:
+            self.fe_event.send_multipart([cid, name, payload])
+
+    def _requeue_lost_piece(self, wid):
+        """A worker was lost with a BATCH piece in flight: requeue the
+        piece — unless it has now taken down a worker
+        ``max_piece_crashes`` consecutive times, in which case it is
+        circuit-broken: quarantined server-side and reported to every
+        client (ECHO + a machine-readable BATCHQUARANTINE event)
+        instead of being requeued into an infinite crash loop."""
+        piece = self.inflight.pop(wid, None)
+        if piece is None:
+            return
+        key = self._piece_key(piece)
+        count = self.piece_crashes.get(key, 0) + 1
+        self.piece_crashes[key] = count
+        if count >= self.max_piece_crashes:
+            self.piece_crashes.pop(key, None)
+            self.quarantined.append(piece)
+            pname = self._piece_name(piece)
+            msg = (f"BATCH piece '{pname}' quarantined: lost its worker "
+                   f"{count} consecutive times (circuit breaker)")
+            print(f"server: {msg}")
+            self._report_clients(msg)
+            self._report_clients(msg, name=b"BATCHQUARANTINE",
+                                 data={"piece": pname, "crashes": count,
+                                       "scencmd": list(piece[1])})
+        else:
+            self.scenarios.insert(0, piece)
+
     def _nodeschanged(self):
         """Notify clients; chained remote nodes are merged in (reference
         server.py:213-225 route-prefixed server table)."""
@@ -172,12 +232,22 @@ class Server(threading.Thread):
         from_worker = sock is self.be_event
         if name == b"REGISTER":
             if from_worker:
-                self.workers[sender] = 0
-                self._pending_spawns = max(0, self._pending_spawns - 1)
-                self.avail_workers.append(sender)
+                if sender not in self.workers:
+                    self.workers[sender] = 0
+                    self._pending_spawns = max(0, self._pending_spawns - 1)
+                # duplicated/late REGISTER frames (flaky transport) must
+                # not double-book the worker: one mid-BATCH (in inflight
+                # or state OP) stays unavailable, or piece B would
+                # overwrite its in-flight piece A and silently drop A
+                if sender not in self.avail_workers \
+                        and sender not in self.inflight \
+                        and self.workers[sender] < 2:
+                    self.avail_workers.append(sender)
                 self._send_pending_scenario()
                 self._nodeschanged()
-            else:
+            elif sender not in self.clients:
+                # backoff clients re-send REGISTER until acked — every
+                # resend must ack, but only the first may register
                 self.clients.append(sender)
             sock.send_multipart(
                 [sender, b"REGISTER",
@@ -196,10 +266,10 @@ class Server(threading.Thread):
                 if sender in self.avail_workers:
                     self.avail_workers.remove(sender)
                 # a worker that quit with a piece still running gives it
-                # back to the queue
-                piece = self.inflight.pop(sender, None)
-                if piece is not None:
-                    self.scenarios.insert(0, piece)
+                # back to the queue — through the circuit breaker: a
+                # poison pill that makes its worker abort cleanly loops
+                # exactly like one that SIGKILLs it
+                self._requeue_lost_piece(sender)
                 self._nodeschanged()
                 # keep the batch draining if pieces are still queued
                 if self.scenarios:
@@ -213,7 +283,11 @@ class Server(threading.Thread):
                 # busy workers must not receive BATCH pieces
                 # (parity: server.py:234-247)
                 if state < 2:
-                    self.inflight.pop(sender, None)   # piece completed
+                    piece = self.inflight.pop(sender, None)
+                    if piece is not None:   # piece completed cleanly:
+                        # reset its consecutive-crash count
+                        self.piece_crashes.pop(self._piece_key(piece),
+                                               None)
                     if sender not in self.avail_workers:
                         self.avail_workers.append(sender)
                         self._send_pending_scenario()
@@ -299,9 +373,7 @@ class Server(threading.Thread):
             self.last_seen.pop(wid, None)
             if wid in self.avail_workers:
                 self.avail_workers.remove(wid)
-            piece = self.inflight.pop(wid, None)
-            if piece is not None:
-                self.scenarios.insert(0, piece)
+            self._requeue_lost_piece(wid)
             if self.restart_crashed and self.spawn_workers:
                 headroom = self.max_nnodes - len(self.workers) \
                     - self._pending_spawns

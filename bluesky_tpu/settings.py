@@ -54,6 +54,19 @@ max_nnodes = os.cpu_count() or 1
 sim_detached = False
 telnet_port = 8888
 
+# ----- fault tolerance (docs/FAULT_TOLERANCE.md has the tuning guide)
+guard_enabled = True              # in-scan isfinite integrity guard
+guard_policy = "quarantine"       # "quarantine" | "rollback" | "halt"
+snap_ring_depth = 4               # rollback horizon = depth * dt sim-sec
+snap_ring_dt = 30.0               # [sim s] between ring captures (0 = off)
+batch_max_crashes = 3             # consecutive worker losses before a
+                                  # BATCH piece is circuit-broken
+connect_backoff_base = 0.25       # [s] first client connect retry delay
+connect_backoff_cap = 4.0         # [s] backoff ceiling (jitter on top)
+node_watchdog_warn = 30.0         # [s] event-loop silence before warning
+node_watchdog_kill = 0.0          # [s] silence before exit(70); 0 = never
+fault_seed = 0                    # RNG seed for the FAULT injectors
+
 _overrides = {}                   # file/CLI values for late-registered keys
 
 

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from ..core.asas import AsasConfig
 from ..core.noise import NoiseConfig
 from ..core.route import RouteManager
-from ..core.step import SimConfig, run_steps
+from ..core.step import SimConfig, run_steps, run_steps_checked
 from ..core.traffic import Traffic
 
 # Sim states (reference bluesky/__init__.py:12)
@@ -196,6 +196,16 @@ class Simulation:
         from ..core.metrics import Metrics
         self.metrics = Metrics(self)
         self.telnet = None            # StackTelnetServer when enabled
+        # Fault tolerance: periodic in-memory snapshot ring + the
+        # state-integrity guard responding to in-scan finite trips
+        # (docs/FAULT_TOLERANCE.md; knobs in settings).
+        from .. import settings as _fault_settings
+        from .snapshot import SnapshotRing
+        from ..fault.guard import IntegrityGuard
+        self.snap_ring = SnapshotRing(
+            depth=getattr(_fault_settings, "snap_ring_depth", 4),
+            dt=getattr(_fault_settings, "snap_ring_dt", 30.0))
+        self.guard = IntegrityGuard(self)
         self.traf.delete_hooks.append(self.cond.delac)
         # Late import to avoid cycles; stack binds commands to this sim.
         from ..stack.stack import Stack
@@ -337,6 +347,8 @@ class Simulation:
         datalog.reset()
         self.scr.reset()
         self.metrics.reset()
+        self.snap_ring.clear()
+        self.guard.reset()
         # After stack.reset: plugin reset hooks may stack commands (e.g.
         # TRAFGEN redraws its spawn circle) that must survive the reset.
         self.plugins.reset()
@@ -499,7 +511,17 @@ class Simulation:
                 self._sort_simt = self.simt
                 self._sort_backend = self.cfg.cd_backend
 
-        self.traf.state = run_steps(self.traf.state, self.cfg, chunk)
+        if self.guard.enabled:
+            # Integrity-guarded chunk: the isfinite check rides the scan
+            # carry and pins a trip to one step of the chunk; the guard
+            # then quarantines or rolls back at this chunk edge.
+            self.traf.state, bad = run_steps_checked(
+                self.traf.state, self.cfg, chunk)
+            bad = int(bad)
+            if bad >= 0:
+                self.guard.trip(bad, chunk)
+        else:
+            self.traf.state = run_steps(self.traf.state, self.cfg, chunk)
         self._step_count += chunk
 
         # Chunk-edge subsystems: plugin updates, conditional triggers,
@@ -514,6 +536,16 @@ class Simulation:
         self.traf.trails.update(self.simt)
         from ..utils import datalog
         datalog.postupdate(self)
+
+        # Periodic snapshot-ring capture: the post-chunk state is
+        # verified finite when the guard is on, so ring entries are
+        # always healthy restore points.  Only the rollback policy ever
+        # consumes the ring, and a capture is a full device->host copy
+        # of the state pytree (tens of MB at 100k aircraft) — so other
+        # configurations must not keep paying for it.
+        if self.state_flag == OP and self.guard.enabled \
+                and self.guard.policy == "rollback":
+            self.snap_ring.maybe_capture(self)
 
         if self.ffstop is not None and self.simt >= self.ffstop - 1e-9:
             self._end_ff()

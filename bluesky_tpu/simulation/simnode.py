@@ -18,10 +18,13 @@ def _make_simnode_class(base):
     class _SimNode(base):
         def __init__(self, event_port=None, stream_port=None, node_id=None,
                      **simkw):
+            # watchdog knobs ride to the Node base, not the Simulation
+            nodekw = {k: simkw.pop(k) for k in
+                      ("watchdog_warn", "watchdog_kill") if k in simkw}
             super().__init__(
                 event_port=event_port or settings.wevent_port,
                 stream_port=stream_port or settings.wstream_port,
-                node_id=node_id)
+                node_id=node_id, **nodekw)
             self.sim = Simulation(**simkw)
             self.sim.scr = ScreenIO(self.sim, self)
             self.sim.node = self

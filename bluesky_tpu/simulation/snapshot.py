@@ -1,5 +1,5 @@
 """Binary state snapshots: save/restore the full device pytree + host
-bookkeeping.
+bookkeeping, and an in-memory snapshot ring for automatic rollback.
 
 The reference has NO binary checkpointing — its mechanism is command-log
 record/replay (SAVEIC/IC, stack.py:1185-1321), which this framework also
@@ -11,7 +11,21 @@ Saved: every SimState array (as NumPy), the host slot tables (ids,
 types), per-slot routes, and enough sim config to resume (simdt, ASAS
 config, cd backend).  Restore requires a Traffic with the same nmax/wmax
 (stated in the file header and checked).
+
+Two consumers share the blob format:
+
+* ``save``/``load`` — the SNAPSHOT SAVE/LOAD stack command (pickle file).
+  ``load`` is hardened against truncated/corrupt files: any unpickling
+  failure degrades to a ``(False, msg)`` command error, never an
+  exception out of the stack.
+* ``SnapshotRing`` — a bounded in-memory ring of periodic captures the
+  integrity guard (fault/guard.py) rolls back to when a chunk trips the
+  in-scan finite check.  Ring rollback restores traffic/routes/config
+  but keeps stack/datalog/plugin state (``reset_traffic`` semantics, not
+  the full ``reset``), so logs record the recovery instead of being
+  truncated by it.
 """
+import collections
 import pickle
 
 import numpy as np
@@ -21,8 +35,8 @@ import jax.numpy as jnp
 FORMAT = 2
 
 
-def save(sim, fname):
-    """Write a snapshot of the complete simulation state."""
+def state_blob(sim) -> dict:
+    """Snapshot the complete simulation state as a host-side dict."""
     traf = sim.traf
     traf.flush()
     state_np = jax.tree.map(lambda a: np.asarray(a), traf.state)
@@ -31,7 +45,7 @@ def save(sim, fname):
                       spd=list(r.spd), wtype=list(r.wtype),
                       flyby=list(r.flyby), iactwp=r.iactwp)
               for i, r in sim.routes.routes.items()}
-    blob = dict(
+    return dict(
         format=FORMAT,
         nmax=traf.nmax, wmax=traf.wmax,
         state=state_np,
@@ -41,24 +55,36 @@ def save(sim, fname):
                  asas=sim.cfg.asas._asdict()),
         dtmult=sim.dtmult,
         routes=routes,
+        # pending ATALT/ATSPD conditions are traffic-scoped state: both
+        # restore paths reset them, so they must ride the blob or a
+        # rollback silently disarms every deferred command
+        cond=dict(idx=np.asarray(sim.cond.idx),
+                  condtype=np.asarray(sim.cond.condtype),
+                  target=np.asarray(sim.cond.target),
+                  lastdif=np.asarray(sim.cond.lastdif),
+                  cmd=list(sim.cond.cmd)),
     )
-    with open(fname, "wb") as f:
-        pickle.dump(blob, f, protocol=pickle.HIGHEST_PROTOCOL)
-    return fname
 
 
-def load(sim, fname):
-    """Restore a snapshot into the running simulation."""
-    with open(fname, "rb") as f:
-        blob = pickle.load(f)
+def restore_blob(sim, blob, full_reset: bool = True):
+    """Restore a state blob into the running simulation.
+
+    ``full_reset=False`` is the rollback path: only traffic-scoped state
+    is cleared (``reset_traffic``), so datalog/stack/plugin state — and
+    with it the record of the fault that triggered the rollback —
+    survives the restore.
+    """
     if blob.get("format") != FORMAT:
-        return False, f"{fname}: unsupported snapshot format"
+        return False, "unsupported snapshot format"
     traf = sim.traf
     if blob["nmax"] != traf.nmax or blob["wmax"] != traf.wmax:
         return False, (f"snapshot is nmax={blob['nmax']}/"
                        f"wmax={blob['wmax']}; this sim is "
                        f"nmax={traf.nmax}/wmax={traf.wmax}")
-    sim.reset()
+    if full_reset:
+        sim.reset()
+    else:
+        sim.reset_traffic()
     traf = sim.traf
     # Device state: same treedef, arrays re-uploaded with current dtypes
     traf.state = jax.tree.map(
@@ -80,6 +106,15 @@ def load(sim, fname):
         hr.wtype = list(r["wtype"])
         hr.flyby = list(r["flyby"])
         hr.iactwp = r["iactwp"]
+    # Pending conditional commands (absent in blobs saved before they
+    # were captured: nothing to restore then)
+    cond = blob.get("cond")
+    if cond is not None:
+        sim.cond.idx = np.asarray(cond["idx"], dtype=np.int64)
+        sim.cond.condtype = np.asarray(cond["condtype"], dtype=np.int64)
+        sim.cond.target = np.asarray(cond["target"], dtype=np.float64)
+        sim.cond.lastdif = np.asarray(cond["lastdif"], dtype=np.float64)
+        sim.cond.cmd = list(cond["cmd"])
     # Config
     from ..core.asas import AsasConfig
     cfg = blob["cfg"]
@@ -87,5 +122,81 @@ def load(sim, fname):
                                cd_backend=cfg["cd_backend"],
                                asas=AsasConfig(**cfg["asas"]))
     sim.dtmult = blob["dtmult"]
-    return True, (f"Snapshot {fname} restored: {traf.ntraf} aircraft "
+    return True, (f"restored: {traf.ntraf} aircraft "
                   f"at simt={sim.simt:.2f}")
+
+
+def save(sim, fname):
+    """Write a snapshot of the complete simulation state."""
+    blob = state_blob(sim)
+    with open(fname, "wb") as f:
+        pickle.dump(blob, f, protocol=pickle.HIGHEST_PROTOCOL)
+    return fname
+
+
+def load(sim, fname):
+    """Restore a snapshot into the running simulation.
+
+    Robust to damaged files: a truncated or corrupt snapshot (the
+    FAULT SNAPTRUNC chaos case) returns a command error instead of
+    raising out of the stack.
+    """
+    try:
+        with open(fname, "rb") as f:
+            blob = pickle.load(f)
+    except (EOFError, pickle.UnpicklingError, AttributeError, MemoryError,
+            ImportError, IndexError, KeyError, ValueError) as exc:
+        return False, (f"{fname}: corrupt or truncated snapshot "
+                       f"({type(exc).__name__}: {exc})")
+    if not isinstance(blob, dict) or blob.get("format") != FORMAT:
+        return False, f"{fname}: unsupported snapshot format"
+    ok, msg = restore_blob(sim, blob)
+    return ok, (f"Snapshot {fname} {msg}" if ok else f"{fname}: {msg}")
+
+
+class SnapshotRing:
+    """Bounded in-memory ring of periodic state snapshots.
+
+    ``maybe_capture`` is called by the sim at chunk edges and captures
+    every ``dt`` seconds of sim time (depth * dt is the rollback
+    horizon).  ``rollback`` restores the newest snapshot with
+    traffic-scoped reset semantics and POPS it from the ring, so a fault
+    that recurs immediately degrades to progressively older snapshots
+    instead of looping on one restore point forever.
+    """
+
+    def __init__(self, depth: int = 4, dt: float = 30.0):
+        self.depth = max(1, int(depth))
+        self.dt = float(dt)
+        self._ring = collections.deque(maxlen=self.depth)
+        self.t_last = -float("inf")
+
+    def __len__(self):
+        return len(self._ring)
+
+    @property
+    def simts(self):
+        """Sim times of the held snapshots, oldest first."""
+        return [float(np.asarray(b["state"].simt)) for b in self._ring]
+
+    def capture(self, sim):
+        self._ring.append(state_blob(sim))
+        self.t_last = sim.simt
+
+    def maybe_capture(self, sim):
+        """Capture if ``dt`` sim seconds have passed since the last one."""
+        if self.dt > 0 and sim.simt - self.t_last >= self.dt - 1e-9:
+            self.capture(sim)
+
+    def rollback(self, sim):
+        """Restore (and consume) the newest snapshot; (ok, msg)."""
+        if not self._ring:
+            return False, "snapshot ring is empty"
+        blob = self._ring.pop()
+        ok, msg = restore_blob(sim, blob, full_reset=False)
+        self.t_last = sim.simt
+        return ok, msg
+
+    def clear(self):
+        self._ring.clear()
+        self.t_last = -float("inf")

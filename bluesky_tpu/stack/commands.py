@@ -996,6 +996,13 @@ def register_all(stack):
             return True, profiler.report(sim, nsteps)
         return False, "PROFILE START [dir] / STOP / KERNELS [nsteps]"
 
+    def faultcmd(*args):
+        """FAULT: chaos-injection harness (fault/harness.py) — poison
+        state with NaN/Inf, flip guard policy, degrade the event
+        transport, stall/kill the worker, truncate snapshots."""
+        from ..fault import harness
+        return harness.fault_command(sim, *args)
+
     def snapshot(sub, fname=None):
         """SNAPSHOT SAVE/LOAD fname: binary pytree state checkpoint
         (device-state snapshot the reference lacks, SURVEY 5.4)."""
@@ -1296,6 +1303,10 @@ def register_all(stack):
         "PROFILE": ["PROFILE START [dir]/STOP/KERNELS [nsteps]",
                     "[txt,word]", profile,
                     "JAX trace capture and per-kernel timings"],
+        "FAULT": ["FAULT NAN/INF [acid] | GUARD ../RING .. | DROP/DUP/"
+                  "DELAY p | NETOFF | STALL s | KILL | SNAPTRUNC f | LIST",
+                  "[word,...]", faultcmd,
+                  "Fault-injection harness (chaos testing)"],
         "SNAPSHOT": ["SNAPSHOT SAVE/LOAD fname", "txt,[word]", snapshot,
                      "Save/restore a binary state snapshot"],
         "SCREENSHOT": ["SCREENSHOT [fname.svg]", "[word]", screenshot,

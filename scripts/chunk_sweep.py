@@ -9,6 +9,7 @@ docs/PERF_ANALYSIS.md and the protocol fields in BENCH_DETAIL rows.
 Usage: python scripts/chunk_sweep.py [N]
 """
 import json
+import os
 import sys
 
 sys.path.insert(0, ".")
@@ -25,6 +26,9 @@ def main(n_ac=100_000):
         r["protocol"] = "best-of-3, host re-sort per chunk"
         rows.append(r)
         print(json.dumps(r), flush=True)
+    # fresh checkout: output/ may not exist yet — a multi-minute run
+    # must not crash at the final dump
+    os.makedirs("output", exist_ok=True)
     with open("output/chunk_sweep.json", "w") as f:
         json.dump(rows, f, indent=1)
     return rows

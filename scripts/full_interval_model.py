@@ -24,6 +24,7 @@ docs/PERF_ANALYSIS.md.
 Run on the chip: python scripts/full_interval_model.py [N]
 """
 import json
+import os
 import sys
 import time
 
@@ -215,6 +216,9 @@ def main(n=100_000):
                           n_collectives=N_COLLECTIVES,
                           coll_bytes_per_ac=COLL_BYTES_PER_AC,
                           sort_every=SORT_EVERY))
+    # fresh checkout: output/ may not exist yet — a multi-minute run
+    # must not crash at the final dump
+    os.makedirs("output", exist_ok=True)
     with open("output/full_interval.json", "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(mm))

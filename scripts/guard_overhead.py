@@ -1,0 +1,105 @@
+"""Measure the in-scan integrity-guard overhead (ISSUE 1 acceptance:
+the finite-check folded into the lax.scan carry must cost < 2% on the
+N=100k sparse bench row).
+
+Protocol matches bench.run_one / BENCH_CHUNK_SWEEP.json exactly: same
+traffic generator, same backend pick, host re-sort per chunk, best-of-3
+reps — run twice per configuration, once with ``run_steps`` and once
+with ``run_steps_checked``, on the SAME warmed state.  Output rows land
+in BENCH_GUARD.json with both rates and the relative overhead.
+
+Usage: python scripts/guard_overhead.py [N] [nsteps_chunk]
+  (defaults: N=100000, chunk=1000 — the headline protocol.  On a
+  CPU-only box the sparse backend is unavailable; pass a smaller N,
+  e.g. 2048, and the dense/tiled pick + platform are recorded in the
+  protocol field so rows are never silently comparable.)
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import bench  # noqa: E402
+
+
+def run_pair(n_ac, nsteps=1000, reps=3, backend=None, geometry=None):
+    import jax
+    import jax.numpy as jnp
+    from bluesky_tpu.core.asas import impl_for_backend, refresh_spatial_sort
+    from bluesky_tpu.core.step import (SimConfig, run_steps,
+                                       run_steps_checked)
+
+    backend = backend or bench._pick_backend(n_ac)
+    geometry = geometry or ("continental" if n_ac > 16384 else "regional")
+    traf = bench._make_traffic(n_ac, geometry, backend == "dense",
+                               jnp.float32)
+    cfg = SimConfig(cd_backend=backend)
+
+    def resort(st):
+        if backend in ("tiled", "pallas", "sparse"):
+            return refresh_spatial_sort(st, cfg.asas, block=cfg.cd_block,
+                                        impl=impl_for_backend(backend))
+        return st
+
+    # Both variants must traverse the IDENTICAL trajectory — the CD
+    # workload depends on conflict density, which drifts as the fleet
+    # disperses — so each starts from a copy of the same initial state
+    # (copied because run_steps donates its input buffers).
+    state0 = traf.state
+
+    def bench_fn(fn):
+        state = fn(resort(jax.tree.map(jnp.copy, state0)), cfg,
+                   nsteps)                           # warmup/compile
+        jax.block_until_ready(state)
+        best = float("inf")
+        state = jax.tree.map(jnp.copy, state0)
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            state = fn(resort(state), cfg, nsteps)
+            jax.block_until_ready(state)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    def checked(st, cfg, nsteps):
+        st, _bad = run_steps_checked(st, cfg, nsteps)
+        return st
+
+    t_plain = bench_fn(run_steps)
+    t_guard = bench_fn(checked)
+    rate = lambda t: n_ac * nsteps / t
+    platform = jax.devices()[0].platform
+    return dict(
+        n=n_ac, backend=backend, geometry=geometry,
+        nsteps_chunk=nsteps,
+        ac_steps_per_s_unguarded=round(rate(t_plain), 1),
+        ac_steps_per_s_guarded=round(rate(t_guard), 1),
+        overhead_pct=round(100.0 * (t_guard - t_plain) / t_plain, 2),
+        protocol=(f"best-of-{reps}, host re-sort per chunk, "
+                  f"platform={platform}"),
+    )
+
+
+def main(n_ac=100_000, nsteps=1000):
+    row = run_pair(n_ac, nsteps=nsteps)
+    print(json.dumps(row), flush=True)
+    rows = []
+    if os.path.isfile("BENCH_GUARD.json"):
+        with open("BENCH_GUARD.json") as f:
+            rows = json.load(f)
+    rows = [r for r in rows
+            if (r["n"], r["nsteps_chunk"]) != (row["n"],
+                                               row["nsteps_chunk"])]
+    rows.append(row)
+    with open("BENCH_GUARD.json", "w") as f:
+        json.dump(rows, f, indent=1)
+    os.makedirs("output", exist_ok=True)
+    with open("output/guard_overhead.json", "w") as f:
+        json.dump(rows, f, indent=1)
+    return row
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 100_000,
+         int(sys.argv[2]) if len(sys.argv) > 2 else 1000)

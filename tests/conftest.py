@@ -74,6 +74,8 @@ _REF_DEPENDENT_FILES = {
     "test_openap_real.py",         # value-for-value vs reference coeff DB
     "test_perf_models.py",         # BS XML + BADA parser golden tests
     "test_resolvers.py",           # ref_oracle golden comparisons
+    "test_cr_mvp_ref.py",          # imports the reference MVP source
+    "test_guiclient_ref.py",       # imports the reference Qt client source
     "test_command_coverage.py",    # parses the reference stack source
     "test_stream_schema.py",       # parses the reference screenio source
     "test_navdb.py",               # real 11 MB navdata

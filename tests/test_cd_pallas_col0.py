@@ -1,0 +1,109 @@
+"""col0 halo-window plumbing of cd_pallas.full_grid_pass (ADVICE r5 #2).
+
+``col0`` offsets intruder (partner/candidate) ids when the column slab
+array passed to the kernel is a LOCAL WINDOW of the global block grid —
+the domain-decomposition mode where each device holds only its halo
+neighbourhood instead of the full replicated slab array.  No production
+caller sets it yet, so this interpret-mode unit test pins the contract
+before the mode that needs it lands: a pass over a column window with
+``col0 != 0`` must produce bit-identical accumulators and GLOBAL-space
+partner ids to the full-grid pass restricted (via the reach mask) to
+those same columns.
+"""
+import numpy as np
+import numpy.testing as npt
+import jax.numpy as jnp
+import pytest
+
+from bluesky_tpu.ops import cd_pallas, cr_mvp
+
+NM, FT = 1852.0, 0.3048
+BLOCK = 128
+N = 512                      # 4 row/column blocks: windows are proper subsets
+
+
+def _packed_scene(seed=3):
+    """Build the [nb, _NF, block] slab array + reach exactly as
+    detect_resolve_pallas does (no spatial sort, N a block multiple)."""
+    rng = np.random.default_rng(seed)
+    dtype = jnp.float32
+    # Dense-ish regional scene so the window actually contains conflicts
+    lat = jnp.asarray(rng.uniform(51.0, 54.0, N), dtype)
+    lon = jnp.asarray(rng.uniform(3.0, 7.0, N), dtype)
+    trk = jnp.asarray(rng.uniform(0, 360, N), dtype)
+    gs = jnp.asarray(rng.uniform(150, 250, N), dtype)
+    alt = jnp.asarray(rng.uniform(3000, 11000, N), dtype)
+    vs = jnp.asarray(rng.uniform(-10, 10, N), dtype)
+    act = rng.random(N) > 0.05
+    trkrad = jnp.radians(trk)
+    fields = cd_pallas.precompute_trig(lat, lon)
+    fields.update({
+        "u": gs * jnp.sin(trkrad), "v": gs * jnp.cos(trkrad),
+        "alt": alt, "vs": vs,
+        "gse": gs * jnp.sin(trkrad), "gsn": gs * jnp.cos(trkrad),
+        "trk": trk, "tr": jnp.ones_like(gs),
+        "active": jnp.asarray(act, dtype),
+        "noreso": jnp.zeros(N, dtype),
+    })
+    nb = N // BLOCK
+    packed = jnp.stack([fields[k] for k in cd_pallas._FIELDS]).reshape(
+        cd_pallas._NF, nb, BLOCK).transpose(1, 0, 2)
+    rpz, hpz, tlook = 5 * NM, 1000 * FT, 300.0
+    reach = cd_pallas.block_reachability(
+        lat, lon, gs, fields["active"] > 0.5, nb, BLOCK,
+        float(rpz), float(tlook))
+    kern_kw = dict(block=BLOCK, kk=8, rpz=float(rpz), hpz=float(hpz),
+                   tlookahead=float(tlook),
+                   mvpcfg=cr_mvp.MVPConfig(rpz_m=rpz * 1.05,
+                                           hpz_m=hpz * 1.05,
+                                           tlookahead=tlook),
+                   reso="mvp")
+    return packed, reach, kern_kw
+
+
+@pytest.mark.parametrize("c0,width", [(1, 2), (2, 2), (3, 1)])
+def test_col0_halo_window_matches_full_grid_oracle(c0, width):
+    packed, reach, kern_kw = _packed_scene()
+    nb = packed.shape[0]
+    # Oracle: the full grid restricted (reach mask) to the window columns
+    colmask = np.zeros((nb, nb), bool)
+    colmask[:, c0:c0 + width] = True
+    reach_np = np.asarray(reach)
+    oracle = cd_pallas.full_grid_pass(
+        packed, jnp.asarray(reach_np & colmask),
+        block=BLOCK, kk=8, cpp=2, kern_kw=kern_kw, interpret=True)
+    # Window: ownship side keeps all rows, but only the halo column
+    # slabs are materialized as intruders; col0 lifts the local block
+    # index back to the global slot space
+    window = cd_pallas.full_grid_pass(
+        packed[c0:c0 + width], jnp.asarray(reach_np[:, c0:c0 + width]),
+        block=BLOCK, kk=8, cpp=2, kern_kw=kern_kw, interpret=True,
+        packed_own=packed, col0=c0)
+    # the restriction must leave real work in the window
+    assert float(np.asarray(oracle[0]).sum()) > 0, "no conflicts in window"
+    names = ("inconf", "tcpamax", "sdve", "sdvn", "sdvv", "tsolv",
+             "ncnt", "lcnt", "ctin", "cidx")
+    for name, a, b in zip(names, oracle, window):
+        a, b = np.asarray(a), np.asarray(b)
+        if a.dtype.kind == "i":
+            npt.assert_array_equal(a, b, err_msg=f"col0={c0}:{name}")
+        else:
+            npt.assert_allclose(a, b, rtol=1e-6, atol=1e-6,
+                                err_msg=f"col0={c0}:{name}")
+
+
+def test_col0_partner_ids_are_global():
+    """Candidate ids out of a col0 window must index the GLOBAL slot
+    space: every non-sentinel id lies inside the window's global range."""
+    packed, reach, kern_kw = _packed_scene()
+    c0, width = 2, 2
+    reach_np = np.asarray(reach)
+    outs = cd_pallas.full_grid_pass(
+        packed[c0:c0 + width], jnp.asarray(reach_np[:, c0:c0 + width]),
+        block=BLOCK, kk=8, cpp=2, kern_kw=kern_kw, interpret=True,
+        packed_own=packed, col0=c0)
+    cidx = np.asarray(outs[9])
+    real = cidx[cidx < 2 ** 30]
+    assert real.size > 0, "window produced no candidates"
+    assert real.min() >= c0 * BLOCK
+    assert real.max() < (c0 + width) * BLOCK
