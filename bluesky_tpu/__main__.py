@@ -52,6 +52,12 @@ def main(argv=None):
     parser.add_argument("--upstream", default="",
                         help="chain this server under another: host:port "
                              "of the upstream server's client event port")
+    parser.add_argument("--resume-batch", default="", metavar="JOURNAL",
+                        help="replay a BATCH journal (JSONL WAL) from a "
+                             "crashed/preempted server: completed pieces "
+                             "are not re-run, in-flight pieces are "
+                             "requeued, quarantine decisions persist; "
+                             "new records append to the same journal")
     parser.add_argument("--import-navdata", default="", metavar="DIR",
                         help="import a reference-format navdata directory "
                              "(fix.dat/nav.dat/airports.dat/awy.dat/fir/"
@@ -146,6 +152,8 @@ def run_import_navdata(args):
 
 
 def run_server(args):
+    import signal
+
     from .network.server import Server
     ports = {}
     if args.event_port:
@@ -158,14 +166,26 @@ def run_server(args):
         upstream = (host or "127.0.0.1", int(port))
     server = Server(headless=True, discoverable=args.discoverable,
                     ports=ports, max_nnodes=settings.max_nnodes,
-                    upstream=upstream)
+                    upstream=upstream,
+                    resume_journal=args.resume_batch or None)
     print(f"bluesky_tpu server: clients on "
           f"{server.ports['event']}/{server.ports['stream']}, workers on "
           f"{server.ports['wevent']}/{server.ports['wstream']}")
+    if server.journal:
+        print(f"bluesky_tpu server: BATCH journal at "
+              f"{server.journal.path}")
+    # preemption-safe shutdown: SIGTERM (scheduler reclaim) drains the
+    # broker loop, QUITs the workers, journals the clean-exit marker
+    # and leaves — the journal then resumes the sweep on the next start
+    signal.signal(signal.SIGTERM, lambda signum, frame: server.stop())
     server.start()
     server.addnodes(1)
     try:
-        server.join()
+        # timed-join loop, not a bare join(): an unbounded join can sit
+        # in an uninterruptible wait and starve the SIGTERM handler —
+        # waking every second guarantees prompt preemption shutdown
+        while server.is_alive():
+            server.join(timeout=1.0)
     except KeyboardInterrupt:
         server.stop()
         server.join(timeout=5)

@@ -14,6 +14,8 @@ Usage (stack/commands.py registers it):
   FAULT NETOFF               remove transport faults
   FAULT STALL sec            stall this worker's event loop for sec
   FAULT KILL                 SIGKILL this worker (no goodbye)
+  FAULT PREEMPT [delay]      preemption notice (SIGTERM model): drain
+                             the chunk, checkpoint, notify, exit
   FAULT SNAPTRUNC fname [keep]  truncate a snapshot file (torn write)
   FAULT LIST                 guard trip history
 
@@ -129,6 +131,17 @@ def fault_command(sim, *args):
     if sub == "KILL":
         injectors.kill_self()          # no return: SIGKILL
 
+    if sub == "PREEMPT":
+        try:
+            delay = float(rest[0]) if rest else 0.0
+        except ValueError:
+            return False, "FAULT PREEMPT [delay_s]"
+        injectors.preempt(sim, delay)
+        return True, (f"FAULT: preemption notice"
+                      + (f" in {delay:g} s" if delay > 0 else "")
+                      + " — the node will drain the current chunk, "
+                        "write a final checkpoint and exit")
+
     if sub == "SNAPTRUNC":
         if not rest:
             return False, "FAULT SNAPTRUNC filename [keep_fraction]"
@@ -151,4 +164,5 @@ def fault_command(sim, *args):
             for t in sim.guard.trips)
 
     return False, ("FAULT NAN/INF [acid] | GUARD .. | RING .. | DROP/DUP/"
-                   "DELAY p | NETOFF | STALL s | KILL | SNAPTRUNC f | LIST")
+                   "DELAY p | NETOFF | STALL s | KILL | PREEMPT [s] | "
+                   "SNAPTRUNC f | LIST")

@@ -7,6 +7,7 @@ deterministic under a seeded RNG so chaos runs replay.
 """
 import os
 import signal
+import threading
 import time
 
 import numpy as np
@@ -135,6 +136,24 @@ def kill_self():
     No goodbye, no linger: the server must detect the death via child
     exit / PING silence and requeue this worker's BATCH piece."""
     os.kill(os.getpid(), signal.SIGKILL)
+
+
+def preempt(sim, delay_s: float = 0.0):
+    """Deliver a preemption notice to this sim after ``delay_s`` —
+    the SIGTERM-from-the-scheduler model (spot/preemptible capacity
+    being reclaimed).  Raises ``sim.preempt_requested``; the owning
+    node drains the in-flight chunk, writes a final checksummed
+    checkpoint, notifies the server and exits cleanly
+    (simulation/simnode._preempt_shutdown) — an embedded sim
+    checkpoints and pauses.  A real out-of-process SIGTERM lands in
+    the same path via the node's signal handler."""
+    if delay_s and float(delay_s) > 0:
+        t = threading.Timer(float(delay_s), sim.request_preempt)
+        t.daemon = True
+        t.start()
+        return t
+    sim.request_preempt()
+    return None
 
 
 def stall(seconds: float):

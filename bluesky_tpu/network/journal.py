@@ -1,0 +1,230 @@
+"""Crash-resumable BATCH journal: an append-only JSONL write-ahead log.
+
+The server's sweep state (``scenarios``/``inflight``/``piece_crashes``/
+``quarantined`` in server.py) is in-memory; without a WAL a server crash
+or preemption loses a multi-hour sweep.  Every state transition of a
+BATCH piece is journaled BEFORE/AS it happens, and ``--resume-batch
+<journal>`` replays the log on restart to rebuild the queue with
+exactly-once completion semantics: completed pieces are not re-run,
+pieces in flight at crash time are requeued, quarantine decisions
+persist.
+
+Record types (one JSON object per line, ``rec`` selects the type):
+
+  ``queued``      {key, scentime, scencmd}  piece entered the queue (the
+                                            only record carrying the
+                                            full piece, so the journal
+                                            alone can rebuild it)
+  ``dispatched``  {key, worker}             piece handed to a worker
+  ``completed``   {key, worker}             piece finished cleanly
+  ``crashed``     {key, crashes}            piece lost its worker (one
+                                            circuit-breaker strike)
+  ``quarantined`` {key, piece, crashes}     circuit-broken: never requeue
+  ``preempted``   {key, worker}             worker preempted mid-piece:
+                                            requeue WITHOUT a strike
+  ``resumed``     {pending, completed, quarantined}  replay marker
+  ``shutdown``    {}                        clean server exit
+
+Piece identity is content-addressed (sha256 over the canonical JSON of
+``(scentime, scencmd)``), so keys are stable across restarts and across
+servers.
+
+Append atomicity: each record is ONE ``write()`` of a single line,
+flushed (+ ``fsync`` unless ``batch_journal_fsync`` is off), so a crash
+can only tear the final line — ``replay`` skips unparseable tails
+instead of failing.  A whole BATCH submission's ``queued`` records
+share one flush+fsync (``queued_many``): the WAL guarantee only needs
+the batch durable before any dispatch.  A journal write failure (disk
+full) disables the journal with a warning; it must never take the
+broker down with it.
+"""
+import hashlib
+import json
+import os
+
+
+class BatchJournal:
+    def __init__(self, path: str, fsync: bool = True):
+        self.path = path
+        self.fsync = bool(fsync)
+        self._f = None
+        self._dead = False        # set after a write failure
+
+    # ------------------------------------------------------------ identity
+    @staticmethod
+    def piece_key(piece) -> str:
+        """Content-addressed piece id, stable across restarts."""
+        scentime, scencmd = piece
+        blob = json.dumps([[float(t) for t in scentime],
+                           [str(c) for c in scencmd]],
+                          separators=(",", ":"))
+        return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]
+
+    # ------------------------------------------------------------- writing
+    def _open(self):
+        if self._f is None:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            # heal a crash-torn tail: if the existing file does not end
+            # in a newline, the next append would glue onto the torn
+            # line and be lost to replay — terminate it first so "a
+            # crash can only tear the final line" stays true across
+            # resumes
+            try:
+                with open(self.path, "rb") as f:
+                    f.seek(-1, os.SEEK_END)
+                    if f.read(1) != b"\n":
+                        with open(self.path, "ab") as fa:
+                            fa.write(b"\n")
+            except (OSError, ValueError):
+                pass                      # absent or empty file
+            self._f = open(self.path, "a", encoding="utf-8")
+        return self._f
+
+    def _write(self, records):
+        if self._dead or not records:
+            return
+        try:
+            f = self._open()
+            for r in records:
+                f.write(json.dumps(r, separators=(",", ":")) + "\n")
+            f.flush()
+            if self.fsync:
+                os.fsync(f.fileno())
+        except OSError as e:
+            self._dead = True
+            print(f"batch journal: disabled after write failure "
+                  f"({self.path}: {e})")
+
+    def append(self, rec: str, **fields):
+        self._write([dict(rec=rec, **fields)])
+
+    @classmethod
+    def _queued_rec(cls, piece):
+        scentime, scencmd = piece
+        return dict(rec="queued", key=cls.piece_key(piece),
+                    scentime=[float(t) for t in scentime],
+                    scencmd=[str(c) for c in scencmd])
+
+    def queued(self, piece):
+        self._write([self._queued_rec(piece)])
+
+    def queued_many(self, pieces):
+        """Journal a whole BATCH submission with ONE flush+fsync — the
+        WAL guarantee only needs the batch on disk before any dispatch,
+        and per-piece fsyncs would stall the broker poll loop for large
+        sweeps."""
+        self._write([self._queued_rec(p) for p in pieces])
+
+    def dispatched(self, piece, worker: bytes = b""):
+        self.append("dispatched", key=self.piece_key(piece),
+                    worker=worker.hex())
+
+    def completed(self, piece, worker: bytes = b""):
+        self.append("completed", key=self.piece_key(piece),
+                    worker=worker.hex())
+
+    def crashed(self, piece, crashes: int):
+        self.append("crashed", key=self.piece_key(piece),
+                    crashes=int(crashes))
+
+    def quarantined(self, piece, crashes: int):
+        self.append("quarantined", key=self.piece_key(piece),
+                    crashes=int(crashes))
+
+    def preempted(self, piece, worker: bytes = b""):
+        self.append("preempted", key=self.piece_key(piece),
+                    worker=worker.hex())
+
+    def shutdown(self):
+        # clean-exit marker — only if this run ever journaled anything
+        # (a server that never saw a BATCH must not litter log_path
+        # with marker-only files)
+        if self._f is not None:
+            self.append("shutdown")
+
+    def close(self):
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f = None
+
+    # ------------------------------------------------------------- replay
+    @staticmethod
+    def replay(path: str) -> dict:
+        """Fold a journal into the queue state a restarted server needs.
+
+        Returns a dict with ``pending`` (pieces to requeue, in original
+        queue order — includes pieces that were dispatched/preempted/
+        crashed but never completed), ``completed``, ``quarantined``
+        piece lists, ``crashes``/``quarantined_crashes`` (journal key ->
+        strike count) and ``torn_lines`` (unparseable records skipped —
+        a crash mid-append can only tear the final line).  Raises
+        ``OSError`` if the journal cannot be read at all.
+
+        Keys are content-addressed, so a sweep that deliberately
+        repeats an identical piece (repeat trials) shares one key
+        across copies: replay uses MULTISET semantics — pending copies
+        of a key = queued count - completed count — so N submissions
+        still yield N runs.  Quarantine applies to the content (a
+        poison piece is poison for every copy).
+        """
+        pieces, order = {}, []
+        n_queued, n_completed = {}, {}
+        quarantined_keys = set()
+        crashes, qcrashes = {}, {}
+        torn = 0
+        # errors="replace": disk-level byte corruption must surface as
+        # skipped torn lines, not a UnicodeDecodeError that escapes the
+        # resume path's OSError handling
+        with open(path, encoding="utf-8", errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    r = json.loads(line)
+                except json.JSONDecodeError:
+                    torn += 1
+                    continue
+                rec, key = r.get("rec"), r.get("key")
+                if rec == "queued" and key:
+                    if key not in pieces:
+                        order.append(key)
+                    pieces[key] = (list(r.get("scentime", [])),
+                                   list(r.get("scencmd", [])))
+                    n_queued[key] = n_queued.get(key, 0) + 1
+                elif key not in pieces:
+                    continue              # marker records / unknown key
+                elif rec in ("dispatched", "preempted"):
+                    pass                  # owed copies = queued - completed
+                elif rec == "crashed":
+                    crashes[key] = int(r.get("crashes",
+                                             crashes.get(key, 0) + 1))
+                elif rec == "completed":
+                    n_completed[key] = n_completed.get(key, 0) + 1
+                    crashes.pop(key, None)
+                elif rec == "quarantined":
+                    quarantined_keys.add(key)
+                    qcrashes[key] = int(r.get("crashes", 0))
+                    crashes.pop(key, None)
+
+        def owed(k):
+            if k in quarantined_keys:
+                return 0
+            return max(0, n_queued.get(k, 0) - n_completed.get(k, 0))
+
+        return dict(
+            pending=[pieces[k] for k in order for _ in range(owed(k))],
+            completed=[pieces[k] for k in order
+                       for _ in range(min(n_queued.get(k, 0),
+                                          n_completed.get(k, 0)))],
+            quarantined=[pieces[k] for k in order
+                         if k in quarantined_keys],
+            crashes={k: c for k, c in crashes.items() if owed(k) > 0},
+            quarantined_crashes=qcrashes,
+            torn_lines=torn,
+        )

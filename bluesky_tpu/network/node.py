@@ -131,6 +131,40 @@ class Node:
     def send_stream(self, name: bytes, data):
         self.stream_out.send_multipart([name + self.node_id, packb(data)])
 
+    # ------------------------------------------------------------- signals
+    def _install_signal_handlers(self):
+        """SIGTERM/SIGINT are treated as a preemption notice (cluster
+        scheduler reclaiming the node, operator Ctrl-C): route them to
+        ``on_preempt_signal`` so subclasses can drain the in-flight
+        chunk and checkpoint instead of dying mid-scan.  Main-thread
+        only (signal-module restriction); embedded/test nodes running
+        in a worker thread use ``sim.request_preempt()`` directly —
+        both paths converge on the same drain code."""
+        import signal as _signal
+        if threading.current_thread() is not threading.main_thread():
+            return
+        self._old_sig = {}
+        for s in (_signal.SIGTERM, _signal.SIGINT):
+            try:
+                self._old_sig[s] = _signal.signal(
+                    s, lambda signum, frame: self.on_preempt_signal(signum))
+            except (ValueError, OSError):
+                pass
+
+    def _restore_signal_handlers(self):
+        import signal as _signal
+        for s, h in getattr(self, "_old_sig", {}).items():
+            try:
+                _signal.signal(s, h)
+            except (ValueError, OSError, TypeError):
+                pass
+
+    def on_preempt_signal(self, signum):
+        """Default preemption response: leave the loop (the teardown
+        still sends STATECHANGE -1).  SimNode overrides this to drain
+        the chunk and write a final checkpoint first."""
+        self.quit()
+
     # ----------------------------------------------------------- watchdog
     def _watchdog_start(self):
         # either knob arms the thread: warn=0 + kill>0 is the
@@ -190,6 +224,7 @@ class Node:
         """
         self.running = True
         self.connect()
+        self._install_signal_handlers()
         self._watchdog_start()
         try:
             while self.running:
@@ -203,6 +238,7 @@ class Node:
             # os._exit(70) the process mid-traceback (or kill an
             # embedding host that had caught and recovered)
             self._watchdog_stop()
+            self._restore_signal_handlers()
         # tell the server we are gone, then tear down
         self.send_event(b"STATECHANGE", -1)
         self.close()

@@ -21,6 +21,14 @@ Hardening beyond the reference:
   registration; external workers are probed with PING/PONG.  A dead
   worker's in-flight BATCH piece is requeued and a replacement is
   spawned — kill -9 a worker mid-batch and the batch still completes.
+* **Durable BATCH sweeps** (docs/FAULT_TOLERANCE.md): every piece
+  transition (queued/dispatched/completed/crashed/quarantined/
+  preempted) is appended to a JSONL write-ahead journal
+  (network/journal.py); ``--resume-batch <journal>`` replays it after a
+  server crash or preemption to rebuild the queue with exactly-once
+  completion semantics.  A ``PREEMPTED`` notice from a draining worker
+  requeues its piece without a circuit-breaker strike, and
+  ``BATCHQUARANTINE`` reports are replayed to late-joining clients.
 * **Server-to-server chaining** (reference server.py:213-225): a server
   started with ``upstream=(host, port)`` registers at another server's
   client port, mirrors that server's node table to its own clients
@@ -63,7 +71,8 @@ class Server(threading.Thread):
     def __init__(self, headless=False, discoverable=False,
                  ports=None, max_nnodes=None, spawn_workers=True,
                  upstream=None, hb_interval=2.0, hb_timeout=30.0,
-                 restart_crashed=True, max_piece_crashes=None):
+                 restart_crashed=True, max_piece_crashes=None,
+                 journal_path=None, resume_journal=None):
         super().__init__(daemon=True)
         self.server_id = make_id()
         self.headless = headless
@@ -96,6 +105,25 @@ class Server(threading.Thread):
             else getattr(_settings, "batch_max_crashes", 3)
         self.piece_crashes = {}            # piece key -> consecutive losses
         self.quarantined = []              # circuit-broken pieces
+        self.quarantine_reports = []       # BATCHQUARANTINE payloads —
+        #                                    replayed to late-joining
+        #                                    clients on REGISTER
+        # ----- durable BATCH state: append-only JSONL journal (WAL)
+        # replayed on restart (--resume-batch).  journal_path=None ->
+        # settings-derived default (<log_path>/batch-<serverid>.jsonl,
+        # or the resume journal itself so chained resumes keep one
+        # file); journal_path="" disables journaling.  The file is only
+        # created when the first BATCH record is appended.
+        from .journal import BatchJournal
+        self.resume_journal = resume_journal or None
+        if journal_path is None:
+            journal_path = self.resume_journal or os.path.join(
+                getattr(_settings, "log_path", "output"),
+                f"batch-{self.server_id.hex()}.jsonl")
+        self.journal = BatchJournal(
+            journal_path,
+            fsync=getattr(_settings, "batch_journal_fsync", True)) \
+            if journal_path else None
         # ----- server-to-server chaining
         self.upstream = upstream           # (host, event_port) or None
         self.link = None                   # DEALER to the upstream server
@@ -135,6 +163,18 @@ class Server(threading.Thread):
                  "--node-id", wid.hex()])
             self.processes.append(proc)
             self.spawned[wid] = proc
+
+    def _spawn_for_backlog(self, count=None):
+        """Spawn up to ``count`` workers (default: one per queued BATCH
+        piece), capped by the max_nnodes headroom — the ONE place the
+        headroom formula lives, so every requeue/replay/reap path
+        spawns consistently."""
+        headroom = self.max_nnodes - len(self.workers) \
+            - self._pending_spawns
+        n = max(0, min(len(self.scenarios) if count is None else count,
+                       headroom))
+        if n > 0:
+            self.addnodes(n)
 
     def stop(self):
         self._stop_requested = True
@@ -209,15 +249,23 @@ class Server(threading.Thread):
             self.piece_crashes.pop(key, None)
             self.quarantined.append(piece)
             pname = self._piece_name(piece)
+            if self.journal:
+                self.journal.quarantined(piece, count)
             msg = (f"BATCH piece '{pname}' quarantined: lost its worker "
                    f"{count} consecutive times (circuit breaker)")
             print(f"server: {msg}")
+            data = {"piece": pname, "crashes": count,
+                    "scencmd": list(piece[1])}
+            self.quarantine_reports.append(data)
             self._report_clients(msg)
-            self._report_clients(msg, name=b"BATCHQUARANTINE",
-                                 data={"piece": pname, "crashes": count,
-                                       "scencmd": list(piece[1])})
+            self._report_clients(msg, name=b"BATCHQUARANTINE", data=data)
         else:
+            # requeue BEFORE the journal append: the fsync is a real
+            # disk wait, and observers polling inflight/scenarios must
+            # never see the piece in neither
             self.scenarios.insert(0, piece)
+            if self.journal:
+                self.journal.crashed(piece, count)
 
     def _nodeschanged(self):
         """Notify clients; chained remote nodes are merged in (reference
@@ -245,15 +293,23 @@ class Server(threading.Thread):
                     self.avail_workers.append(sender)
                 self._send_pending_scenario()
                 self._nodeschanged()
-            elif sender not in self.clients:
+            new_client = False
+            if not from_worker and sender not in self.clients:
                 # backoff clients re-send REGISTER until acked — every
                 # resend must ack, but only the first may register
                 self.clients.append(sender)
+                new_client = True
             sock.send_multipart(
                 [sender, b"REGISTER",
                  packb({"host_id": self.server_id,
                         "nodes": list(self.workers)
                         + list(self.remote_nodes)})])
+            if new_client:
+                # replay circuit-breaker verdicts so a late-joining /
+                # reattaching operator still sees what the sweep dropped
+                for data in self.quarantine_reports:
+                    sock.send_multipart(
+                        [sender, b"BATCHQUARANTINE", packb(data)])
         elif name == b"ADDNODES":
             count = unpackb(payload) if payload else 1
             self.addnodes(int(count or 1))
@@ -273,10 +329,7 @@ class Server(threading.Thread):
                 self._nodeschanged()
                 # keep the batch draining if pieces are still queued
                 if self.scenarios:
-                    headroom = self.max_nnodes - len(self.workers) \
-                        - self._pending_spawns
-                    self.addnodes(max(0, min(len(self.scenarios),
-                                             headroom)))
+                    self._spawn_for_backlog()
             else:
                 self.workers[sender] = state
                 # worker dropped out of OP -> available for the next piece;
@@ -288,6 +341,9 @@ class Server(threading.Thread):
                         # reset its consecutive-crash count
                         self.piece_crashes.pop(self._piece_key(piece),
                                                None)
+                        if self.journal:    # exactly-once: a resumed
+                            # server will never requeue this piece
+                            self.journal.completed(piece, sender)
                     if sender not in self.avail_workers:
                         self.avail_workers.append(sender)
                         self._send_pending_scenario()
@@ -295,16 +351,41 @@ class Server(threading.Thread):
                     self.avail_workers.remove(sender)
         elif name == b"PONG":
             pass                           # last_seen already stamped
+        elif name == b"PREEMPTED" and from_worker:
+            # a preempted worker drained its chunk, wrote a checkpoint
+            # and is exiting: requeue its piece WITHOUT a circuit-
+            # breaker strike (preemption is capacity churn, not a piece
+            # fault) — the follow-up STATECHANGE(-1) then finds nothing
+            # in flight, so no crash is counted either
+            data = unpackb(payload) if payload else None
+            piece = self.inflight.pop(sender, None)
+            if piece is not None:
+                self.scenarios.insert(0, piece)
+                if self.journal:
+                    self.journal.preempted(piece, sender)
+                # hand the piece straight to an idle worker if one is
+                # available — the preempted worker's own STATECHANGE(-1)
+                # only spawns replacements, it does not dispatch
+                while self.avail_workers and self.scenarios:
+                    self._send_pending_scenario()
+            ck = (data or {}).get("checkpoint", "")
+            msg = (f"worker {sender.hex()} preempted"
+                   + (f" (checkpoint: {ck})" if ck else "")
+                   + (" — piece requeued" if piece is not None else ""))
+            print(f"server: {msg}")
+            self._report_clients(msg)
         elif name == b"BATCH":
             data = unpackb(payload)
-            self.scenarios.extend(
-                split_scenarios(data["scentime"], data["scencmd"]))
+            pieces = split_scenarios(data["scentime"], data["scencmd"])
+            if self.journal:
+                # one flush+fsync for the whole submission — per-piece
+                # syncs would stall the poll loop on large sweeps
+                self.journal.queued_many(pieces)
+            self.scenarios.extend(pieces)
             while self.avail_workers and self.scenarios:
                 self._send_pending_scenario()
             if self.scenarios:
-                headroom = self.max_nnodes - len(self.workers) \
-                    - self._pending_spawns
-                self.addnodes(max(0, min(len(self.scenarios), headroom)))
+                self._spawn_for_backlog()
         elif name == b"QUIT":
             for wid in self.workers:
                 self.be_event.send_multipart([wid, b"QUIT", packb(None)])
@@ -320,10 +401,51 @@ class Server(threading.Thread):
             wid = self.avail_workers.pop(0)
             piece = self.scenarios.pop(0)
             self.inflight[wid] = piece     # held until the worker leaves OP
+            if self.journal:
+                self.journal.dispatched(piece, wid)
             scentime, scencmd = piece
             self.be_event.send_multipart(
                 [wid, b"BATCH", packb({"scentime": scentime,
                                        "scencmd": scencmd})])
+
+    def _replay_journal(self):
+        """--resume-batch: rebuild the sweep from the journal —
+        completed pieces stay done (exactly-once), pieces in flight at
+        crash time are requeued, quarantine decisions (and their
+        client-visible reports) persist, crash counters carry over so
+        a poison pill cannot reset its strikes by killing the server."""
+        from .journal import BatchJournal
+        try:
+            state = BatchJournal.replay(self.resume_journal)
+        except OSError as e:
+            print(f"server: --resume-batch {self.resume_journal}: {e}")
+            return
+        for piece in state["quarantined"]:
+            self.quarantined.append(piece)
+            self.quarantine_reports.append(
+                {"piece": self._piece_name(piece),
+                 "crashes": state["quarantined_crashes"].get(
+                     BatchJournal.piece_key(piece), 0),
+                 "scencmd": list(piece[1]), "resumed": True})
+        for piece in state["pending"]:
+            jkey = BatchJournal.piece_key(piece)
+            if jkey in state["crashes"]:
+                self.piece_crashes[self._piece_key(piece)] = \
+                    state["crashes"][jkey]
+        self.scenarios.extend(state["pending"])
+        if self.journal:
+            self.journal.append("resumed",
+                                pending=len(state["pending"]),
+                                completed=len(state["completed"]),
+                                quarantined=len(state["quarantined"]))
+        print(f"server: resumed BATCH journal {self.resume_journal}: "
+              f"{len(state['pending'])} piece(s) requeued, "
+              f"{len(state['completed'])} already complete, "
+              f"{len(state['quarantined'])} quarantined"
+              + (f", {state['torn_lines']} torn line(s) skipped"
+                 if state["torn_lines"] else ""))
+        if self.scenarios and self.spawn_workers:
+            self._spawn_for_backlog()
 
     # ------------------------------------------------- liveness / chaining
     def _reap_dead_workers(self):
@@ -360,10 +482,7 @@ class Server(threading.Thread):
                 print(f"server: spawned worker {wid.hex()} died before "
                       f"registering (exit {proc.returncode})")
                 if self.restart_crashed and self.scenarios:
-                    headroom = self.max_nnodes - len(self.workers) \
-                        - self._pending_spawns
-                    if headroom > 0:
-                        self.addnodes(1)
+                    self._spawn_for_backlog(1)
         for wid in dead:
             print(f"server: worker {wid.hex()} died — "
                   f"{'requeueing piece, ' if wid in self.inflight else ''}"
@@ -375,10 +494,7 @@ class Server(threading.Thread):
                 self.avail_workers.remove(wid)
             self._requeue_lost_piece(wid)
             if self.restart_crashed and self.spawn_workers:
-                headroom = self.max_nnodes - len(self.workers) \
-                    - self._pending_spawns
-                if headroom > 0:
-                    self.addnodes(1)
+                self._spawn_for_backlog(1)
             while self.avail_workers and self.scenarios:
                 self._send_pending_scenario()
         if dead:
@@ -424,6 +540,8 @@ class Server(threading.Thread):
             self.link.send_multipart([b"REGISTER", packb(None)])
             poller.register(self.link, zmq.POLLIN)
         self.running = not self._stop_requested
+        if self.resume_journal:
+            self._replay_journal()
         if not self.headless:
             self.addnodes(1)
         while self.running:
@@ -477,6 +595,12 @@ class Server(threading.Thread):
                 proc.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 proc.kill()
+        if self.journal:
+            # clean-exit marker; queued-but-unfinished pieces stay
+            # pending in the journal, so --resume-batch still works
+            # after an orderly preemption shutdown
+            self.journal.shutdown()
+            self.journal.close()
         for sock in (self.fe_event, self.fe_stream, self.be_event,
                      self.be_stream):
             sock.close()

@@ -67,6 +67,15 @@ node_watchdog_warn = 30.0         # [s] event-loop silence before warning
 node_watchdog_kill = 0.0          # [s] silence before exit(70); 0 = never
 fault_seed = 0                    # RNG seed for the FAULT injectors
 
+# ----- durable runs (preemption-safe checkpoints + BATCH journal)
+snapshot_autosave_dt = 0.0        # [sim s] between on-disk autosnapshots
+                                  # of the newest ring entry (0 = off)
+snapshot_autosave_path = ""       # "" -> <log_path>/autosave.snap
+preempt_snapshot_dir = ""         # "" -> log_path; SIGTERM / FAULT
+                                  # PREEMPT final checkpoints land here
+batch_journal_fsync = True        # fsync each BATCH journal record (WAL
+                                  # durability vs append latency)
+
 _overrides = {}                   # file/CLI values for late-registered keys
 
 

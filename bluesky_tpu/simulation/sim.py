@@ -13,6 +13,7 @@ run at chunk boundaries.  With the default chunk of 20 steps (1 s sim time)
 command latency matches the reference's ASAS interval; BENCHMARK/FF runs use
 big chunks for full throughput.
 """
+import os
 import time
 from typing import Optional
 
@@ -206,6 +207,15 @@ class Simulation:
             depth=getattr(_fault_settings, "snap_ring_depth", 4),
             dt=getattr(_fault_settings, "snap_ring_dt", 30.0))
         self.guard = IntegrityGuard(self)
+        # Durable runs (docs/FAULT_TOLERANCE.md): periodic on-disk
+        # autosnapshot (off by default — one atomic write per interval)
+        # and the preemption flag the SIGTERM handler / FAULT PREEMPT
+        # injector raise; the owning node drains the chunk, checkpoints
+        # and exits (simnode), an embedded run checkpoints and pauses.
+        self.autosave_dt = float(getattr(
+            _fault_settings, "snapshot_autosave_dt", 0.0))
+        self._autosave_t = -float("inf")
+        self.preempt_requested = False
         self.traf.delete_hooks.append(self.cond.delac)
         # Late import to avoid cycles; stack binds commands to this sim.
         from ..stack.stack import Stack
@@ -349,11 +359,74 @@ class Simulation:
         self.metrics.reset()
         self.snap_ring.clear()
         self.guard.reset()
+        self._autosave_t = -float("inf")
+        # a stale preemption notice (FAULT PREEMPT timer armed before
+        # the RESET) must not fire into the freshly-reset sim
+        self.preempt_requested = False
         # After stack.reset: plugin reset hooks may stack commands (e.g.
         # TRAFGEN redraws its spawn circle) that must survive the reset.
         self.plugins.reset()
         self.plotter.reset()
         return True
+
+    # ----------------------------------------------------- preempt/autosave
+    def request_preempt(self):
+        """Raise the preemption flag (SIGTERM handler, FAULT PREEMPT):
+        handled at the next chunk edge so the in-flight device chunk
+        drains instead of being torn mid-scan."""
+        self.preempt_requested = True
+        return True
+
+    def handle_preempt(self):
+        """Drain-side response to a preemption notice: write a final
+        atomic checksummed checkpoint and pause.  Returns
+        ``(path_or_None, err_or_None)``.  Node wrappers call this at
+        the chunk edge, then notify the server and exit cleanly; an
+        embedded sim just pauses with the checkpoint on disk."""
+        from .. import settings as _settings
+        from . import snapshot as snap
+        self.preempt_requested = False
+        d = getattr(_settings, "preempt_snapshot_dir", "") \
+            or _settings.log_path
+        tag = getattr(getattr(self, "node", None), "node_id",
+                      b"").hex()[:8] or "sim"
+        path = os.path.join(d, f"preempt-{tag}.snap")
+        self.pause()
+        try:
+            os.makedirs(d, exist_ok=True)
+            snap.save(self, path)
+        except OSError as e:
+            self.scr.echo(f"preempt checkpoint FAILED: {e}")
+            return None, str(e)
+        self.scr.echo(f"preempted at simt={self.simt:.2f}: "
+                      f"checkpoint written to {path}")
+        return path, None
+
+    def _autosave_path(self):
+        from .. import settings as _settings
+        return getattr(_settings, "snapshot_autosave_path", "") \
+            or os.path.join(_settings.log_path, "autosave.snap")
+
+    def _autosave(self):
+        """Persist the newest SnapshotRing entry (or a fresh capture
+        when the ring is empty/stale) to disk atomically — the
+        periodic on-disk checkpoint a preempted/killed process resumes
+        from.  A failed write degrades to an echo, never an exception
+        out of the step loop."""
+        from . import snapshot as snap
+        blob = self.snap_ring.newest()
+        if blob is None \
+                or float(np.asarray(blob["state"].simt)) <= self._autosave_t:
+            blob = snap.state_blob(self)
+        path = self._autosave_path()
+        try:
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            snap.write_blob(blob, path)
+        except OSError as e:
+            self.scr.echo(f"autosnapshot failed: {e}")
+        self._autosave_t = self.simt
 
     def fastforward(self, nsec: Optional[float] = None):
         """FF [sec]: run at full speed [for nsec] (simulation.py:180-185)."""
@@ -547,6 +620,15 @@ class Simulation:
                 and self.guard.policy == "rollback":
             self.snap_ring.maybe_capture(self)
 
+        # Periodic on-disk autosnapshot (snapshot_autosave_dt, off by
+        # default): persist the newest ring entry — or a fresh capture
+        # when no ring is being kept — with the atomic checksummed
+        # writer, so a later preemption/kill resumes from here.
+        if self.autosave_dt > 0 and self.state_flag == OP \
+                and self.simt - self._autosave_t \
+                >= self.autosave_dt - 1e-9:
+            self._autosave()
+
         if self.ffstop is not None and self.simt >= self.ffstop - 1e-9:
             self._end_ff()
         return True
@@ -663,6 +745,12 @@ class Simulation:
                 # stop exactly at the horizon (ladder-quantized downstream)
                 mc = max(1, int(round(remaining / self.cfg.simdt)))
             alive = self.step(max_chunk=mc)
+            if self.preempt_requested:
+                # embedded-run preemption: checkpoint + pause here (a
+                # networked node drains via simnode instead, which also
+                # notifies the server and exits the process)
+                self.handle_preempt()
+                break
             if not alive or self.state_flag in (HOLD, END):
                 if self.state_flag == HOLD and until_simt is not None \
                         and self.simt < until_simt - 1e-9:

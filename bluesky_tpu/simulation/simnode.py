@@ -51,6 +51,30 @@ def _make_simnode_class(base):
             self.sim.scr.close()      # deregister stream timers
             super().close()
 
+        # ------------------------------------------------------ preemption
+        def on_preempt_signal(self, signum):
+            # SIGTERM from the scheduler: don't die mid-chunk — raise
+            # the flag and let step() drain + checkpoint at the edge
+            self.sim.request_preempt()
+
+        def _preempt_shutdown(self):
+            """Preemption-safe exit: the current chunk has drained
+            (sim.step returns at chunk edges), so write the final
+            checksummed checkpoint, tell the server (PREEMPTED — the
+            in-flight BATCH piece is requeued WITHOUT a circuit-breaker
+            strike; STATECHANGE -1 follows from the run() teardown)
+            and leave cleanly."""
+            sim = self.sim
+            path, err = sim.handle_preempt()
+            info = {"simt": sim.simt, "ntraf": sim.traf.ntraf}
+            if path:
+                info["checkpoint"] = path
+            if err:
+                info["error"] = err
+            self.send_event(b"PREEMPTED", info)
+            sim.stop()
+            self.quit()
+
         # ------------------------------------------------------------ events
         def event(self, name, data, sender_route):
             sim = self.sim
@@ -93,6 +117,9 @@ def _make_simnode_class(base):
             sim = self.sim
             sim.scr.update()
             alive = sim.step()
+            if sim.preempt_requested and self.running:
+                self._preempt_shutdown()
+                return
             if sim.state_flag != OP:
                 _time.sleep(0.02)   # idle pacing (~50 Hz stack polling)
             if sim.state_flag != self.prev_state:

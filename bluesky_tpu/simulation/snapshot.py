@@ -24,15 +24,31 @@ Two consumers share the blob format:
   but keeps stack/datalog/plugin state (``reset_traffic`` semantics, not
   the full ``reset``), so logs record the recovery instead of being
   truncated by it.
+
+On-disk format v3 (durable runs, docs/FAULT_TOLERANCE.md):
+
+    BSTPUSNAP3\\n <sha256-hex>\\n <pickled blob bytes>
+
+written atomically — tmp file in the same directory, flush + fsync,
+``os.replace`` onto the final name — so a crash mid-save can only leave
+a stale tmp file, never a torn file under the final name.  ``load``
+verifies the digest before unpickling: a bit-flipped blob that would
+still unpickle (failure class #2, torn write / silent corruption) is
+rejected instead of restored.  Plain-pickle v2 files (no magic) keep
+loading for back-compat.
 """
 import collections
+import hashlib
+import os
 import pickle
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
-FORMAT = 2
+FORMAT = 3
+COMPAT_FORMATS = (2, 3)         # blob formats restore_blob accepts
+MAGIC = b"BSTPUSNAP3\n"         # v3 file header (v2 = bare pickle)
 
 
 def state_blob(sim) -> dict:
@@ -74,7 +90,7 @@ def restore_blob(sim, blob, full_reset: bool = True):
     with it the record of the fault that triggered the rollback —
     survives the restore.
     """
-    if blob.get("format") != FORMAT:
+    if blob.get("format") not in COMPAT_FORMATS:
         return False, "unsupported snapshot format"
     traf = sim.traf
     if blob["nmax"] != traf.nmax or blob["wmax"] != traf.wmax:
@@ -126,30 +142,81 @@ def restore_blob(sim, blob, full_reset: bool = True):
                   f"at simt={sim.simt:.2f}")
 
 
-def save(sim, fname):
-    """Write a snapshot of the complete simulation state."""
-    blob = state_blob(sim)
-    with open(fname, "wb") as f:
-        pickle.dump(blob, f, protocol=pickle.HIGHEST_PROTOCOL)
+def write_blob(blob, fname):
+    """Atomically persist a state blob: tmp file + fsync + rename.
+
+    The tmp file lives in the destination directory (``os.replace``
+    must not cross filesystems); any failure removes it, so the final
+    name only ever holds a complete, checksummed snapshot — a previous
+    good file survives a failed re-save untouched.  Raises ``OSError``
+    on disk-full/bad-path; callers degrade to a command error.
+    """
+    payload = pickle.dumps(blob, protocol=pickle.HIGHEST_PROTOCOL)
+    digest = hashlib.sha256(payload).hexdigest().encode("ascii")
+    tmp = f"{fname}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(MAGIC + digest + b"\n" + payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, fname)
+    except OSError:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
     return fname
+
+
+def save(sim, fname):
+    """Write an atomic, checksummed snapshot of the complete simulation
+    state (format v3).  Raises ``OSError`` on disk-full/bad path — the
+    SNAPSHOT stack command catches it and degrades to a command error,
+    symmetric with the hardened ``load``."""
+    return write_blob(state_blob(sim), fname)
+
+
+def read_blob(fname):
+    """Read + verify a snapshot file; returns ``(blob, None)`` or
+    ``(None, errmsg)``.  v3 files are checksum-verified BEFORE
+    unpickling, so a bit-flipped payload that would still unpickle is
+    rejected; files without the v3 magic fall back to the v2 plain
+    pickle for back-compat."""
+    try:
+        with open(fname, "rb") as f:
+            raw = f.read()
+        if raw.startswith(MAGIC):
+            header_end = raw.index(b"\n", len(MAGIC))
+            digest = raw[len(MAGIC):header_end].decode("ascii")
+            payload = raw[header_end + 1:]
+            if hashlib.sha256(payload).hexdigest() != digest:
+                return None, ("corrupt or truncated snapshot "
+                              "(checksum mismatch)")
+            blob = pickle.loads(payload)
+        else:
+            blob = pickle.loads(raw)        # v2: bare pickle, no digest
+    except (OSError, EOFError, pickle.UnpicklingError, AttributeError,
+            MemoryError, ImportError, IndexError, KeyError,
+            ValueError) as exc:
+        return None, (f"corrupt or truncated snapshot "
+                      f"({type(exc).__name__}: {exc})")
+    if not isinstance(blob, dict) \
+            or blob.get("format") not in COMPAT_FORMATS:
+        return None, "unsupported snapshot format"
+    return blob, None
 
 
 def load(sim, fname):
     """Restore a snapshot into the running simulation.
 
-    Robust to damaged files: a truncated or corrupt snapshot (the
-    FAULT SNAPTRUNC chaos case) returns a command error instead of
-    raising out of the stack.
+    Robust to damaged files: a truncated, bit-flipped or corrupt
+    snapshot (the FAULT SNAPTRUNC chaos case) returns a command error
+    instead of raising out of the stack.
     """
-    try:
-        with open(fname, "rb") as f:
-            blob = pickle.load(f)
-    except (EOFError, pickle.UnpicklingError, AttributeError, MemoryError,
-            ImportError, IndexError, KeyError, ValueError) as exc:
-        return False, (f"{fname}: corrupt or truncated snapshot "
-                       f"({type(exc).__name__}: {exc})")
-    if not isinstance(blob, dict) or blob.get("format") != FORMAT:
-        return False, f"{fname}: unsupported snapshot format"
+    blob, err = read_blob(fname)
+    if blob is None:
+        return False, f"{fname}: {err}"
     ok, msg = restore_blob(sim, blob)
     return ok, (f"Snapshot {fname} {msg}" if ok else f"{fname}: {msg}")
 
@@ -182,6 +249,11 @@ class SnapshotRing:
     def capture(self, sim):
         self._ring.append(state_blob(sim))
         self.t_last = sim.simt
+
+    def newest(self):
+        """The most recent snapshot blob, or None (the autosnapshot
+        path persists this entry to disk without consuming it)."""
+        return self._ring[-1] if self._ring else None
 
     def maybe_capture(self, sim):
         """Capture if ``dt`` sim seconds have passed since the last one."""

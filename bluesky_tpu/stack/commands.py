@@ -1013,7 +1013,13 @@ def register_all(stack):
         if not fname.lower().endswith(".snap"):
             fname += ".snap"
         if s == "SAVE":
-            out = snap.save(sim, fname)
+            # disk-full / bad path degrades to a command error instead
+            # of raising out of the stack, symmetric with LOAD; the
+            # atomic writer guarantees any previous good file survives
+            try:
+                out = snap.save(sim, fname)
+            except OSError as e:
+                return False, f"SNAPSHOT SAVE {fname}: {e}"
             return True, f"Snapshot written to {out}"
         if s == "LOAD":
             import os as _os
@@ -1304,7 +1310,8 @@ def register_all(stack):
                     "[txt,word]", profile,
                     "JAX trace capture and per-kernel timings"],
         "FAULT": ["FAULT NAN/INF [acid] | GUARD ../RING .. | DROP/DUP/"
-                  "DELAY p | NETOFF | STALL s | KILL | SNAPTRUNC f | LIST",
+                  "DELAY p | NETOFF | STALL s | KILL | PREEMPT [s] | "
+                  "SNAPTRUNC f | LIST",
                   "[word,...]", faultcmd,
                   "Fault-injection harness (chaos testing)"],
         "SNAPSHOT": ["SNAPSHOT SAVE/LOAD fname", "txt,[word]", snapshot,

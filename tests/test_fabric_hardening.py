@@ -10,9 +10,16 @@
   and routes events for remote nodes over the link — a client on the
   chained server runs a stack command on a worker two servers away and
   gets the ECHO back.
+* Server crash-recovery: kill -9 a REAL server process (and its worker
+  children) mid-BATCH — a restarted server replays the journal with
+  ``--resume-batch`` semantics and the sweep completes with every
+  piece run exactly once (journal-verified).
 """
+import json
 import os
 import signal
+import subprocess
+import sys
 import threading
 import time
 
@@ -83,6 +90,124 @@ def test_killed_worker_piece_requeued_and_batch_completes(tmp_path):
         for proc in server.processes:
             if proc.poll() is None:
                 proc.kill()
+
+
+# Minimal real-process server driver: the Server class on caller-chosen
+# ports (the CLI pins worker ports to the global defaults, which would
+# collide across parallel test runs), run as its OWN process group so
+# SIGKILL takes the broker AND its spawned worker children down with no
+# teardown — a faithful server crash.
+_SERVER_DRIVER = """
+import sys
+from bluesky_tpu.network.server import Server
+ev, st, wev, wst = (int(a) for a in sys.argv[1:5])
+jpath = sys.argv[5]
+resume = sys.argv[6] if len(sys.argv) > 6 else None
+server = Server(headless=True,
+                ports=dict(event=ev, stream=st, wevent=wev, wstream=wst),
+                spawn_workers=True, max_nnodes=1, hb_interval=0.5,
+                journal_path=jpath, resume_journal=resume)
+server.start()
+server.addnodes(1)          # like run_server: one initial worker
+server.join()
+"""
+
+
+def _journal_records(jpath):
+    if not os.path.isfile(jpath):
+        return []
+    recs = []
+    with open(jpath) as f:
+        for line in f:
+            try:
+                recs.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    return recs
+
+
+def test_killed_server_resumes_batch_exactly_once(tmp_path):
+    """kill -9 the SERVER mid-BATCH; restart with --resume-batch: the
+    already-completed piece is not re-run, the in-flight piece is, and
+    the journal shows exactly one completion per piece."""
+    from bluesky_tpu.network.journal import BatchJournal
+
+    scn = tmp_path / "sweep.scn"
+    scn.write_text(
+        "00:00:00.00>SCEN CASE_A\n"
+        "00:00:00.00>CRE AAA1 B744 52 4 90 FL200 250\n"
+        "00:00:00.00>FF\n"
+        "00:05:00.00>HOLD\n"
+        "00:00:00.00>SCEN CASE_B\n"
+        "00:00:00.00>CRE BBB1 B744 53 5 90 FL300 250\n"
+        "00:00:00.00>FF\n"
+        "00:30:00.00>HOLD\n")
+    jpath = str(tmp_path / "batch.jsonl")
+
+    def start_server(ports, resume=None):
+        argv = [sys.executable, "-c", _SERVER_DRIVER,
+                *(str(p) for p in ports), jpath]
+        if resume:
+            argv.append(resume)
+        return subprocess.Popen(argv, start_new_session=True)
+
+    def kill_group(proc, sig):
+        try:
+            os.killpg(os.getpgid(proc.pid), sig)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+
+    ports = free_ports(4)
+    srv = start_server(ports)
+    client = Client()
+    srv2 = None
+    try:
+        client.connect(event_port=ports[0], stream_port=ports[1],
+                       timeout=30.0)
+        client.stack(f"BATCH {scn}")
+        # watch the journal (the only view an operator has of a remote
+        # server): wait until one piece completed and the next is in
+        # flight, then SIGKILL the whole server process group
+        def one_done_one_inflight():
+            client.receive(10)
+            recs = _journal_records(jpath)
+            done = {r["key"] for r in recs if r["rec"] == "completed"}
+            disp = [r for r in recs if r["rec"] == "dispatched"
+                    and r["key"] not in done]
+            return len(done) == 1 and len(disp) >= 1
+        assert wait_for(one_done_one_inflight, timeout=480), \
+            f"never reached one-done-one-inflight: {_journal_records(jpath)}"
+        kill_group(srv, signal.SIGKILL)
+        srv.wait(timeout=10)
+
+        st = BatchJournal.replay(jpath)
+        assert len(st["completed"]) == 1 and len(st["pending"]) == 1
+
+        # ---- restart from the journal (fresh ports = fresh fabric)
+        ports2 = free_ports(4)
+        srv2 = start_server(ports2, resume=jpath)
+
+        def sweep_complete():
+            st = BatchJournal.replay(jpath)
+            return not st["pending"] and len(st["completed"]) == 2
+        assert wait_for(sweep_complete, timeout=480), \
+            f"resumed sweep never completed: {_journal_records(jpath)}"
+
+        # journal-verified exactly-once: one completion per piece key
+        completed = [r["key"] for r in _journal_records(jpath)
+                     if r["rec"] == "completed"]
+        assert len(completed) == 2 and len(set(completed)) == 2
+        assert any(r["rec"] == "resumed"
+                   for r in _journal_records(jpath))
+    finally:
+        client.close()
+        kill_group(srv, signal.SIGKILL)
+        if srv2 is not None:
+            kill_group(srv2, signal.SIGTERM)   # clean preemption path
+            try:
+                srv2.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                kill_group(srv2, signal.SIGKILL)
 
 
 def test_silent_external_worker_is_reaped():
