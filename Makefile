@@ -23,13 +23,15 @@ test-fast:
 
 # Fault-injection lane: the full chaos suite (tests/test_chaos.py,
 # docs/FAULT_TOLERANCE.md recovery matrix), the durability suite
-# (atomic snapshots, preemption, BATCH journal crash-resume) and the
-# slow fabric cases (kill -9 a real worker mid-BATCH, silent-worker
-# reaping).
+# (atomic snapshots, preemption, BATCH journal crash-resume), the
+# overload/straggler suite (admission control, fairness, hedging,
+# HEALTH — incl. the slow 16-piece FAULT STRAGGLE acceptance case)
+# and the slow fabric cases (kill -9 a real worker mid-BATCH,
+# silent-worker reaping).
 chaos:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	$(PYTHON) -m pytest tests/test_chaos.py tests/test_durability.py \
-	tests/test_fabric_hardening.py -q $(XDIST)
+	tests/test_overload.py tests/test_fabric_hardening.py -q $(XDIST)
 
 lint:
 	@$(PYTHON) -m pyflakes bluesky_tpu tests 2>/dev/null \

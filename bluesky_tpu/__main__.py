@@ -277,10 +277,17 @@ def run_client(args):
                    event_port=args.event_port or settings.event_port,
                    stream_port=args.stream_port or settings.stream_port)
     client.subscribe(b"SIMINFO")
-    client.event_received.connect(
-        lambda name, data, sender: print(
-            data.get("text", data) if isinstance(data, dict) else data)
-        if name == b"ECHO" else None)
+
+    def on_event(name, data, sender):
+        if name in (b"ECHO", b"HEALTH"):
+            print(data.get("text", data) if isinstance(data, dict)
+                  else data)
+        elif name == b"BATCHREJECTED":
+            d = data or {}
+            print(f"BATCH rejected: queue {d.get('queue_depth', '?')}/"
+                  f"{d.get('limit', '?')} full — retry in "
+                  f"{d.get('retry_after', '?')} s")
+    client.event_received.connect(on_event)
     print(f"connected to {client.host_id.hex()}; "
           f"{len(client.nodes)} node(s). Ctrl-D to quit.")
     try:
@@ -291,7 +298,12 @@ def run_client(args):
                 continue
             if line.upper() in ("QUIT", "EXIT", "BYE"):
                 break
-            client.stack(line)
+            if line.upper() == "HEALTH":
+                # fabric-level introspection is answered by the SERVER,
+                # not the active sim node
+                client.request_health()
+            else:
+                client.stack(line)
             # give the reply a moment to arrive
             for _ in range(20):
                 if client.receive(25):

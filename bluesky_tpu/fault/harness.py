@@ -13,6 +13,11 @@ Usage (stack/commands.py registers it):
   FAULT DELAY sec            delay outgoing event frames by sec
   FAULT NETOFF               remove transport faults
   FAULT STALL sec            stall this worker's event loop for sec
+  FAULT STRAGGLE factor      throttle the chunk loop (factor extra
+                             wall-s per sim-s): the merely-slow worker
+  FAULT STRAGGLE STALL [sec] freeze progress (heartbeats keep flowing)
+                             [for sec]; server-side hedging recovers
+  FAULT STRAGGLE OFF         clear the straggle fault
   FAULT KILL                 SIGKILL this worker (no goodbye)
   FAULT PREEMPT [delay]      preemption notice (SIGTERM model): drain
                              the chunk, checkpoint, notify, exit
@@ -47,6 +52,11 @@ def _status(sim):
                      f"delayed {sock.n_delayed})")
     else:
         lines.append("transport: clean")
+    if getattr(sim, "straggle_stall", False):
+        lines.append("straggle: STALLED (progress frozen)")
+    elif getattr(sim, "straggle_factor", 0.0) > 0:
+        lines.append(f"straggle: throttled +{sim.straggle_factor:g} "
+                     f"wall s per sim s")
     return True, "\n".join(lines)
 
 
@@ -128,6 +138,29 @@ def fault_command(sim, *args):
         injectors.stall(sec)
         return True, f"FAULT: stalled {sec:g} s"
 
+    if sub == "STRAGGLE":
+        arg = rest[0].upper() if rest else ""
+        if arg in ("OFF", "0"):
+            injectors.straggle(sim)
+            return True, "FAULT: straggle cleared"
+        if arg == "STALL":
+            try:
+                dur = float(rest[1]) if len(rest) > 1 else 0.0
+            except ValueError:
+                return False, "FAULT STRAGGLE STALL [seconds]"
+            injectors.straggle(sim, stall_progress=True, stall_s=dur)
+            return True, ("FAULT: progress stalled"
+                          + (f" for {dur:g} s" if dur > 0 else "")
+                          + " — heartbeats keep flowing; the server "
+                            "hedges the piece after straggler_timeout")
+        try:
+            factor = float(arg) if arg else 1.0
+        except ValueError:
+            return False, "FAULT STRAGGLE factor | STALL [s] | OFF"
+        injectors.straggle(sim, factor=factor)
+        return True, (f"FAULT: chunk loop throttled — +{factor:g} wall "
+                      f"s per sim s")
+
     if sub == "KILL":
         injectors.kill_self()          # no return: SIGKILL
 
@@ -164,5 +197,5 @@ def fault_command(sim, *args):
             for t in sim.guard.trips)
 
     return False, ("FAULT NAN/INF [acid] | GUARD .. | RING .. | DROP/DUP/"
-                   "DELAY p | NETOFF | STALL s | KILL | PREEMPT [s] | "
-                   "SNAPTRUNC f | LIST")
+                   "DELAY p | NETOFF | STALL s | STRAGGLE f/STALL/OFF | "
+                   "KILL | PREEMPT [s] | SNAPTRUNC f | LIST")

@@ -163,6 +163,42 @@ def stall(seconds: float):
     time.sleep(float(seconds))
 
 
+def straggle(sim, factor: float = 0.0, stall_progress: bool = False,
+             stall_s: float = 0.0):
+    """The merely-slow / stuck-but-alive worker model (the dominant
+    throughput killer in multi-GPU traffic simulation, arXiv:2406.08496
+    load imbalance) — the fault class PING silence can NOT detect,
+    because the event loop keeps running and heartbeats keep flowing.
+
+    ``factor`` throttles the chunk loop (each sim second costs
+    ``factor`` extra wall seconds), sinking this worker's progress
+    rate below the fleet median.  ``stall_progress`` freezes progress
+    outright (the chunk loop spins without advancing simt) — with
+    ``stall_s`` set, a timer releases the stall after that long.  The
+    server's progress-heartbeat straggler detector is the detector;
+    speculative hedging is the response.  ``factor=0`` and
+    ``stall_progress=False`` clears the fault.  Both settings survive
+    sim RESET on purpose: they model host slowness, not scenario
+    state."""
+    sim.straggle_factor = max(0.0, float(factor))
+    sim.straggle_stall = bool(stall_progress)
+    sim._straggle_debt = 0.0       # a new injection starts clean
+    # generation stamp: a timed stall's auto-clear must not fire into a
+    # LATER straggle injection (re-issuing an indefinite stall while an
+    # old timer is pending would otherwise end it early)
+    gen = getattr(sim, "_straggle_gen", 0) + 1
+    sim._straggle_gen = gen
+    if stall_progress and stall_s and float(stall_s) > 0:
+        def _clear():
+            if getattr(sim, "_straggle_gen", 0) == gen:
+                sim.straggle_stall = False
+        t = threading.Timer(float(stall_s), _clear)
+        t.daemon = True
+        t.start()
+        return t
+    return None
+
+
 # ------------------------------------------------------------- file faults
 def truncate_file(fname: str, keep_fraction: float = 0.5) -> int:
     """Truncate a file (snapshot, log) to a fraction of its size —

@@ -28,6 +28,10 @@ class Client:
         self.stream_received = Signal("stream")
         self.nodes_changed = Signal("nodes")
         self._pending = []         # node-bound events queued until a node registers
+        self.last_rejection = None  # latest BATCHREJECTED payload (the
+        #                             admission-control refusal carries
+        #                             queue depth + a retry-after hint)
+        self.last_health = None     # latest HEALTH reply payload
         ctx = zmq.Context.instance()
         self.event_io = ctx.socket(zmq.DEALER)
         self.event_io.setsockopt(zmq.IDENTITY, self.client_id)
@@ -119,6 +123,12 @@ class Client:
     def stack(self, cmdline: str, target=None):
         self.send_event(b"STACKCMD", cmdline, target)
 
+    def request_health(self):
+        """Ask the server for its serving-fabric health snapshot; the
+        reply arrives as a ``HEALTH`` event (also cached in
+        ``self.last_health``)."""
+        self.send_event(b"HEALTH", target=b"")
+
     def subscribe(self, streamname: bytes, node_id: bytes = b""):
         self.stream_in.setsockopt(zmq.SUBSCRIBE, streamname + node_id)
 
@@ -155,6 +165,10 @@ class Client:
             self.host_id = data["host_id"]
             self._set_nodes(data["nodes"])
         else:
+            if name == b"BATCHREJECTED":
+                self.last_rejection = data   # retry logic reads this
+            elif name == b"HEALTH":
+                self.last_health = data
             sender = route[0] if route else b""
             self.event_received.emit(name, data, sender)
 

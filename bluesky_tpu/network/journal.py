@@ -22,6 +22,20 @@ Record types (one JSON object per line, ``rec`` selects the type):
   ``quarantined`` {key, piece, crashes}     circuit-broken: never requeue
   ``preempted``   {key, worker}             worker preempted mid-piece:
                                             requeue WITHOUT a strike
+  ``hedged``      {key, worker, hedge_worker}  speculative straggler
+                                            re-dispatch: a SECOND copy
+                                            of an in-flight piece went
+                                            to ``hedge_worker`` (first
+                                            completion wins)
+  ``dup_completed`` {key, worker}           the hedge LOSER also finished
+                                            after the winner's
+                                            ``completed``: recorded for
+                                            audit, NOT counted as a
+                                            completion (a repeat-trial
+                                            sweep queueing identical
+                                            content twice must not have
+                                            its second copy consumed by
+                                            a hedge duplicate)
   ``resumed``     {pending, completed, quarantined}  replay marker
   ``shutdown``    {}                        clean server exit
 
@@ -137,6 +151,16 @@ class BatchJournal:
         self.append("preempted", key=self.piece_key(piece),
                     worker=worker.hex())
 
+    def hedged(self, piece, worker: bytes = b"",
+               hedge_worker: bytes = b""):
+        self.append("hedged", key=self.piece_key(piece),
+                    worker=worker.hex(),
+                    hedge_worker=hedge_worker.hex())
+
+    def dup_completed(self, piece, worker: bytes = b""):
+        self.append("dup_completed", key=self.piece_key(piece),
+                    worker=worker.hex())
+
     def shutdown(self):
         # clean-exit marker — only if this run ever journaled anything
         # (a server that never saw a BATCH must not litter log_path
@@ -199,8 +223,15 @@ class BatchJournal:
                     n_queued[key] = n_queued.get(key, 0) + 1
                 elif key not in pieces:
                     continue              # marker records / unknown key
-                elif rec in ("dispatched", "preempted"):
-                    pass                  # owed copies = queued - completed
+                elif rec in ("dispatched", "preempted", "hedged",
+                             "dup_completed"):
+                    # owed copies = queued - completed.  A hedge is a
+                    # duplicate of an already-dispatched copy, and a
+                    # dup_completed is the hedge loser finishing after
+                    # the winner — counting either as a dispatch or a
+                    # completion would break exactly-once for repeat-
+                    # trial sweeps (identical content queued N times).
+                    pass
                 elif rec == "crashed":
                     crashes[key] = int(r.get("crashes",
                                              crashes.get(key, 0) + 1))

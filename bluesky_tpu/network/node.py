@@ -107,6 +107,11 @@ class Node:
         self.event_io.setsockopt(zmq.LINGER, 500)
         self.stream_out = ctx.socket(zmq.PUB)
         self.stream_out.setsockopt(zmq.LINGER, 0)
+        # bounded send buffer: a stalled broker/subscriber costs this
+        # worker dropped stream frames (PUB drops at HWM), never a
+        # blocked step loop (docs/FAULT_TOLERANCE.md row #11)
+        self.stream_out.setsockopt(
+            zmq.SNDHWM, int(getattr(settings, "stream_sndhwm", 1000)))
         self._endpoints = (f"tcp://{host}:{event_port}",
                            f"tcp://{host}:{stream_port}")
 
@@ -185,6 +190,16 @@ class Node:
             self.watchdog.stop()
 
     # ------------------------------------------------------------ overrides
+    def heartbeat_payload(self, stamp):
+        """PONG payload for a server PING.  The base node just echoes
+        the stamp; SimNode returns a progress dict (simt, chunks done,
+        state) so the server's straggler detector can distinguish a
+        worker that is advancing slowly from one whose progress has
+        stalled outright — and both from one that is silent (a long
+        first-compile blocks this loop entirely, so NO heartbeat
+        arrives and the busy-PING budget applies instead)."""
+        return stamp
+
     def event(self, name: bytes, data, sender_route):
         """Handle one event; override in subclasses."""
 
@@ -207,8 +222,10 @@ class Node:
                 self.host_id = data["host_id"]
             elif name == b"PING":
                 # server liveness probe: echo the stamp back (the reply
-                # is protocol-level so every Node flavor is covered)
-                self.send_event(b"PONG", data)
+                # is protocol-level so every Node flavor is covered).
+                # Subclasses piggyback progress on the reply so the
+                # server can tell a stalled worker from a busy one.
+                self.send_event(b"PONG", self.heartbeat_payload(data))
             elif name == b"QUIT":
                 self.quit()
             else:

@@ -29,6 +29,20 @@ Hardening beyond the reference:
   completion semantics.  A ``PREEMPTED`` notice from a draining worker
   requeues its piece without a circuit-breaker strike, and
   ``BATCHQUARANTINE`` reports are replayed to late-joining clients.
+* **Overload/straggler serving layer** (docs/FAULT_TOLERANCE.md rows
+  #10/#11): workers piggyback per-piece progress (simt, chunks done)
+  on their PONG replies; an in-flight piece whose progress stalls past
+  ``straggler_timeout`` — or whose rate falls far below the fleet
+  median — while heartbeats stay fresh is *hedged*: a second copy goes
+  to an idle worker, first completion wins, the loser is cancelled
+  (``BATCHCANCEL``), and the journal records ``hedged``/
+  ``dup_completed`` so exactly-once survives a crash mid-hedge.
+  Admission control bounds the pending queue (``batch_queue_max``,
+  over-limit submissions get a structured ``BATCHREJECTED``), dispatch
+  is round-robin per submitting client (one heavy client cannot starve
+  the rest), the stream path is bounded (SNDHWM + drop counter) so a
+  stalled GUI cannot back-pressure the broker, and ``HEALTH`` returns
+  the whole picture machine-readably.
 * **Server-to-server chaining** (reference server.py:213-225): a server
   started with ``upstream=(host, port)`` registers at another server's
   client port, mirrors that server's node table to its own clients
@@ -37,7 +51,9 @@ Hardening beyond the reference:
   accumulated sender tail (single-hop routes are palindromes, so the
   flat fabric is unaffected).
 """
+import collections
 import os
+import statistics
 import subprocess
 import sys
 import threading
@@ -65,6 +81,85 @@ def split_scenarios(scentime, scencmd):
             for a, b in zip(bounds[:-1], bounds[1:])]
 
 
+class FairQueue:
+    """Per-client round-robin queue of pending BATCH pieces.
+
+    One flood-submitting client must not starve the others, so pieces
+    are held in per-owner sub-queues and ``pop_next`` serves owners in
+    rotation.  The *read* surface stays list-like (``len``/``bool``/
+    ``iter``/``[i]`` over the flattened drain order) because operators,
+    tests and the journal-replay path all inspect the queue like the
+    plain list it replaces; mutation goes through ``push``/
+    ``push_front``/``extend`` so every piece keeps its owner.
+    """
+
+    def __init__(self):
+        self._queues = {}                  # owner -> deque of pieces
+        self._rr = collections.deque()     # owner service rotation
+
+    def _ensure(self, owner):
+        q = self._queues.get(owner)
+        if q is None:
+            q = self._queues[owner] = collections.deque()
+            self._rr.append(owner)
+        return q
+
+    def push(self, piece, owner=b""):
+        self._ensure(owner).append(piece)
+
+    def push_front(self, piece, owner=b""):
+        """Requeue (crash/preempt/resume): the piece goes back to the
+        FRONT of its owner's sub-queue, keeping sweep order."""
+        self._ensure(owner).appendleft(piece)
+
+    def extend(self, pieces, owner=b""):
+        self._ensure(owner).extend(pieces)
+
+    def pop_next(self):
+        """``(owner, piece)`` from the next owner in rotation with work
+        pending, or ``None``.  The served owner moves to the back."""
+        for _ in range(len(self._rr)):
+            owner = self._rr[0]
+            self._rr.rotate(-1)
+            q = self._queues.get(owner)
+            if q:
+                return owner, q.popleft()
+        return None
+
+    def depth_by_owner(self):
+        return {o: len(q) for o, q in self._queues.items() if q}
+
+    def _flat(self):
+        """Flattened round-robin drain order (what pop_next would
+        yield), starting from the current rotation head.  Index
+        pointers keep this O(total) — observers poll it."""
+        qs = {o: list(q) for o, q in self._queues.items() if q}
+        order = [o for o in self._rr if o in qs]
+        idx = dict.fromkeys(order, 0)
+        out = []
+        remaining = sum(len(q) for q in qs.values())
+        while remaining:
+            for o in order:
+                i = idx[o]
+                if i < len(qs[o]):
+                    out.append(qs[o][i])
+                    idx[o] = i + 1
+                    remaining -= 1
+        return out
+
+    def __len__(self):
+        return sum(len(q) for q in self._queues.values())
+
+    def __bool__(self):
+        return any(self._queues.values())
+
+    def __iter__(self):
+        return iter(self._flat())
+
+    def __getitem__(self, i):
+        return self._flat()[i]
+
+
 class Server(threading.Thread):
     """Runs the broker loop in a thread (reference: Server(Thread))."""
 
@@ -72,7 +167,9 @@ class Server(threading.Thread):
                  ports=None, max_nnodes=None, spawn_workers=True,
                  upstream=None, hb_interval=2.0, hb_timeout=30.0,
                  restart_crashed=True, max_piece_crashes=None,
-                 journal_path=None, resume_journal=None):
+                 journal_path=None, resume_journal=None,
+                 straggler_timeout=None, hedge_enabled=None,
+                 batch_queue_max=None):
         super().__init__(daemon=True)
         self.server_id = make_id()
         self.headless = headless
@@ -84,7 +181,8 @@ class Server(threading.Thread):
         self.clients = []                  # connected client ids
         self.workers = {}                  # worker_id -> state int
         self.avail_workers = []            # idle worker ids (for BATCH)
-        self.scenarios = []                # pending BATCH pieces
+        self.scenarios = FairQueue()       # pending BATCH pieces,
+        #                                    round-robin per client
         self.processes = []                # spawned worker Popen handles
         self._pending_spawns = 0           # spawned but not yet REGISTERed
         # ----- liveness / restart
@@ -93,6 +191,8 @@ class Server(threading.Thread):
         self.restart_crashed = restart_crashed
         self.spawned = {}                  # worker_id -> Popen
         self.inflight = {}                 # worker_id -> BATCH piece
+        self.inflight_owner = {}           # worker_id -> submitting client
+        self.inflight_t = {}               # worker_id -> dispatch stamp
         self.last_seen = {}                # worker_id -> monotonic stamp
         self._next_hb = 0.0
         # ----- per-scenario circuit breaker: a piece that loses its
@@ -105,9 +205,40 @@ class Server(threading.Thread):
             else getattr(_settings, "batch_max_crashes", 3)
         self.piece_crashes = {}            # piece key -> consecutive losses
         self.quarantined = []              # circuit-broken pieces
-        self.quarantine_reports = []       # BATCHQUARANTINE payloads —
-        #                                    replayed to late-joining
-        #                                    clients on REGISTER
+        # BATCHQUARANTINE payloads replayed to late-joining clients on
+        # REGISTER — capped so a long-lived server does not replay
+        # unbounded quarantine history to every reattaching operator
+        self.quarantine_reports = collections.deque(
+            maxlen=max(1, int(getattr(_settings,
+                                      "quarantine_report_cap", 64))))
+        # ----- overload / straggler layer (docs/FAULT_TOLERANCE.md
+        # rows #10/#11): per-worker progress from heartbeat PONGs,
+        # speculative hedges, admission control + drop counters
+        self.straggler_timeout = straggler_timeout \
+            if straggler_timeout is not None \
+            else getattr(_settings, "straggler_timeout", 30.0)
+        self.hedge_enabled = hedge_enabled if hedge_enabled is not None \
+            else getattr(_settings, "hedge_enabled", True)
+        self.hedge_rate_factor = getattr(_settings,
+                                         "hedge_rate_factor", 0.2)
+        self.batch_queue_max = batch_queue_max \
+            if batch_queue_max is not None \
+            else getattr(_settings, "batch_queue_max", 4096)
+        self.hb_busy_multiplier = getattr(_settings,
+                                          "hb_busy_multiplier", 10.0)
+        self.worker_progress = {}          # wid -> {simt, chunks, rate,
+        #                                    t (last report), advance_t}
+        self.hedge_by = {}                 # primary wid -> hedge wid
+        self.hedge_of = {}                 # hedge wid -> primary wid
+        self._cancel_pending = {}          # cancelled loser wid -> piece
+        self.hedges_started = 0
+        self.hedges_won_hedge = 0          # hedge copy finished first
+        self.hedges_won_primary = 0        # primary recovered and won
+        self.hedges_cancelled = 0          # losers that acked the cancel
+        self.dup_completions = 0           # losers that finished anyway
+        self.rejected_batches = 0          # BATCHREJECTED sent
+        self.stream_drops = 0              # stream frames dropped at HWM
+        self._completion_stamps = collections.deque(maxlen=64)
         # ----- durable BATCH state: append-only JSONL journal (WAL)
         # replayed on restart (--resume-batch).  journal_path=None ->
         # settings-derived default (<log_path>/batch-<serverid>.jsonl,
@@ -143,6 +274,15 @@ class Server(threading.Thread):
         self.be_event.setsockopt(zmq.LINGER, 500)
         self.fe_stream.setsockopt(zmq.LINGER, 0)
         self.be_stream.setsockopt(zmq.LINGER, 0)
+        # Bounded stream buffering (row #11): SNDHWM caps the per-
+        # subscriber queue, and XPUB_NODROP turns an over-HWM send into
+        # EAGAIN instead of a silent per-peer drop — the forward loop
+        # then drops the frame itself and COUNTS it (stream_drops), so
+        # a stalled GUI client costs observable drops, never broker
+        # back-pressure or unbounded memory.
+        self.fe_stream.setsockopt(
+            zmq.SNDHWM, int(getattr(_settings, "stream_sndhwm", 1000)))
+        self.fe_stream.setsockopt(zmq.XPUB_NODROP, 1)
 
     # ----------------------------------------------------------- lifecycle
     def addnodes(self, count=1):
@@ -232,6 +372,19 @@ class Server(threading.Thread):
         for cid in self.clients:
             self.fe_event.send_multipart([cid, name, payload])
 
+    def _drop_hedge_links(self, wid):
+        """Dissolve any hedge pairing ``wid`` is part of; returns the
+        partner id if the partner is STILL running the piece (so the
+        piece is not actually lost), else None."""
+        partner = self.hedge_by.pop(wid, None)
+        if partner is None:
+            partner = self.hedge_of.pop(wid, None)
+            self.hedge_by.pop(partner, None)
+        else:
+            self.hedge_of.pop(partner, None)
+        return partner if partner is not None \
+            and partner in self.inflight else None
+
     def _requeue_lost_piece(self, wid):
         """A worker was lost with a BATCH piece in flight: requeue the
         piece — unless it has now taken down a worker
@@ -239,8 +392,20 @@ class Server(threading.Thread):
         circuit-broken: quarantined server-side and reported to every
         client (ECHO + a machine-readable BATCHQUARANTINE event)
         instead of being requeued into an infinite crash loop."""
+        self._cancel_pending.pop(wid, None)
         piece = self.inflight.pop(wid, None)
+        owner = self.inflight_owner.pop(wid, b"")
+        self.inflight_t.pop(wid, None)
+        self.worker_progress.pop(wid, None)
         if piece is None:
+            return
+        if self._drop_hedge_links(wid) is not None:
+            # the hedge partner still runs a copy of this piece: the
+            # piece is not lost, so neither a requeue nor a circuit-
+            # breaker strike — one crashed half of a hedge must not
+            # poison-count content the other half may yet complete
+            print(f"server: hedged worker {wid.hex()} lost — partner "
+                  f"still running the piece, no requeue")
             return
         key = self._piece_key(piece)
         count = self.piece_crashes.get(key, 0) + 1
@@ -263,7 +428,7 @@ class Server(threading.Thread):
             # requeue BEFORE the journal append: the fsync is a real
             # disk wait, and observers polling inflight/scenarios must
             # never see the piece in neither
-            self.scenarios.insert(0, piece)
+            self.scenarios.push_front(piece, owner)
             if self.journal:
                 self.journal.crashed(piece, count)
 
@@ -339,18 +504,45 @@ class Server(threading.Thread):
                     piece = self.inflight.pop(sender, None)
                     if piece is not None:   # piece completed cleanly:
                         # reset its consecutive-crash count
+                        self.inflight_owner.pop(sender, None)
+                        self.inflight_t.pop(sender, None)
                         self.piece_crashes.pop(self._piece_key(piece),
                                                None)
+                        self._completion_stamps.append(time.monotonic())
                         if self.journal:    # exactly-once: a resumed
                             # server will never requeue this piece
                             self.journal.completed(piece, sender)
+                        self._resolve_hedge_win(sender, piece)
+                    elif sender in self._cancel_pending:
+                        # the hedge LOSER finished before its cancel
+                        # landed (its BATCHCANCELLED ack would have
+                        # arrived first — DEALER/ROUTER pairs are FIFO):
+                        # a duplicate completion.  Audit-journal it;
+                        # replay does NOT count it as a completion.
+                        dup = self._cancel_pending.pop(sender)
+                        self.dup_completions += 1
+                        if self.journal:
+                            self.journal.dup_completed(dup, sender)
                     if sender not in self.avail_workers:
                         self.avail_workers.append(sender)
                         self._send_pending_scenario()
                 elif sender in self.avail_workers:
                     self.avail_workers.remove(sender)
         elif name == b"PONG":
-            pass                           # last_seen already stamped
+            # last_seen already stamped; a SimNode piggybacks progress
+            # (simt, chunks done) on the reply — feed the straggler
+            # detector so a stall is distinguishable from a long chunk
+            data = unpackb(payload) if payload else None
+            if isinstance(data, dict) and "simt" in data:
+                self._note_progress(sender, data)
+        elif name == b"BATCHCANCELLED" and from_worker:
+            # hedge loser acked the cancel (it had NOT completed: a
+            # completion would have arrived first on the FIFO pair)
+            if self._cancel_pending.pop(sender, None) is not None:
+                self.hedges_cancelled += 1
+        elif name == b"HEALTH":
+            sock.send_multipart(
+                [sender, b"HEALTH", packb(self.health_payload())])
         elif name == b"PREEMPTED" and from_worker:
             # a preempted worker drained its chunk, wrote a checkpoint
             # and is exiting: requeue its piece WITHOUT a circuit-
@@ -359,8 +551,15 @@ class Server(threading.Thread):
             # in flight, so no crash is counted either
             data = unpackb(payload) if payload else None
             piece = self.inflight.pop(sender, None)
+            owner = self.inflight_owner.pop(sender, b"")
+            self.inflight_t.pop(sender, None)
+            if piece is not None and self._drop_hedge_links(sender) \
+                    is not None:
+                # the hedge partner still runs this piece — a preempted
+                # hedge half neither requeues nor re-dispatches
+                piece = None
             if piece is not None:
-                self.scenarios.insert(0, piece)
+                self.scenarios.push_front(piece, owner)
                 if self.journal:
                     self.journal.preempted(piece, sender)
                 # hand the piece straight to an idle worker if one is
@@ -377,11 +576,28 @@ class Server(threading.Thread):
         elif name == b"BATCH":
             data = unpackb(payload)
             pieces = split_scenarios(data["scentime"], data["scencmd"])
+            # Admission control: a flood of submissions must not grow
+            # the pending queue (and its journal) without bound.  The
+            # over-limit submitter gets a structured refusal with the
+            # queue state and a drain-rate-informed retry hint; the
+            # queue and journal stay untouched.
+            depth = len(self.scenarios)
+            if self.batch_queue_max \
+                    and depth + len(pieces) > self.batch_queue_max:
+                self.rejected_batches += 1
+                sock.send_multipart(
+                    [sender, b"BATCHREJECTED",
+                     packb({"queue_depth": depth,
+                            "limit": self.batch_queue_max,
+                            "submitted": len(pieces),
+                            "retry_after": self._retry_after(
+                                len(pieces))})])
+                return
             if self.journal:
                 # one flush+fsync for the whole submission — per-piece
                 # syncs would stall the poll loop on large sweeps
                 self.journal.queued_many(pieces)
-            self.scenarios.extend(pieces)
+            self.scenarios.extend(pieces, owner=sender)
             while self.avail_workers and self.scenarios:
                 self._send_pending_scenario()
             if self.scenarios:
@@ -399,14 +615,228 @@ class Server(threading.Thread):
     def _send_pending_scenario(self):
         if self.avail_workers and self.scenarios:
             wid = self.avail_workers.pop(0)
-            piece = self.scenarios.pop(0)
+            owner, piece = self.scenarios.pop_next()
             self.inflight[wid] = piece     # held until the worker leaves OP
+            self.inflight_owner[wid] = owner
+            self.inflight_t[wid] = time.monotonic()
+            prog = self.worker_progress.get(wid)
+            if prog is not None:           # straggler clock restarts at
+                prog["advance_t"] = self.inflight_t[wid]   # dispatch
             if self.journal:
                 self.journal.dispatched(piece, wid)
             scentime, scencmd = piece
             self.be_event.send_multipart(
                 [wid, b"BATCH", packb({"scentime": scentime,
                                        "scencmd": scencmd})])
+
+    # ------------------------------------------- stragglers / introspection
+    def _note_progress(self, wid, data):
+        """Fold a progress heartbeat (PONG payload from a SimNode) into
+        the per-worker record: sim-time/chunk counters, the stamp of
+        the last *advance*, and an EMA progress rate [sim s / wall s].
+        A BATCH dispatch resets the sim (simt drops to 0), so chunk
+        count — monotonic per worker process — is the advance signal;
+        simt deltas feed the rate."""
+        now = time.monotonic()
+        simt = float(data.get("simt", 0.0))
+        chunks = int(data.get("chunks", 0))
+        prev = self.worker_progress.get(wid)
+        if prev is None:
+            self.worker_progress[wid] = {
+                "simt": simt, "chunks": chunks, "rate": 0.0,
+                "t": now, "advance_t": now,
+                "state": data.get("state"),
+                "ff": bool(data.get("ff", False))}
+            return
+        dt = now - prev["t"]
+        if chunks > prev["chunks"] or simt > prev["simt"] + 1e-9:
+            if dt > 1e-6 and simt > prev["simt"]:
+                inst = (simt - prev["simt"]) / dt
+                prev["rate"] = inst if prev["rate"] <= 0.0 \
+                    else 0.5 * prev["rate"] + 0.5 * inst
+            prev["advance_t"] = now
+        prev.update(simt=simt, chunks=chunks, t=now,
+                    state=data.get("state"),
+                    ff=bool(data.get("ff", False)))
+
+    def _check_stragglers(self, now):
+        """Speculative straggler re-dispatch: an in-flight piece whose
+        worker keeps sending progress heartbeats (so it is alive — a
+        worker blocked in a long first-compile sends NONE and is left
+        to the busy-PING budget) but whose progress has not advanced
+        for ``straggler_timeout`` — or whose rate sits far below the
+        fleet median — is hedged to an idle worker.  First completion
+        wins; the loser is cancelled."""
+        if not self.hedge_enabled or self.straggler_timeout <= 0 \
+                or not self.avail_workers:
+            return
+        fresh = 3.0 * self.hb_interval     # report recency window
+        # The fleet-median rate is only meaningful across workers
+        # running FULL SPEED (fast-forward sweep pieces): a wall-clock
+        # paced piece reports ~dtmult sim-s/s by design, and hedging
+        # it on "low rate" would burn a second worker on a copy that
+        # cannot finish any earlier.  Stall detection (flat progress)
+        # still covers non-FF pieces.
+        rates = [p["rate"] for w, p in self.worker_progress.items()
+                 if w in self.inflight and p["rate"] > 0.0
+                 and p.get("ff") and now - p["t"] <= fresh]
+        median = statistics.median(rates) if len(rates) >= 2 else None
+        for wid, piece in list(self.inflight.items()):
+            if not self.avail_workers:
+                return
+            if wid in self.hedge_by or wid in self.hedge_of:
+                continue                   # one hedge per piece
+            prog = self.worker_progress.get(wid)
+            if prog is None or now - prog["t"] > fresh:
+                continue                   # silent, not stalled
+            age = now - self.inflight_t.get(wid, now)
+            if age <= self.straggler_timeout:
+                continue                   # dispatch grace period
+            stalled = now - prog["advance_t"] > self.straggler_timeout
+            slow = median is not None and prog.get("ff") \
+                and prog["rate"] < self.hedge_rate_factor * median
+            if stalled or slow:
+                self._dispatch_hedge(
+                    wid, piece, "stalled" if stalled else
+                    f"rate {prog['rate']:.2f} << median {median:.2f}")
+
+    def _dispatch_hedge(self, wid, piece, why):
+        """Send a second copy of ``wid``'s in-flight piece to an idle
+        worker (first completion wins)."""
+        hwid = self.avail_workers.pop(0)
+        self.inflight[hwid] = piece
+        self.inflight_owner[hwid] = self.inflight_owner.get(wid, b"")
+        self.inflight_t[hwid] = time.monotonic()
+        self.hedge_by[wid] = hwid
+        self.hedge_of[hwid] = wid
+        self.hedges_started += 1
+        prog = self.worker_progress.get(hwid)
+        if prog is not None:
+            prog["advance_t"] = self.inflight_t[hwid]
+        if self.journal:
+            self.journal.hedged(piece, wid, hwid)
+        pname = self._piece_name(piece)
+        msg = (f"hedging BATCH piece '{pname}': worker {wid.hex()} "
+               f"{why} — speculative copy to {hwid.hex()}")
+        print(f"server: {msg}")
+        self._report_clients(msg)
+        scentime, scencmd = piece
+        self.be_event.send_multipart(
+            [hwid, b"BATCH", packb({"scentime": scentime,
+                                    "scencmd": scencmd})])
+
+    def _resolve_hedge_win(self, winner, piece):
+        """First completion of a hedged piece wins: count who won and
+        cancel the partner's still-running copy (``BATCHCANCEL``; the
+        loser acks with ``BATCHCANCELLED``, or its own completion
+        arrives first and is journaled as ``dup_completed``)."""
+        if winner not in self.hedge_by and winner not in self.hedge_of:
+            return
+        was_hedge = winner in self.hedge_of
+        partner = self._drop_hedge_links(winner)
+        if was_hedge:
+            self.hedges_won_hedge += 1
+        else:
+            self.hedges_won_primary += 1
+        if partner is None:
+            return                         # partner already gone
+        self.inflight.pop(partner, None)
+        self.inflight_owner.pop(partner, None)
+        self.inflight_t.pop(partner, None)
+        self._cancel_pending[partner] = piece
+        self.be_event.send_multipart(
+            [partner, b"BATCHCANCEL", packb(None)])
+        print(f"server: hedge resolved — "
+              f"{'hedge' if was_hedge else 'primary'} {winner.hex()} "
+              f"won '{self._piece_name(piece)}', cancelling "
+              f"{partner.hex()}")
+
+    def _retry_after(self, n_new):
+        """Retry hint for a BATCHREJECTED: time for ``n_new`` slots to
+        drain at the recently observed completion rate, else the
+        settings default."""
+        from .. import settings as _settings
+        now = time.monotonic()
+        recent = [t for t in self._completion_stamps if now - t < 60.0]
+        if len(recent) >= 2 and now - recent[0] > 1e-3:
+            rate = len(recent) / (now - recent[0])
+            return round(min(max(n_new / rate, 1.0), 600.0), 1)
+        return float(getattr(_settings, "batch_retry_after", 5.0))
+
+    def health_payload(self):
+        """Machine-readable serving-fabric health (the ``HEALTH``
+        command): queue depth and per-client split, per-worker
+        in-flight piece age / heartbeat staleness / progress rate,
+        hedge + admission + stream-drop counters, plus a human-
+        readable ``text`` rendering."""
+        now = time.monotonic()
+        workers = {}
+        for wid, state in self.workers.items():
+            w = {"state": state,
+                 "hb_age": round(now - self.last_seen.get(wid, now), 3)}
+            piece = self.inflight.get(wid)
+            if piece is not None:
+                w["piece"] = self._piece_name(piece)
+                w["piece_age"] = round(
+                    now - self.inflight_t.get(wid, now), 3)
+                if wid in self.hedge_of:
+                    w["hedge"] = "hedge"
+                elif wid in self.hedge_by:
+                    w["hedge"] = "hedged"
+            prog = self.worker_progress.get(wid)
+            if prog is not None:
+                w["simt"] = round(prog["simt"], 3)
+                w["rate"] = round(prog["rate"], 4)
+                w["stalled_for"] = round(now - prog["advance_t"], 3)
+            workers[wid.hex()] = w
+        data = {
+            "queue_depth": len(self.scenarios),
+            "queue_limit": self.batch_queue_max,
+            "queue_by_client": {o.hex(): n for o, n in
+                                self.scenarios.depth_by_owner().items()},
+            "inflight": len(self.inflight),
+            "avail_workers": len(self.avail_workers),
+            "workers": workers,
+            "hedges": {"started": self.hedges_started,
+                       "won_by_hedge": self.hedges_won_hedge,
+                       "won_by_primary": self.hedges_won_primary,
+                       "cancelled": self.hedges_cancelled,
+                       "dup_completions": self.dup_completions},
+            "rejected_batches": self.rejected_batches,
+            "stream_drops": self.stream_drops,
+            "quarantined": len(self.quarantined),
+            "straggler_timeout": self.straggler_timeout,
+            "hedge_enabled": bool(self.hedge_enabled),
+        }
+        data["text"] = self._health_text(data)
+        return data
+
+    @staticmethod
+    def _health_text(d):
+        lines = [f"queue: {d['queue_depth']}"
+                 + (f"/{d['queue_limit']}" if d['queue_limit'] else "")
+                 + f" pending ({len(d['queue_by_client'])} client(s)), "
+                 f"{d['inflight']} in flight, "
+                 f"{d['avail_workers']} idle worker(s)",
+                 "hedges: {started} started, {won_by_hedge} won by "
+                 "hedge, {won_by_primary} by primary, {cancelled} "
+                 "cancelled, {dup_completions} duplicate "
+                 "completion(s)".format(**d["hedges"]),
+                 f"admission: {d['rejected_batches']} BATCH submission"
+                 f"(s) rejected; stream drops: {d['stream_drops']}; "
+                 f"quarantined: {d['quarantined']}"]
+        for wid, w in d["workers"].items():
+            line = (f"  {wid[:8]}: state {w['state']}, "
+                    f"hb {w['hb_age']:.1f}s ago")
+            if "piece" in w:
+                line += (f", piece '{w['piece']}' "
+                         f"{w['piece_age']:.1f}s in flight"
+                         + (f" [{w['hedge']}]" if "hedge" in w else ""))
+            if "rate" in w:
+                line += (f", rate {w['rate']:g} sim-s/s, last advance "
+                         f"{w['stalled_for']:.1f}s ago")
+            lines.append(line)
+        return "\n".join(lines)
 
     def _replay_journal(self):
         """--resume-batch: rebuild the sweep from the journal —
@@ -459,12 +889,13 @@ class Server(threading.Thread):
             proc = self.spawned.get(wid)
             # A worker mid-BATCH may be stuck in a long device chunk or
             # a first-step JIT compile (minutes at large N) without a
-            # chance to pump events — give busy workers 10x the silence
-            # budget before declaring a pong-based death (process exit
-            # stays immediate for spawned children).
-            budget = self.hb_timeout * (10.0 if wid in self.inflight
-                                        or self.workers.get(wid, 0) >= 2
-                                        else 1.0)
+            # chance to pump events — give busy workers
+            # hb_busy_multiplier x the silence budget before declaring
+            # a pong-based death (process exit stays immediate for
+            # spawned children).
+            budget = self.hb_timeout * (
+                self.hb_busy_multiplier if wid in self.inflight
+                or self.workers.get(wid, 0) >= 2 else 1.0)
             if proc is not None and proc.poll() is not None:
                 dead.append(wid)           # child exited without goodbye
             elif proc is None and now - self.last_seen.get(wid, now) \
@@ -550,6 +981,7 @@ class Server(threading.Thread):
             if now >= self._next_hb:
                 self._next_hb = now + self.hb_interval
                 self._reap_dead_workers()
+                self._check_stragglers(now)
             if self.link is not None and self.link in events:
                 try:
                     self._handle_link(self.link.recv_multipart())
@@ -557,8 +989,27 @@ class Server(threading.Thread):
                     print(f"server: dropped malformed link message: "
                           f"{exc!r}")
             if self.be_stream in events:
-                self.fe_stream.send_multipart(
-                    self.be_stream.recv_multipart())
+                frames = self.be_stream.recv_multipart()
+                try:
+                    # NOBLOCK + XPUB_NODROP: a subscriber at its HWM
+                    # (stalled GUI) surfaces as EAGAIN instead of a
+                    # silent, uncountable per-peer drop
+                    self.fe_stream.send_multipart(frames,
+                                                  flags=zmq.NOBLOCK)
+                except zmq.Again:
+                    # count the drop, then re-send with the lossy flag
+                    # temporarily restored: the saturated peer ALONE
+                    # misses the frame — healthy subscribers must not
+                    # go dark because one GUI stalled
+                    self.stream_drops += 1
+                    self.fe_stream.setsockopt(zmq.XPUB_NODROP, 0)
+                    try:
+                        self.fe_stream.send_multipart(
+                            frames, flags=zmq.NOBLOCK)
+                    except zmq.Again:
+                        pass
+                    finally:
+                        self.fe_stream.setsockopt(zmq.XPUB_NODROP, 1)
             if self.fe_stream in events:    # subscription propagation
                 self.be_stream.send_multipart(
                     self.fe_stream.recv_multipart())

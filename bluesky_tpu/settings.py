@@ -67,6 +67,30 @@ node_watchdog_warn = 30.0         # [s] event-loop silence before warning
 node_watchdog_kill = 0.0          # [s] silence before exit(70); 0 = never
 fault_seed = 0                    # RNG seed for the FAULT injectors
 
+# ----- overload / straggler serving layer (docs/FAULT_TOLERANCE.md
+# rows #10/#11): progress heartbeats, speculative re-dispatch,
+# admission control and bounded stream buffering
+hb_busy_multiplier = 10.0         # [x hb_timeout] PING-silence budget for
+                                  # a worker mid-BATCH / in OP (long device
+                                  # chunks + first-compile legitimately
+                                  # block the event loop for minutes)
+straggler_timeout = 30.0          # [s] fresh heartbeats but no sim-time/
+                                  # chunk advance on an in-flight piece
+                                  # before it is hedged (0 = never)
+hedge_enabled = True              # speculative straggler re-dispatch
+hedge_rate_factor = 0.2           # also hedge when a worker's progress
+                                  # rate < factor * fleet median
+batch_queue_max = 4096            # pending BATCH pieces before a
+                                  # submission gets BATCHREJECTED
+                                  # (0 = unbounded, pre-PR3 behavior)
+batch_retry_after = 5.0           # [s] BATCHREJECTED retry hint when no
+                                  # drain-rate estimate exists yet
+stream_sndhwm = 1000              # [msgs] send buffer bound on the stream
+                                  # sockets; a stalled GUI client gets
+                                  # drops (counted), never back-pressure
+quarantine_report_cap = 64        # BATCHQUARANTINE replay history kept
+                                  # for late-joining clients
+
 # ----- durable runs (preemption-safe checkpoints + BATCH journal)
 snapshot_autosave_dt = 0.0        # [sim s] between on-disk autosnapshots
                                   # of the newest ring entry (0 = off)

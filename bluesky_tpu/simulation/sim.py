@@ -216,6 +216,16 @@ class Simulation:
             _fault_settings, "snapshot_autosave_dt", 0.0))
         self._autosave_t = -float("inf")
         self.preempt_requested = False
+        # FAULT STRAGGLE (fault/injectors.straggle): the merely-slow /
+        # stuck-but-alive worker model.  Both survive RESET on purpose —
+        # they model a property of the HOST (thermal throttling, a noisy
+        # neighbor), not of the scenario, so a BATCH piece landing on a
+        # straggling worker stays straggling.
+        self.straggle_factor = 0.0    # extra wall-s owed per sim-s
+        self.straggle_stall = False   # freeze progress, keep loop alive
+        self._straggle_debt = 0.0     # owed throttle sleep, paid in
+        #                               small slices so the node loop
+        #                               keeps pumping heartbeats
         self.traf.delete_hooks.append(self.cond.delac)
         # Late import to avoid cycles; stack binds commands to this sim.
         from ..stack.stack import Stack
@@ -470,6 +480,27 @@ class Simulation:
         if self.state_flag != OP:
             return True
 
+        # FAULT STRAGGLE STALL: skip the device chunk entirely — simt
+        # freezes while the host loop keeps pumping events, so progress
+        # heartbeats still flow with a flat simt/chunk count.  That is
+        # exactly the signature the server's straggler detector hedges
+        # on (a SILENT worker is the watchdog/busy-budget case instead).
+        if self.straggle_stall:
+            time.sleep(0.02)
+            return True
+
+        # FAULT STRAGGLE <factor>: pay outstanding throttle debt in
+        # SMALL slices, one per host-loop iteration, instead of one
+        # chunk-sized sleep — an FF chunk is 50 sim-s, so a block
+        # sleep of factor*50 wall-s would silence the event loop and
+        # make the "slow but alive" worker look DEAD (no heartbeats)
+        # rather than slow, hiding it from rate-based hedging.
+        if self._straggle_debt > 0:
+            pay = min(self._straggle_debt, 0.05)
+            self._straggle_debt -= pay
+            time.sleep(pay)
+            return True
+
         # Benchmark bookkeeping
         if self.benchdt > 0.0 and self.bencht == 0.0:
             self.bencht = time.perf_counter()
@@ -596,6 +627,13 @@ class Simulation:
         else:
             self.traf.state = run_steps(self.traf.state, self.cfg, chunk)
         self._step_count += chunk
+        # FAULT STRAGGLE <factor>: every simulated second OWES `factor`
+        # extra wall seconds, added to the debt ledger paid off in
+        # slices above — this worker's progress rate sinks below the
+        # fleet median while its heartbeats keep flowing.
+        if self.straggle_factor > 0:
+            self._straggle_debt += \
+                chunk * self.cfg.simdt * self.straggle_factor
 
         # Chunk-edge subsystems: plugin updates, conditional triggers,
         # trails, loggers (the reference runs these per 0.05 s step,

@@ -75,6 +75,23 @@ def _make_simnode_class(base):
             sim.stop()
             self.quit()
 
+        # --------------------------------------------------------- heartbeat
+        def heartbeat_payload(self, stamp):
+            """Progress piggybacked on the PONG reply: sim-time and
+            chunks done let the server's straggler detector tell a
+            stalled piece (fresh heartbeats, flat progress) from a
+            long device chunk or first compile (no heartbeats at all —
+            this loop is blocked, and the busy-PING budget applies)."""
+            sim = self.sim
+            # "ff" gates the server's RATE-based hedging: sim-s/wall-s
+            # is only comparable across workers running full speed — a
+            # wall-clock-paced piece reports ~dtmult by design, which
+            # must not read as "far below the fleet median".
+            return {"stamp": stamp, "simt": sim.simt,
+                    "chunks": sim._step_count,
+                    "state": sim.state_flag, "ntraf": sim.traf.ntraf,
+                    "ff": bool(sim.ffmode)}
+
         # ------------------------------------------------------------ events
         def event(self, name, data, sender_route):
             sim = self.sim
@@ -102,6 +119,25 @@ def _make_simnode_class(base):
                 sim.reset()
                 sim.stack.set_scendata(data["scentime"], data["scencmd"])
                 sim.op()
+            elif name == b"BATCHCANCEL":
+                # the server hedged this piece and the other copy won:
+                # ack FIRST (the FIFO event pair is how the server
+                # tells a cancel ack from a duplicate completion), then
+                # abandon the piece — the reset's STATECHANGE makes
+                # this worker available again
+                self.send_event(b"BATCHCANCELLED", None)
+                sim.reset()
+            elif name == b"BATCHREJECTED":
+                d = data or {}
+                sim.scr.echo(
+                    f"BATCH rejected by the server: queue "
+                    f"{d.get('queue_depth', '?')}/{d.get('limit', '?')} "
+                    f"full — retry in {d.get('retry_after', '?')} s")
+            elif name == b"HEALTH":
+                # reply to the stack HEALTH command's server query
+                txt = data.get("text") if isinstance(data, dict) \
+                    else str(data)
+                sim.scr.echo(txt or "no health data")
             elif name == b"GETSIMSTATE":
                 self.send_event(b"SIMSTATE", {
                     "state": sim.state_flag, "simt": sim.simt,

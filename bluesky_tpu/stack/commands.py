@@ -999,9 +999,29 @@ def register_all(stack):
     def faultcmd(*args):
         """FAULT: chaos-injection harness (fault/harness.py) — poison
         state with NaN/Inf, flip guard policy, degrade the event
-        transport, stall/kill the worker, truncate snapshots."""
+        transport, stall/kill/straggle the worker, truncate
+        snapshots."""
         from ..fault import harness
         return harness.fault_command(sim, *args)
+
+    def healthcmd():
+        """HEALTH: serving-fabric introspection.  On a networked
+        worker the server is queried (queue depth + per-client split,
+        per-worker in-flight piece age / heartbeat staleness /
+        progress rate, hedge + admission + stream-drop counters) and
+        the reply is echoed when it arrives; a detached sim reports
+        its local state."""
+        node = getattr(sim, "node", None)
+        if node is not None and getattr(node, "event_io", None) \
+                is not None:
+            node.send_event(b"HEALTH", None)   # empty route -> server
+            return True, "HEALTH requested from the server"
+        return True, (f"detached sim: state {sim.state_flag}, simt "
+                      f"{sim.simt:.1f} s, {traf.ntraf} aircraft, "
+                      f"{sim._step_count} steps done"
+                      + (", straggle STALLED"
+                         if getattr(sim, 'straggle_stall', False)
+                         else ""))
 
     def snapshot(sub, fname=None):
         """SNAPSHOT SAVE/LOAD fname: binary pytree state checkpoint
@@ -1310,10 +1330,13 @@ def register_all(stack):
                     "[txt,word]", profile,
                     "JAX trace capture and per-kernel timings"],
         "FAULT": ["FAULT NAN/INF [acid] | GUARD ../RING .. | DROP/DUP/"
-                  "DELAY p | NETOFF | STALL s | KILL | PREEMPT [s] | "
-                  "SNAPTRUNC f | LIST",
+                  "DELAY p | NETOFF | STALL s | STRAGGLE f/STALL/OFF | "
+                  "KILL | PREEMPT [s] | SNAPTRUNC f | LIST",
                   "[word,...]", faultcmd,
                   "Fault-injection harness (chaos testing)"],
+        "HEALTH": ["HEALTH", "", healthcmd,
+                   "Serving-fabric health: queue depth, worker "
+                   "progress, hedges, drops"],
         "SNAPSHOT": ["SNAPSHOT SAVE/LOAD fname", "txt,[word]", snapshot,
                      "Save/restore a binary state snapshot"],
         "SCREENSHOT": ["SCREENSHOT [fname.svg]", "[word]", screenshot,

@@ -1,0 +1,707 @@
+"""Overload-safe serving: admission control, progress heartbeats,
+speculative straggler re-dispatch (docs/FAULT_TOLERANCE.md recovery-
+matrix rows #10 straggling worker / #11 client overload).
+
+* Admission control: an over-limit BATCH submission gets a structured
+  ``BATCHREJECTED`` (queue depth + retry-after) and leaves the pending
+  queue AND the journal untouched.
+* Per-client fairness: two clients submitting interleaved BATCHes both
+  make progress (round-robin dispatch), instead of FIFO starvation.
+* Progress heartbeats + hedging: a worker whose heartbeats stay fresh
+  but whose progress stalls is hedged to an idle worker; first
+  completion wins, the loser is cancelled, and the journal's
+  ``hedged``/``dup_completed`` records keep --resume-batch replay
+  exactly-once even for duplicate completions.
+* HEALTH: machine-readable queue/worker/hedge/drop introspection.
+* Slow lane: the acceptance chaos case — a 16-piece BATCH with one
+  ``FAULT STRAGGLE``-stalled REAL worker completes (journal-verified
+  exactly-once) with hedging on, and does NOT complete within the same
+  wall budget with hedging off.
+"""
+import json
+import os
+import time
+
+import pytest
+
+zmq = pytest.importorskip("zmq")
+
+from bluesky_tpu.network.client import Client
+from bluesky_tpu.network.common import make_id
+from bluesky_tpu.network.journal import BatchJournal
+from bluesky_tpu.network.node import split_envelope
+from bluesky_tpu.network.npcodec import packb, unpackb
+from bluesky_tpu.network.server import FairQueue, Server
+from tests.test_network import free_ports, wait_for
+
+
+# ----------------------------------------------------------------- helpers
+def _mkserver(tmp_path=None, **kw):
+    ev, st, wev, wst = free_ports(4)
+    kw.setdefault("journal_path",
+                  str(tmp_path / "batch.jsonl") if tmp_path else "")
+    server = Server(headless=True,
+                    ports=dict(event=ev, stream=st, wevent=wev,
+                               wstream=wst),
+                    spawn_workers=False, **kw)
+    server.start()
+    time.sleep(0.2)
+    return server, ev, st, wev
+
+
+def _connect(ev, st):
+    client = Client()
+    client.connect(event_port=ev, stream_port=st, timeout=5.0)
+    return client
+
+
+def _batch(n, tag):
+    """An n-piece BATCH payload with distinct SCEN names."""
+    scentime, scencmd = [], []
+    for i in range(n):
+        scentime += [0.0, 0.0]
+        scencmd += [f"SCEN {tag}{i}",
+                    f"CRE {tag}{i} B744 {50 + i} 4 90 FL200 250"]
+    return {"scentime": scentime, "scencmd": scencmd}
+
+
+class FakeWorker:
+    """Protocol-level scripted worker driven inline by the test thread
+    (no hidden concurrency): registers on construction, then the test
+    feeds progress PONGs and state changes explicitly."""
+
+    def __init__(self, wev):
+        self.id = make_id()
+        ctx = zmq.Context.instance()
+        self.sock = ctx.socket(zmq.DEALER)
+        self.sock.setsockopt(zmq.IDENTITY, self.id)
+        self.sock.setsockopt(zmq.LINGER, 0)
+        self.sock.connect(f"tcp://127.0.0.1:{wev}")
+        self.send(b"REGISTER", None)
+        self.got = []              # (name, data) of every received event
+
+    def send(self, name, data=None):
+        self.sock.send_multipart([name, packb(data)])
+
+    def statechange(self, state):
+        self.send(b"STATECHANGE", state)
+
+    def pong(self, simt, chunks, state=2):
+        """An unsolicited progress heartbeat (the server folds any
+        PONG with a progress dict into the straggler detector)."""
+        self.send(b"PONG", {"stamp": 0.0, "simt": float(simt),
+                            "chunks": int(chunks), "state": state})
+
+    def pump(self):
+        while self.sock.poll(0):
+            route, name, payload = split_envelope(
+                self.sock.recv_multipart())
+            self.got.append((name,
+                             unpackb(payload) if payload else None))
+
+    def received(self, name):
+        self.pump()
+        return [d for n, d in self.got if n == name]
+
+    def close(self):
+        self.sock.close()
+
+
+def _records(jpath):
+    if not os.path.isfile(jpath):
+        return []
+    out = []
+    with open(jpath) as f:
+        for line in f:
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    return out
+
+
+# --------------------------------------------------------------- FairQueue
+class TestFairQueue:
+    def test_round_robin_across_owners(self):
+        q = FairQueue()
+        q.extend(["a1", "a2"], owner=b"A")
+        q.extend(["b1"], owner=b"B")
+        assert len(q) == 3 and bool(q)
+        assert q.pop_next() == (b"A", "a1")
+        assert q.pop_next() == (b"B", "b1")
+        assert q.pop_next() == (b"A", "a2")
+        assert q.pop_next() is None and not q
+
+    def test_push_front_and_list_surface(self):
+        q = FairQueue()
+        q.push("a1", b"A")
+        q.push_front("a0", b"A")
+        assert q[0] == "a0" and list(q) == ["a0", "a1"]
+        assert q.depth_by_owner() == {b"A": 2}
+
+    def test_flat_view_interleaves(self):
+        q = FairQueue()
+        q.extend(["a1", "a2"], owner=b"A")
+        q.extend(["b1", "b2"], owner=b"B")
+        flat = list(q)
+        assert set(flat) == {"a1", "a2", "b1", "b2"}
+        # one owner never occupies the first two slots alone
+        assert {flat[0][0], flat[1][0]} == {"a", "b"}
+
+
+# --------------------------------------------------------- admission control
+class TestAdmission:
+    def test_over_limit_batch_rejected_queue_and_journal_untouched(
+            self, tmp_path):
+        jpath = str(tmp_path / "batch.jsonl")
+        server, ev, st, wev = _mkserver(batch_queue_max=2,
+                                        journal_path=jpath)
+        client = _connect(ev, st)
+        rejections = []
+        client.event_received.connect(
+            lambda n, d, s: rejections.append(d)
+            if n == b"BATCHREJECTED" else None)
+        try:
+            client.send_event(b"BATCH", _batch(3, "X"), target=b"")
+            assert wait_for(lambda: (client.receive(10),
+                                     bool(rejections))[1], timeout=10)
+            rej = rejections[0]
+            assert rej["queue_depth"] == 0 and rej["limit"] == 2
+            assert rej["submitted"] == 3 and rej["retry_after"] > 0
+            assert client.last_rejection == rej
+            # queue untouched, journal never even created
+            assert len(server.scenarios) == 0
+            assert server.rejected_batches == 1
+            assert not os.path.isfile(jpath)
+            # an in-limit submission still goes through
+            client.send_event(b"BATCH", _batch(2, "Y"), target=b"")
+            assert wait_for(lambda: len(server.scenarios) == 2,
+                            timeout=10)
+            recs = _records(jpath)
+            assert len([r for r in recs if r["rec"] == "queued"]) == 2
+        finally:
+            client.close()
+            server.stop()
+            server.join(timeout=5)
+
+
+# ------------------------------------------------------- per-client fairness
+class TestFairness:
+    def test_two_clients_interleave(self):
+        """Two clients submit BATCHes back to back; a single worker
+        drains them — completions must alternate between the clients
+        instead of finishing client A's whole sweep first."""
+        server, ev, st, wev = _mkserver()
+        ca = _connect(ev, st)
+        cb = _connect(ev, st)
+        w = None
+        order = []
+        try:
+            ca.send_event(b"BATCH", _batch(3, "A"), target=b"")
+            assert wait_for(lambda: len(server.scenarios) == 3,
+                            timeout=10)
+            cb.send_event(b"BATCH", _batch(3, "B"), target=b"")
+            assert wait_for(lambda: len(server.scenarios) == 6,
+                            timeout=10)
+            w = FakeWorker(wev)
+
+            def drive():
+                w.pump()
+                piece = server.inflight.get(w.id)
+                if piece is not None:
+                    name = Server._piece_name(piece)
+                    if name not in order:
+                        order.append(name)
+                        w.statechange(2)
+                        w.statechange(1)
+                return len(order) >= 6
+            assert wait_for(drive, timeout=20), order
+            assert [n[0] for n in order] == list("ABABAB"), order
+        finally:
+            if w:
+                w.close()
+            ca.close()
+            cb.close()
+            server.stop()
+            server.join(timeout=5)
+
+
+# ----------------------------------------------------- stragglers + hedging
+class TestHedging:
+    def _stalled_fabric(self, tmp_path):
+        """Server + one worker holding a piece with frozen progress +
+        one idle worker: returns after the hedge has fired."""
+        jpath = str(tmp_path / "batch.jsonl")
+        server, ev, st, wev = _mkserver(
+            tmp_path, hb_interval=0.1, hb_timeout=30.0,
+            straggler_timeout=0.4, journal_path=jpath)
+        client = _connect(ev, st)
+        w1 = FakeWorker(wev)
+        assert wait_for(lambda: w1.id in server.workers, timeout=10)
+        client.send_event(b"BATCH", _batch(1, "H"), target=b"")
+        assert wait_for(lambda: w1.id in server.inflight, timeout=10)
+        w1.statechange(2)
+        w2 = FakeWorker(wev)
+        assert wait_for(lambda: len(server.avail_workers) == 1,
+                        timeout=10)
+
+        def hedged():
+            w1.pong(1.0, 5)        # fresh heartbeats, frozen progress
+            return bool(w2.received(b"BATCH"))
+        assert wait_for(hedged, timeout=15, step=0.05), \
+            "straggler never hedged"
+        assert server.hedges_started == 1
+        assert w2.id in server.inflight \
+            and server.inflight[w2.id] == server.inflight[w1.id]
+        recs = _records(jpath)
+        assert len([r for r in recs if r["rec"] == "hedged"]) == 1
+        return server, client, w1, w2, jpath
+
+    def test_hedge_first_completion_wins_loser_cancelled(self,
+                                                         tmp_path):
+        server, client, w1, w2, jpath = self._stalled_fabric(tmp_path)
+        try:
+            w2.statechange(2)
+            w2.statechange(1)      # the hedge copy finishes first
+
+            def cancelled():
+                return bool(w1.received(b"BATCHCANCEL"))
+            assert wait_for(cancelled, timeout=10), \
+                "loser never got BATCHCANCEL"
+            w1.send(b"BATCHCANCELLED")
+            w1.statechange(0)      # reset after abandoning the piece
+            assert wait_for(lambda: not server.inflight
+                            and server.hedges_cancelled == 1,
+                            timeout=10)
+            assert server.hedges_won_hedge == 1
+            assert server.dup_completions == 0
+            recs = _records(jpath)
+            completed = [r for r in recs if r["rec"] == "completed"]
+            assert len(completed) == 1     # exactly once
+            st = BatchJournal.replay(jpath)
+            assert not st["pending"] and len(st["completed"]) == 1
+        finally:
+            w1.close()
+            w2.close()
+            client.close()
+            server.stop()
+            server.join(timeout=5)
+
+    def test_duplicate_completion_journaled_not_counted(self,
+                                                        tmp_path):
+        """The loser also finishes (its completion raced the cancel):
+        journaled as ``dup_completed``, which replay must NOT count —
+        otherwise a repeat-trial sweep queueing identical content
+        twice would lose its second copy."""
+        server, client, w1, w2, jpath = self._stalled_fabric(tmp_path)
+        try:
+            w2.statechange(2)
+            w2.statechange(1)
+            assert wait_for(lambda: w1.id in server._cancel_pending,
+                            timeout=10)
+            w1.statechange(1)      # loser completes before reading the
+            #                        cancel: a duplicate completion
+            assert wait_for(lambda: server.dup_completions == 1,
+                            timeout=10)
+            recs = _records(jpath)
+            assert len([r for r in recs
+                        if r["rec"] == "completed"]) == 1
+            assert len([r for r in recs
+                        if r["rec"] == "dup_completed"]) == 1
+            st = BatchJournal.replay(jpath)
+            assert not st["pending"] and len(st["completed"]) == 1
+        finally:
+            w1.close()
+            w2.close()
+            client.close()
+            server.stop()
+            server.join(timeout=5)
+
+    def test_crashed_hedge_half_neither_requeues_nor_strikes(
+            self, tmp_path):
+        """One half of a hedge pair dying must not requeue the piece
+        (the other half still runs it) nor strike the circuit
+        breaker."""
+        server, client, w1, w2, jpath = self._stalled_fabric(tmp_path)
+        try:
+            w1.statechange(-1)     # the stalled primary gives up
+            assert wait_for(lambda: w1.id not in server.workers,
+                            timeout=10)
+            assert len(server.scenarios) == 0      # NOT requeued
+            assert not server.piece_crashes        # no strike
+            assert w2.id in server.inflight        # hedge still runs
+            w2.statechange(2)
+            w2.statechange(1)
+            assert wait_for(lambda: not server.inflight, timeout=10)
+            st = BatchJournal.replay(jpath)
+            assert not st["pending"] and len(st["completed"]) == 1
+        finally:
+            w1.close()
+            w2.close()
+            client.close()
+            server.stop()
+            server.join(timeout=5)
+
+
+class TestRateBasedHedging:
+    def test_rate_median_hedges_only_fast_forward_pieces(self):
+        """sim-s/wall-s is only comparable across full-speed (FF)
+        pieces: a wall-clock-paced piece reports ~dtmult by design
+        and must never be rate-hedged; flip its ff flag and the same
+        numbers DO hedge it."""
+        s = Server(headless=True, spawn_workers=False, journal_path="",
+                   hb_interval=0.1, straggler_timeout=1.0)
+        try:
+            now = time.monotonic()
+            a, b, slow, idle = (make_id() for _ in range(4))
+            for w in (a, b, slow):
+                s.workers[w] = 2
+                s.last_seen[w] = now
+                s.inflight[w] = ([0.0], [f"SCEN {w.hex()[:4]}"])
+                s.inflight_t[w] = now - 5.0        # past grace period
+            s.workers[idle] = 0
+            s.last_seen[idle] = now
+            s.avail_workers.append(idle)
+            for w, rate, ff in ((a, 10.0, True), (b, 9.0, True),
+                                (slow, 0.5, False)):
+                s.worker_progress[w] = {
+                    "simt": 1.0, "chunks": 1, "rate": rate, "t": now,
+                    "advance_t": now, "state": 2, "ff": ff}
+            s._check_stragglers(now)
+            assert s.hedges_started == 0   # non-FF: low rate by design
+            s.worker_progress[slow]["ff"] = True
+            s._check_stragglers(time.monotonic())
+            assert s.hedges_started == 1
+            assert s.hedge_of.get(idle) == slow
+        finally:
+            for sock in (s.fe_event, s.fe_stream, s.be_event,
+                         s.be_stream):
+                sock.close()
+
+
+class TestJournalHedgeReplay:
+    P = ([0.0], ["SCEN H1"])
+
+    def test_hedge_then_win_then_dup_replays_exactly_once(self,
+                                                          tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = BatchJournal(path)
+        j.queued(self.P)
+        j.dispatched(self.P, b"\x00AAAA")
+        j.hedged(self.P, b"\x00AAAA", b"\x00BBBB")
+        j.completed(self.P, b"\x00BBBB")
+        j.dup_completed(self.P, b"\x00AAAA")
+        j.close()
+        st = BatchJournal.replay(path)
+        assert st["pending"] == [] and len(st["completed"]) == 1
+
+    def test_crash_mid_hedge_requeues_one_copy(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = BatchJournal(path)
+        j.queued(self.P)
+        j.dispatched(self.P, b"\x00AAAA")
+        j.hedged(self.P, b"\x00AAAA", b"\x00BBBB")
+        j.close()                  # crash before any completion
+        st = BatchJournal.replay(path)
+        assert len(st["pending"]) == 1     # ONE copy, not two
+
+    def test_dup_does_not_consume_repeat_trial_copy(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = BatchJournal(path)
+        j.queued_many([self.P, self.P])    # deliberate repeat trial
+        j.dispatched(self.P, b"\x00AAAA")
+        j.hedged(self.P, b"\x00AAAA", b"\x00BBBB")
+        j.completed(self.P, b"\x00BBBB")
+        j.dup_completed(self.P, b"\x00AAAA")
+        j.close()
+        st = BatchJournal.replay(path)
+        assert len(st["pending"]) == 1     # second trial still owed
+
+
+# ------------------------------------------------------------------- HEALTH
+class TestHealth:
+    def test_health_payload_and_text(self, tmp_path):
+        server, ev, st, wev = _mkserver(batch_queue_max=1)
+        client = _connect(ev, st)
+        w = FakeWorker(wev)
+        try:
+            assert wait_for(lambda: w.id in server.workers, timeout=10)
+            client.send_event(b"BATCH", _batch(2, "Z"), target=b"")
+            client.request_health()
+            assert wait_for(lambda: (client.receive(10),
+                                     client.last_health
+                                     is not None)[1], timeout=10)
+            h = client.last_health
+            assert h["rejected_batches"] == 1
+            assert h["queue_depth"] == 0 and h["queue_limit"] == 1
+            assert h["hedges"]["started"] == 0
+            assert w.id.hex() in h["workers"]
+            assert "stream_drops" in h
+            assert "queue" in h["text"] and "hedges" in h["text"]
+        finally:
+            w.close()
+            client.close()
+            server.stop()
+            server.join(timeout=5)
+
+
+# --------------------------------------------------------- satellite knobs
+class TestKnobs:
+    def _bare_server(self, **kw):
+        s = Server(headless=True, spawn_workers=False,
+                   journal_path="", **kw)
+        # never started: close the sockets directly
+        s._close_sockets = lambda: [sock.close() for sock in
+                                    (s.fe_event, s.fe_stream,
+                                     s.be_event, s.be_stream)]
+        return s
+
+    def test_hb_busy_multiplier_is_a_settings_knob(self, monkeypatch):
+        from bluesky_tpu import settings
+        monkeypatch.setattr(settings, "hb_busy_multiplier", 3.5,
+                            raising=False)
+        s = self._bare_server()
+        try:
+            assert s.hb_busy_multiplier == 3.5
+        finally:
+            s._close_sockets()
+
+    def test_quarantine_reports_bounded(self, monkeypatch):
+        from bluesky_tpu import settings
+        monkeypatch.setattr(settings, "quarantine_report_cap", 2,
+                            raising=False)
+        s = self._bare_server()
+        try:
+            for i in range(5):
+                s.quarantine_reports.append({"piece": f"P{i}"})
+            assert len(s.quarantine_reports) == 2
+            assert s.quarantine_reports[0]["piece"] == "P3"
+        finally:
+            s._close_sockets()
+
+    def test_overload_knobs_reach_server(self, monkeypatch):
+        from bluesky_tpu import settings
+        monkeypatch.setattr(settings, "straggler_timeout", 7.0,
+                            raising=False)
+        monkeypatch.setattr(settings, "batch_queue_max", 12,
+                            raising=False)
+        monkeypatch.setattr(settings, "hedge_enabled", False,
+                            raising=False)
+        s = self._bare_server()
+        try:
+            assert s.straggler_timeout == 7.0
+            assert s.batch_queue_max == 12
+            assert s.hedge_enabled is False
+        finally:
+            s._close_sockets()
+
+
+# ------------------------------------------------------ FAULT STRAGGLE unit
+class TestStraggleInjector:
+    @pytest.fixture(scope="class")
+    def sim(self):
+        from bluesky_tpu.simulation.sim import Simulation
+        return Simulation(nmax=8)
+
+    def _do(self, sim, line):
+        sim.stack.stack(line)
+        sim.stack.process()
+        out = "\n".join(sim.scr.echobuf)
+        sim.scr.echobuf.clear()
+        return out
+
+    def test_stall_freezes_progress_and_off_resumes(self, sim):
+        self._do(sim, "CRE ST1 B744 52 4 90 FL200 250")
+        sim.fastforward()
+        sim.op()
+        sim.run(until_simt=1.0)
+        out = self._do(sim, "FAULT STRAGGLE STALL")
+        assert "stalled" in out
+        t0 = sim.simt
+        sim.op()
+        sim.run(until_simt=t0 + 5.0, max_iters=10)
+        assert sim.simt == t0              # frozen, loop kept turning
+        out = self._do(sim, "FAULT")
+        assert "STALLED" in out
+        out = self._do(sim, "FAULT STRAGGLE OFF")
+        assert "cleared" in out
+        sim.fastforward()
+        sim.op()
+        sim.run(until_simt=t0 + 1.0)
+        assert sim.simt > t0
+
+    def test_factor_throttle_and_survives_reset(self, sim):
+        out = self._do(sim, "FAULT STRAGGLE 0.5")
+        assert "throttled" in out
+        assert sim.straggle_factor == 0.5
+        sim.reset()                        # host fault survives RESET
+        assert sim.straggle_factor == 0.5
+        self._do(sim, "FAULT STRAGGLE OFF")
+        assert sim.straggle_factor == 0.0
+
+    def test_factor_throttle_still_advances_in_small_slices(self, sim):
+        """The throttle pays its sleep debt in heartbeat-sized slices
+        (one per host-loop iteration), never one chunk-sized block —
+        a throttled worker must look SLOW, not silent."""
+        self._do(sim, "CRE TH1 B744 52 4 90 FL200 250")
+        self._do(sim, "FAULT STRAGGLE 0.2")
+        sim.fastforward()
+        sim.op()
+        t0 = sim.simt
+        sim.run(until_simt=t0 + 2.0, max_iters=200)
+        assert sim.simt > t0               # slower, but alive
+        self._do(sim, "FAULT STRAGGLE OFF")
+        assert sim._straggle_debt == 0.0   # cleared with the fault
+
+    def test_stale_timed_stall_does_not_clear_newer_stall(self, sim):
+        from bluesky_tpu.fault import injectors
+        t = injectors.straggle(sim, stall_progress=True, stall_s=0.05)
+        injectors.straggle(sim, stall_progress=True)   # indefinite
+        t.join(timeout=2)
+        time.sleep(0.05)
+        assert sim.straggle_stall   # old timer must not end the new one
+        injectors.straggle(sim)
+        assert not sim.straggle_stall
+
+    def test_health_detached(self, sim):
+        out = self._do(sim, "HEALTH")
+        assert "detached sim" in out
+
+
+# ------------------------------------------------- acceptance chaos (slow)
+@pytest.mark.slow
+def test_straggler_chaos_16_pieces_hedging_on_vs_off(tmp_path):
+    """The acceptance case end to end with REAL spawned workers: a
+    16-piece BATCH with one FAULT STRAGGLE-stalled worker completes
+    with hedging on (journal-verified exactly-once), an over-limit
+    submission gets BATCHREJECTED while HEALTH reports queue depth and
+    hedge counters — and with hedging OFF the same harness does not
+    finish within the hedged run's wall budget (the stalled piece is
+    held forever by a worker that still answers every PING)."""
+    scn = _batch_sweep(16)
+
+    # ---------------- hedging ON
+    jpath = str(tmp_path / "hedge-on.jsonl")
+    server, client, victim = _straggler_fabric(jpath, hedge=True)
+    rejections = []
+    client.event_received.connect(
+        lambda n, d, s: rejections.append(d)
+        if n == b"BATCHREJECTED" else None)
+    t_on = None
+    try:
+        t0 = time.monotonic()
+        client.send_event(b"BATCH", scn, target=b"")
+        assert wait_for(lambda: (client.receive(10),
+                                 len(server.scenarios) > 0)[1],
+                        timeout=30)
+        # over-limit second submission: 16 queued-ish + 16 > 20
+        client.send_event(b"BATCH", scn, target=b"")
+        assert wait_for(lambda: (client.receive(10),
+                                 bool(rejections))[1], timeout=30), \
+            "over-limit BATCH was not rejected"
+        assert rejections[0]["limit"] == 20
+        assert rejections[0]["retry_after"] > 0
+        # the sweep completes despite the stalled worker
+        assert wait_for(lambda: (client.receive(10),
+                                 not server.scenarios
+                                 and not server.inflight)[1],
+                        timeout=900), \
+            "hedging-on sweep never completed"
+        t_on = time.monotonic() - t0
+        assert server.hedges_started >= 1, \
+            "stalled worker was never hedged"
+        # HEALTH reflects the whole story
+        client.request_health()
+        assert wait_for(lambda: (client.receive(10),
+                                 client.last_health is not None)[1],
+                        timeout=15)
+        h = client.last_health
+        assert h["queue_depth"] == 0
+        assert h["hedges"]["started"] >= 1
+        assert h["rejected_batches"] == 1
+    finally:
+        _teardown(server, client)
+    # journal-verified exactly-once
+    recs = _records(jpath)
+    completed = [r["key"] for r in recs if r["rec"] == "completed"]
+    assert len(completed) == 16 and len(set(completed)) == 16
+    assert any(r["rec"] == "hedged" for r in recs)
+    st = BatchJournal.replay(jpath)
+    assert not st["pending"] and len(st["completed"]) == 16
+
+    # ---------------- hedging OFF: same harness, never finishes
+    jpath2 = str(tmp_path / "hedge-off.jsonl")
+    server2, client2, victim2 = _straggler_fabric(jpath2, hedge=False)
+    try:
+        t0 = time.monotonic()
+        client2.send_event(b"BATCH", scn, target=b"")
+        # the 15 healthy pieces drain...
+        assert wait_for(
+            lambda: (client2.receive(10),
+                     len([r for r in _records(jpath2)
+                          if r["rec"] == "completed"]) >= 15)[1],
+            timeout=900), "healthy pieces never drained"
+        # ...but the stalled piece is still in flight well past the
+        # hedged run's total wall time: hedging-on beats hedging-off
+        budget = max(1.5 * t_on, t_on + 5.0)
+        while time.monotonic() - t0 < budget:
+            client2.receive(10)
+            time.sleep(0.25)
+        assert server2.inflight, \
+            "hedging-off unexpectedly completed (straggler rescued?)"
+        assert len([r for r in _records(jpath2)
+                    if r["rec"] == "completed"]) == 15
+        assert server2.hedges_started == 0
+    finally:
+        _teardown(server2, client2)
+
+
+def _batch_sweep(n):
+    """n BATCH pieces that each FF a single aircraft to a HOLD."""
+    scentime, scencmd = [], []
+    for i in range(n):
+        scentime += [0.0, 0.0, 0.0, 60.0]
+        scencmd += [f"SCEN SW{i:02d}",
+                    f"CRE SW{i:02d} B744 {40 + i} 4 90 FL200 250",
+                    "FF", "HOLD"]
+    return {"scentime": scentime, "scencmd": scencmd}
+
+
+def _straggler_fabric(jpath, hedge):
+    """3 REAL spawned workers, the first stalled via FAULT STRAGGLE."""
+    ev, st, wev, wst = free_ports(4)
+    server = Server(headless=True,
+                    ports=dict(event=ev, stream=st, wevent=wev,
+                               wstream=wst),
+                    spawn_workers=True, max_nnodes=3,
+                    hb_interval=0.25, hb_timeout=30.0,
+                    straggler_timeout=3.0, hedge_enabled=hedge,
+                    batch_queue_max=20, journal_path=jpath)
+    server.start()
+    time.sleep(0.2)
+    client = Client()
+    client.connect(event_port=ev, stream_port=st, timeout=30.0)
+    echoes = []
+    client.event_received.connect(
+        lambda n, d, s: echoes.append(str(d))
+        if n == b"ECHO" else None)
+    server.addnodes(3)
+    assert wait_for(lambda: (client.receive(10),
+                             len(server.workers) == 3)[1],
+                    timeout=300), "3 real workers never registered"
+    victim = next(iter(server.workers))
+    client.stack("FAULT STRAGGLE STALL", target=victim)
+    assert wait_for(lambda: (client.receive(10),
+                             any("progress stalled" in e
+                                 for e in echoes))[1], timeout=60), \
+        f"FAULT STRAGGLE never acked: {echoes}"
+    return server, client, victim
+
+
+def _teardown(server, client):
+    server.stop()
+    server.join(timeout=10)
+    client.close()
+    for proc in server.processes:
+        if proc.poll() is None:
+            proc.kill()
