@@ -254,15 +254,23 @@ class Metrics:
                       f"({num}), dt={self.dt:.2f}")
 
     # ------------------------------------------------------------- update
-    def update(self):
-        """Evaluate the active metric when due (chunk edges)."""
+    def update(self, edge=None):
+        """Evaluate the active metric when due (chunk edges).
+
+        ``edge`` is a retired ``ChunkEdge`` (simulation/pipeline.py):
+        the pipelined loop passes it so every field below comes out of
+        the fused telemetry pack — ONE device->host copy per edge
+        instead of a dozen ``np.asarray`` pulls — and the sampling
+        timestamp is the edge's own clock, not a blocking device read.
+        Without it (synchronous edges) the live state is sampled as
+        before."""
         if self.metric_number < 0:
             return
-        t = self.sim.simt
+        t = edge.simt if edge is not None else self.sim.simt
         if t < self.tnext - 1e-9:
             return
         self.tnext = t + self.dt
-        st = self.sim.traf.state.ac
+        st = edge.fetch() if edge is not None else self.sim.traf.state.ac
         active = np.asarray(st.active)
         lat = np.asarray(st.lat)
         lon = np.asarray(st.lon)
@@ -306,7 +314,7 @@ class Metrics:
                 clat, clon = self.area.cell_centroid(ci, cj)
                 self.logger.log(self.sim, ["CoCa"], [key], [len(slots)],
                                 [round(clat, 4)], [round(clon, 4)],
-                                *[[round(v, 6)] for v in row])
+                                *[[round(v, 6)] for v in row], simt=t)
             self.coca_combined = combined_sum
             self.last_coca_cells = occupants
         else:
@@ -332,12 +340,12 @@ class Metrics:
                     np.round(alt[idx] / FT, 1),
                     np.round(tas[idx] / aero.kts, 1),
                     np.round(trk[idx], 1),
-                    [n] * len(idx), per_ac)
+                    [n] * len(idx), per_ac, simt=t)
             else:
                 # schema-stable empty row (same 8 columns as aircraft
                 # rows, acid '-')
                 self.logger.log(self.sim, ["HB"], ["-"], [0.0], [0.0],
-                                [0.0], [0.0], [0.0], [n], [0])
+                                [0.0], [0.0], [0.0], [n], [0], simt=t)
 
     def reset(self):
         self.metric_number = -1

@@ -159,6 +159,32 @@ def step(state: SimState, cfg: SimConfig) -> SimState:
     return state.replace(ac=ac, simt=simt + simdt)
 
 
+def _scan_steps(state: SimState, cfg: SimConfig, nsteps: int,
+                checked: bool):
+    """The ONE chunk-scan body every runner shares: ``checked`` folds
+    the integrity guard into the carry (first-bad-step index, -1 clean).
+    Single source of truth so the guard semantics measured by
+    guard_overhead.py are exactly the ones the sim runs."""
+    if checked:
+        def body(carry, i):
+            s, bad = carry
+            s = step(s, cfg)
+            bad = jnp.where(bad >= 0, bad,
+                            jnp.where(state_finite(s), -1, i))
+            return (s, bad), None
+
+        (state, bad), _ = jax.lax.scan(
+            body, (state, jnp.full((), -1, jnp.int32)),
+            jnp.arange(nsteps, dtype=jnp.int32))
+        return state, bad
+
+    def body(s, _):
+        return step(s, cfg), None
+
+    state, _ = jax.lax.scan(body, state, None, length=nsteps)
+    return state, None
+
+
 @partial(jax.jit, static_argnames=("cfg", "nsteps"), donate_argnums=0)
 def run_steps(state: SimState, cfg: SimConfig, nsteps: int) -> SimState:
     """Advance nsteps with one compiled scan; state buffers are donated.
@@ -167,10 +193,7 @@ def run_steps(state: SimState, cfg: SimConfig, nsteps: int) -> SimState:
     (simulation.py:216-223) as a single device program: host syncs once per
     chunk, matching SURVEY.md §2.10's "lax.scan over k steps inside one jit".
     """
-    def body(s, _):
-        return step(s, cfg), None
-
-    state, _ = jax.lax.scan(body, state, None, length=nsteps)
+    state, _ = _scan_steps(state, cfg, nsteps, checked=False)
     return state
 
 
@@ -208,17 +231,88 @@ def run_steps_checked(state: SimState, cfg: SimConfig, nsteps: int):
     for free: the fault is pinned to one simdt without re-running the
     chunk.
     """
-    def body(carry, i):
-        s, bad = carry
-        s = step(s, cfg)
-        bad = jnp.where(bad >= 0, bad,
-                        jnp.where(state_finite(s), -1, i))
-        return (s, bad), None
+    return _scan_steps(state, cfg, nsteps, checked=True)
 
-    (state, bad), _ = jax.lax.scan(
-        body, (state, jnp.full((), -1, jnp.int32)),
-        jnp.arange(nsteps, dtype=jnp.int32))
-    return state, bad
+
+class EdgeTelemetry(NamedTuple):
+    """Packed chunk-edge telemetry: everything the host's chunk-edge
+    subsystems (guard response, metrics, trails, ACDATA stream) read
+    from the device, as SEPARATE output buffers of the chunk program.
+
+    Two properties make the pipelined chunk loop possible:
+
+    * These are *outputs*, never aliases of the (donated) state buffers
+      — so the host can dispatch the NEXT chunk (donating the state)
+      and still read this edge's values while it runs.
+    * The whole pack transfers as ONE device->host copy
+      (``jax.device_get`` on the tuple), replacing the dozens of
+      per-field ``np.asarray`` pulls metrics/ScreenIO used to issue per
+      chunk edge; ``bad`` alone is a one-scalar poll (the deferred
+      guard word).
+    """
+    simt: jnp.ndarray       # [s] sim time at the chunk edge
+    bad: jnp.ndarray        # int32 first bad step in chunk, -1 = clean
+    nconf_cur: jnp.ndarray  # scalar int32 directional conflict count
+    nlos_cur: jnp.ndarray   # scalar int32 directional LoS count
+    # Per-aircraft kinematic fields (metrics + ACDATA consumers)
+    active: jnp.ndarray
+    lat: jnp.ndarray
+    lon: jnp.ndarray
+    alt: jnp.ndarray
+    hdg: jnp.ndarray
+    trk: jnp.ndarray
+    tas: jnp.ndarray
+    gs: jnp.ndarray
+    cas: jnp.ndarray
+    vs: jnp.ndarray
+    # ASAS display fields (ACDATA)
+    inconf: jnp.ndarray
+    tcpamax: jnp.ndarray
+    asasn: jnp.ndarray
+    asase: jnp.ndarray
+
+
+def pack_telemetry(state: SimState, bad=None) -> EdgeTelemetry:
+    """Build the edge pack from a post-chunk state (inside jit)."""
+    ac, asas = state.ac, state.asas
+    if bad is None:
+        bad = jnp.full((), -1, jnp.int32)
+    return EdgeTelemetry(
+        simt=state.simt, bad=bad,
+        nconf_cur=asas.nconf_cur, nlos_cur=asas.nlos_cur,
+        active=ac.active, lat=ac.lat, lon=ac.lon, alt=ac.alt,
+        hdg=ac.hdg, trk=ac.trk, tas=ac.tas, gs=ac.gs, cas=ac.cas,
+        vs=ac.vs, inconf=asas.inconf, tcpamax=asas.tcpamax,
+        asasn=asas.asasn, asase=asas.asase)
+
+
+def _edge_scan(state: SimState, cfg: SimConfig, nsteps: int,
+               checked: bool):
+    state, bad = _scan_steps(state, cfg, nsteps, checked)
+    return state, pack_telemetry(state, bad)
+
+
+@partial(jax.jit, static_argnames=("cfg", "nsteps", "checked"),
+         donate_argnums=0)
+def run_steps_edge(state: SimState, cfg: SimConfig, nsteps: int,
+                   checked: bool = False):
+    """``run_steps`` (or the guarded scan, ``checked=True``) returning
+    ``(state, EdgeTelemetry)``.  State buffers are donated like
+    ``run_steps``; the telemetry pack is materialized as separate
+    buffers so it survives the next chunk's donation — the enabling
+    contract of the pipelined chunk loop (simulation/sim.py)."""
+    return _edge_scan(state, cfg, nsteps, checked)
+
+
+@partial(jax.jit, static_argnames=("cfg", "nsteps", "checked"))
+def run_steps_edge_keep(state: SimState, cfg: SimConfig, nsteps: int,
+                        checked: bool = False):
+    """``run_steps_edge`` WITHOUT input donation: the caller keeps the
+    pre-chunk state buffers valid.  The pipelined loop uses this for
+    the chunk after a snapshot-ring capture edge, so the full pre-chunk
+    pytree can be copied to the host *while the next chunk runs*
+    instead of blocking the dispatch (the off-critical-path capture)."""
+    return _edge_scan(state, cfg, nsteps, checked)
 
 
 step_jit = jax.jit(step, static_argnames=("cfg",))

@@ -37,6 +37,10 @@ class Trails:
         self.lastlat = np.zeros(nmax)
         self.lastlon = np.zeros(nmax)
         self.lasttim = np.zeros(nmax)
+        # Pipelined edges skip the inactive-path anchor refresh (it
+        # would force a telemetry fetch nobody consumes), so TRAIL ON
+        # requests a one-shot re-anchor before the first segments.
+        self._need_anchor = False
         self._clear_buffers()
 
     def _clear_buffers(self):
@@ -84,21 +88,25 @@ class Trails:
         self.lasttim[:] = 0.0
 
     # -------------------------------------------------------------- update
-    def update(self, t, lat=None, lon=None):
+    def update(self, t, lat=None, lon=None, active=None):
         """Append segments for aircraft whose last anchor is > dt old.
 
-        lat/lon: host samples of the position arrays (fetched once per
-        chunk edge by the caller); fetched here only if not supplied.
+        lat/lon/active: host samples of the state arrays (the pipelined
+        chunk loop hands in the fused edge-telemetry pack — one bulk
+        copy per edge); fetched from the live state only if not
+        supplied.
         """
-        active_mask = np.asarray(self.traf.state.ac.active)
+        active_mask = np.asarray(self.traf.state.ac.active) \
+            if active is None else np.asarray(active)
         if lat is None:
             ac = self.traf.state.ac
             lat = np.asarray(ac.lat)
             lon = np.asarray(ac.lon)
-        if not self.active:
+        if not self.active or self._need_anchor:
             self.lastlat = np.array(lat, copy=True)
             self.lastlon = np.array(lon, copy=True)
             self.lasttim[:] = t
+            self._need_anchor = False
             return
         # >= with an fp-slack so chunk edges spaced exactly dt apart (the
         # Simulation clamps the chunk to the trail resolution) still sample.
@@ -135,6 +143,9 @@ class Trails:
             return True, f"TRAIL is {'ON' if self.active else 'OFF'}"
         a0 = args[0]
         if isinstance(a0, bool):
+            if a0 and not self.active:
+                self._need_anchor = True    # fresh anchors, no stale
+                #                             segments from old positions
             self.active = a0
             if len(args) > 1 and args[1] is not None:
                 try:

@@ -239,6 +239,15 @@ class PluginManager:
         dts += [f[1] for f in self.update_funs.values()]
         return min(dts) if dts else None
 
+    def has_due(self, simt):
+        """Any preupdate/update hook due at (or before) ``simt``?  The
+        pipelined chunk loop asks this BEFORE dispatching: a due hook
+        may read or mutate state, so its edge must run synchronously.
+        Same epsilon as ``_run_due``."""
+        return any(simt >= fun[0] - 1e-9
+                   for funs in (self.preupdate_funs, self.update_funs)
+                   for fun in funs.values())
+
     def _run_due(self, funs, simt):
         for fun in funs.values():
             if simt >= fun[0] - 1e-9:

@@ -26,6 +26,17 @@ _REF_DATA = os.environ.get("BLUESKY_TPU_DATA") \
 
 # ----------------------------------------------------------------- defaults
 simdt = 0.05
+chunk_steps = 20                  # interactive device-chunk length in
+                                  # steps (1 s sim time at simdt=0.05);
+                                  # CHUNKSTEPS stack command at runtime.
+                                  # FF/BATCH runs still use >=1000-step
+                                  # chunks.  Off-ladder values compile
+                                  # one extra scan program.
+chunk_pipeline = True             # async chunk pipeline: dispatch chunk
+                                  # k+1 before chunk k's edge work, edge
+                                  # subsystems read the fused telemetry
+                                  # pack, guard readback is deferred one
+                                  # chunk (docs/PERF_ANALYSIS.md)
 performance_model = "openap"
 prefer_compiled = True            # use the C host extension when built
 data_path = _REF_DATA if os.path.isdir(_REF_DATA) else "data"

@@ -1,0 +1,97 @@
+"""Host-side view of one chunk edge's packed telemetry.
+
+The pipelined chunk loop (sim.py) dispatches chunk k+1 before running
+chunk k's edge subsystems; those subsystems must therefore read chunk
+k's values from somewhere other than ``traf.state`` (whose buffers were
+just donated into the next dispatch).  ``ChunkEdge`` wraps the
+``EdgeTelemetry`` pack the chunk program returned (core/step.py) and
+exposes it with two-stage laziness:
+
+* ``bad_step`` reads ONLY the guard word — a one-scalar device->host
+  poll that doubles as the chunk-completion fence (it blocks until the
+  chunk that produced this edge has finished, bounding the pipeline to
+  one chunk in flight).
+* Any field access triggers ONE ``jax.device_get`` of the whole pack,
+  cached — so an edge nobody samples (no metrics due, no GUI attached)
+  costs a single scalar transfer, and an edge everybody samples costs
+  exactly one bulk copy instead of a dozen ``np.asarray`` pulls.
+
+Thread note: ScreenIO may fetch an edge from the node thread while the
+sim thread retires the next one; ``fetch`` is idempotent and the object
+is never mutated after construction, so the race is benign.
+"""
+from typing import Optional
+
+import jax
+import numpy as np
+
+
+class ChunkEdge:
+    """One retired-or-pending chunk edge: telemetry + host bookkeeping."""
+
+    def __init__(self, telemetry, chunk: int,
+                 simt_planned: Optional[float] = None):
+        self._telemetry = telemetry
+        self.chunk = int(chunk)
+        self._simt_planned = simt_planned
+        self._np = None
+        self._bad = None
+
+    # ------------------------------------------------------------- fetch
+    @property
+    def bad_step(self) -> int:
+        """First bad step index within the chunk (-1 clean): the
+        deferred guard word.  One scalar transfer; blocks until the
+        producing chunk completes (the pipeline's completion fence)."""
+        if self._bad is None:
+            b = self._telemetry.bad
+            self._bad = -1 if b is None else int(b)
+        return self._bad
+
+    def fetch(self):
+        """The whole pack as host NumPy arrays — one device_get, cached."""
+        if self._np is None:
+            self._np = jax.device_get(self._telemetry)
+        return self._np
+
+    @property
+    def fetched(self) -> bool:
+        return self._np is not None
+
+    # ------------------------------------------------------------ fields
+    @property
+    def simt(self) -> float:
+        """Sim time at this edge.  Uses the host prediction when one was
+        recorded at dispatch (no device read); else the device value."""
+        if self._simt_planned is not None:
+            return self._simt_planned
+        return float(np.asarray(self.fetch().simt))
+
+    @property
+    def simt_device(self) -> float:
+        """The device's own edge clock — a ONE-SCALAR read (does not
+        pull the whole pack), used to verify/re-anchor the host's
+        predicted clock so float drift can never accumulate."""
+        if self._np is not None:
+            return float(np.asarray(self._np.simt))
+        return float(np.asarray(self._telemetry.simt))
+
+    def __getattr__(self, name):
+        # telemetry field access (lat, lon, active, nconf_cur, ...)
+        pack = self.fetch()
+        try:
+            return getattr(pack, name)
+        except AttributeError:
+            raise AttributeError(
+                f"ChunkEdge has no field {name!r}") from None
+
+    def acdata_arrays(self):
+        """The ACDATA per-aircraft field dict (screenio stream), sliced
+        by the live mask; one bulk fetch backs all of it."""
+        pack = self.fetch()
+        idx = np.flatnonzero(np.asarray(pack.active))
+        data = {name: np.asarray(getattr(pack, name))[idx]
+                for name in ("lat", "lon", "alt", "trk", "tas", "gs",
+                             "cas", "vs", "inconf", "tcpamax", "asasn",
+                             "asase")}
+        return idx, data

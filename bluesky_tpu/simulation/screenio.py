@@ -148,9 +148,13 @@ class ScreenIO(DisplayState):
 
     # -------------------------------------------------------------- streams
     def send_siminfo(self):
-        """Achieved sim speed etc at 1 Hz (screenio.py:185-192)."""
+        """Achieved sim speed etc at 1 Hz (screenio.py:185-192).
+
+        Uses the planned clock: with a chunk in flight (pipelined
+        stepping) a device read here would stall this node thread until
+        the chunk drains."""
         now = time.perf_counter()
-        simt = self.sim.simt
+        simt = self.sim.simt_planned
         dt = max(now - self.prevtime, 1e-9)
         speed = (simt - self.prevsimt) / dt
         self.prevtime, self.prevsimt = now, simt
@@ -174,23 +178,38 @@ class ScreenIO(DisplayState):
         """
         sim = self.sim
         traf = sim.traf
-        state = traf.state
-        st = state.ac
-        active = np.asarray(st.active)
-        idx = np.flatnonzero(active)
-        data = {"simt": sim.simt,
-                "id": [traf.ids[i] for i in idx],
-                "actype": [traf.types[i] for i in idx]}
-        for name in ("lat", "lon", "alt", "trk", "tas", "gs", "cas",
-                     "vs"):
-            data[name] = np.asarray(getattr(st, name))[idx]
-        asas = state.asas
-        data["inconf"] = np.asarray(asas.inconf)[idx]
-        data["tcpamax"] = np.asarray(asas.tcpamax)[idx]
-        data["asasn"] = np.asarray(asas.asasn)[idx]
-        data["asase"] = np.asarray(asas.asase)[idx]
-        nconf = int(asas.nconf_cur) // 2      # directional -> pairs
-        nlos = int(asas.nlos_cur) // 2
+        edge = sim._last_edge
+        if edge is not None:
+            # Fused edge telemetry: every per-aircraft field below comes
+            # from the most recent retired chunk edge's pack — ONE bulk
+            # device->host copy (cached on the edge), no per-field pulls
+            # and no stall on an in-flight pipelined chunk.  Commands
+            # that mutate state invalidate the cache (stack.py), falling
+            # back to the live-state path until the next edge retires.
+            idx, data = edge.acdata_arrays()
+            data["simt"] = edge.simt
+            data["id"] = [traf.ids[i] for i in idx]
+            data["actype"] = [traf.types[i] for i in idx]
+            nconf = int(np.asarray(edge.nconf_cur)) // 2   # -> pairs
+            nlos = int(np.asarray(edge.nlos_cur)) // 2
+        else:
+            state = traf.state
+            st = state.ac
+            active = np.asarray(st.active)
+            idx = np.flatnonzero(active)
+            data = {"simt": sim.simt,
+                    "id": [traf.ids[i] for i in idx],
+                    "actype": [traf.types[i] for i in idx]}
+            for name in ("lat", "lon", "alt", "trk", "tas", "gs", "cas",
+                         "vs"):
+                data[name] = np.asarray(getattr(st, name))[idx]
+            asas = state.asas
+            data["inconf"] = np.asarray(asas.inconf)[idx]
+            data["tcpamax"] = np.asarray(asas.tcpamax)[idx]
+            data["asasn"] = np.asarray(asas.asasn)[idx]
+            data["asase"] = np.asarray(asas.asase)[idx]
+            nconf = int(asas.nconf_cur) // 2      # directional -> pairs
+            nlos = int(asas.nlos_cur) // 2
         self._nconf_tot += max(0, nconf - self._nconf_prev)
         self._nlos_tot += max(0, nlos - self._nlos_prev)
         self._nconf_prev, self._nlos_prev = nconf, nlos

@@ -12,6 +12,15 @@ chunk edges — stack commands, scenario triggers, loggers and plugin hooks all
 run at chunk boundaries.  With the default chunk of 20 steps (1 s sim time)
 command latency matches the reference's ASAS interval; BENCHMARK/FF runs use
 big chunks for full throughput.
+
+Chunk edges are *pipelined* by default (settings.chunk_pipeline /
+CHUNKSTEPS PIPELINE): step() dispatches the next chunk before running the
+previous chunk's edge subsystems, which consume the fused EdgeTelemetry
+pack (core/step.run_steps_edge) instead of pulling fields off the live
+state — host edge work overlaps in-flight device compute, the guard word
+is polled one chunk deferred, and any edge that must mutate state falls
+back to a synchronous chunk that is bit-identical to the unpipelined
+loop.  docs/PERF_ANALYSIS.md §chunk-edge pipeline has the full contract.
 """
 import os
 import time
@@ -24,8 +33,9 @@ import jax.numpy as jnp
 from ..core.asas import AsasConfig
 from ..core.noise import NoiseConfig
 from ..core.route import RouteManager
-from ..core.step import SimConfig, run_steps, run_steps_checked
+from ..core.step import SimConfig
 from ..core.traffic import Traffic
+from .pipeline import ChunkEdge
 
 # Sim states (reference bluesky/__init__.py:12)
 INIT, HOLD, OP, END = range(4)
@@ -165,7 +175,7 @@ class Simulation:
 
     def __init__(self, nmax: int = 1024, wmax: int = 32, dtype=None,
                  openap_path: Optional[str] = None, rng_seed: int = 0,
-                 chunk_steps: int = 20):
+                 chunk_steps: Optional[int] = None):
         dtype = dtype or jnp.float32
         self.traf = Traffic(nmax=nmax, wmax=wmax, dtype=dtype,
                             openap_path=openap_path, rng_seed=rng_seed)
@@ -173,7 +183,24 @@ class Simulation:
         self.scr = Screen()
         self.cfg = SimConfig()
         self.state_flag = INIT
-        self.chunk_steps = chunk_steps
+        from .. import settings as _pipe_settings
+        # Interactive device-chunk length: settings knob + CHUNKSTEPS
+        # stack command (ctor arg overrides for embedded use)
+        self.chunk_steps = int(chunk_steps if chunk_steps is not None
+                               else getattr(_pipe_settings,
+                                            "chunk_steps", 20))
+        # Async chunk pipeline (docs/PERF_ANALYSIS.md §chunk-edge
+        # pipeline): when on, step() dispatches chunk k+1 before running
+        # chunk k's edge subsystems off the fused telemetry pack, with a
+        # synchronous fallback whenever edge work must mutate state.
+        self.pipeline_enabled = bool(getattr(_pipe_settings,
+                                             "chunk_pipeline", True))
+        self._pending_edge = None    # ChunkEdge of the in-flight chunk
+        self._simt_next = 0.0        # predicted clock after that chunk
+        self._last_edge = None       # newest retired edge (ACDATA cache)
+        self._retiring = False       # reentrancy guard for drains
+        self.pipe_stats = {"pipelined_chunks": 0, "sync_chunks": 0,
+                           "deferred_trips": 0, "sync_reasons": {}}
         self.dtmult = 1.0
         self.ffmode = False
         self.ffstop: Optional[float] = None
@@ -259,6 +286,18 @@ class Simulation:
         return float(self.traf.state.simt)
 
     @property
+    def simt_planned(self) -> float:
+        """The sim clock WITHOUT forcing a device sync: while a chunk is
+        in flight (pipelined stepping) this is the host's prediction of
+        the clock at its edge — exact, because the prediction folds the
+        per-step additions in the state's own float dtype and is
+        re-anchored against the device scalar at every retirement.
+        With no chunk in flight it is the device value."""
+        if self._pending_edge is not None:
+            return self._simt_next
+        return self.simt
+
+    @property
     def simdt(self) -> float:
         return self.cfg.simdt
 
@@ -326,10 +365,12 @@ class Simulation:
         return True
 
     def pause(self):
+        self._retire_edge("pause")
         self.state_flag = HOLD
         return True
 
     def stop(self):
+        self._retire_edge("stop")
         self.state_flag = END
         from ..utils import datalog
         datalog.reset()
@@ -344,6 +385,8 @@ class Simulation:
         what the SYN generators call (reference synthetic.py:48,58,...) —
         unlike the full ``reset`` they must NOT wipe SimConfig (CDMETHOD,
         DT), datalog or plugin state."""
+        self._retire_edge("reset")
+        self._last_edge = None
         self.traf.reset()
         self.cond.reset()
         self.routes = RouteManager(self.traf, self.routes.wmax)
@@ -352,6 +395,8 @@ class Simulation:
         return True
 
     def reset(self):
+        self._retire_edge("reset")
+        self._last_edge = None
         self.state_flag = INIT
         self._sort_simt = -1.0
         self._sort_backend = None
@@ -462,6 +507,17 @@ class Simulation:
 
         Mirrors the per-step order of simulation.py:62-128 at chunk
         granularity.  Returns False once END is reached.
+
+        Pipelined stepping (default, ``settings.chunk_pipeline``): the
+        next chunk is dispatched BEFORE the previous chunk's edge
+        subsystems run, so host edge work (guard word, metrics, trails,
+        stream telemetry, snapshot capture) overlaps in-flight device
+        compute.  Any edge that must read-modify the state — pending
+        stack commands (incl. every scenario-trigger boundary), queued
+        aircraft creations, armed conditionals, runway approach, due
+        plugin/logger/plot hooks, FF stop, preemption, guard policy
+        ``halt``, autosave — retires the deferred edge first and steps
+        synchronously, bit-identically to the unpipelined loop.
         """
         if self.state_flag == END:
             return False
@@ -469,15 +525,25 @@ class Simulation:
         # External TCP/telnet command lines (tools/network.py bridge)
         if self.telnet is not None:
             self.telnet.pump()
-        # Scenario commands due at current sim time (stack.checkfile)
-        self.stack.checkfile(self.simt)
-        # Process pending commands (may change state/config/traffic)
-        self.stack.process()
+        # Scenario commands due at current sim time (stack.checkfile).
+        # The planned clock avoids a device sync while a chunk is in
+        # flight; it is exact (see simt_planned).
+        simt = self.simt_planned
+        self.stack.checkfile(simt)
+        # Process pending commands (may change state/config/traffic).
+        # Commands observe and mutate the post-chunk state, so the
+        # deferred edge retires first — this IS the trigger-boundary /
+        # stack-command synchronous fallback.
+        if self.stack.cmdstack:
+            self._retire_edge("stack")
+            self.stack.process()
+            simt = self.simt_planned    # RESET/IC may move the clock
 
         if self.state_flag == INIT and self.traf.ntraf > 0:
             self.op()   # auto-start like simulation.py:89-98
 
         if self.state_flag != OP:
+            self._retire_edge("hold")
             return True
 
         # FAULT STRAGGLE STALL: skip the device chunk entirely — simt
@@ -505,6 +571,10 @@ class Simulation:
         if self.benchdt > 0.0 and self.bencht == 0.0:
             self.bencht = time.perf_counter()
 
+        if self.traf._pending:
+            # queued aircraft creations write into the state arrays:
+            # retire the deferred edge, then apply them (sync fallback)
+            self._retire_edge("flush")
         self.traf.flush()
 
         # Determine the chunk: stop exactly at the next scenario trigger.
@@ -557,11 +627,11 @@ class Simulation:
         tnext = self.stack.next_trigger_time()
         if tnext is not None:
             steps_to_trigger = int(np.ceil(
-                max(0.0, tnext - self.simt) / self.cfg.simdt + 1e-9))
+                max(0.0, tnext - simt) / self.cfg.simdt + 1e-9))
             if steps_to_trigger > 0:
                 limit = min(limit, steps_to_trigger)
         if self.ffstop is not None:
-            steps_to_stop = int(round((self.ffstop - self.simt) / self.cfg.simdt))
+            steps_to_stop = int(round((self.ffstop - simt) / self.cfg.simdt))
             if steps_to_stop <= 0:
                 self._end_ff()
                 return True
@@ -571,8 +641,14 @@ class Simulation:
         # 2-step chunks, not 1-step).  Arbitrary trigger distances stay
         # ladder-quantized so scenarios can't force a compile per
         # distinct distance (run_steps nsteps is a static jit arg).
+        # A CHUNKSTEPS value off the ladder joins it (the user asked for
+        # that exact size and accepts its one-off compilation).
+        ladder = self.CHUNK_LADDER
+        if self.chunk_steps not in ladder:
+            ladder = tuple(sorted(set(ladder) | {int(self.chunk_steps)},
+                                  reverse=True))
         chunk = 1
-        for c in self.CHUNK_LADDER:
+        for c in ladder:
             if c <= limit:
                 chunk = c
                 break
@@ -590,50 +666,170 @@ class Simulation:
         self.syst += chunk * self.cfg.simdt / max(self.dtmult, 1e-9)
 
         # Plugin preupdate hooks fire before the device chunk
-        # (simulation.py:83)
-        self.plugins.preupdate(self.simt)
-        self.traf.flush()   # preupdate hooks may have queued aircraft
+        # (simulation.py:83); they may read/mutate state, so a due hook
+        # retires the deferred edge first
+        if self.plugins.has_due(simt):
+            self._retire_edge("plugin")
+            self.plugins.preupdate(simt)
+            self.traf.flush()   # preupdate hooks may have queued aircraft
+            # plugin hooks may mutate traffic DIRECTLY (traf.delete/
+            # create) without a stack command, so the ACDATA edge cache
+            # cannot be trusted past them
+            self._last_edge = None
 
-        # Host-side spatial-sort refresh for the large-N CD backends,
-        # every sort_every CD intervals of sim time (exact at any
-        # staleness; see core/asas.refresh_spatial_sort).
+        reasons = self._sync_reasons(simt, chunk)
+        if reasons:
+            self._retire_edge(reasons[0])
+            self.pipe_stats["sync_reasons"][reasons[0]] = \
+                self.pipe_stats["sync_reasons"].get(reasons[0], 0) + 1
+            self._step_sync(chunk, self.simt)
+        else:
+            self._step_pipelined(chunk, simt)
+
+        if self.ffstop is not None \
+                and self.simt_planned >= self.ffstop - 1e-9:
+            self._end_ff()
+        return True
+
+    # ------------------------------------------------- chunk dispatch/edges
+    def _sync_reasons(self, simt: float, chunk: int):
+        """Why the upcoming chunk edge cannot be deferred (empty list =
+        safe to pipeline).  Every reason is a subsystem that reads or
+        mutates the post-chunk state on the host at that edge."""
+        reasons = []
+        if not self.pipeline_enabled:
+            reasons.append("off")
+        # The edge clock must be the DEVICE's (f32-folded) value: a
+        # float64 'simt + chunk*simdt' drifts ~1e-3 s from it at large
+        # simt — 6 orders beyond the 1e-9 due-epsilons below, enough to
+        # misclassify a hook due exactly at the edge (the common case:
+        # dt grids align with chunk edges).
+        t_edge = self._fold_clock(simt, chunk)
+        if self.cond.ncond > 0:
+            reasons.append("cond")          # ATALT/ATSPD sample + fire
+        if self._rwy_near:
+            reasons.append("runway")        # landing chain reads state
+        if self.plotter.plots:
+            reasons.append("plot")          # PLOT samples live attrs
+        if self.plugins.has_due(t_edge):
+            reasons.append("plugin")        # update hook at the edge
+        from ..utils import datalog
+        if datalog.any_due(t_edge):
+            reasons.append("datalog")       # periodic logger samples
+        if self.ffstop is not None and t_edge >= self.ffstop - 1e-9:
+            reasons.append("ff-stop")       # _end_ff timing boundary
+        if self.preempt_requested:
+            reasons.append("preempt")       # drain + checkpoint next
+        if self.guard.enabled and self.guard.policy == "halt":
+            reasons.append("guard-halt")    # halt wants the tripped
+            #                                 state frozen at its edge
+        if self.autosave_dt > 0 \
+                and t_edge - self._autosave_t >= self.autosave_dt - 1e-9:
+            reasons.append("autosave")      # on-disk persist reads state
+        return reasons
+
+    def _dispatch_chunk(self, state, chunk: int, keep: bool, simt: float):
+        """Enqueue the (due) spatial-sort refresh and the chunk program
+        back-to-back — both are async dispatches with no host readback
+        between them, so a re-sort edge costs one extra enqueue instead
+        of a host round-trip.  Returns ``(state, telemetry)`` futures.
+
+        ``keep=True`` selects the non-donating runner: the caller needs
+        the *input* state buffers to stay valid (snapshot-ring capture
+        overlapping the dispatched chunk).
+        """
         if self.cfg.cd_backend in ("tiled", "pallas", "sparse"):
             due = self.cfg.asas.sort_every * self.cfg.asas.dtasas
             # Also force a refresh when the backend changed: 'sparse'
             # stores stripe DESTINATIONS in sort_perm, the others a
             # Morton PERMUTATION — feeding one into the other scrambles
             # the sorted layout.
-            if (self.simt - self._sort_simt >= due
+            if (simt - self._sort_simt >= due
                     or self._sort_simt < 0
                     or self._sort_backend != self.cfg.cd_backend):
                 from ..core.asas import impl_for_backend, \
                     refresh_spatial_sort
-                self.traf.state = refresh_spatial_sort(
-                    self.traf.state, self.cfg.asas,
+                state = refresh_spatial_sort(
+                    state, self.cfg.asas,
                     block=self.cfg.cd_block,
                     impl=impl_for_backend(self.cfg.cd_backend))
-                self._sort_simt = self.simt
+                self._sort_simt = simt
                 self._sort_backend = self.cfg.cd_backend
+        from ..core.step import run_steps_edge, run_steps_edge_keep
+        runner = run_steps_edge_keep if keep else run_steps_edge
+        return runner(state, self.cfg, chunk, checked=self.guard.enabled)
 
+    def _fold_clock(self, t0: float, chunk: int) -> float:
+        """Predict the device clock after ``chunk`` steps by folding the
+        per-step additions in the state's own float dtype — bit-exact
+        emulation of the scan's ``simt + simdt`` chain, so the planned
+        clock can never diverge from the device clock.
+        ``np.add.accumulate`` applies strictly sequential left-to-right
+        rounding (no pairwise tree), i.e. the scan's exact chain, in C —
+        O(chunk) but ~ns/step, negligible even for 100k-step chunks."""
+        dt_np = np.dtype(self.traf.state.simt.dtype)
+        chain = np.empty(chunk + 1, dt_np)
+        chain[0] = t0
+        chain[1:] = np.asarray(self.cfg.simdt, dt_np)
+        return float(np.add.accumulate(chain)[-1])
+
+    def _step_pipelined(self, chunk: int, simt: float):
+        """Double-buffered dispatch: enqueue the next chunk, THEN retire
+        the previous chunk's edge off its telemetry pack while the new
+        chunk runs on the device."""
+        pend = self._pending_edge
+        ring = self.snap_ring
+        # Will retiring the pending edge capture a rollback restore
+        # point?  Then this dispatch must NOT donate its input buffers:
+        # they hold exactly the post-chunk state that goes into the
+        # ring, and the device->host copy overlaps the dispatched chunk.
+        capture_now = (pend is not None and self.guard.enabled
+                       and self.guard.policy == "rollback"
+                       and ring.dt > 0
+                       and simt - ring.t_last >= ring.dt - 1e-9)
+        state_in = self.traf.state
+        new_state, telem = self._dispatch_chunk(
+            state_in, chunk, keep=capture_now, simt=simt)
+        self.traf.state = new_state
+        self._step_count += chunk
+        self._straggle_charge(chunk)
+        self._simt_next = self._fold_clock(simt, chunk)
+        self._pending_edge = ChunkEdge(telem, chunk,
+                                       simt_planned=self._simt_next)
+        self.pipe_stats["pipelined_chunks"] += 1
+        if pend is not None:
+            self._finish_edge(
+                pend, capture_state=state_in if capture_now else None)
+
+    def _step_sync(self, chunk: int, simt: float):
+        """The synchronous chunk: dispatch, block on the guard word,
+        then run every edge subsystem against the live state — the
+        pre-pipeline behavior, bit-identical step math."""
+        self.pipe_stats["sync_chunks"] += 1
+        state, telem = self._dispatch_chunk(self.traf.state, chunk,
+                                            keep=False, simt=simt)
+        self.traf.state = state
+        self._step_count += chunk
+        self._straggle_charge(chunk)
+        edge = ChunkEdge(telem, chunk)      # device clock, no prediction
+        tripped = False
         if self.guard.enabled:
             # Integrity-guarded chunk: the isfinite check rides the scan
             # carry and pins a trip to one step of the chunk; the guard
             # then quarantines or rolls back at this chunk edge.
-            self.traf.state, bad = run_steps_checked(
-                self.traf.state, self.cfg, chunk)
-            bad = int(bad)
+            bad = edge.bad_step
             if bad >= 0:
                 self.guard.trip(bad, chunk)
-        else:
-            self.traf.state = run_steps(self.traf.state, self.cfg, chunk)
-        self._step_count += chunk
-        # FAULT STRAGGLE <factor>: every simulated second OWES `factor`
-        # extra wall seconds, added to the debt ledger paid off in
-        # slices above — this worker's progress rate sinks below the
-        # fleet median while its heartbeats keep flowing.
-        if self.straggle_factor > 0:
-            self._straggle_debt += \
-                chunk * self.cfg.simdt * self.straggle_factor
+                tripped = True
+        # Publish the edge to the ACDATA cache only when its pack still
+        # describes the live state: a trip just scrubbed/rolled back the
+        # fleet, so the tripped pack (NaN positions, deleted slots) must
+        # never reach the stream.  Conditional/runway mutations below go
+        # through the stack, which clears the cache (stack.py); plugin
+        # hooks can mutate traffic DIRECTLY, so a due hook clears it
+        # explicitly after the subsystem block.
+        self._last_edge = None if tripped else edge
+        plugins_due = self.plugins.has_due(self.simt)
 
         # Chunk-edge subsystems: plugin updates, conditional triggers,
         # trails, loggers (the reference runs these per 0.05 s step,
@@ -647,6 +843,8 @@ class Simulation:
         self.traf.trails.update(self.simt)
         from ..utils import datalog
         datalog.postupdate(self)
+        if plugins_due:
+            self._last_edge = None
 
         # Periodic snapshot-ring capture: the post-chunk state is
         # verified finite when the guard is on, so ring entries are
@@ -667,8 +865,93 @@ class Simulation:
                 >= self.autosave_dt - 1e-9:
             self._autosave()
 
-        if self.ffstop is not None and self.simt >= self.ffstop - 1e-9:
-            self._end_ff()
+    def _straggle_charge(self, chunk: int):
+        # FAULT STRAGGLE <factor>: every simulated second OWES `factor`
+        # extra wall seconds, added to the debt ledger paid off in
+        # slices above — this worker's progress rate sinks below the
+        # fleet median while its heartbeats keep flowing.
+        if self.straggle_factor > 0:
+            self._straggle_debt += \
+                chunk * self.cfg.simdt * self.straggle_factor
+
+    def _finish_edge(self, edge, capture_state=None):
+        """Retire one DEFERRED chunk edge: poll the guard word (the
+        one-scalar completion fence), respond to a late trip, then run
+        the passive edge consumers off the fused telemetry pack.  Runs
+        while the next chunk computes on the device."""
+        bad = edge.bad_step
+        if self.guard.enabled and bad >= 0:
+            self._deferred_trip(edge, bad)
+            return
+        # Re-anchor the planned clock against the device's own edge
+        # clock (one scalar, already materialized).  With the bit-exact
+        # fold this is a no-op; it guarantees drift can never compound.
+        if self._pending_edge is not None:
+            actual = edge.simt_device
+            if actual != edge.simt:
+                self._simt_next = self._fold_clock(
+                    actual, self._pending_edge.chunk)
+                self._pending_edge._simt_planned = self._simt_next
+        # Passive consumers: each samples the edge state from the pack
+        # (ONE bulk device->host copy, and only if somebody reads).
+        self.metrics.update(edge)
+        if self.traf.trails.active:
+            pack = edge.fetch()
+            self.traf.trails.update(edge.simt,
+                                    np.asarray(pack.lat),
+                                    np.asarray(pack.lon),
+                                    active=np.asarray(pack.active))
+        # Off-critical-path snapshot-ring capture: the dispatch kept
+        # (did not donate) these buffers, so the full pytree copy runs
+        # concurrently with the in-flight chunk.
+        if capture_state is not None:
+            self.snap_ring.capture(self, state=capture_state,
+                                   simt=edge.simt)
+        self._last_edge = edge
+
+    def _deferred_trip(self, edge, bad: int):
+        """A guard word that came back tripped one chunk LATE (the
+        deferred-readback contract): the fleet has already advanced
+        into the next chunk, computed from the poisoned state.  Drop
+        the in-flight edge (its telemetry is downstream of the fault)
+        and run the guard response against the CURRENT state —
+        ``rollback`` restores a pre-fault ring entry exactly as in the
+        synchronous path (the ring horizon dwarfs the one-chunk lag);
+        ``quarantine`` deletes every aircraft non-finite NOW, catching
+        any spread the extra chunk caused.  ``halt`` never defers
+        (guard-halt is a sync fallback reason)."""
+        self._pending_edge = None
+        self._last_edge = None
+        self.pipe_stats["deferred_trips"] += 1
+        rec = self.guard.trip(int(bad), edge.chunk)
+        if isinstance(rec, dict):
+            rec["deferred"] = True
+            rec["detect_lag_chunks"] = 1
+
+    def _retire_edge(self, reason: str = "sync"):
+        """Synchronization point: finish the deferred edge work of the
+        in-flight chunk (if any) before host code reads or mutates the
+        state.  Safe to call anywhere; reentrancy-guarded because edge
+        work itself (guard rollback -> reset_traffic) drains."""
+        if self._pending_edge is None or self._retiring:
+            return
+        self._retiring = True
+        try:
+            edge, self._pending_edge = self._pending_edge, None
+            self._finish_edge(edge, capture_state=None)
+            # The retired edge state IS the live state again (nothing
+            # was dispatched after it), so a due ring capture can use
+            # the classic path at this sync boundary.
+            if self.state_flag == OP and self.guard.enabled \
+                    and self.guard.policy == "rollback":
+                self.snap_ring.maybe_capture(self)
+        finally:
+            self._retiring = False
+
+    def drain_pipeline(self):
+        """Public alias: block until no chunk is in flight and all edge
+        work has run (callers: node shutdown, tests, snapshots)."""
+        self._retire_edge("drain")
         return True
 
     def _runway_approach_active(self) -> bool:
@@ -679,15 +962,32 @@ class Simulation:
         The gate radius is per-aircraft: threshold proximity guard plus
         the worst one-chunk travel at that aircraft's actual ground
         speed (floored at 340 m/s so a stale/slow reading still covers
-        normal jets)."""
+        normal jets).
+
+        While a pipelined chunk is in flight, the test samples the last
+        RETIRED edge's telemetry pack instead of the live state — an
+        ``np.asarray`` on the in-flight buffers would block the host
+        until the chunk drains, silently serializing the pipeline for
+        every scenario with runway-destination aircraft.  The pack is
+        up to one extra chunk stale, so the gate widens by one more
+        chunk of worst-case travel."""
         cands = self.routes.runway_final_slots()
         if not cands:
             return False
-        st = self.traf.state
-        lat = np.asarray(st.ac.lat)
-        lon = np.asarray(st.ac.lon)
-        gs = np.asarray(st.ac.gs)
-        chunk_s = self.CHUNK_LADDER[0] * self.cfg.simdt
+        edge = self._last_edge if self._pending_edge is not None else None
+        if edge is not None:
+            pack = edge.fetch()
+            lat = np.asarray(pack.lat)
+            lon = np.asarray(pack.lon)
+            gs = np.asarray(pack.gs)
+            staleness = 2.0        # [chunks] covered by the gate radius
+        else:
+            st = self.traf.state
+            lat = np.asarray(st.ac.lat)
+            lon = np.asarray(st.ac.lon)
+            gs = np.asarray(st.ac.gs)
+            staleness = 1.0
+        chunk_s = staleness * self.CHUNK_LADDER[0] * self.cfg.simdt
         # Worst-case acceleration cushion: gs is sampled at chunk START,
         # and an aircraft can accelerate through the chunk (perf-model
         # accel is ~0.5-2 m/s^2); 2 m/s^2 * chunk_s bounds the extra
@@ -771,13 +1071,17 @@ class Simulation:
         self.pause()
 
     def run(self, until_simt: Optional[float] = None, max_iters: int = 10 ** 9):
-        """Drive step() until END/HOLD or a sim-time horizon."""
+        """Drive step() until END/HOLD or a sim-time horizon.
+
+        Horizon math uses the planned clock so the loop itself never
+        forces a device sync; the pipeline drains before returning so
+        callers observe a fully-retired state."""
         it = 0
         while it < max_iters:
             it += 1
             mc = None
             if until_simt is not None:
-                remaining = until_simt - self.simt
+                remaining = until_simt - self.simt_planned
                 if remaining <= 1e-9:
                     break
                 # stop exactly at the horizon (ladder-quantized downstream)
@@ -791,8 +1095,9 @@ class Simulation:
                 break
             if not alive or self.state_flag in (HOLD, END):
                 if self.state_flag == HOLD and until_simt is not None \
-                        and self.simt < until_simt - 1e-9:
+                        and self.simt_planned < until_simt - 1e-9:
                     break
                 if self.state_flag != OP:
                     break
+        self.drain_pipeline()
         return self.simt

@@ -87,7 +87,10 @@ def _make_simnode_class(base):
             # is only comparable across workers running full speed — a
             # wall-clock-paced piece reports ~dtmult by design, which
             # must not read as "far below the fleet median".
-            return {"stamp": stamp, "simt": sim.simt,
+            # planned clock: a device read here would block the event
+            # loop on the in-flight pipelined chunk, turning "busy" into
+            # "silent" for the server's straggler detector
+            return {"stamp": stamp, "simt": sim.simt_planned,
                     "chunks": sim._step_count,
                     "state": sim.state_flag, "ntraf": sim.traf.ntraf,
                     "ff": bool(sim.ffmode)}
@@ -107,10 +110,11 @@ def _make_simnode_class(base):
                 # lockstep: advance exactly dtmult seconds of sim time
                 # (possibly several quantized chunks), then ack
                 sim.op()
-                t_target = sim.simt + sim.dtmult
-                while sim.state_flag == OP and sim.simt < t_target - 1e-9:
+                t_target = sim.simt_planned + sim.dtmult
+                while sim.state_flag == OP \
+                        and sim.simt_planned < t_target - 1e-9:
                     nsteps = max(1, int(round(
-                        (t_target - sim.simt) / sim.simdt)))
+                        (t_target - sim.simt_planned) / sim.simdt)))
                     sim.step(max_chunk=nsteps)
                 sim.pause()
                 self.send_event(b"STEP", None,
@@ -140,7 +144,7 @@ def _make_simnode_class(base):
                 sim.scr.echo(txt or "no health data")
             elif name == b"GETSIMSTATE":
                 self.send_event(b"SIMSTATE", {
-                    "state": sim.state_flag, "simt": sim.simt,
+                    "state": sim.state_flag, "simt": sim.simt_planned,
                     "simdt": sim.simdt, "ntraf": sim.traf.ntraf},
                     list(reversed(sender_route)) or None)
             elif name == b"QUIT":

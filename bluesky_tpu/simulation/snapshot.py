@@ -51,11 +51,20 @@ COMPAT_FORMATS = (2, 3)         # blob formats restore_blob accepts
 MAGIC = b"BSTPUSNAP3\n"         # v3 file header (v2 = bare pickle)
 
 
-def state_blob(sim) -> dict:
-    """Snapshot the complete simulation state as a host-side dict."""
+def state_blob(sim, state=None) -> dict:
+    """Snapshot the complete simulation state as a host-side dict.
+
+    ``state`` overrides the device pytree to copy: the pipelined chunk
+    loop passes the KEPT (non-donated) post-chunk buffers so the
+    device->host copy overlaps the next in-flight chunk instead of
+    blocking the dispatch.  Host tables (ids/routes/cond) are read live
+    — the pipeline only defers edges with no host-table mutations, so
+    they match the passed state."""
     traf = sim.traf
-    traf.flush()
-    state_np = jax.tree.map(lambda a: np.asarray(a), traf.state)
+    if state is None:
+        traf.flush()
+        state = traf.state
+    state_np = jax.tree.map(lambda a: np.asarray(a), state)
     routes = {i: dict(name=list(r.name), lat=list(r.lat),
                       lon=list(r.lon), alt=list(r.alt),
                       spd=list(r.spd), wtype=list(r.wtype),
@@ -246,9 +255,12 @@ class SnapshotRing:
         """Sim times of the held snapshots, oldest first."""
         return [float(np.asarray(b["state"].simt)) for b in self._ring]
 
-    def capture(self, sim):
-        self._ring.append(state_blob(sim))
-        self.t_last = sim.simt
+    def capture(self, sim, state=None, simt=None):
+        """Capture now.  ``state``/``simt`` let the pipelined loop hand
+        in the kept post-chunk buffers + planned edge clock so the copy
+        overlaps the in-flight chunk (no device sync here)."""
+        self._ring.append(state_blob(sim, state=state))
+        self.t_last = sim.simt if simt is None else float(simt)
 
     def newest(self):
         """The most recent snapshot blob, or None (the autosnapshot

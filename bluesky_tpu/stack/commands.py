@@ -1004,6 +1004,46 @@ def register_all(stack):
         from ..fault import harness
         return harness.fault_command(sim, *args)
 
+    def chunksteps(arg=None, onoff=None):
+        """CHUNKSTEPS [n | PIPELINE ON/OFF]: interactive device-chunk
+        length + async-pipeline toggle, with HEALTH-style readback."""
+        if arg is None:
+            ps = sim.pipe_stats
+            reasons = ", ".join(
+                f"{k}:{v}" for k, v in sorted(
+                    ps["sync_reasons"].items())) or "-"
+            return True, (
+                f"CHUNKSTEPS {sim.chunk_steps} "
+                f"(={sim.chunk_steps * sim.simdt:.2f} s sim/chunk, "
+                f"pipeline {'ON' if sim.pipeline_enabled else 'OFF'}; "
+                f"chunks: {ps['pipelined_chunks']} pipelined, "
+                f"{ps['sync_chunks']} sync, "
+                f"{ps['deferred_trips']} deferred guard trips; "
+                f"sync fallbacks: {reasons})")
+        if str(arg).upper() == "PIPELINE":
+            if onoff is None:
+                return True, (f"CHUNKSTEPS PIPELINE is "
+                              f"{'ON' if sim.pipeline_enabled else 'OFF'}")
+            sw = str(onoff).upper()
+            if sw not in ("ON", "OFF", "TRUE", "FALSE", "1", "0"):
+                return False, "CHUNKSTEPS PIPELINE ON/OFF"
+            sim.pipeline_enabled = sw in ("ON", "TRUE", "1")
+            if not sim.pipeline_enabled:
+                sim.drain_pipeline()
+            return True, (f"Chunk pipeline "
+                          f"{'ON' if sim.pipeline_enabled else 'OFF'}")
+        try:
+            n = int(float(arg))
+        except (TypeError, ValueError):
+            return False, "CHUNKSTEPS [n | PIPELINE ON/OFF]"
+        if n < 1:
+            return False, f"CHUNKSTEPS: need n >= 1, got {n}"
+        sim.chunk_steps = n
+        note = "" if n in sim.CHUNK_LADDER else \
+            " (off-ladder: compiles one extra scan program)"
+        return True, (f"Chunk set to {n} steps "
+                      f"(={n * sim.simdt:.2f} s sim){note}")
+
     def healthcmd():
         """HEALTH: serving-fabric introspection.  On a networked
         worker the server is queried (queue depth + per-client split,
@@ -1016,9 +1056,12 @@ def register_all(stack):
                 is not None:
             node.send_event(b"HEALTH", None)   # empty route -> server
             return True, "HEALTH requested from the server"
+        ps = sim.pipe_stats
         return True, (f"detached sim: state {sim.state_flag}, simt "
-                      f"{sim.simt:.1f} s, {traf.ntraf} aircraft, "
-                      f"{sim._step_count} steps done"
+                      f"{sim.simt_planned:.1f} s, {traf.ntraf} aircraft, "
+                      f"{sim._step_count} steps done, chunks "
+                      f"{ps['pipelined_chunks']} pipelined/"
+                      f"{ps['sync_chunks']} sync"
                       + (", straggle STALLED"
                          if getattr(sim, 'straggle_stall', False)
                          else ""))
@@ -1248,6 +1291,10 @@ def register_all(stack):
                    "Protected zone half-height"],
         "ZONER": ["ZONER [radius nm]", "[float]", zoner,
                   "Protected zone radius"],
+        "CHUNKSTEPS": ["CHUNKSTEPS [n | PIPELINE ON/OFF]", "[txt,txt]",
+                       chunksteps,
+                       "Interactive device-chunk length / async-pipeline "
+                       "toggle (readback without args)"],
         "CONFINFO": ["CONFINFO", "", confinfo, "Current conflict counts"],
         "PLUGINS": ["PLUGINS LIST or PLUGINS LOAD/REMOVE plugin",
                     "[txt,txt]",

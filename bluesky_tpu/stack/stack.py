@@ -112,6 +112,10 @@ class Stack:
             echo(f"Usage: {usage}")
             return
 
+        # Any command may mutate traffic/display state: the ACDATA
+        # stream must stop serving the cached chunk-edge telemetry
+        # (simulation/pipeline.py) until the next edge retires.
+        self.sim._last_edge = None
         try:
             result = fn(*parsed)
         except TypeError as e:

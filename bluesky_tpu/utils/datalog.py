@@ -152,12 +152,16 @@ class EventLogger(CSVLogger):
     def __init__(self, name: str, header: str):
         super().__init__(name, header, dt=0.0, getters={})
 
-    def log(self, sim, *columns):
+    def log(self, sim, *columns, simt=None):
         """Write one row per element; columns are arrays/lists of equal
-        length (scalars broadcast)."""
+        length (scalars broadcast).  ``simt`` overrides the timestamp:
+        pipelined chunk edges pass their own edge clock so the row is
+        stamped with the sampled state's time (and no device sync is
+        forced while the next chunk is in flight)."""
         if not self.file or not columns:
             return
-        simt = sim.simt
+        if simt is None:
+            simt = sim.simt
         cols = [np.atleast_1d(np.asarray(c)) for c in columns]
         nrows = max(c.shape[0] for c in cols)
         for c in cols:
@@ -218,6 +222,14 @@ def postupdate(sim):
         if lg.active and lg.dt > 0 and simt >= lg.tlog:
             lg.tlog += lg.dt
             lg.log(sim)
+
+
+def any_due(simt: float) -> bool:
+    """Any active periodic logger due at (or before) ``simt``?  The
+    pipelined chunk loop asks this before dispatching: logger getters
+    read live sim state, so a due sample forces a synchronous edge."""
+    return any(lg.active and lg.dt > 0 and simt >= lg.tlog
+               for lg in _loggers.values())
 
 
 def reset():

@@ -1,12 +1,21 @@
-"""VERDICT r4 #6: measure the 100k-continental headline's sensitivity to
-the scan-chunk length (refresh + dispatch amortization vs chunk).
+"""Measure the headline's sensitivity to the scan-chunk length, with
+the async chunk pipeline ON and OFF (VERDICT r4 #6 + ISSUE 4).
 
-Runs the exact bench.run_one protocol at chunk = 20 / 100 / 400 / 1000
-steps (20 is the production Simulation default, 1000 the FF/BATCH
-headline protocol) and prints one JSON line per row; the table lands in
-docs/PERF_ANALYSIS.md and the protocol fields in BENCH_DETAIL rows.
+Runs the bench.run_chunked protocol — the production Simulation.step
+cost model: per-chunk host re-sort, per-edge telemetry consumption —
+at chunk = 20 / 100 / 400 / 1000 steps over the same total step count,
+and emits one JSON row per (chunk, pipeline) cell including the
+host-edge overhead breakdown (dispatch gap + telemetry-pull time per
+chunk).  20 is the production interactive default, 1000 the FF/BATCH
+headline protocol; the pipeline's job is to close the gap between
+them.
 
-Usage: python scripts/chunk_sweep.py [N]
+Rows land in output/chunk_sweep.json AND are merged into the repo-root
+BENCH_CHUNK_SWEEP.json: rows from other platforms (e.g. the historical
+TPU v5e sweep) are kept, rows for the current platform are replaced.
+
+Usage: python scripts/chunk_sweep.py [N] [--pipeline on|off|both]
+       [--total-steps S]
 """
 import json
 import os
@@ -17,22 +26,66 @@ sys.path.insert(0, ".")
 import bench  # noqa: E402
 
 
-def main(n_ac=100_000):
+def _platform():
+    import jax
+    dev = jax.devices()[0]
+    return f"{dev.platform}:{getattr(dev, 'device_kind', '?')}"
+
+
+def main(n_ac=100_000, pipeline="both", total_steps=1000):
+    modes = {"on": [True], "off": [False],
+             "both": [False, True]}[pipeline]
+    plat = _platform()
     rows = []
     for nsteps in (20, 100, 400, 1000):
-        r = bench.run_one(n_ac, backend=None, geometry="continental",
-                          nsteps=nsteps, reps=3)
-        r["nsteps_chunk"] = nsteps
-        r["protocol"] = "best-of-3, host re-sort per chunk"
-        rows.append(r)
-        print(json.dumps(r), flush=True)
+        for pipe in modes:
+            r = bench.run_chunked(n_ac, backend=None,
+                                  geometry="continental", chunk=nsteps,
+                                  total_steps=max(total_steps, nsteps),
+                                  pipeline=pipe, reps=3)
+            r["platform"] = plat
+            rows.append(r)
+            print(json.dumps(r), flush=True)
     # fresh checkout: output/ may not exist yet — a multi-minute run
     # must not crash at the final dump
     os.makedirs("output", exist_ok=True)
     with open("output/chunk_sweep.json", "w") as f:
         json.dump(rows, f, indent=1)
+    merge_bench_file(rows, plat)
     return rows
 
 
+def merge_bench_file(rows, plat, path="BENCH_CHUNK_SWEEP.json"):
+    """Replace this platform's rows in BENCH_CHUNK_SWEEP.json, keep the
+    rest (the historical TPU sweep stays on record when re-running on
+    CPU and vice versa)."""
+    old = []
+    if os.path.isfile(path):
+        try:
+            with open(path) as f:
+                old = json.load(f)
+        except (OSError, ValueError):
+            old = []
+    kept = [r for r in old if r.get("platform", "tpu:v5e") != plat]
+    with open(path, "w") as f:
+        json.dump(kept + rows, f, indent=1)
+
+
 if __name__ == "__main__":
-    main(int(sys.argv[1]) if len(sys.argv) > 1 else 100_000)
+    # positional parse: consume each flag's value by INDEX, never by
+    # textual equality (``chunk_sweep.py 400 --total-steps 400`` must
+    # keep N=400)
+    argv = sys.argv[1:]
+    pipeline = "both"
+    total = 1000
+    if "--pipeline" in argv:
+        i = argv.index("--pipeline")
+        pipeline = argv[i + 1].lower()
+        del argv[i:i + 2]
+    if "--total-steps" in argv:
+        i = argv.index("--total-steps")
+        total = int(argv[i + 1])
+        del argv[i:i + 2]
+    args = [a for a in argv if not a.startswith("--")]
+    main(int(args[0]) if args else 100_000, pipeline=pipeline,
+         total_steps=total)

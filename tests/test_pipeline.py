@@ -1,0 +1,244 @@
+"""Async chunk pipeline (ISSUE 4): bit-exact parity of pipelined vs
+synchronous stepping, deferred guard readback + widened rollback
+window, the CHUNKSTEPS knob, and the fused edge-telemetry pack.
+"""
+import numpy as np
+import jax
+
+from bluesky_tpu.simulation.sim import Simulation
+
+
+SCENARIO = (
+    "CRE KL1 B744 52 4 90 FL200 250",
+    "CRE KL2 B744 52.2 4.3 270 FL210 250",
+    # stack commands, triggers and create/delete at chunk edges — the
+    # full set of sync-fallback boundaries the pipeline must cross
+    "SCHEDULE 00:00:03 ALT KL1 FL300",
+    "SCHEDULE 00:00:05 HDG KL2 180",
+    "SCHEDULE 00:00:06 CRE KL3 B744 53 5 180 FL100 200",
+    "SCHEDULE 00:00:09 DEL KL2",
+    "FF",
+)
+
+
+def _run_scenario(pipeline, until=14.0, cmds=SCENARIO, nmax=32):
+    sim = Simulation(nmax=nmax)
+    sim.pipeline_enabled = pipeline
+    for cmd in cmds:
+        sim.stack.stack(cmd)
+    sim.stack.process()
+    sim.op()
+    sim.run(until_simt=until, max_iters=1000)
+    return sim
+
+
+def _state_leaves(sim):
+    return jax.tree.leaves(jax.tree.map(np.asarray, sim.traf.state))
+
+
+def test_pipelined_vs_sync_bit_exact():
+    """Same scenario, pipeline on vs off: every state array (positions,
+    speeds, ASAS bookkeeping, RNG key, clocks) must match BIT-exactly —
+    the pipeline reorders host work, never device math."""
+    a = _run_scenario(True)
+    b = _run_scenario(False)
+    assert a.pipe_stats["pipelined_chunks"] > 0
+    assert b.pipe_stats["pipelined_chunks"] == 0
+    assert a.traf.ids == b.traf.ids
+    assert a.traf.types == b.traf.types
+    for la, lb in zip(_state_leaves(a), _state_leaves(b)):
+        np.testing.assert_array_equal(la, lb)
+
+
+def test_sync_fallback_on_conditionals():
+    """An armed ATALT conditional samples state at every edge — the
+    pipeline must fall back to synchronous chunks while it is armed."""
+    sim = _run_scenario(True, until=6.0, cmds=(
+        "CRE KL1 B744 52 4 90 FL200 250",
+        "ALT KL1 FL300",
+        "ATALT KL1 FL250 SPD KL1 300",
+        "FF"))
+    assert sim.pipe_stats["sync_chunks"] > 0
+    assert "cond" in sim.pipe_stats["sync_reasons"]
+
+
+def test_deferred_guard_trip_rollback():
+    """A NaN injected via FAULT must still be pinned and rolled back
+    under deferred readback, within the widened 2-chunk window."""
+    sim = Simulation(nmax=16)
+    assert sim.pipeline_enabled
+    sim.guard.set_policy("rollback")
+    sim.snap_ring.dt = 2.0
+    for cmd in ("CRE KL1 B744 52 4 90 FL200 250",
+                "CRE KL2 B744 52.5 4.5 270 FL210 250", "FF"):
+        sim.stack.stack(cmd)
+    sim.stack.process()
+    sim.op()
+    sim.run(until_simt=8.0, max_iters=200)
+    assert len(sim.snap_ring) > 0
+    ring_simts = list(sim.snap_ring.simts)
+
+    sim.op()
+    sim.fastforward()
+    sim.stack.stack("FAULT NAN KL1")    # injected at a chunk boundary
+    chunk_s = 1000 * sim.cfg.simdt      # FF chunk length in sim-s
+    t_inject = sim.simt_planned
+    for _ in range(4):
+        sim.step()
+    sim.drain_pipeline()
+
+    assert len(sim.guard.trips) == 1
+    rec = sim.guard.trips[0]
+    assert rec["action"] == "rollback+quarantine"
+    # deferred detection: the trip is flagged as caught one chunk late,
+    # and the trip-handling edge lies within 2 chunks of the injection
+    assert rec.get("deferred") is True
+    assert rec.get("detect_lag_chunks") == 1
+    assert rec["simt"] <= t_inject + 2 * chunk_s + 1e-6
+    # rolled back to a pre-fault ring entry, poisoned aircraft gone
+    assert sim.traf.id2idx("KL1") < 0
+    assert sim.traf.id2idx("KL2") >= 0
+    assert rec["simt"] >= max(ring_simts) - 1e-6
+    for leaf in _state_leaves(sim):
+        if np.issubdtype(leaf.dtype, np.floating):
+            assert np.isfinite(leaf).all() or not np.isnan(leaf).any()
+
+
+def test_deferred_guard_trip_quarantine():
+    """Default policy: the poisoned aircraft is quarantined a chunk
+    late and the run continues with the healthy fleet."""
+    sim = Simulation(nmax=16)
+    assert sim.guard.policy == "quarantine"
+    for cmd in ("CRE KL1 B744 52 4 90 FL200 250",
+                "CRE KL2 B744 55 8 270 FL210 250", "FF"):
+        sim.stack.stack(cmd)
+    sim.stack.process()
+    sim.op()
+    sim.run(until_simt=2.0, max_iters=100)
+    sim.op()
+    sim.fastforward()
+    sim.stack.stack("FAULT NAN KL1")
+    for _ in range(3):
+        sim.step()
+    sim.drain_pipeline()
+    assert len(sim.guard.trips) == 1
+    assert sim.guard.trips[0]["action"] == "quarantine"
+    assert sim.traf.id2idx("KL1") < 0
+    assert sim.traf.id2idx("KL2") >= 0
+    # scrubbed: no NaN anywhere in the state
+    for leaf in _state_leaves(sim):
+        if np.issubdtype(leaf.dtype, np.floating):
+            assert not np.isnan(leaf).any()
+
+
+def test_chunksteps_command_and_knob():
+    sim = Simulation(nmax=8)
+    sim.scr.echobuf.clear()
+    sim.stack.stack("CHUNKSTEPS")
+    sim.stack.process()
+    assert "CHUNKSTEPS 20" in sim.scr.echobuf[-1]
+    assert "pipeline ON" in sim.scr.echobuf[-1]
+
+    sim.stack.stack("CHUNKSTEPS 7")
+    sim.stack.process()
+    assert sim.chunk_steps == 7
+    assert "off-ladder" in sim.scr.echobuf[-1]
+    # the off-ladder size actually runs: interactive chunks are 7 steps
+    sim.stack.stack("CRE KL1 B744 52 4 90 FL200 250")
+    sim.stack.process()
+    sim.setdtmult(1e6)          # skip wall-clock pacing
+    sim.op()
+    n0 = sim._step_count
+    sim.step()
+    sim.step()
+    sim.drain_pipeline()
+    assert (sim._step_count - n0) % 7 == 0 and sim._step_count > n0
+
+    sim.stack.stack("CHUNKSTEPS PIPELINE OFF")
+    sim.stack.process()
+    assert sim.pipeline_enabled is False
+    sim.step()
+    assert sim.pipe_stats["sync_reasons"].get("off", 0) >= 1
+    sim.stack.stack("CHUNKSTEPS PIPELINE ON")
+    sim.stack.process()
+    assert sim.pipeline_enabled is True
+
+    sim.stack.stack("CHUNKSTEPS 0")
+    sim.stack.process()
+    assert sim.chunk_steps == 7          # rejected, unchanged
+
+
+def test_settings_knobs(monkeypatch):
+    from bluesky_tpu import settings
+    monkeypatch.setattr(settings, "chunk_steps", 5, raising=False)
+    monkeypatch.setattr(settings, "chunk_pipeline", False, raising=False)
+    sim = Simulation(nmax=8)
+    assert sim.chunk_steps == 5
+    assert sim.pipeline_enabled is False
+    # ctor arg still overrides the settings default
+    sim2 = Simulation(nmax=8, chunk_steps=200)
+    assert sim2.chunk_steps == 200
+
+
+def test_edge_pack_matches_state_and_acdata_schema():
+    """The retired edge's fused telemetry equals the live state and
+    covers the per-aircraft ACDATA fields (one bulk copy per edge)."""
+    sim = _run_scenario(True, until=4.0, cmds=(
+        "CRE KL1 B744 52 4 90 FL200 250",
+        "CRE KL2 B744 52.2 4.3 270 FL210 250", "FF"))
+    edge = sim._last_edge
+    assert edge is not None
+    idx, data = edge.acdata_arrays()
+    assert len(idx) == 2
+    st = sim.traf.state
+    for name in ("lat", "lon", "alt", "trk", "tas", "gs", "cas", "vs"):
+        np.testing.assert_array_equal(
+            data[name], np.asarray(getattr(st.ac, name))[idx])
+    for name in ("inconf", "tcpamax", "asasn", "asase"):
+        np.testing.assert_array_equal(
+            data[name], np.asarray(getattr(st.asas, name))[idx])
+    assert int(np.asarray(edge.nconf_cur)) \
+        == int(np.asarray(st.asas.nconf_cur))
+    # a state-mutating command invalidates the cached edge: the ACDATA
+    # stream must fall back to the live state until the next edge
+    sim.stack.stack("MOVE KL1 53 5")
+    sim.stack.process()
+    assert sim._last_edge is None
+
+
+def test_metrics_consume_edge_telemetry():
+    """METRICS keeps evaluating on pipelined edges, fed by the pack."""
+    sim = _run_scenario(True, until=6.0, cmds=(
+        "CRE KL1 B744 52.6 5.4 90 FL200 250",
+        "CRE KL2 B744 52.7 5.5 270 FL210 250",
+        "METRICS 2 1",
+        "FF"))
+    assert sim.pipe_stats["pipelined_chunks"] > 0
+    assert sim.metrics.n_selected == 2
+    assert sim.metrics.tnext > 5.0
+
+
+def test_snapshot_ring_capture_off_critical_path():
+    """Pipelined ring captures happen at the same sim times as the
+    synchronous loop's (the keep-dispatch overlap changes WHEN the copy
+    runs, never WHAT it holds)."""
+    def cap_run(pipeline):
+        sim = Simulation(nmax=16)
+        sim.pipeline_enabled = pipeline
+        sim.guard.set_policy("rollback")
+        sim.snap_ring.dt = 2.0
+        for cmd in ("CRE KL1 B744 52 4 90 FL200 250", "FF"):
+            sim.stack.stack(cmd)
+        sim.stack.process()
+        sim.op()
+        sim.run(until_simt=9.0, max_iters=100)
+        return sim
+
+    a, b = cap_run(True), cap_run(False)
+    assert len(a.snap_ring) == len(b.snap_ring) > 0
+    assert np.allclose(a.snap_ring.simts, b.snap_ring.simts)
+    # blob contents of the newest entry are identical
+    na, nb = a.snap_ring.newest(), b.snap_ring.newest()
+    for la, lb in zip(jax.tree.leaves(na["state"]),
+                      jax.tree.leaves(nb["state"])):
+        np.testing.assert_array_equal(la, lb)

@@ -108,6 +108,64 @@ def test_routedata_covers_reference_schema(simfabric):
     assert isinstance(frame["iactwp"], int)
 
 
+def test_acdata_edge_pack_matches_live_pull_schema():
+    """The fused edge-telemetry ACDATA path (simulation/pipeline.py)
+    must emit the exact same keys/shapes/values as the live-state pull
+    path — the stream schema cannot depend on whether the sim happened
+    to serve the frame from a retired chunk edge or from the state.
+
+    No network/reference needed: a capturing fake node records what
+    ScreenIO would put on the wire, and the codec round-trip proves the
+    pack survives serialization.
+    """
+    from bluesky_tpu.simulation.sim import Simulation
+    from bluesky_tpu.simulation.screenio import ScreenIO
+    from bluesky_tpu.network.npcodec import packb, unpackb
+
+    class FakeNode:
+        def __init__(self):
+            self.streams = []
+
+        def send_stream(self, name, data):
+            self.streams.append((name, data))
+
+        def send_event(self, *a, **k):
+            pass
+
+    sim = Simulation(nmax=16)
+    node = FakeNode()
+    scr = ScreenIO(sim, node)
+    sim.scr = scr
+    sim.stack.stack("CRE KL204 B744 52 4 90 FL200 250")
+    sim.stack.stack("CRE KL205 B744 52.2 4.1 270 FL210 250")
+    sim.stack.process()
+    sim.setdtmult(1e6)
+    sim.op()
+    sim.step()
+    sim.step()
+    sim.drain_pipeline()                  # final edge == live state
+
+    assert sim._last_edge is not None     # pipelined edge retired
+    scr.send_aircraft_data()
+    _, from_edge = node.streams[-1]
+
+    sim._last_edge = None                 # force the live-state path
+    scr.send_aircraft_data()
+    _, from_state = node.streams[-1]
+
+    assert set(from_edge) == set(from_state)
+    for key in ("lat", "lon", "alt", "trk", "tas", "gs", "cas", "vs",
+                "inconf", "tcpamax", "asasn", "asase"):
+        np.testing.assert_array_equal(
+            np.asarray(from_edge[key]), np.asarray(from_state[key]))
+    assert from_edge["id"] == from_state["id"] == ["KL204", "KL205"]
+    # and the edge-served frame round-trips the wire codec
+    rt = unpackb(packb({k: v for k, v in from_edge.items()
+                        if k != "simt"}))
+    np.testing.assert_array_equal(np.asarray(rt["lat"]),
+                                  np.asarray(from_edge["lat"]))
+
+
 def test_trail_segments_stream_as_deltas(simfabric):
     server, node, client = simfabric
     frames = []
