@@ -28,7 +28,7 @@ import numpy as np
 BASELINE_AC_STEPS_PER_SEC = 700 * 20.0
 
 
-def _make_traffic(n_ac, geometry, pair_matrix, dtype):
+def _make_traffic(n_ac, geometry, pair_matrix, dtype, nmax=None):
     from bluesky_tpu.core.traffic import Traffic
     rng = np.random.default_rng(0)
     if geometry == "global":
@@ -44,7 +44,8 @@ def _make_traffic(n_ac, geometry, pair_matrix, dtype):
         r = 3.8 * np.sqrt(rng.random(n_ac))
         lat = 52.6 + r * np.cos(ang)
         lon = 5.4 + r * np.sin(ang) / 0.6
-    traf = Traffic(nmax=n_ac, dtype=dtype, pair_matrix=pair_matrix)
+    traf = Traffic(nmax=nmax or n_ac, dtype=dtype,
+                   pair_matrix=pair_matrix)
     traf.create(n_ac, "B744",
                 rng.uniform(3000.0, 11000.0, n_ac),
                 rng.uniform(130.0, 240.0, n_ac), None,
@@ -129,7 +130,8 @@ def run_one(n_ac, backend=None, geometry=None, nsteps=1000, reps=3):
 
 
 def run_chunked(n_ac, backend=None, geometry=None, chunk=20,
-                total_steps=1000, pipeline=True, reps=3):
+                total_steps=1000, pipeline=True, reps=3, shard="off",
+                shard_devices=0):
     """Multi-chunk protocol with per-chunk-edge host work — the
     production ``Simulation.step`` loop's cost model, measurable with
     the pipeline on or off.
@@ -152,12 +154,45 @@ def run_chunked(n_ac, backend=None, geometry=None, chunk=20,
 
     backend = backend or _pick_backend(n_ac)
     geometry = geometry or ("continental" if n_ac > 16384 else "regional")
-    traf = _make_traffic(n_ac, geometry, backend == "dense", jnp.float32)
+    # mesh-aware chunk runner (ISSUE 5): the production cost model on a
+    # device mesh — 'replicate' shards rows vs replicated columns,
+    # 'spatial' runs the latitude-stripe decomposition (sparse backend,
+    # nmax gets 2x re-bucketing headroom)
+    ndev = 0
+    mesh = None
+    if shard and shard != "off":
+        import jax as _jax
+        from bluesky_tpu.parallel import sharding as shd
+        ndev = shard_devices or len(_jax.devices())
+        mesh = shd.make_mesh(ndev)
+        if shard == "spatial" and backend != "sparse":
+            backend = "sparse"
+    nmax = 2 * n_ac if shard == "spatial" else n_ac
+    if ndev:
+        nmax = -(-nmax // ndev) * ndev
+    traf = _make_traffic(n_ac, geometry, backend == "dense", jnp.float32,
+                         nmax=nmax)
     cfg = SimConfig(cd_backend=backend)
     state = traf.state
+    if mesh is not None:
+        from bluesky_tpu.parallel import sharding as shd
+        if shard == "spatial":
+            state, _, sp_info = shd.prepare_spatial(state, mesh, cfg.asas)
+            cfg = cfg._replace(cd_shard_mode="spatial", cd_mesh=mesh,
+                               cd_mesh_axis="ac",
+                               cd_halo_blocks=sp_info["halo_blocks"])
+        else:
+            if backend in ("pallas", "sparse"):
+                cfg = cfg._replace(cd_mesh=mesh, cd_mesh_axis="ac")
+            state = shd.shard_state(state, mesh)
     nchunks = max(1, total_steps // chunk)
 
     def resort(st):
+        if shard == "spatial":
+            from bluesky_tpu.core.asas import refresh_spatial_shard
+            return refresh_spatial_shard(
+                st, cfg.asas, ndev, block=min(cfg.cd_block, 256),
+                halo_blocks=cfg.cd_halo_blocks)[0]
         if backend in ("tiled", "pallas", "sparse"):
             return refresh_spatial_sort(st, cfg.asas, block=cfg.cd_block,
                                         impl=impl_for_backend(backend))
@@ -205,6 +240,7 @@ def run_chunked(n_ac, backend=None, geometry=None, chunk=20,
                    ac_steps_per_s=round(rate, 1),
                    x_realtime=round(rate * cfg.simdt / n_ac, 1),
                    nsteps_chunk=chunk, nchunks=nchunks,
+                   shard=shard, shard_devices=ndev,
                    pipeline=bool(pipeline),
                    dispatch_gap_s=round(dispatch_gap, 4),
                    telemetry_pull_s=round(telem_pull, 4),
@@ -437,12 +473,16 @@ if __name__ == "__main__":
         # (dispatch_gap_s / telemetry_pull_s) in the emitted row
         mode = sys.argv[sys.argv.index("--pipeline") + 1].lower() \
             if len(sys.argv) > sys.argv.index("--pipeline") + 1 else "on"
+        shard = sys.argv[sys.argv.index("--shard") + 1].lower() \
+            if "--shard" in sys.argv else "off"
         args = [a for a in sys.argv[1:]
-                if not a.startswith("--") and a not in ("on", "off")]
+                if not a.startswith("--")
+                and a not in ("on", "off", "replicate", "spatial")]
         n = int(args[0]) if args else 100_000
         chunk = int(args[1]) if len(args) > 1 else 20
         print(json.dumps(run_chunked(n, chunk=chunk,
-                                     pipeline=(mode != "off"))))
+                                     pipeline=(mode != "off"),
+                                     shard=shard)))
     else:
         n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
         main(n_ac=n)

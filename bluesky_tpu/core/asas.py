@@ -21,6 +21,7 @@ from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..ops import aero, cd as cdops, cd_tiled, cr_mvp
 from ..ops.cd import ConflictData
@@ -242,6 +243,129 @@ def _sparse_sort_refresh(lat, lon, gs, alt, vs, active, old_perm,
     return dest, new_partners
 
 
+@functools.partial(jax.jit, static_argnames=(
+    "block", "ndev", "extra", "halo", "tlookahead", "rpz",
+    "min_reach_m", "margin_s"))
+def _spatial_shard_refresh(lat, lon, gs, alt, vs, active, old_perm,
+                           partners_s, *, block, ndev, extra, halo,
+                           tlookahead, rpz, min_reach_m, margin_s):
+    """Spatial-mode sort refresh: stripe sort + device RE-BUCKETING as
+    one compiled program.
+
+    Unlike ``_sparse_sort_refresh`` (which only moves aircraft between
+    SORTED slots), the spatial mode also migrates aircraft between
+    CALLER slots so that caller shard d of the device mesh holds
+    exactly the aircraft whose sorted latitude-stripe slots device d
+    owns — the invariant that makes the per-interval padded scatter and
+    result back-map device-local (zero per-interval O(N) collectives,
+    ops/cd_sched.py spatial branch).  Inactive rows fill the per-shard
+    gaps and carry the SENTINEL sort slot ``n_tot`` (dropped from the
+    scatter; their results read the accumulator identities).
+
+    Returns ``(newslot, src, sort_perm_new, partners_new, stats)``:
+
+    * ``newslot`` [n]: old caller slot -> new caller slot (the host
+      applies it to ids/routes/conditions via
+      ``Traffic.apply_slot_permutation``),
+    * ``src`` [n]: new caller slot -> old caller slot (gather index for
+      permuting every [n]-leading state leaf),
+    * ``sort_perm_new`` [n]: new caller slot -> sorted slot (sentinel
+      ``n_tot`` on inactive rows),
+    * ``partners_new`` [n_tot, K]: the sorted-space partner table
+      remapped old layout -> new layout (old sorted -> old caller ->
+      new sorted),
+    * ``stats``: ``(counts [ndev], halo_ok, halo_need, gsmax)`` —
+      per-device active occupancy, whether the ``halo``-block window
+      covers every reachable block pair even after ``margin_s`` seconds
+      of worst-case drift (the exact conservative
+      rpz + lookahead*(gs_i+gs_j) bound, horizontally widened by
+      2*gsmax*margin_s), and the widest halo actually needed.
+    """
+    from ..ops import cd_sched
+    n = lat.shape[0]
+    nb = -(-n // block) + extra
+    n_tot = nb * block
+    nb_l = nb // ndev
+    S = nb_l * block
+    C = n // ndev
+    thresh = cd_sched.reach_threshold_m(gs, active, tlookahead, rpz)
+    dest0 = cd_sched.stripe_sort_dest(
+        lat, lon, gs, active, thresh, block, extra,
+        alt=alt, vs=vs, spread_pad=True).astype(jnp.int32)
+    dev = jnp.minimum(dest0 // S, ndev - 1)
+
+    # ---- caller-slot re-bucketing (a full [n] bijection) ----
+    aidx = jnp.arange(n, dtype=jnp.int32)
+    key = jnp.where(active, dest0, n_tot + aidx)   # actives first, by slot
+    order = jnp.argsort(key)
+    act_o = active[order]
+    dev_o = dev[order]
+    oh = (dev_o[:, None] == jnp.arange(ndev, dtype=jnp.int32)[None, :]) \
+        & act_o[:, None]
+    counts = jnp.sum(oh, axis=0, dtype=jnp.int32)          # [ndev]
+    rank_o = jnp.sum((jnp.cumsum(oh, axis=0) - 1) * oh, axis=1)
+    slot_act_o = dev_o * C + rank_o
+    # free caller slots (per-shard tails) in ascending order for the
+    # inactive fillers; counts <= C is checked by the host caller
+    free = (aidx % C) >= counts[jnp.minimum(aidx // C, ndev - 1)]
+    free_slots = jnp.sort(jnp.where(free, aidx, n))
+    n_act = jnp.sum(active, dtype=jnp.int32)
+    inact_rank = jnp.clip(aidx - n_act, 0, n - 1)
+    newslot_o = jnp.where(act_o, slot_act_o,
+                          free_slots[inact_rank]).astype(jnp.int32)
+    newslot = jnp.zeros((n,), jnp.int32).at[order].set(newslot_o)
+    src = jnp.zeros((n,), jnp.int32).at[newslot].set(aidx)
+    dest_sent = jnp.where(active, dest0, n_tot)
+    sort_perm_new = dest_sent[src]
+
+    # ---- partner-table remap: old sorted -> old caller -> new sorted
+    # (same chain as _sparse_sort_refresh, plus the caller migration,
+    # which cancels out because the table is keyed in sorted space) ----
+    inv_old = cd_sched.slot_inverse(old_perm, n, n_tot)
+    pv = partners_s[:n_tot]
+    caller_vals = jnp.where(pv >= 0, inv_old[jnp.clip(pv, 0, n_tot)], -1)
+    cv = jnp.clip(caller_vals, 0, n - 1)
+    new_vals = jnp.where((caller_vals >= 0) & active[cv],
+                         dest0[cv], -1)
+    row_ok = (old_perm < n_tot) & active
+    per_caller = jnp.where(row_ok[:, None],
+                           new_vals[jnp.clip(old_perm, 0, n_tot - 1), :],
+                           -1)
+    partners_new = jnp.full((n_tot, pv.shape[1]), -1, jnp.int32) \
+        .at[dest_sent].set(per_caller, mode="drop")
+
+    # ---- halo coverage check, drift-margin widened ----
+    pcols = cd_sched.scatter_padded(
+        [lat, lon, gs, active.astype(lat.dtype)], dest_sent, n_tot)
+    plat, plon, pgs, pact = pcols
+    summ = cd_tiled.block_summaries(plat, plon, pgs, pact > 0.5,
+                                    nb, block)
+    gsmax = jnp.max(jnp.where(active, gs, 0.0))
+    # min_reach_m: the interval's schedule widens reachability to the
+    # SWARM neighbourhood radius (cd_sched min_reach_m=R_SWARM), so the
+    # coverage check must validate the SAME widened bound — and it
+    # applies no vertical gating at all, so its reach is a superset of
+    # the interval's vertically-gated one for any min_vreach_m.
+    reach_m = cd_tiled.reachability_from_summaries(
+        summ, summ, float(rpz), float(tlookahead),
+        min_reach_m=float(min_reach_m),
+        margin_m=2.0 * gsmax * margin_s)
+    bi = jnp.arange(nb, dtype=jnp.int32)
+    d_i = bi // nb_l
+    lo = d_i * nb_l - halo
+    hi = (d_i + 1) * nb_l + halo
+    outside = (bi[None, :] < lo[:, None]) | (bi[None, :] >= hi[:, None])
+    halo_ok = ~jnp.any(reach_m & outside)
+    # widest halo the current geometry would need (readback/diagnosis):
+    # blocks past the owning device's own range, over reachable pairs
+    need = jnp.maximum(jnp.maximum(
+        (d_i * nb_l)[:, None] - bi[None, :],
+        bi[None, :] - ((d_i + 1) * nb_l)[:, None] + 1), 0)
+    halo_need = jnp.max(jnp.where(reach_m, need, 0))
+    return newslot, src, sort_perm_new, partners_new, \
+        (counts, halo_ok, halo_need, gsmax)
+
+
 _morton_perm_jit = jax.jit(
     lambda lat, lon, active: cd_tiled.spatial_permutation(
         lat, lon, active).astype(jnp.int32))
@@ -269,9 +393,115 @@ def refresh_spatial_sort(state: SimState, cfg: AsasConfig,
     return state.replace(asas=state.asas.replace(sort_perm=perm))
 
 
+def refresh_spatial_shard(state: SimState, cfg: AsasConfig, ndev: int,
+                          block: int = 256, halo_blocks: int = 0):
+    """Spatial-mode chunk-edge refresh: stripe sort, caller-slot
+    re-bucketing, partner remap and the halo-coverage check as one
+    jitted program, then the state permutation applied host-side.
+
+    Returns ``(state, newslot, stats)`` — ``newslot`` is the
+    old-caller -> new-caller slot map as a numpy array (the caller
+    remaps ids/routes/conditions with it,
+    ``Traffic.apply_slot_permutation``), ``stats`` a dict with the
+    per-device occupancy, halo coverage flag and needed halo width.
+
+    Raises ``RuntimeError`` when the geometry cannot satisfy the
+    spatial contract — a device's stripe population exceeding its
+    caller-shard capacity (QarSUMO-style partition imbalance), or
+    reachability crossing more than the halo window even after the
+    drift margin — instead of silently risking missed conflicts; the
+    caller falls back to the column-replicated mode (or a wider halo).
+    """
+    from ..ops import cd_sched
+    ac = state.ac
+    n = ac.lat.shape[0]
+    block = min(block, 256)
+    extra, nb, nb_l, n_tot = cd_sched.spatial_layout(n, block, ndev)
+    if state.asas.partners_s.shape[0] < n_tot:
+        raise RuntimeError(
+            f"spatial refresh: partners_s holds "
+            f"{state.asas.partners_s.shape[0]} rows < n_tot={n_tot} — "
+            "enable spatial mode first (it resizes the sorted tables)")
+    halo_max = (ndev - 1) * nb_l           # multi-hop exchange ceiling
+    # halo_blocks == 0 -> AUTO: check coverage against the widest
+    # possible window, then pin 1.25x the measured need (>= one
+    # device) so drift headroom survives between refreshes; the caller
+    # stores the pinned width in SimConfig.cd_halo_blocks so every
+    # interval compiles against the same static window.
+    auto = not halo_blocks
+    halo = halo_max if auto else min(int(halo_blocks), halo_max)
+    # The interval's schedule widens reachability to the SWARM
+    # neighbourhood radius; validate halo coverage against the same
+    # widened bound (cd_sched.detect_resolve_sched's min_reach).
+    min_reach = 0.0
+    if cfg.reso_on and cfg.reso_method.upper() == "SWARM":
+        from ..ops import cr_swarm
+        min_reach = float(cr_swarm.R_SWARM)
+    newslot, srcidx, sort_perm, partners_new, stats = \
+        _spatial_shard_refresh(
+            ac.lat, ac.lon, ac.gs, ac.alt, ac.vs, ac.active,
+            state.asas.sort_perm, state.asas.partners_s[:n_tot],
+            block=block, ndev=int(ndev), extra=extra, halo=halo,
+            tlookahead=float(cfg.dtlookahead), rpz=float(cfg.rpz),
+            min_reach_m=min_reach,
+            margin_s=float(cfg.sort_every * cfg.dtasas))
+    counts, halo_ok, halo_need, gsmax = stats
+    if auto:
+        halo = min(max(nb_l, int(np.ceil(1.25 * int(halo_need)))),
+                   halo_max)
+    counts = np.asarray(counts)
+    C = n // ndev
+    if counts.max() > C:
+        raise RuntimeError(
+            f"spatial refresh: stripe occupancy overflow — device "
+            f"{int(counts.argmax())} owns {int(counts.max())} aircraft "
+            f"> caller-shard capacity {C} (nmax/{ndev}). Raise nmax or "
+            "use SHARD REPLICATE for this geometry.")
+    if not bool(halo_ok):
+        raise RuntimeError(
+            f"spatial refresh: halo coverage violated — reachability "
+            f"(drift-margin widened) needs {int(halo_need)} halo blocks "
+            f"> {halo} available per side. Use SHARD REPLICATE or fewer "
+            "devices for this geometry.")
+
+    def permute(leaf):
+        if hasattr(leaf, "ndim") and leaf.ndim >= 1 \
+                and leaf.shape[0] == n:
+            return leaf[srcidx]
+        return leaf
+    new_state = jax.tree.map(permute, state)
+    asas_new = new_state.asas
+    # caller-space partner ids (tiled path) move WITH the slots
+    p = asas_new.partners
+    p = jnp.where(p >= 0, newslot[jnp.clip(p, 0, n - 1)], -1)
+    spad = state.asas.partners_s.shape[0] - n_tot
+    if spad > 0:
+        partners_new = jnp.concatenate(
+            [partners_new,
+             jnp.full((spad, partners_new.shape[1]), -1, jnp.int32)])
+    new_state = new_state.replace(asas=asas_new.replace(
+        sort_perm=sort_perm, partners_s=partners_new, partners=p))
+    info = dict(counts=counts, occupancy=float(counts.max() / max(C, 1)),
+                halo_blocks=halo, halo_need=int(halo_need),
+                gsmax=float(gsmax), nb=nb, nb_local=nb_l, n_tot=n_tot,
+                extra_blocks=extra,
+                halo_rows=2 * halo * block * ndev)
+    return new_state, np.asarray(newslot), info
+
+
+def spatial_table_size(n, block=256, ndev=1):
+    """Rows of the sorted-space partner table in spatial mode (the
+    padded layout is device-divisible, so the table is sized to it
+    EXACTLY — a per-interval slice of a sharded table would cost an
+    O(N*K) reshard every interval)."""
+    from ..ops import cd_sched
+    return cd_sched.spatial_layout(n, block, ndev)[3]
+
+
 def update_tiled(state: SimState, cfg: AsasConfig, block: int = 512,
-                 impl: str = "lax", mesh=None,
-                 mesh_axis: str = "ac") -> Tuple[SimState, RowConflictData]:
+                 impl: str = "lax", mesh=None, mesh_axis: str = "ac",
+                 shard_mode: str = "replicate",
+                 halo_blocks: int = 0) -> Tuple[SimState, RowConflictData]:
     """One ASAS interval via the blockwise large-N backend (ops/cd_tiled.py).
 
     Same pipeline as ``update`` — detect, resolve, bookkeep, resume
@@ -324,7 +554,23 @@ def update_tiled(state: SimState, cfg: AsasConfig, block: int = 512,
     if impl == "sparse":
         from ..ops import cd_sched
         block = min(block, 256)
-        n_tot = cd_sched.padded_size(ac.lat.shape[0], block)
+        n = ac.lat.shape[0]
+        extra_eff = 32
+        if shard_mode == "spatial":
+            # Spatial mode keys the padded layout off the sorted-space
+            # partner table, which SHARD sizing made EXACTLY the
+            # device-divisible padded size (a per-interval slice of a
+            # sharded table would reshard O(N*K) every interval).
+            n_tot = asas.partners_s.shape[0]
+            nb0 = -(-n // block)
+            if n_tot % block or n_tot // block <= nb0:
+                raise ValueError(
+                    f"spatial mode needs partners_s sized to the padded "
+                    f"layout (got {n_tot} rows for n={n}, block={block}) "
+                    "— enable it via Simulation.set_shard/SHARD SPATIAL")
+            extra_eff = n_tot // block - nb0
+        else:
+            n_tot = cd_sched.padded_size(n, block)
         out = cd_sched.detect_resolve_sched(
             ac.lat, ac.lon, ac.trk, ac.gs, ac.alt, ac.vs,
             ac.gseast, ac.gsnorth, ac.active, asas.noreso,
@@ -334,7 +580,9 @@ def update_tiled(state: SimState, cfg: AsasConfig, block: int = 512,
             resume_rpz_m=cfg.rpz * cfg.resofach,
             tas=ac.tas if kern_reso == "eby" else None,
             cas=ac.cas if kern_reso == "swarm" else None,
-            reso=kern_reso, mesh=mesh, mesh_axis=mesh_axis)
+            reso=kern_reso, mesh=mesh, mesh_axis=mesh_axis,
+            shard_mode=shard_mode, extra_blocks=extra_eff,
+            halo_blocks=halo_blocks)
         if kern_reso == "swarm":
             rd, partners_s, act_new, swarm_sums = out
         else:

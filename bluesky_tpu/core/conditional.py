@@ -36,6 +36,12 @@ class ConditionList:
     def ncond(self):
         return len(self.cmd)
 
+    def permute(self, newslot):
+        """Spatial shard re-bucketing moved aircraft between slots —
+        follow them (slots stay stable between refreshes)."""
+        if self.idx.size:
+            self.idx = np.asarray(newslot)[self.idx].astype(np.int64)
+
     # ------------------------------------------------------------ commands
     def ataltcmd(self, acidx, targalt, cmdtxt):
         """acid ATALT alt cmd (conditional.py:51-54)."""

@@ -69,8 +69,20 @@ class RouteManager:
             traf.delete_hooks.append(
                 lambda idx, t=traf: t._route_mgr.drop_slots(idx)
                 if getattr(t, "_route_mgr", None) else None)
+            # Spatial shard refreshes move aircraft between caller
+            # slots (stripe re-bucketing); host route plans are keyed
+            # by slot and must move with them.
+            traf.permute_hooks.append(
+                lambda ns, t=traf: t._route_mgr.permute_slots(ns)
+                if getattr(t, "_route_mgr", None) else None)
             traf._route_delete_hooked = traf
         traf._route_mgr = self
+
+    def permute_slots(self, newslot):
+        """Re-key the host plans after a spatial slot re-bucketing
+        (``newslot[old] = new``); device route rows were already
+        permuted with the state."""
+        self.routes = {int(newslot[s]): r for s, r in self.routes.items()}
 
     def drop_slots(self, idx):
         """Clear the host plans of deleted slots and blank their device

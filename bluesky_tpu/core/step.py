@@ -52,6 +52,20 @@ class SimConfig(NamedTuple):
     # stays jit-static; parallel.sharding.sharded_step_fn fills it in.
     cd_mesh: object = None
     cd_mesh_axis: str = "ac"
+    # Multi-chip decomposition of the sparse backend on that mesh:
+    # 'replicate' = interleaved row blocks vs replicated O(N) columns
+    # (the round-4 scheme, ~200x ceiling as D grows); 'spatial' =
+    # device-owned latitude stripes with conservative halo exchange —
+    # O(N/D) state/schedule/sort per device, O(halo) wire per interval
+    # (docs/PERF_ANALYSIS.md §multi-chip).  Spatial requires the
+    # stripe-bucketed caller layout kept by the spatial sort refresh
+    # (core/asas.refresh_spatial_shard / the SHARD stack command).
+    cd_shard_mode: str = "replicate"
+    # Halo width in 256-wide blocks each side of a device's stripe
+    # range (0 = one full neighbour device, always covering; smaller
+    # values cut the boundary exchange and are validated against the
+    # exact reach bound + drift margin at every refresh).
+    cd_halo_blocks: int = 0
 
 
 def step(state: SimState, cfg: SimConfig) -> SimState:
@@ -90,6 +104,15 @@ def step(state: SimState, cfg: SimConfig) -> SimState:
             raise ValueError(
                 f"Unknown SimConfig.cd_backend {cfg.cd_backend!r}; "
                 "expected 'dense', 'tiled', 'pallas' or 'sparse'.")
+        if cfg.cd_shard_mode not in ("replicate", "spatial"):
+            raise ValueError(
+                f"Unknown SimConfig.cd_shard_mode {cfg.cd_shard_mode!r}; "
+                "expected 'replicate' or 'spatial'.")
+        if cfg.cd_shard_mode == "spatial" and cfg.cd_backend != "sparse":
+            raise ValueError(
+                "cd_shard_mode='spatial' is the sparse backend's "
+                "domain decomposition (latitude stripes are a property "
+                "of the stripe-sorted schedule); use cd_backend='sparse'")
         if cfg.cd_backend == "dense" and state.asas.resopairs.size == 0:
             raise ValueError(
                 "State was allocated with pair_matrix=False (no [N,N] "
@@ -109,10 +132,11 @@ def step(state: SimState, cfg: SimConfig) -> SimState:
         def run_asas(s):
             if cfg.cd_backend in ("tiled", "pallas", "sparse"):
                 impl = asasmod.impl_for_backend(cfg.cd_backend)
-                s2, _cd = asasmod.update_tiled(s, cfg.asas,
-                                               block=cfg.cd_block, impl=impl,
-                                               mesh=cfg.cd_mesh,
-                                               mesh_axis=cfg.cd_mesh_axis)
+                s2, _cd = asasmod.update_tiled(
+                    s, cfg.asas, block=cfg.cd_block, impl=impl,
+                    mesh=cfg.cd_mesh, mesh_axis=cfg.cd_mesh_axis,
+                    shard_mode=cfg.cd_shard_mode,
+                    halo_blocks=cfg.cd_halo_blocks)
             else:
                 s2, _cd = asasmod.update(s, cfg.asas)
             return s2.replace(

@@ -67,6 +67,14 @@ class Traffic:
         self._id2slot = {}
         self._pending = []          # queued creation dicts
         self._autoid = 0
+        # Observers notified with an old->new slot map when the SPATIAL
+        # shard refresh re-buckets caller slots by latitude stripe
+        # (parallel/sharding.prepare_spatial; routes/conditions/trails
+        # register here).  Slots remain stable between refreshes; any
+        # host subsystem caching slot indices across chunk edges in
+        # spatial mode must subscribe.  (Defined before Trails below —
+        # it subscribes at construction.)
+        self.permute_hooks = []
         # Display trails (reference traffic.py:79 bs.traf.trails)
         from .trails import Trails
         self.trails = Trails(self)
@@ -75,6 +83,22 @@ class Traffic:
         # creation flush (slot array; reference TrafficArrays.create cascade)
         self.delete_hooks = []
         self.create_hooks = []
+
+    def apply_slot_permutation(self, newslot):
+        """Re-bucket host bookkeeping after a spatial shard refresh
+        moved aircraft between caller slots (``newslot[old] = new``).
+        The device state was already permuted by the refresh; this
+        remaps ids/types and fans out to ``permute_hooks``."""
+        newslot = np.asarray(newslot)
+        src = np.empty(self.nmax, dtype=np.intp)      # new -> old slot
+        src[newslot] = np.arange(self.nmax, dtype=np.intp)
+        self.ids = np.asarray(self.ids, dtype=object)[src].tolist()
+        self.types = np.asarray(self.types, dtype=object)[src].tolist()
+        # remap the live id -> slot map in O(ntraf), not O(nmax)
+        self._id2slot = {i: int(newslot[s])
+                         for i, s in self._id2slot.items()}
+        for hook in self.permute_hooks:
+            hook(newslot)
 
     # ------------------------------------------------------------------ info
     @property

@@ -42,6 +42,17 @@ class Trails:
         # requests a one-shot re-anchor before the first segments.
         self._need_anchor = False
         self._clear_buffers()
+        # Follow aircraft across spatial shard re-bucketings (the
+        # per-slot anchors/colors are keyed by caller slot)
+        traf.permute_hooks.append(self.permute_slots)
+
+    def permute_slots(self, newslot):
+        ns = np.asarray(newslot)
+        inv = np.argsort(ns)                   # new slot -> old slot
+        self.accolor = self.accolor[inv]
+        self.lastlat = self.lastlat[inv]
+        self.lastlon = self.lastlon[inv]
+        self.lasttim = self.lasttim[inv]
 
     def _clear_buffers(self):
         # Foreground line pieces (streamed in ACDATA / drawn by a GUI)

@@ -71,6 +71,19 @@ _N_SWARM = 7
 _ACC_NEUTRAL = (0.0, 0.0, 0.0, 0.0, 0.0, _BIG, 0.0, 0.0, _BIG, 2**30)
 
 
+def shard_map_compat(body, mesh, in_specs, out_specs):
+    """``jax.shard_map`` across JAX generations: the top-level API with
+    ``check_vma`` (>= 0.6), else the experimental module with its older
+    ``check_rep`` spelling (0.4.x) — replication checking off in both
+    (the bodies use collectives the checker cannot see through)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map
+    return shard_map(body, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=False)
+
+
 def _init_accumulators(refs, block, kk):
     """Write the identity element into each accumulator ref (10 refs in
     output order)."""
@@ -862,10 +875,10 @@ def detect_resolve_pallas(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                 kern_kw=kern_kw, interpret=interpret,
                 packed_own=own_l, row0=row0, rstride=ndev))
 
-        outs = jax.shard_map(
-            body, mesh=mesh,
-            in_specs=(P(mesh_axis), P(mesh_axis), P()),
-            out_specs=P(mesh_axis), check_vma=False)(own_p, reach_p, packed)
+        outs = shard_map_compat(
+            body, mesh,
+            (P(mesh_axis), P(mesh_axis), P()),
+            P(mesh_axis))(own_p, reach_p, packed)
         return [o[inv][:nb] for o in outs]
 
     def run_cand(cand):

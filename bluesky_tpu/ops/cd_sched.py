@@ -56,6 +56,19 @@ from .cd_tiled import RowConflictData, block_reachability, precompute_trig
 #: (16*block is a multiple of the (8, 128) vreg for block >= 128)
 _NFP = 16
 
+
+def _element_spec(shape, imap):
+    """Element-indexed BlockSpec across JAX generations: ``pl.Element``
+    dims where available (>= 0.5), the whole-spec
+    ``indexing_mode=pl.Unblocked()`` form otherwise (0.4.x) — both give
+    the index map element (slab-row) granularity for the dynamic
+    ``(start, len)`` window DMAs."""
+    if hasattr(pl, "Element"):
+        return pl.BlockSpec(tuple(pl.Element(s) for s in shape), imap,
+                            memory_space=pltpu.VMEM)
+    return pl.BlockSpec(shape, imap, memory_space=pltpu.VMEM,
+                        indexing_mode=pl.Unblocked())
+
 #: max grid rows per pallas_call — the TPU compiler dies without
 #: diagnostics somewhere above ~1700 rows (see the row-split note in
 #: detect_resolve_sched); 1408 rows = 360k aircraft stays well inside
@@ -72,6 +85,23 @@ def padded_size(n, block=256, extra=32):
     """Total slots of the padded stripe-sorted layout for n aircraft."""
     block = min(block, 256)
     return (-(-n // block) + extra) * block
+
+
+def spatial_layout(n, block=256, ndev=1, extra=32):
+    """Padded-layout parameters for the spatial domain-decomposition
+    mode: pick the extra-block count (<= ``extra``, >= 2) so the padded
+    block count divides evenly into ``ndev`` contiguous device stripes.
+    Returns ``(extra_eff, nb, nb_local, n_tot)``.  Shrinking ``extra``
+    only makes the latitude stripes taller (stripe height is
+    ``max(reach, span/(extra-1))``), never incorrect — reachability is
+    recomputed from true positions every interval."""
+    block = min(block, 256)
+    nb0 = -(-n // block)
+    extra_eff = extra - ((nb0 + extra) % ndev)
+    if extra_eff < 2:
+        extra_eff += ndev
+    nb = nb0 + extra_eff
+    return extra_eff, nb, nb // ndev, nb * block
 
 
 def slot_inverse(perm, n, n_tot, fill=-1):
@@ -109,12 +139,23 @@ _CLIMB_VS = 1.0     # [m/s]
 
 
 def stripe_sort_dest(lat, lon, gs, active, thresh_m, block, extra,
-                     alt=None, vs=None, n_layers=0):
+                     alt=None, vs=None, n_layers=0, spread_pad=False):
     """See module docstring; ``n_layers`` may be an int, or "auto" to
     gate the per-stripe altitude layering ON DEVICE from the density
-    estimate (no host sync — the tunnel costs ~80 ms per pull)."""
+    estimate (no host sync — the tunnel costs ~80 ms per pull).
+
+    ``spread_pad`` (the SPATIAL layout): distribute the layout's free
+    padding blocks between stripes proportionally to cumulative active
+    count instead of leaving them all at the end — the map from
+    aircraft fraction to block position becomes ~affine, so a
+    contiguous equal-block device split gets ~equal aircraft counts
+    (without it, low-occupancy layouts put every occupied block at the
+    front and the first devices overflow their caller shards).  The
+    single-chip schedule is indifferent to WHERE padding sits (empty
+    blocks are skipped exactly), so this only shapes device balance."""
     return _stripe_sort_dest_impl(lat, lon, gs, active, thresh_m, block,
-                                  extra, alt, vs, n_layers)
+                                  extra, alt, vs, n_layers,
+                                  spread_pad=spread_pad)
 
 
 def _auto_layers(lat, lon, alt, active, thresh_m):
@@ -143,7 +184,8 @@ def _auto_layers(lat, lon, alt, active, thresh_m):
 
 
 def _stripe_sort_dest_impl(lat, lon, gs, active, thresh_m, block, extra,
-                           alt=None, vs=None, n_layers=0):
+                           alt=None, vs=None, n_layers=0,
+                           spread_pad=False):
     """Padded stripe-major sort: per-aircraft destination slots.
 
     Returns ``dest`` [n] int32: aircraft i occupies padded slot dest[i]
@@ -205,8 +247,22 @@ def _stripe_sort_dest_impl(lat, lon, gs, active, thresh_m, block, extra,
     onehot = ss[:, None] == jnp.arange(extra, dtype=jnp.int32)[None, :]
     counts = jnp.sum(onehot, axis=0, dtype=jnp.int32)          # [extra]
     nblocks = -(-counts // block)
-    base = jnp.concatenate([jnp.zeros((1,), jnp.int32),
-                            jnp.cumsum(nblocks)[:-1]]) * block
+    base_b = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                              jnp.cumsum(nblocks)[:-1]])
+    if spread_pad:
+        # Count-proportional dilution of the free padding blocks (see
+        # the stripe_sort_dest docstring); the inactive stripe
+        # (extra - 1) stays pinned at the very end of the layout.
+        nb_tot = -(-n // block) + extra
+        free = nb_tot - jnp.sum(nblocks)
+        act_counts = counts.at[extra - 1].set(0)
+        cc = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                              jnp.cumsum(act_counts)[:-1]])
+        n_act = jnp.maximum(jnp.sum(act_counts), 1)
+        pad_before = (free * cc // n_act).astype(jnp.int32)
+        pad_before = pad_before.at[extra - 1].set(free)
+        base_b = base_b + pad_before
+    base = base_b * block
     first = jnp.concatenate([jnp.zeros((1,), jnp.int32),
                              jnp.cumsum(counts)[:-1]])
     rank = jnp.arange(n, dtype=jnp.int32) - first[ss]
@@ -298,11 +354,18 @@ def _sched_kernel(wl_ref, own_ref, *rest,
     def own(k):
         return oslab[_IDX[k]:_IDX[k] + 1, :]
 
-    # wl's trailing column carries the global row-block base: local row
-    # i is GLOBAL row row0 + i*rstride (0/1 except under shard_map,
-    # where each device owns an interleaved row subset for load balance
-    # but column and partner ids stay global).
+    # wl's trailing columns carry the global row-block base and the
+    # global id of the column slab array's block 0: local row i is
+    # GLOBAL row row0 + i*rstride (0/1 except under shard_map, where
+    # each device owns a row subset but column and partner ids stay
+    # global), and local column block j is GLOBAL block col0 + j.
+    # col0 != 0 only in the spatial domain-decomposition mode, where the
+    # column slabs are the device's local halo window of the global
+    # grid instead of the full replicated slab array — DMA/window
+    # indices stay halo-local, pair ids lift back to the global slot
+    # space (the cd_pallas col0 contract, tests/test_cd_pallas_col0.py).
     row0 = wl_ref[i, s_cap]
+    col0 = wl_ref[i, s_cap + 1]
     gid_own = (row0 + i * rstride) * block + jax.lax.broadcasted_iota(
         jnp.int32, (1, block), 1)
     act_o = own("active") > 0.5
@@ -330,7 +393,7 @@ def _sched_kernel(wl_ref, own_ref, *rest,
                 def intr(f):
                     return islab_t[:, _IDX[f]:_IDX[f] + 1]
 
-                jb = base + k
+                jb = col0 + base + k                       # GLOBAL block id
                 gid_int = jb * block + jax.lax.broadcasted_iota(
                     jnp.int32, (block, 1), 0)
                 act_i = intr("active") > 0.5
@@ -363,7 +426,8 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                          extra_blocks=32, interpret=None, perm=None,
                          cols_per_prog=4, partners=None, resume_rpz_m=None,
                          tas=None, cas=None, reso="mvp", mesh=None,
-                         mesh_axis="ac"):
+                         mesh_axis="ac", shard_mode="replicate",
+                         halo_blocks=0):
     """Sparse-scheduled equivalent of ``cd_pallas.detect_resolve_pallas``.
 
     ``perm`` is the cached ``stripe_sort_dest`` destination table (NOT a
@@ -385,6 +449,24 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
     O(N*K) all-reduce for the partner back-permute; no all-to-alls, no
     per-tile collectives.  The pair math — the dominant cost — scales
     ~linearly with devices.
+
+    With ``shard_mode='spatial'`` (and a real mesh) the decomposition
+    changes from row-interleave-vs-replicated-columns to device-OWNED
+    latitude stripes: each device holds the caller shard of exactly the
+    aircraft whose sorted stripe slots it owns (the spatial refresh's
+    re-bucketing invariant, core/asas.refresh_spatial_shard), builds
+    its padded columns/trig/windows locally over its own O(N/D) rows,
+    and the per-interval communication is ONLY the halo boundary-slab
+    collective-permutes + one O(N/block) summary all-gather + scalar
+    psums — zero O(N) column all-gathers (asserted on the HLO in
+    tests/test_hlo_collectives.py).  ``halo_blocks`` sets the window
+    half-width (0 = one full neighbour device; the exchange hops
+    several neighbours when stripes are narrower than the reach).
+    Results are bit-identical to the same call without a mesh — the
+    single-chip reference on the identical stripe-bucketed layout
+    (tests/test_spatial.py).  Without a mesh, ``shard_mode='spatial'``
+    only switches the back-map to its sentinel-masked form (inactive
+    rows carry the sentinel slot in spatial layouts).
 
     With ``partners`` ([n_tot, K] int32, SORTED-space ids, -1 empty) the
     kernels also run in-kernel resume-nav (keep evaluation on every
@@ -438,82 +520,119 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                / jnp.maximum(gs.astype(dtype), 0.5)),
         "active": active.astype(dtype), "noreso": noreso.astype(dtype),
     }
-    padded = dict(zip(cols, scatter_padded(
-        [v.astype(dtype) for v in cols.values()], perm, n_tot)))
-
-    fields = precompute_trig(padded["lat"], padded["lon"])
-    trkrad = jnp.radians(padded["trk"])
-    fields.update({
-        "u": padded["gs"] * jnp.sin(trkrad),
-        "v": padded["gs"] * jnp.cos(trkrad),
-        "alt": padded["alt"], "vs": padded["vs"],
-        "gse": padded["gse"], "gsn": padded["gsn"], "tr": padded["tr"],
-        "active": padded["active"], "noreso": padded["noreso"],
-    })
-    fields["trk"] = padded["trk"]
-    packed = jnp.stack([fields[k] for k in _FIELDS]).reshape(
-        len(_FIELDS), nb, block).transpose(1, 0, 2)        # [nb, _NF, block]
-
-    act_b = padded["active"] > 0.5
     if reso == "swarm":
         from . import cr_swarm
         min_reach, min_vreach = cr_swarm.R_SWARM, cr_swarm.DH_SWARM
     else:
         min_reach = min_vreach = 0.0
-    reach = block_reachability(padded["lat"], padded["lon"], padded["gs"],
-                               act_b, nb, block, float(rpz),
-                               float(tlookahead), alt=padded["alt"],
-                               vs=padded["vs"], hpz=float(hpz),
-                               min_reach_m=min_reach,
-                               min_vreach_m=min_vreach)
-
-    # Segment windows + the Wmax-block pad region the sentinel slots
-    # point at (slots are clamped so every DMA stays in bounds); start
-    # and len ride one bit-packed scalar-prefetch array (SMEM budget,
-    # see _sched_kernel).
     if nb >= 2 ** 20 or wmax >= 2 ** 11:
         raise ValueError(
             f"worklist bit-pack overflow: nb={nb} must be < 2^20 and "
             f"wmax={wmax} < 2^11 (start|len share one int32; a silent "
             "overflow would drop conflict windows)")
-    st, ln, overflow = build_windows(reach, s_cap, wmax, pad_start=nb)
-    st = jnp.clip(st, 0, nb)
-    wl = st | (ln << 20)
-    packed16 = jnp.concatenate([
-        jnp.concatenate(                                   # 13 -> 16 rows
-            [packed, jnp.zeros((nb, _NFP - len(_FIELDS), block), dtype)],
-            axis=1),
-        jnp.zeros((wmax, _NFP, block), dtype)], axis=0)    # DMA pad region
+
+    ndev_sp = mesh.shape[mesh_axis] if (
+        shard_mode == "spatial" and mesh is not None
+        and mesh_axis in mesh.shape) else 0
+    spatial = ndev_sp > 1
+    if shard_mode == "spatial" and not resume:
+        raise ValueError(
+            "spatial shard mode requires the resume/partner-table path "
+            "(the production sparse backend always passes `partners`)")
+    if spatial and nb % ndev_sp != 0:
+        raise ValueError(
+            f"spatial shard mode: padded block count nb={nb} must divide "
+            f"into {ndev_sp} devices — build the layout with "
+            f"cd_sched.spatial_layout (extra_blocks={extra_blocks})")
+    if spatial and n % ndev_sp != 0:
+        raise ValueError(
+            f"spatial shard mode: nmax={n} must be divisible by the "
+            f"{ndev_sp}-device mesh")
+
+    def make_fields(padded_cols):
+        """Per-slot trig/velocity columns of the padded layout — shared
+        verbatim by the single-chip prep and the per-device spatial
+        shard so the two can never drift (bit-parity contract)."""
+        flds = precompute_trig(padded_cols["lat"], padded_cols["lon"])
+        trkrad = jnp.radians(padded_cols["trk"])
+        flds.update({
+            "u": padded_cols["gs"] * jnp.sin(trkrad),
+            "v": padded_cols["gs"] * jnp.cos(trkrad),
+            "alt": padded_cols["alt"], "vs": padded_cols["vs"],
+            "gse": padded_cols["gse"], "gsn": padded_cols["gsn"],
+            "tr": padded_cols["tr"],
+            "active": padded_cols["active"],
+            "noreso": padded_cols["noreso"],
+        })
+        flds["trk"] = padded_cols["trk"]
+        return flds
 
     kk = k_partners
     pold = None
     if resume:
         pold = partners.reshape(nb, block, kk).transpose(0, 2, 1) \
             .astype(jnp.int32)                             # [nb, kk, block]
-    reach_f = reach & overflow[:, None]
     neutral_vals = _ACC_NEUTRAL + ((0.0, -1, 0.0) if resume else ()) \
         + ((0.0,) * cd_pallas._N_SWARM if reso == "swarm" else ())
+    #: per-BACKED-row neutral values for caller rows whose sort slot is
+    #: the sentinel (inactive rows in spatial mode): exactly the
+    #: accumulator identities a never-touched slot holds, so masked
+    #: gathers and real gathers of empty slots cannot differ.
+    backed_neutral = [0.0, 0.0, 0.0, 0.0, 0.0, cd_pallas._BIG]
+    if resume:
+        backed_neutral.append(0.0)                         # active flag
+    if reso == "swarm":
+        backed_neutral.extend([0.0] * cd_pallas._N_SWARM)
+
+    if not spatial:
+        padded = dict(zip(cols, scatter_padded(
+            [v.astype(dtype) for v in cols.values()], perm, n_tot)))
+        fields = make_fields(padded)
+        packed = jnp.stack([fields[k] for k in _FIELDS]).reshape(
+            len(_FIELDS), nb, block).transpose(1, 0, 2)    # [nb, _NF, block]
+
+        act_b = padded["active"] > 0.5
+        reach = block_reachability(
+            padded["lat"], padded["lon"], padded["gs"], act_b, nb, block,
+            float(rpz), float(tlookahead), alt=padded["alt"],
+            vs=padded["vs"], hpz=float(hpz), min_reach_m=min_reach,
+            min_vreach_m=min_vreach)
+
+        # Segment windows + the Wmax-block pad region the sentinel slots
+        # point at (slots are clamped so every DMA stays in bounds);
+        # start and len ride one bit-packed scalar-prefetch array (SMEM
+        # budget, see _sched_kernel).
+        st, ln, overflow = build_windows(reach, s_cap, wmax, pad_start=nb)
+        st = jnp.clip(st, 0, nb)
+        wl = st | (ln << 20)
+        packed16 = jnp.concatenate([
+            jnp.concatenate(                               # 13 -> 16 rows
+                [packed,
+                 jnp.zeros((nb, _NFP - len(_FIELDS), block), dtype)],
+                axis=1),
+            jnp.zeros((wmax, _NFP, block), dtype)], axis=0)  # DMA pad
+        reach_f = reach & overflow[:, None]
 
     def run_rows(wl_r, own16_r, packedown_r, pold_r, reachf_r, overflow_r,
-                 row0, same_hemi, intr16, intr, rstride=1):
+                 row0, same_hemi, intr16, intr, rstride=1, col0=0):
         """Sched kernel + overflow fallback over one row subset.
 
-        ``wl_r`` [rows, s_cap+1] carries (start|len) plus the global
-        row-block base in its last column (local row i = global row
-        row0 + i*rstride); ``own16_r``/``packedown_r`` are the subset's
-        ownship slabs; ``intr16``/``intr`` are the FULL column arrays
-        (global ids) — identical to the whole grid when row0 == 0 and
-        rstride == 1, the per-device share under ``shard_map``."""
+        ``wl_r`` [rows, s_cap+2] carries (start|len) plus the global
+        row-block base and the columns' global block-0 id in its last
+        two columns (local row i = global row row0 + i*rstride, local
+        column block j = global block col0 + j); ``own16_r``/
+        ``packedown_r`` are the subset's ownship slabs; ``intr16``/
+        ``intr`` are the column slab arrays — the FULL grid (col0 == 0)
+        on the single-chip and column-replicated paths, the device's
+        local halo window in the spatial mode."""
         rows = wl_r.shape[0]
         own_spec = pl.BlockSpec((1, _NFP, block), lambda i, wl: (i, 0, 0),
                                 memory_space=pltpu.VMEM)
         intr_specs = [
-            pl.BlockSpec((pl.Element(wmax), pl.Element(_NFP),
-                          pl.Element(block)),
-                         functools.partial(
-                             lambda i, wl, s=0: (wl[i, s] & 0xFFFFF, 0, 0),
-                             s=s),
-                         memory_space=pltpu.VMEM)
+            _element_spec((wmax, _NFP, block),
+                          functools.partial(
+                              lambda i, wl, s=0: (wl[i, s] & 0xFFFFF, 0, 0),
+                              s=s))
             for s in range(s_cap)]
         acc_spec = lambda: pl.BlockSpec((1, 1, block),
                                         lambda i, wl: (i, 0, 0),
@@ -571,7 +690,7 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                 intr, rf, block=block, kk=kk, cpp=cols_per_prog,
                 kern_kw=kern_kw, interpret=interpret, pold=pold_r,
                 rpz_m=resume_rpz_m, packed_own=packedown_r, row0=row0,
-                rstride=rstride)
+                rstride=rstride, col0=col0)
 
         def neutral(_):
             return [jnp.full(o.shape, v, o.dtype)
@@ -582,8 +701,166 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         rsel = overflow_r[:, None, None]
         return tuple(jnp.where(rsel, f, s) for f, s in zip(outs_f, outs_s))
 
-    row0_col = lambda w, r0: jnp.concatenate(
-        [w, jnp.full((w.shape[0], 1), r0, jnp.int32)], axis=1)
+    row0_col = lambda w, r0, c0=0: jnp.concatenate(
+        [w,
+         jnp.full((w.shape[0], 1), r0, jnp.int32),
+         jnp.full((w.shape[0], 1), c0, jnp.int32)], axis=1)
+
+    if spatial:
+        # ------------------------------------------------------------
+        # Spatial domain decomposition: device d OWNS the contiguous
+        # latitude-stripe block range [d*nb_l, (d+1)*nb_l) of the
+        # sorted layout — O(N/D) scatter, trig, reachability, window
+        # build and kernel rows per device — and exchanges only the
+        # `halo`-block boundary stripes with its lat-neighbours over
+        # ICI (collective-permute), plus one O(N/block) all-gather of
+        # the per-block summary vectors the exact reachability bound
+        # reads.  No O(N) per-aircraft column is ever gathered
+        # (asserted mechanically in tests/test_hlo_collectives.py).
+        # The caller guarantees (and the spatial refresh verifies with
+        # a drift margin, core/asas.refresh_spatial_shard) that the
+        # halo window covers every reachable column until the next
+        # refresh, and that each aircraft's caller slot lives on the
+        # device owning its sorted slot — which makes the per-interval
+        # scatter and result back-map DEVICE-LOCAL.
+        # ------------------------------------------------------------
+        from jax.sharding import PartitionSpec as P
+        ndev = ndev_sp
+        nb_l = nb // ndev
+        S_l = nb_l * block
+        halo = int(halo_blocks) if halo_blocks else nb_l
+        # the halo may span several neighbour devices (narrow stripes
+        # at large D): the exchange below hops ceil(halo/nb_l) devices
+        # per side, wire still ~2*halo blocks per device
+        halo = min(halo, (ndev - 1) * nb_l)
+        n_hops = -(-halo // nb_l)
+        nbh = nb_l + 2 * halo
+        cols_f = {k: v.astype(dtype) for k, v in cols.items()}
+
+        def body(cols_l, perm_l, pold_l):
+            d = jax.lax.axis_index(mesh_axis)
+            base = d * jnp.int32(S_l)
+            in_dev = (perm_l >= base) & (perm_l < base + S_l)
+            # sentinel (inactive) and off-device slots drop out of the
+            # scatter; the spatial refresh guarantees the latter set is
+            # empty, so dropping is exact, never lossy
+            dest_loc = jnp.where(in_dev, perm_l - base, S_l)
+            padded_l = {
+                k: jnp.zeros((S_l,), dtype).at[dest_loc].set(
+                    v, mode="drop")
+                for k, v in cols_l.items()}
+            fields_l = make_fields(padded_l)
+            packed_l = jnp.stack(
+                [fields_l[k] for k in _FIELDS]).reshape(
+                    len(_FIELDS), nb_l, block).transpose(1, 0, 2)
+            act_l = padded_l["active"] > 0.5
+
+            # Exact reachability of OWN rows vs the whole grid from the
+            # gathered per-block summaries (identical per-block math to
+            # the single-chip block_reachability — bit-parity contract)
+            summ_l = cd_tiled.block_summaries(
+                padded_l["lat"], padded_l["lon"], padded_l["gs"], act_l,
+                nb_l, block, alt=padded_l["alt"], vs=padded_l["vs"])
+            summ_g = {k: jax.lax.all_gather(v, mesh_axis, tiled=True)
+                      for k, v in summ_l.items()}
+            reach_rows = cd_tiled.reachability_from_summaries(
+                summ_l, summ_g, float(rpz), float(tlookahead),
+                hpz=float(hpz), min_reach_m=min_reach,
+                min_vreach_m=min_vreach)                   # [nb_l, nb]
+
+            # Restrict to the halo window; out-of-grid columns (mesh
+            # edges) are masked, never visited
+            cg = base // block - halo + jnp.arange(nbh, dtype=jnp.int32)
+            vcol = (cg >= 0) & (cg < nb)
+            reach_h = reach_rows[:, jnp.clip(cg, 0, nb - 1)] \
+                & vcol[None, :]
+            st_l, ln_l, overflow_l = build_windows(
+                reach_h, s_cap, wmax, pad_start=nbh)
+            wl_l = jnp.clip(st_l, 0, nbh) | (ln_l << 20)
+
+            # Halo exchange: ship only the boundary slabs to the
+            # lat-neighbours, hopping as many devices as the halo spans
+            # (h-th hop carries the h-th-nearest neighbour's share;
+            # edge devices receive zeros = inactive, and their
+            # out-of-grid columns are reach-masked anyway).  Wire per
+            # device ~ 2 * halo * _NF * block * 4 B regardless of hops.
+            parts_lo, parts_hi = [], []
+            for h in range(1, n_hops + 1):
+                take = halo - (h - 1) * nb_l if h == n_hops else nb_l
+                lo_h = jax.lax.ppermute(
+                    packed_l[nb_l - take:], mesh_axis,
+                    [(i, i + h) for i in range(ndev - h)])
+                hi_h = jax.lax.ppermute(
+                    packed_l[:take], mesh_axis,
+                    [(i, i - h) for i in range(h, ndev)])
+                # ascending global order: farthest-left part first
+                parts_lo.insert(0, lo_h)
+                parts_hi.append(hi_h)
+            halo13 = jnp.concatenate(
+                parts_lo + [packed_l] + parts_hi, axis=0)
+            halo16 = jnp.concatenate([
+                jnp.concatenate(
+                    [halo13, jnp.zeros(
+                        (nbh, _NFP - len(_FIELDS), block), dtype)],
+                    axis=1),
+                jnp.zeros((wmax, _NFP, block), dtype)], axis=0)
+            own16 = halo16[halo:halo + nb_l]
+
+            row0 = base // block
+            col0 = row0 - halo
+            outs_l = run_rows(
+                row0_col(wl_l, row0, col0), own16, packed_l, pold_l,
+                reach_h & overflow_l[:, None], overflow_l, row0, False,
+                halo16, halo13, rstride=1, col0=col0)
+
+            # Back-map to THIS device's caller shard (device-local
+            # gather; sentinel rows read the accumulator identities)
+            (inconf_l, tcpamax_l, sdve_l, sdvn_l, sdvv_l, tsolv_l,
+             ncnt_l, lcnt_l, ctin_l, cidx_l) = outs_l[:10]
+            rows_l = [inconf_l, tcpamax_l, sdve_l, sdvn_l, sdvv_l,
+                      tsolv_l, outs_l[12]]                 # + active
+            if reso == "swarm":
+                rows_l.extend(outs_l[13:13 + cd_pallas._N_SWARM])
+            stacked_l = jnp.stack([o.reshape(S_l) for o in rows_l])
+            gsl = jnp.clip(dest_loc, 0, S_l - 1)
+            backed_l = jnp.where(
+                in_dev[None, :], stacked_l[:, gsl],
+                jnp.asarray(backed_neutral, dtype)[:, None])
+            tt_l = ctin_l.transpose(0, 2, 1).reshape(S_l, kk)[gsl]
+            ti_l = cidx_l.transpose(0, 2, 1).reshape(S_l, kk)[gsl]
+            tt_l = jnp.where(in_dev[:, None], tt_l, cd_pallas._BIG)
+            ti_l = jnp.where(in_dev[:, None], ti_l, jnp.int32(2 ** 30))
+            nconf_l = jax.lax.psum(
+                jnp.sum(ncnt_l.astype(jnp.int32), dtype=jnp.int32),
+                mesh_axis)
+            nlos_l = jax.lax.psum(
+                jnp.sum(lcnt_l.astype(jnp.int32), dtype=jnp.int32),
+                mesh_axis)
+            return backed_l, tt_l, ti_l, outs_l[11], nconf_l, nlos_l
+
+        col_specs = {k: P(mesh_axis) for k in cols_f}
+        backed, topk_tin, ti_raw, pmerged, nconf, nlos = \
+            cd_pallas.shard_map_compat(
+                body, mesh,
+                (col_specs, P(mesh_axis), P(mesh_axis)),
+                (P(None, mesh_axis), P(mesh_axis), P(mesh_axis),
+                 P(mesh_axis), P(), P()))(cols_f, perm, pold)
+
+        topk_idx = jnp.where(
+            (topk_tin < cd_pallas._BIG) & (ti_raw < n_tot), ti_raw, -1)
+        rd = RowConflictData(
+            inconf=backed[0] > 0.5,
+            tcpamax=backed[1],
+            sum_dve=backed[2], sum_dvn=backed[3], sum_dvv=backed[4],
+            tsolv=backed[5],
+            nconf=nconf, nlos=nlos,
+            topk_idx=topk_idx, topk_tin=topk_tin)
+        partners_new = pmerged.transpose(0, 2, 1).reshape(n_tot, kk)
+        active_caller = backed[6] > 0.5
+        if reso == "swarm":
+            return rd, partners_new, active_caller, \
+                tuple(backed[7:7 + cd_pallas._N_SWARM])
+        return rd, partners_new, active_caller
 
     if mesh is not None and mesh.shape[mesh_axis] > 1:
         # shard_map over the row blocks: each device schedules and
@@ -624,9 +901,8 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         specs_in = (P(mesh_axis), P(mesh_axis), P(mesh_axis),
                     P(mesh_axis) if resume else P(),
                     P(mesh_axis), P(mesh_axis), P(), P())
-        outs = jax.shard_map(
-            body, mesh=mesh, in_specs=specs_in,
-            out_specs=P(mesh_axis), check_vma=False)(
+        outs = cd_pallas.shard_map_compat(
+            body, mesh, specs_in, P(mesh_axis))(
                 wl_p, own16_p, packedown_p,
                 pold_p if resume else jnp.zeros((ndev,), jnp.int32),
                 reachf_p, overflow_p, packed16, packed)
@@ -677,9 +953,28 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
     if reso == "swarm":
         rows.extend(outs[sw_start:sw_start + cd_pallas._N_SWARM])
     stacked = jnp.stack([o.reshape(n_tot) for o in rows])
-    backed = stacked[:, perm]                              # [6|7|+7, n]
-    topk_tin = ctin.transpose(0, 2, 1).reshape(n_tot, kk)[perm]
-    topk_idx = cidx.transpose(0, 2, 1).reshape(n_tot, kk)[perm]
+    if shard_mode == "spatial":
+        # A spatial-mode refresh stores the SENTINEL slot n_tot for
+        # inactive rows (they are dropped from the padded scatter);
+        # mask their gathers to the accumulator identities so this
+        # single-chip reference stays bit-identical to the mesh
+        # decomposition's masked device-local back-map.
+        pvalid = perm < n_tot
+        pc = jnp.clip(perm, 0, n_tot - 1)
+        backed = jnp.where(pvalid[None, :], stacked[:, pc],
+                           jnp.asarray(backed_neutral, dtype)[:, None])
+        topk_tin = jnp.where(
+            pvalid[:, None],
+            ctin.transpose(0, 2, 1).reshape(n_tot, kk)[pc],
+            cd_pallas._BIG)
+        topk_idx = jnp.where(
+            pvalid[:, None],
+            cidx.transpose(0, 2, 1).reshape(n_tot, kk)[pc],
+            jnp.int32(2 ** 30))
+    else:
+        backed = stacked[:, perm]                          # [6|7|+7, n]
+        topk_tin = ctin.transpose(0, 2, 1).reshape(n_tot, kk)[perm]
+        topk_idx = cidx.transpose(0, 2, 1).reshape(n_tot, kk)[perm]
     if not resume:
         # Translate sorted-space partner ids to caller slots via the
         # inverse scatter (sentinel-filled with n -> invalid -> -1).

@@ -271,6 +271,93 @@ def run_spatially_sorted(kernel, lat, lon, trk, gs, alt, vs, gseast,
     return rd
 
 
+def block_summaries(lat, lon, gs, active, nb, block, alt=None, vs=None):
+    """Per-block active-aircraft summaries: the ONLY quantities the
+    reachability bound reads.  Returns a dict of [nb] arrays
+    (latmin/latmax/lonmin/lonmax/gsmax, plus altmin/altmax/vsmax when
+    ``alt``/``vs`` are given).  Split out of ``block_reachability`` so
+    the spatial domain-decomposition mode (ops/cd_sched.py) can compute
+    summaries for its OWN blocks locally, all-gather the [nb]-sized
+    summary vectors (O(N/block) metadata, never the O(N) columns), and
+    evaluate reachability rows from them with bit-identical math."""
+    shape = (nb, block)
+    blat = lat.reshape(shape)
+    blon = lon.reshape(shape)
+    bgs = gs.reshape(shape)
+    act = active.reshape(shape)
+    inf = jnp.asarray(jnp.inf, lat.dtype)
+    out = dict(
+        latmin=jnp.min(jnp.where(act, blat, inf), axis=1),
+        latmax=jnp.max(jnp.where(act, blat, -inf), axis=1),
+        lonmin=jnp.min(jnp.where(act, blon, inf), axis=1),
+        lonmax=jnp.max(jnp.where(act, blon, -inf), axis=1),
+        gsmax=jnp.max(jnp.where(act, bgs, 0.0), axis=1))
+    if alt is not None:
+        balt = alt.reshape(shape)
+        bvs = jnp.abs(vs.reshape(shape))
+        out.update(
+            altmin=jnp.min(jnp.where(act, balt, inf), axis=1),
+            altmax=jnp.max(jnp.where(act, balt, -inf), axis=1),
+            vsmax=jnp.max(jnp.where(act, bvs, 0.0), axis=1))
+    return out
+
+
+def reachability_from_summaries(row, col, rpz, tlookahead, hpz=None,
+                                min_reach_m=0.0, min_vreach_m=0.0,
+                                margin_m=0.0):
+    """[nbr, nbc] bool reachability between two summary sets (the
+    pairwise half of ``block_reachability``; ``row`` and ``col`` may be
+    the same dict — the classic square case — or a device's own rows
+    against the gathered global columns in the spatial mesh mode).
+    ``margin_m`` widens the horizontal bound (the spatial refresh's
+    drift allowance when validating halo coverage ahead of time)."""
+    latmin_r, latmax_r = row["latmin"], row["latmax"]
+    latmin_c, latmax_c = col["latmin"], col["latmax"]
+    maxabslat_r = jnp.maximum(jnp.abs(latmin_r), jnp.abs(latmax_r))
+    maxabslat_c = jnp.maximum(jnp.abs(latmin_c), jnp.abs(latmax_c))
+
+    dlat_gap = jnp.maximum(0.0, jnp.maximum(
+        latmin_r[:, None] - latmax_c[None, :],
+        latmin_c[None, :] - latmax_r[:, None]))
+    # Circular longitude gap between the two [min, max] intervals:
+    # linear gap, or around the back of the sphere, whichever is smaller
+    lin_gap = jnp.maximum(0.0, jnp.maximum(
+        row["lonmin"][:, None] - col["lonmax"][None, :],
+        col["lonmin"][None, :] - row["lonmax"][:, None]))
+    wrap_gap = jnp.maximum(0.0, 360.0 - (
+        jnp.maximum(row["lonmax"][:, None], col["lonmax"][None, :])
+        - jnp.minimum(row["lonmin"][:, None], col["lonmin"][None, :])))
+    dlon_gap = jnp.minimum(lin_gap, wrap_gap)
+
+    cos_lb = jnp.cos(jnp.radians(jnp.minimum(
+        90.0, jnp.maximum(maxabslat_r[:, None], maxabslat_c[None, :]))))
+    r_min = 6335000.0
+    zonal = 2.0 * r_min * jnp.arcsin(jnp.clip(
+        cos_lb * jnp.sin(jnp.radians(0.5 * jnp.minimum(dlon_gap, 360.0))),
+        0.0, 1.0))
+    merid = dlat_gap * 110000.0
+    dist_lb = jnp.maximum(merid, zonal)
+    thresh = rpz + tlookahead * (row["gsmax"][:, None]
+                                 + col["gsmax"][None, :])
+    # min_reach_m widens the bound for reductions over pairs beyond the
+    # conflict horizon (the Swarm 7.5 nm neighbourhood: with a short
+    # DTLOOK the conflict bound alone could skip genuine neighbours)
+    thresh = jnp.maximum(thresh, min_reach_m) + margin_m
+    reach = dist_lb <= thresh * 1.05
+    if hpz is not None and "altmin" in row:
+        altgap = jnp.maximum(0.0, jnp.maximum(
+            row["altmin"][:, None] - col["altmax"][None, :],
+            col["altmin"][None, :] - row["altmax"][:, None]))
+        vthresh = hpz + tlookahead * (row["vsmax"][:, None]
+                                      + col["vsmax"][None, :])
+        # min_vreach_m: vertical analogue of min_reach_m (the Swarm
+        # 1500 ft neighbourhood exceeds hpz, so the conflict bound alone
+        # would skip genuine co-cruising neighbours one band up)
+        vthresh = jnp.maximum(vthresh, min_vreach_m)
+        reach = reach & (altgap <= vthresh * 1.05)
+    return reach
+
+
 def block_reachability(lat, lon, gs, active, nb, block, rpz, tlookahead,
                        alt=None, vs=None, hpz=None, min_reach_m=0.0,
                        min_vreach_m=0.0):
@@ -305,62 +392,11 @@ def block_reachability(lat, lon, gs, active, nb, block, rpz, tlookahead,
       are never falsely skipped.
     Empty blocks get +/-inf bounds -> infinite gap -> always skipped.
     """
-    shape = (nb, block)
-    blat = lat.reshape(shape)
-    blon = lon.reshape(shape)
-    bgs = gs.reshape(shape)
-    act = active.reshape(shape)
-    inf = jnp.asarray(jnp.inf, lat.dtype)
-    latmin = jnp.min(jnp.where(act, blat, inf), axis=1)
-    latmax = jnp.max(jnp.where(act, blat, -inf), axis=1)
-    lonmin = jnp.min(jnp.where(act, blon, inf), axis=1)
-    lonmax = jnp.max(jnp.where(act, blon, -inf), axis=1)
-    gsmax = jnp.max(jnp.where(act, bgs, 0.0), axis=1)
-    maxabslat = jnp.maximum(jnp.abs(latmin), jnp.abs(latmax))
-
-    dlat_gap = jnp.maximum(0.0, jnp.maximum(
-        latmin[:, None] - latmax[None, :],
-        latmin[None, :] - latmax[:, None]))
-    # Circular longitude gap between the two [min, max] intervals:
-    # linear gap, or around the back of the sphere, whichever is smaller
-    lin_gap = jnp.maximum(0.0, jnp.maximum(
-        lonmin[:, None] - lonmax[None, :],
-        lonmin[None, :] - lonmax[:, None]))
-    wrap_gap = jnp.maximum(0.0, 360.0 - (
-        jnp.maximum(lonmax[:, None], lonmax[None, :])
-        - jnp.minimum(lonmin[:, None], lonmin[None, :])))
-    dlon_gap = jnp.minimum(lin_gap, wrap_gap)
-
-    cos_lb = jnp.cos(jnp.radians(jnp.minimum(
-        90.0, jnp.maximum(maxabslat[:, None], maxabslat[None, :]))))
-    r_min = 6335000.0
-    zonal = 2.0 * r_min * jnp.arcsin(jnp.clip(
-        cos_lb * jnp.sin(jnp.radians(0.5 * jnp.minimum(dlon_gap, 360.0))),
-        0.0, 1.0))
-    merid = dlat_gap * 110000.0
-    dist_lb = jnp.maximum(merid, zonal)
-    thresh = rpz + tlookahead * (gsmax[:, None] + gsmax[None, :])
-    # min_reach_m widens the bound for reductions over pairs beyond the
-    # conflict horizon (the Swarm 7.5 nm neighbourhood: with a short
-    # DTLOOK the conflict bound alone could skip genuine neighbours)
-    thresh = jnp.maximum(thresh, min_reach_m)
-    reach = dist_lb <= thresh * 1.05
-    if alt is not None:
-        balt = alt.reshape(shape)
-        bvs = jnp.abs(vs.reshape(shape))
-        altmin = jnp.min(jnp.where(act, balt, inf), axis=1)
-        altmax = jnp.max(jnp.where(act, balt, -inf), axis=1)
-        vsmax = jnp.max(jnp.where(act, bvs, 0.0), axis=1)
-        altgap = jnp.maximum(0.0, jnp.maximum(
-            altmin[:, None] - altmax[None, :],
-            altmin[None, :] - altmax[:, None]))
-        vthresh = hpz + tlookahead * (vsmax[:, None] + vsmax[None, :])
-        # min_vreach_m: vertical analogue of min_reach_m (the Swarm
-        # 1500 ft neighbourhood exceeds hpz, so the conflict bound alone
-        # would skip genuine co-cruising neighbours one band up)
-        vthresh = jnp.maximum(vthresh, min_vreach_m)
-        reach = reach & (altgap <= vthresh * 1.05)
-    return reach
+    summ = block_summaries(lat, lon, gs, active, nb, block, alt=alt, vs=vs)
+    return reachability_from_summaries(summ, summ, rpz, tlookahead,
+                                       hpz=hpz if alt is not None else None,
+                                       min_reach_m=min_reach_m,
+                                       min_vreach_m=min_vreach_m)
 
 
 def detect_resolve_tiled(lat, lon, trk, gs, alt, vs, gseast, gsnorth,

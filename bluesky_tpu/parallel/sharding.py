@@ -19,6 +19,21 @@ and the same code runs single-chip when the mesh has one device.
 
 A second mesh axis ('ens') replicates whole scenarios for Monte-Carlo
 ensembles (BASELINE config #4): see ``ensemble_step``.
+
+Two decompositions for the sparse backend's shard_map kernels
+(SimConfig.cd_shard_mode / the SHARD stack command):
+
+* ``replicate`` — interleaved row blocks per device against the
+  replicated O(N) column state (round 4; ~200x ceiling as D grows,
+  docs/PERF_ANALYSIS.md §multi-chip);
+* ``spatial`` — device-OWNED latitude stripes with conservative halo
+  exchange (``prepare_spatial``): the spatial sort refresh re-buckets
+  each aircraft into the caller shard of the device owning its sorted
+  stripe slot, so per-interval scatter/trig/reachability/windows are
+  O(N/D) device-local and only boundary slabs + per-block summaries
+  ride ICI.  Bit-identical to the single-chip sparse schedule
+  (tests/test_spatial.py) with zero O(N) column all-gathers on the
+  compiled HLO (tests/test_hlo_collectives.py).
 """
 from functools import partial
 
@@ -74,6 +89,71 @@ def shard_state(state: SimState, mesh: Mesh) -> SimState:
     """Place a host-built state onto the mesh with the canonical shardings."""
     return jax.tree.map(lambda x, s: jax.device_put(x, s), state,
                         state_shardings(state, mesh))
+
+
+def spatial_state_shardings(state: SimState, mesh: Mesh):
+    """Spatial-mode shardings: the canonical per-aircraft split plus
+    the sorted-space partner table sharded over its (device-divisible)
+    padded rows — it must never re-enter an interval replicated, or the
+    shard_map boundary would reshard O(N*K) every interval."""
+    sh = state_shardings(state, mesh)
+    return sh.replace(asas=sh.asas.replace(
+        partners_s=NamedSharding(mesh, P("ac", None))))
+
+
+def prepare_spatial(state: SimState, mesh: Mesh, acfg, block: int = 256,
+                    halo_blocks: int = 0, put: bool = True):
+    """Enter the spatial domain-decomposition mode: size the
+    sorted-space partner table to the device-divisible padded layout,
+    run the spatial refresh (stripe sort + caller-slot re-bucketing +
+    halo-coverage check), and place the state on the mesh with the
+    canonical shardings (the re-bucketed caller axis IS the stripe
+    ownership map: device d's shard holds the aircraft of its latitude
+    stripes).
+
+    Returns ``(state, newslot, info)`` — ``newslot`` the old->new
+    caller slot map the host applies to its id/route bookkeeping
+    (``Traffic.apply_slot_permutation``), ``info`` the refresh stats
+    (occupancy, halo need, layout) for SHARD readback.
+
+    Entering the mode RESETS engagement hysteresis (the partner table
+    is rebuilt empty in the new layout): conservative — engaged pairs
+    re-detect on the next CD interval.
+    """
+    import jax.numpy as jnp
+    from ..core import asas as asasmod
+    ndev = mesh.shape["ac"]
+    n = state.nmax
+    if n % ndev:
+        raise ValueError(f"spatial mode: nmax={n} must divide into the "
+                         f"{ndev}-device mesh")
+    n_tot = asasmod.spatial_table_size(n, block, ndev)
+    kk = state.asas.partners_s.shape[1]
+    state = state.replace(asas=state.asas.replace(
+        partners_s=jnp.full((n_tot, kk), -1, jnp.int32)))
+    state, newslot, info = asasmod.refresh_spatial_shard(
+        state, acfg, ndev, block=block, halo_blocks=halo_blocks)
+    if put:
+        # single-host placement; a multi-host job places the shards
+        # itself (jax.make_array_from_callback over
+        # spatial_state_shardings — see tests/multihost_worker.py)
+        state = jax.tree.map(lambda x, s: jax.device_put(x, s), state,
+                             spatial_state_shardings(state, mesh))
+    return state, newslot, info
+
+
+def unprepare_spatial(state: SimState):
+    """Leave spatial mode: restore the default-size sorted tables
+    (hysteresis resets, like entering — conservative either way).
+    Caller slots keep their last bucketing (valid, just no longer
+    maintained)."""
+    import jax.numpy as jnp
+    from ..core.state import SORT_PAD
+    n = state.nmax
+    kk = state.asas.partners_s.shape[1]
+    return state.replace(asas=state.asas.replace(
+        partners_s=jnp.full((n + SORT_PAD, kk), -1, jnp.int32),
+        sort_perm=jnp.arange(n, dtype=jnp.int32)))
 
 
 def sharded_step_fn(mesh: Mesh, cfg: SimConfig, nsteps: int = 1):

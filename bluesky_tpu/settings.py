@@ -102,6 +102,20 @@ stream_sndhwm = 1000              # [msgs] send buffer bound on the stream
 quarantine_report_cap = 64        # BATCHQUARANTINE replay history kept
                                   # for late-joining clients
 
+# ----- multi-chip decomposition (docs/PERF_ANALYSIS.md §multi-chip)
+shard_mode = "off"                # "off" | "replicate" (row-interleaved
+                                  # kernels vs replicated O(N) columns) |
+                                  # "spatial" (device-owned latitude
+                                  # stripes + halo exchange; sparse
+                                  # backend only).  SHARD stack command
+                                  # switches at runtime.
+shard_devices = 0                 # mesh size (0 = every visible device)
+shard_halo_blocks = 0             # spatial halo width in 256-slot blocks
+                                  # per side (0 = one full neighbour
+                                  # device; validated against the exact
+                                  # reach bound + drift margin at every
+                                  # refresh)
+
 # ----- durable runs (preemption-safe checkpoints + BATCH journal)
 snapshot_autosave_dt = 0.0        # [sim s] between on-disk autosnapshots
                                   # of the newest ring entry (0 = off)

@@ -254,6 +254,39 @@ class Simulation:
         #                               small slices so the node loop
         #                               keeps pumping heartbeats
         self.traf.delete_hooks.append(self.cond.delac)
+        self.traf.permute_hooks.append(self.cond.permute)
+        # Spatial mode: a freshly created aircraft has no sorted slot
+        # (sentinel until the next stripe refresh would make it
+        # INVISIBLE to CD), so any creation forces the refresh at the
+        # very next dispatch — the flush and the refresh sit in the
+        # same host edge, so no chunk ever steps a blind aircraft.
+        self.traf.create_hooks.append(
+            lambda slots: setattr(self, "_sort_simt", -1.0)
+            if self.shard_mode == "spatial" else None)
+        self._shard_fallback = False
+        # Multi-chip decomposition (docs/PERF_ANALYSIS.md §multi-chip):
+        # 'off' | 'replicate' (interleaved rows vs replicated columns) |
+        # 'spatial' (device-owned latitude stripes + halo exchange).
+        # SHARD stack command at runtime; settings.shard_mode at start.
+        self.shard_mode = "off"
+        self.shard_mesh = None
+        self.shard_stats = {}
+        from .. import settings as _shard_settings
+        _sm = str(getattr(_shard_settings, "shard_mode", "off")).lower()
+        if _sm in ("replicate", "spatial"):
+            try:
+                if _sm == "spatial" and self.cfg.cd_backend != "sparse":
+                    # a settings-driven spatial deployment implies the
+                    # sparse backend (stripes are its schedule)
+                    self.cfg = self.cfg._replace(cd_backend="sparse",
+                                                 cd_block=256)
+                self.set_shard(
+                    _sm, int(getattr(_shard_settings, "shard_devices", 0)),
+                    halo_blocks=int(getattr(_shard_settings,
+                                            "shard_halo_blocks", 0)))
+            except Exception as e:  # noqa: BLE001 — a bad knob must not
+                #                     kill the sim at construction
+                self.scr.echo(f"shard_mode={_sm} not enabled: {e}")
         # Late import to avoid cycles; stack binds commands to this sim.
         from ..stack.stack import Stack
         self.stack = Stack(self)
@@ -405,6 +438,10 @@ class Simulation:
         self.cond.reset()
         self.routes = RouteManager(self.traf, self.routes.wmax)
         self.cfg = SimConfig()
+        # traf.reset rebuilt default-shape tables on the default device
+        self.shard_mode, self.shard_mesh = "off", None
+        self.shard_stats = {}
+        self._shard_fallback = False
         self.dtmult = 1.0
         self.ffmode = False
         self.stack.reset()
@@ -423,6 +460,100 @@ class Simulation:
         self.plugins.reset()
         self.plotter.reset()
         return True
+
+    # -------------------------------------------------------------- sharding
+    def set_shard(self, mode: str, ndev: int = 0, halo_blocks: int = 0):
+        """Select the multi-chip mode: ``off`` | ``replicate`` |
+        ``spatial`` over the first ``ndev`` devices (0 = all).
+
+        ``replicate``: the round-4 scheme — state sharded on the
+        aircraft axis, sparse/pallas kernels row-split with replicated
+        O(N) columns.  ``spatial``: device-owned latitude stripes with
+        halo exchange (sparse backend only) — aircraft are re-bucketed
+        into the owning device's caller shard at every sort refresh,
+        O(N/D) schedule/sort per device, O(halo) wire per interval.
+        Switching modes resets engagement hysteresis (conservative:
+        pairs re-detect next interval).
+        """
+        import jax as _jax
+        from ..parallel import sharding as shd
+        mode = str(mode).lower()
+        if mode not in ("off", "replicate", "spatial"):
+            raise ValueError(f"SHARD {mode}: off/replicate/spatial")
+        self.drain_pipeline()
+        self.traf.flush()
+        if mode == "spatial" and self.cfg.cd_backend != "sparse":
+            raise ValueError(
+                "SHARD SPATIAL needs the sparse backend (latitude "
+                "stripes are a property of the stripe-sorted schedule) "
+                "— CDMETHOD SPARSE first")
+        # leave the previous mode's table layout
+        if self.shard_mode == "spatial" and mode != "spatial":
+            self.traf.state = shd.unprepare_spatial(self.traf.state)
+        if mode == "off":
+            self.shard_mode, self.shard_mesh = "off", None
+            self.cfg = self.cfg._replace(cd_mesh=None,
+                                         cd_shard_mode="replicate")
+            self._sort_simt = -1.0
+            return True
+        devs = _jax.devices()
+        ndev = ndev or len(devs)
+        if ndev > len(devs):
+            raise ValueError(f"SHARD: {ndev} devices requested, "
+                             f"{len(devs)} available")
+        mesh = shd.make_mesh(ndev)
+        if mode == "spatial":
+            state, newslot, info = shd.prepare_spatial(
+                self.traf.state, mesh, self.cfg.asas,
+                block=min(self.cfg.cd_block, 256),
+                halo_blocks=halo_blocks)
+            self.traf.state = state
+            self.traf.apply_slot_permutation(newslot)
+            self.shard_stats = info
+            self._sort_simt = self.simt
+            self._sort_backend = "sparse"
+            self._last_edge = None      # slots moved: ACDATA cache stale
+        else:
+            self.traf.state = shd.shard_state(self.traf.state, mesh)
+            self._sort_simt = -1.0
+        self.shard_mode, self.shard_mesh = mode, mesh
+        if mode == "spatial":
+            # pin the (auto-sized) halo so every interval compiles
+            # against the exact window the refresh validated
+            halo_blocks = self.shard_stats["halo_blocks"]
+        self.cfg = self.cfg._replace(
+            cd_mesh=mesh, cd_mesh_axis="ac",
+            cd_shard_mode="spatial" if mode == "spatial" else "replicate",
+            cd_halo_blocks=halo_blocks)
+        return True
+
+    def _spatial_refresh(self, state):
+        """Spatial-mode chunk-edge sort refresh: stripe re-sort +
+        caller-slot re-bucketing + halo check (one jitted program), the
+        host id/route remap, and stat capture for SHARD readback.
+        Unlike the plain refresh this must sync the device (the
+        occupancy/halo guards read scalars) — paid once per
+        ``sort_every`` intervals."""
+        from ..core.asas import refresh_spatial_shard
+        try:
+            state, newslot, info = refresh_spatial_shard(
+                state, self.cfg.asas, self.shard_mesh.shape["ac"],
+                block=min(self.cfg.cd_block, 256),
+                halo_blocks=self.cfg.cd_halo_blocks)
+        except RuntimeError as e:
+            # The geometry broke the spatial contract (stripe occupancy
+            # past a shard's capacity, or reach past the halo window).
+            # Running on with a stale bucketing loses the drift-margin
+            # guarantee, so schedule a fallback to the column-replicated
+            # mode at the next step() boundary (a safe sync point) and
+            # step this one chunk on the still-margin-covered old sort.
+            self.scr.echo(f"SHARD SPATIAL contract violated: {e}")
+            self._shard_fallback = True
+            return state
+        self.traf.apply_slot_permutation(newslot)
+        self.shard_stats = info
+        self._last_edge = None          # slots moved: ACDATA cache stale
+        return state
 
     # ----------------------------------------------------- preempt/autosave
     def request_preempt(self):
@@ -521,6 +652,13 @@ class Simulation:
         """
         if self.state_flag == END:
             return False
+
+        if self._shard_fallback:
+            self._shard_fallback = False
+            nd = self.shard_mesh.shape["ac"] if self.shard_mesh else 0
+            self.scr.echo("SHARD: falling back to REPLICATE "
+                          f"({nd} devices)")
+            self.set_shard("replicate", nd)
 
         # External TCP/telnet command lines (tools/network.py bridge)
         if self.telnet is not None:
@@ -747,12 +885,15 @@ class Simulation:
             if (simt - self._sort_simt >= due
                     or self._sort_simt < 0
                     or self._sort_backend != self.cfg.cd_backend):
-                from ..core.asas import impl_for_backend, \
-                    refresh_spatial_sort
-                state = refresh_spatial_sort(
-                    state, self.cfg.asas,
-                    block=self.cfg.cd_block,
-                    impl=impl_for_backend(self.cfg.cd_backend))
+                if self.shard_mode == "spatial":
+                    state = self._spatial_refresh(state)
+                else:
+                    from ..core.asas import impl_for_backend, \
+                        refresh_spatial_sort
+                    state = refresh_spatial_sort(
+                        state, self.cfg.asas,
+                        block=self.cfg.cd_block,
+                        impl=impl_for_backend(self.cfg.cd_backend))
                 self._sort_simt = simt
                 self._sort_backend = self.cfg.cd_backend
         from ..core.step import run_steps_edge, run_steps_edge_keep

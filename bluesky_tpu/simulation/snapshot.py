@@ -112,9 +112,40 @@ def restore_blob(sim, blob, full_reset: bool = True):
         sim.reset_traffic()
     traf = sim.traf
     # Device state: same treedef, arrays re-uploaded with current dtypes
+    old_table = traf.state.asas.partners_s
     traf.state = jax.tree.map(
         lambda old, new: jnp.asarray(new, old.dtype),
         traf.state, blob["state"])
+    # Cross-shard-mode blobs: the sorted-space caches (sort_perm, the
+    # partner table) are keyed to the CAPTURING mode's padded layout.
+    # Adopting a spatial-mode layout into a sim whose tables are sized
+    # differently would silently drop top-stripe aircraft from the
+    # sparse schedule (their sorted slots land past the smaller
+    # layout's row count and the padded scatter runs in drop mode).
+    # Reset the caches to the exact init layout instead — identity
+    # sort (the known-good stale layout; reachability is rebuilt from
+    # true positions every interval) and an empty partner table at the
+    # RUNNING tables' size — and force a re-sort before the next chunk.
+    if traf.state.asas.partners_s.shape != old_table.shape:
+        traf.state = traf.state.replace(asas=traf.state.asas.replace(
+            sort_perm=jnp.arange(traf.nmax, dtype=jnp.int32),
+            partners_s=jnp.full_like(old_table, -1)))
+        sim._sort_simt = -1.0
+    # Restore under an active mesh: re-place the (host-restored) arrays
+    # with the mode's canonical shardings, and in spatial mode force a
+    # re-bucketing refresh before the next chunk — the restored
+    # stripe layout is internally consistent (it was captured with its
+    # sort_perm/partner tables), but its drift-margin clock is unknown,
+    # so the conservative halo re-validation must run first.
+    if getattr(sim, "shard_mesh", None) is not None \
+            and getattr(sim, "shard_mode", "off") != "off":
+        from ..parallel import sharding as shd
+        sh = shd.spatial_state_shardings(traf.state, sim.shard_mesh) \
+            if sim.shard_mode == "spatial" \
+            else shd.state_shardings(traf.state, sim.shard_mesh)
+        traf.state = jax.tree.map(lambda x, s: jax.device_put(x, s),
+                                  traf.state, sh)
+        sim._sort_simt = -1.0
     traf.ids = list(blob["ids"])
     traf.types = list(blob["types"])
     traf._id2slot = {acid: i for i, acid in enumerate(traf.ids)

@@ -1044,6 +1044,44 @@ def register_all(stack):
         return True, (f"Chunk set to {n} steps "
                       f"(={n * sim.simdt:.2f} s sim){note}")
 
+    def shardcmd(mode=None, ndev=None, halo=None):
+        """SHARD [OFF | REPLICATE [n] | SPATIAL [n [halo]]]: multi-chip
+        decomposition, with HEALTH-style readback when called bare."""
+        import jax as _jax
+        if mode is None:
+            if sim.shard_mode == "off":
+                return True, (f"SHARD OFF ({len(_jax.devices())} "
+                              f"device(s) visible; modes: REPLICATE, "
+                              "SPATIAL [sparse backend])")
+            nd = sim.shard_mesh.shape["ac"] if sim.shard_mesh else 0
+            msg = (f"SHARD {sim.shard_mode.upper()}: {nd} devices, "
+                   f"backend {sim.cfg.cd_backend}")
+            st = sim.shard_stats
+            if sim.shard_mode == "spatial" and st:
+                cnt = st.get("counts")
+                imb = (float(cnt.max()) / max(float(cnt.mean()), 1e-9)
+                       if cnt is not None and cnt.size else 0.0)
+                msg += (
+                    f"; stripes {st['nb_local']} blocks/device "
+                    f"(nb={st['nb']}, extra={st['extra_blocks']}), "
+                    f"occupancy {st['occupancy']:.0%} of shard cap, "
+                    f"last-refresh imbalance {imb:.2f}x, "
+                    f"halo {st['halo_blocks']} blocks/side "
+                    f"(need {st['halo_need']}) = "
+                    f"{st['halo_rows']} exchanged rows/interval, "
+                    f"gsmax {st['gsmax']:.0f} m/s")
+            return True, msg
+        m = str(mode).upper()
+        if m not in ("OFF", "REPLICATE", "SPATIAL"):
+            return False, "SHARD [OFF | REPLICATE [n] | SPATIAL [n [halo]]]"
+        try:
+            nd = int(float(ndev)) if ndev is not None else 0
+            hb = int(float(halo)) if halo is not None else 0
+            sim.set_shard(m.lower(), nd, halo_blocks=hb)
+        except (ValueError, RuntimeError) as e:
+            return False, f"SHARD {m}: {e}"
+        return shardcmd()
+
     def healthcmd():
         """HEALTH: serving-fabric introspection.  On a networked
         worker the server is queried (queue depth + per-client split,
@@ -1384,6 +1422,10 @@ def register_all(stack):
         "HEALTH": ["HEALTH", "", healthcmd,
                    "Serving-fabric health: queue depth, worker "
                    "progress, hedges, drops"],
+        "SHARD": ["SHARD [OFF | REPLICATE [n] | SPATIAL [n [halo]]]",
+                  "[txt,txt,txt]", shardcmd,
+                  "Multi-chip mode: replicated columns or spatial "
+                  "latitude-stripe decomposition (readback bare)"],
         "SNAPSHOT": ["SNAPSHOT SAVE/LOAD fname", "txt,[word]", snapshot,
                      "Save/restore a binary state snapshot"],
         "SCREENSHOT": ["SCREENSHOT [fname.svg]", "[word]", screenshot,

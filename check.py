@@ -72,6 +72,18 @@ def _data():
     return ", ".join(out)
 probe(_data, "data paths", detail=lambda s: s, optional=True)
 
+# multi-chip decomposition surface (SHARD REPLICATE/SPATIAL): report
+# the visible mesh size; the full 8-device parity matrix is the
+# driver/CI dryrun (MULTICHIP_r06.json, __graft_entry__.dryrun_multichip)
+def _shard():
+    import jax as _jax
+    from bluesky_tpu.parallel import sharding as _shd
+    nd = len(_jax.devices())
+    assert _shd.prepare_spatial and _shd.make_mesh
+    return f"{nd} device(s); modes: replicate, spatial"
+probe(_shard, "multi-chip shard modes", detail=lambda s: s,
+      optional=True)
+
 # one-aircraft smoke sim on whatever backend JAX picked
 def _smoke():
     from bluesky_tpu.simulation.sim import Simulation

@@ -149,7 +149,7 @@ def measure(n):
 
     # --- scheduled pairs + interleaved imbalance (real schedule) ---
     from scaling_table import schedule_pairs_per_row
-    per_row, _, n_over = schedule_pairs_per_row(
+    per_row, _, n_over, _dest, _reach = schedule_pairs_per_row(
         ac.lat, ac.lon, ac.gs, ac.alt, ac.vs)
     return dict(
         n=n, nb=nb, t_sched_ms=round(t_sched, 2),
@@ -161,24 +161,37 @@ def measure(n):
         overflow_rows=int(n_over))
 
 
-def project(m, sort_every=SORT_EVERY, sharded_windows=False):
+def project(m, sort_every=SORT_EVERY, mode="replicate",
+            spatial_fn=None):
     """D -> projected ms/interval and x-realtime from the measured parts.
 
-    ``sharded_windows=True`` models reach+windows computed per-device
-    inside shard_map (row-parallel, an implemented-design option); the
-    scatter+trig column rebuild stays replicated either way under the
-    column-replication scheme."""
+    ``mode='replicate'``: the column-replication scheme as implemented
+    in round 4 — schedule build and refresh stay replicated (the ~200x
+    ceiling).  ``mode='spatial'``: the ISSUE-5 domain decomposition as
+    implemented — per-device scatter/trig/reach/windows over OWN
+    stripes and a stripe-local share of the refresh, so every former
+    O(N) replicated term scales ~1/D; the wire term is the measured
+    halo + summary volume of the real per-D layout (``spatial_fn(d)``
+    -> scaling_table.spatial_stats dict) instead of the O(N) column
+    gathers.  The D=1 rows of both modes coincide with the measured
+    single-chip interval (the calibration anchor)."""
     per_row = np.asarray(m["per_row"])
     nb = len(per_row)
-    # CD share splits: row-sharded pair work + the replicated sched
-    # build that runs inside it
+    # CD share splits: row-sharded pair work + the sched build that
+    # runs inside it
     cd_rowshard = max(m["t_cd_ms"] - m["t_sched_ms"], 0.0)
-    repl_fixed = m["t_scatter_ms"] if sharded_windows else m["t_sched_ms"]
-    rowpar_sched = m["t_sched_ms"] - repl_fixed
+    spatial = mode == "spatial"
+    repl_fixed = 0.0 if spatial else m["t_sched_ms"]
     coll_bytes = COLL_BYTES_PER_AC * m["n"]
     rows = []
     for d in (1, 2, 4, 8, 16, 32, 0):      # 0 = the D->inf limit
-        if d:
+        stats = None
+        if spatial and d > 1 and spatial_fn is not None:
+            stats = spatial_fn(d)
+        if stats is not None:
+            dev = np.asarray(stats["dev_pairs"], float)
+            imb = dev.max() / max(dev.mean(), 1.0)
+        elif d:
             nbp = -(-nb // d) * d
             rr = np.pad(per_row, (0, nbp - nb))
             dev = rr.reshape(nbp // d, d).T.sum(axis=1)
@@ -186,36 +199,70 @@ def project(m, sort_every=SORT_EVERY, sharded_windows=False):
         else:
             imb = 1.0
         inv = (1.0 / d) if d else 0.0
-        coll = 0.0 if d == 1 else \
-            coll_bytes / (ICI_GBPS * 1e9) * 1e3 \
-            + N_COLLECTIVES * COLL_LAT_US / 1e3
-        interval = (cd_rowshard * inv * imb + repl_fixed
-                    + rowpar_sched * inv
-                    + m["t_base_ms"] * inv
-                    + m["t_refresh_call_ms"] / sort_every + coll)
+        if d == 1:
+            coll = 0.0
+        elif spatial:
+            # halo slabs + summary metadata per device over ICI, ~12
+            # collective launches (2 permutes, summary gathers, count
+            # psums); D->inf keeps the (D-independent) halo volume of
+            # the largest measured layout
+            st = stats or (spatial_fn(32) if spatial_fn else None)
+            wire = (st["halo_bytes_dev"] + st["summ_bytes"]) \
+                if st else 2 * 16 * 256 * 16 * 4
+            coll = wire / (ICI_GBPS * 1e9) * 1e3 \
+                + 12 * COLL_LAT_US / 1e3
+        else:
+            coll = coll_bytes / (ICI_GBPS * 1e9) * 1e3 \
+                + N_COLLECTIVES * COLL_LAT_US / 1e3
+        sched = m["t_sched_ms"] * inv if spatial else repl_fixed
+        refresh = m["t_refresh_call_ms"] / sort_every \
+            * (inv if spatial else 1.0)
+        interval = (cd_rowshard * inv * imb + sched
+                    + m["t_base_ms"] * inv + refresh + coll)
         rows.append(dict(D=d or "inf",
                          cd_ms=round(cd_rowshard * inv * imb, 2),
-                         repl_ms=round(repl_fixed + rowpar_sched * inv, 2),
+                         repl_ms=round(sched, 2),
                          base_ms=round(m["t_base_ms"] * inv, 2),
-                         refresh_ms=round(m["t_refresh_call_ms"]
-                                          / sort_every, 2),
+                         refresh_ms=round(refresh, 2),
                          coll_ms=round(coll, 2),
                          interval_ms=round(interval, 2),
                          x_realtime=round(1000.0 / interval, 1)))
     return rows
 
 
-def main(n=100_000):
-    m = measure(n)
+def _spatial_fn_for(n):
+    """Per-D spatial layout/halo stats on the benchmark fleet (the
+    schedule-measured division of scaling_table.spatial_stats)."""
+    from scaling_table import make_fleet, spatial_stats
+    fleet = make_fleet(n, "continental")
+
+    def fn(d):
+        return spatial_stats(*fleet, ndev=d)
+    return fn
+
+
+def emit(m, per_row=None):
+    """Project both decompositions from the measured terms, write the
+    artifact, print the PERF_ANALYSIS tables."""
+    if per_row is not None:
+        m = dict(m, per_row=per_row)
+    sfn = _spatial_fn_for(m["n"])
     proj = project(m)
-    proj_sw = project(m, sharded_windows=True)
+    proj_sp = project(m, mode="spatial", spatial_fn=sfn)
     mm = {k: v for k, v in m.items() if k != "per_row"}
     out = dict(measured=mm, projected=proj,
-               projected_sharded_windows=proj_sw,
+               projected_spatial=proj_sp,
                model=dict(ici_gbps=ICI_GBPS, coll_lat_us=COLL_LAT_US,
                           n_collectives=N_COLLECTIVES,
                           coll_bytes_per_ac=COLL_BYTES_PER_AC,
-                          sort_every=SORT_EVERY))
+                          sort_every=SORT_EVERY,
+                          spatial_collectives=12,
+                          spatial_halo=dict(
+                              (d, {k: int(v) for k, v in sfn(d).items()
+                                   if k in ("halo_blocks", "halo_need",
+                                            "halo_bytes_dev",
+                                            "summ_bytes", "nb_local")})
+                              for d in (2, 4, 8, 16, 32))))
     # fresh checkout: output/ may not exist yet — a multi-minute run
     # must not crash at the final dump
     os.makedirs("output", exist_ok=True)
@@ -223,16 +270,44 @@ def main(n=100_000):
         json.dump(out, f, indent=1)
     print(json.dumps(mm))
     for title, p in (("column-replication (as implemented)", proj),
-                     ("with per-device reach+windows", proj_sw)):
+                     ("spatial decomposition (as implemented)", proj_sp)):
         print(f"\n{title}:")
-        print("| D | CD | replicated | base | refresh | coll | "
+        print("| D | CD | sched | base | refresh | coll | "
               "interval ms | x-realtime |")
         print("|---|---|---|---|---|---|---|---|")
         for r in p:
             print(f"| {r['D']} | {r['cd_ms']} | {r['repl_ms']} | "
                   f"{r['base_ms']} | {r['refresh_ms']} | {r['coll_ms']} | "
                   f"{r['interval_ms']} | {r['x_realtime']} |")
+    return out
+
+
+def main(n=100_000):
+    emit(measure(n))
+
+
+def reproject(path="BENCH_FULL_INTERVAL.json"):
+    """Recompute the projections (incl. the spatial decomposition) from
+    a previously measured artifact's terms — the chip-measured D=1
+    numbers stay authoritative, only the D-scaling model and the
+    schedule-measured layout stats (CPU-computable) are refreshed.
+    Run after changing the decomposition without chip access:
+    ``python scripts/full_interval_model.py --reproject``."""
+    with open(path) as f:
+        old = json.load(f)
+    m = old["measured"]
+    # per-row pairs re-derived from the same deterministic benchmark
+    # fleet the measurement used (dropped from the artifact for size)
+    from scaling_table import schedule_pairs_per_row
+    traf = bench._make_traffic(m["n"], "continental", False, jnp.float32)
+    ac = traf.state.ac
+    per_row, _, _, _, _ = schedule_pairs_per_row(
+        ac.lat, ac.lon, ac.gs, ac.alt, ac.vs)
+    return emit(m, per_row=per_row.tolist())
 
 
 if __name__ == "__main__":
-    main(int(sys.argv[1]) if len(sys.argv) > 1 else 100_000)
+    if "--reproject" in sys.argv:
+        reproject()
+    else:
+        main(int(sys.argv[1]) if len(sys.argv) > 1 else 100_000)

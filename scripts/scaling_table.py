@@ -58,16 +58,19 @@ def make_fleet(n, geom, seed=0):
             jnp.asarray(vs, jnp.float32))
 
 
-def schedule_pairs_per_row(lat, lon, gs, alt, vs):
+def schedule_pairs_per_row(lat, lon, gs, alt, vs, extra=EXTRA,
+                           spread_pad=False):
     """[nb] scheduled block-granular pairs per row block, via the real
     round-4 schedule (windows for covered rows, row-restricted full
-    grid for overflow rows)."""
+    grid for overflow rows).  ``extra``/``spread_pad`` select the
+    SPATIAL layout variant (device-divisible padding, count-diluted)."""
     n = lat.shape[0]
     active = jnp.ones((n,), bool)
     thresh = cd_sched.reach_threshold_m(gs, active, TLOOK, RPZ)
     dest = cd_sched.stripe_sort_dest(lat, lon, gs, active, thresh,
-                                     BLOCK, EXTRA, alt=alt, vs=vs)
-    nb = -(-n // BLOCK) + EXTRA
+                                     BLOCK, extra, alt=alt, vs=vs,
+                                     spread_pad=spread_pad)
+    nb = -(-n // BLOCK) + extra
     n_tot = nb * BLOCK
     plat, plon, pgs, palt, pvs, pact = cd_sched.scatter_padded(
         [lat, lon, gs, alt, vs, active.astype(jnp.float32)], dest, n_tot)
@@ -79,7 +82,47 @@ def schedule_pairs_per_row(lat, lon, gs, alt, vs):
     win_pairs = jnp.sum(ln, axis=1) * BLOCK * BLOCK
     grid_pairs = jnp.sum(reach, axis=1) * BLOCK * BLOCK
     per_row = jnp.where(overflow, grid_pairs, win_pairs)
-    return np.asarray(per_row), nb, int(jnp.sum(overflow))
+    return np.asarray(per_row), nb, int(jnp.sum(overflow)), dest, \
+        np.asarray(reach)
+
+
+def spatial_stats(lat, lon, gs, alt, vs, ndev, halo_blocks=0):
+    """Measured per-device division of the SPATIAL decomposition at
+    D=ndev: scheduled pairs per device (contiguous stripe split on the
+    count-diluted layout), aircraft occupancy per device, the widest
+    halo the reachability actually needs, and the halo exchange volume
+    per device per interval.  This is schedule-measured on the real
+    layout, like the replicate columns — what one chip cannot measure
+    is the ICI time itself."""
+    n = lat.shape[0]
+    extra, nb, nb_l, n_tot = cd_sched.spatial_layout(n, BLOCK, ndev)
+    per_row, nb2, n_over, dest, reach = schedule_pairs_per_row(
+        lat, lon, gs, alt, vs, extra=extra, spread_pad=True)
+    assert nb2 == nb
+    dev_pairs = per_row.reshape(ndev, nb_l).sum(axis=1)
+    dest_np = np.asarray(dest)
+    S = nb_l * BLOCK
+    counts = np.bincount(np.minimum(dest_np // S, ndev - 1),
+                         minlength=ndev)
+    # widest halo the reachability needs (blocks past the owning
+    # device's range over reachable pairs) -> the halo the refresh
+    # would demand; the multi-hop exchange supports any width
+    bi = np.arange(nb)
+    d_i = bi // nb_l
+    need = np.maximum(np.maximum(
+        (d_i * nb_l)[:, None] - bi[None, :],
+        bi[None, :] - ((d_i + 1) * nb_l)[:, None] + 1), 0)
+    halo_need = int(need[reach].max()) if reach.any() else 0
+    halo = halo_blocks or max(nb_l, halo_need)
+    # exchanged boundary slabs: 2 directions x halo blocks x 16 rows
+    halo_bytes_dev = 2 * halo * 16 * BLOCK * 4
+    # summary metadata all-gather: 8 f32 vectors of nb entries
+    summ_bytes = 8 * nb * 4
+    return dict(ndev=ndev, extra=extra, nb=nb, nb_local=nb_l,
+                dev_pairs=dev_pairs, counts=counts,
+                overflow_rows=n_over, halo_blocks=halo,
+                halo_need=halo_need,
+                halo_bytes_dev=halo_bytes_dev, summ_bytes=summ_bytes)
 
 
 def main():
@@ -88,26 +131,41 @@ def main():
     print(f"N = {n}; block {BLOCK}, s_cap {S_CAP}, wmax {WMAX}; "
           f"pair cost {ps_per_pair*1e12:.0f} ps (measured)")
     for geom in ("continental", "global", "regional"):
-        per_row, nb, n_over = schedule_pairs_per_row(
-            *make_fleet(n, geom))
+        fleet = make_fleet(n, geom)
+        per_row, nb, n_over, _, _ = schedule_pairs_per_row(*fleet)
         total = per_row.sum()
-        # Replicated column slabs: [nb+wmax, 16, block] f32 per interval
-        ag_mb = (nb + WMAX) * 16 * BLOCK * 4 / 1e6
+        # Replicated mode wire: the O(N) raw column gathers (~90 B/ac,
+        # HLO-verified — XLA regathers columns, not the slab array)
+        repl_mb = 90.0 * n / 1e6
         print(f"\n[{geom}] rows={nb} overflow_rows={n_over} "
               f"total scheduled pairs={total:.3e} "
-              f"column all-gather={ag_mb:.1f} MB/interval")
-        print(f"{'D':>3} {'rows/dev':>8} {'max pairs/dev':>14} "
+              f"replicate-mode column gathers={repl_mb:.1f} MB/interval")
+        print(f"{'D':>3} {'mode':>9} {'max pairs/dev':>14} "
               f"{'mean pairs/dev':>14} {'imbalance':>9} "
-              f"{'kernel ms/dev':>13}")
+              f"{'kernel ms/dev':>13} {'wire MB/dev':>11} "
+              f"{'occ':>5}")
         for d in (1, 2, 4, 8, 16, 32):
             nbp = -(-nb // d) * d
             rows = np.pad(per_row, (0, nbp - nb))
-            # the INTERLEAVED assignment detect_resolve_sched uses
-            # (device d owns rows d, d+D, ...)
+            # REPLICATE: the INTERLEAVED assignment (device d owns rows
+            # d, d+D, ...) against replicated O(N) columns
             dev = rows.reshape(nbp // d, d).T.sum(axis=1)
             mx, mean = dev.max(), dev.mean()
-            print(f"{d:>3} {nbp//d:>8} {mx:>14.3e} {mean:>14.3e} "
-                  f"{mx/max(mean,1):>9.2f} {mx*ps_per_pair*1e3:>13.2f}")
+            print(f"{d:>3} {'replicate':>9} {mx:>14.3e} {mean:>14.3e} "
+                  f"{mx/max(mean,1):>9.2f} {mx*ps_per_pair*1e3:>13.2f} "
+                  f"{0.0 if d == 1 else repl_mb:>11.2f} {'-':>5}")
+            if d == 1:
+                continue
+            # SPATIAL: contiguous stripe ownership on the
+            # count-diluted device-divisible layout, halo exchange only
+            st = spatial_stats(*fleet, ndev=d)
+            smx, smean = st["dev_pairs"].max(), st["dev_pairs"].mean()
+            wire_mb = (st["halo_bytes_dev"] + st["summ_bytes"]) / 1e6
+            occ = st["counts"].max() / (n / d)
+            print(f"{d:>3} {'spatial':>9} {smx:>14.3e} {smean:>14.3e} "
+                  f"{smx/max(smean,1):>9.2f} "
+                  f"{smx*ps_per_pair*1e3:>13.2f} {wire_mb:>11.2f} "
+                  f"{occ:>5.2f}")
 
 
 if __name__ == "__main__":

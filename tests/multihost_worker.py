@@ -9,7 +9,14 @@ collectives, here over the gloo DCN-analogue transport) runs as one
 SPMD program.  Process 0 writes the gathered results to ``--out`` for
 the parent to compare against its single-process run.
 
-Usage: python multihost_worker.py <process_id> <coord_port> <out.npz>
+Usage: python multihost_worker.py <pid> <coord_port> <out.npz> [mode]
+
+``mode`` (default "replicate") selects the multi-chip decomposition:
+"spatial" runs the ISSUE-5 latitude-stripe mode — every process
+executes the identical spatial refresh (stripe sort + caller-slot
+re-bucketing) on its host copy, places the re-bucketed state and the
+device-divisible partner table shard-by-shard, and the halo exchange's
+collective-permutes cross the process boundary over gloo.
 """
 import os
 import sys
@@ -21,10 +28,18 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+# Cross-process CPU collectives need the gloo transport selected
+# explicitly on jax 0.4.x ("Multiprocess computations aren't
+# implemented on the CPU backend" otherwise); newer jaxlibs default it.
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:  # noqa: BLE001 — flag spelling varies by version
+    pass
 
 
 def main():
     pid, port, outfile = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+    mode = sys.argv[4] if len(sys.argv) > 4 else "replicate"
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     sys.path.insert(0, os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
@@ -41,17 +56,27 @@ def main():
     from jax.experimental import multihost_utils
 
     from bluesky_tpu.core.step import SimConfig
-    from test_sharding import make_mixed_scene
+    from test_sharding import make_mixed_scene  # noqa: F401
 
-    cfg = SimConfig(cd_backend="sparse", cd_block=256)
     nsteps = 25
-
     mesh = sharding.make_mesh()          # all 8 job devices
-    scene = make_mixed_scene()
+    if mode == "spatial":
+        from test_spatial import make_scene
+        cfg = SimConfig(cd_backend="sparse", cd_block=256,
+                        cd_shard_mode="spatial")
+        # deterministic refresh: every process computes the identical
+        # re-bucketed state, then places only its own shards
+        scene, _, sp_info = sharding.prepare_spatial(
+            make_scene(), mesh, cfg.asas, put=False)
+        cfg = cfg._replace(cd_halo_blocks=sp_info["halo_blocks"])
+        shardings = sharding.spatial_state_shardings(scene, mesh)
+    else:
+        cfg = SimConfig(cd_backend="sparse", cd_block=256)
+        scene = make_mixed_scene()
+        shardings = sharding.state_shardings(scene, mesh)
     # Every process builds the identical host state; place it onto the
     # global mesh shard-by-shard (each process materialises only the
     # shards its local devices own).
-    shardings = sharding.state_shardings(scene, mesh)
 
     def put(leaf, sh):
         host = np.asarray(leaf)

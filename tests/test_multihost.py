@@ -30,7 +30,8 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def test_init_multihost_two_process_sparse_step(tmp_path):
+@pytest.mark.parametrize("mode", ["replicate", "spatial"])
+def test_init_multihost_two_process_sparse_step(tmp_path, mode):
     here = os.path.dirname(os.path.abspath(__file__))
     outfile = tmp_path / "mh_out.npz"
     port = _free_port()
@@ -39,7 +40,7 @@ def test_init_multihost_two_process_sparse_step(tmp_path):
 
     procs = [subprocess.Popen(
         [sys.executable, os.path.join(here, "multihost_worker.py"),
-         str(pid), str(port), str(outfile)],
+         str(pid), str(port), str(outfile), mode],
         env=env, cwd=here, stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT, text=True) for pid in (0, 1)]
     outs = []
@@ -55,8 +56,23 @@ def test_init_multihost_two_process_sparse_step(tmp_path):
     assert outfile.is_file(), outs[0][-4000:]
 
     got = np.load(outfile)
-    cfg = SimConfig(cd_backend="sparse", cd_block=256)
-    ref = run_steps(make_mixed_scene(), cfg, 25)
+    if mode == "spatial":
+        # single-chip reference on the SAME re-bucketed layout the
+        # workers computed (the refresh is deterministic)
+        import jax
+        from bluesky_tpu.parallel import sharding
+        from test_spatial import make_scene
+        cfg = SimConfig(cd_backend="sparse", cd_block=256,
+                        cd_shard_mode="spatial")
+        mesh = sharding.make_mesh(8)
+        st, _, sp_info = sharding.prepare_spatial(make_scene(), mesh,
+                                                  cfg.asas, put=False)
+        cfg = cfg._replace(cd_halo_blocks=sp_info["halo_blocks"])
+        st = jax.tree.map(lambda x: jax.device_put(np.asarray(x)), st)
+        ref = run_steps(st, cfg, 25)
+    else:
+        cfg = SimConfig(cd_backend="sparse", cd_block=256)
+        ref = run_steps(make_mixed_scene(), cfg, 25)
 
     assert float(got["simt"]) == pytest.approx(25 * cfg.simdt)
     assert int(got["nconf"]) == int(ref.asas.nconf_cur)
