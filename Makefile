@@ -25,13 +25,15 @@ test-fast:
 # docs/FAULT_TOLERANCE.md recovery matrix), the durability suite
 # (atomic snapshots, preemption, BATCH journal crash-resume), the
 # overload/straggler suite (admission control, fairness, hedging,
-# HEALTH — incl. the slow 16-piece FAULT STRAGGLE acceptance case)
-# and the slow fabric cases (kill -9 a real worker mid-BATCH,
+# HEALTH — incl. the slow 16-piece FAULT STRAGGLE acceptance case),
+# the packed multi-world serving suite (crash-mid-pack exactly-once
+# demux) and the slow fabric cases (kill -9 a real worker mid-BATCH,
 # silent-worker reaping).
 chaos:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	$(PYTHON) -m pytest tests/test_chaos.py tests/test_durability.py \
-	tests/test_overload.py tests/test_fabric_hardening.py -q $(XDIST)
+	tests/test_overload.py tests/test_fabric_hardening.py \
+	tests/test_world_serving.py -q $(XDIST)
 
 lint:
 	@$(PYTHON) -m pyflakes bluesky_tpu tests 2>/dev/null \

@@ -258,6 +258,88 @@ def run_chunked(n_ac, backend=None, geometry=None, chunk=20,
     return best
 
 
+def make_world_states(n_ac, worlds, dtype=None, geometry="regional",
+                      pair_matrix=True, seed=0):
+    """W per-world SimStates from one base fleet: headings rotated and
+    PRNG keys re-seeded per world so the scenarios genuinely diverge
+    (a Monte-Carlo sweep's shape) while sharing the nmax bucket."""
+    import jax
+    import jax.numpy as jnp
+    dtype = dtype or jnp.float32
+    traf = _make_traffic(n_ac, geometry, pair_matrix, dtype)
+    base = traf.state
+    states = []
+    for w in range(worlds):
+        hdg = jnp.mod(base.ac.hdg + 360.0 * w / max(worlds, 1), 360.0)
+        states.append(base.replace(
+            # distinct buffers (donation rejects one buffer twice)
+            ac=base.ac.replace(hdg=hdg, trk=jnp.copy(hdg)),
+            rng=jax.random.PRNGKey(seed + w)))
+    return states
+
+
+def run_worlds(n_ac, worlds, nsteps=200, reps=2, backend="dense",
+               baseline_reps=None):
+    """Multi-world throughput: W scenarios of N aircraft advanced as
+    ONE stacked scan (core/step.run_steps_worlds) vs the one-piece-per-
+    worker baseline — the same compiled single-world program dispatched
+    serially, which is the chip-time a worker-process fleet sharing one
+    device gets (docs/PERF_ANALYSIS.md §multi-world).
+
+    Emits the batched row AND the baseline row; ``speedup`` is
+    aggregate aircraft-steps/s batched over baseline.
+    """
+    import jax
+    import jax.numpy as jnp
+    from bluesky_tpu.core.step import (SimConfig, run_steps,
+                                       run_steps_worlds, stack_worlds)
+
+    cfg = SimConfig(cd_backend=backend)
+    states = make_world_states(n_ac, worlds,
+                               pair_matrix=(backend == "dense"))
+
+    # ---- baseline: serial single-world dispatches of the same program.
+    # Workers time-sharing one chip cannot beat the serial per-dispatch
+    # rate, so K dispatches bound a K-worker fleet's aggregate.
+    k = baseline_reps if baseline_reps is not None else min(worlds, 8)
+    solo = jax.tree_util.tree_map(jnp.copy, states[0])
+    solo = run_steps(solo, cfg, nsteps)            # warmup/compile
+    jax.block_until_ready(solo)
+    t0 = time.perf_counter()
+    for _ in range(k):
+        solo = run_steps(solo, cfg, nsteps)
+    jax.block_until_ready(solo)
+    base_dt = time.perf_counter() - t0
+    base_rate = k * n_ac * nsteps / base_dt
+    baseline = dict(n=n_ac, worlds=1, protocol="one-piece-per-worker "
+                    "(serial single-world dispatches, shared chip)",
+                    backend=backend, nsteps_chunk=nsteps,
+                    dispatches=k,
+                    ac_steps_per_s=round(base_rate, 1),
+                    x_realtime_per_world=round(
+                        base_rate * cfg.simdt / n_ac, 2))
+
+    # ---- batched: one stacked dispatch steps every world.
+    wstate = run_steps_worlds(stack_worlds(states), cfg, nsteps)
+    jax.block_until_ready(wstate)                  # warmup/compile
+    best = 0.0
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        wstate = run_steps_worlds(wstate, cfg, nsteps)
+        jax.block_until_ready(wstate)
+        dt = time.perf_counter() - t0
+        best = max(best, worlds * n_ac * nsteps / dt)
+    row = dict(n=n_ac, worlds=worlds, protocol="world-batched "
+               "(one stacked vmapped scan per dispatch)",
+               backend=backend, nsteps_chunk=nsteps,
+               ac_steps_per_s=round(best, 1),
+               x_realtime_per_world=round(
+                   best * cfg.simdt / (worlds * n_ac), 2),
+               speedup=round(best / base_rate, 2),
+               reps=f"best-of-{reps}")
+    return row, baseline
+
+
 def cd_pairs_per_s(n_ac, backend, geometry, reps=3):
     """CD&R kernel alone: effective pair rate."""
     import jax
@@ -467,6 +549,18 @@ if __name__ == "__main__":
         args = [a for a in sys.argv[1:] if not a.startswith("--")]
         sharded(n_ac=int(args[0]) if args else 4096,
                 backend=args[1] if len(args) > 1 else "sparse")
+    elif "--worlds" in sys.argv:
+        # multi-world batched throughput vs the one-piece-per-worker
+        # baseline: `bench.py --worlds W [N]` (scripts/world_sweep.py
+        # runs the full W x N matrix into BENCH_WORLDS.json)
+        i = sys.argv.index("--worlds")
+        w = int(sys.argv[i + 1]) if len(sys.argv) > i + 1 else 256
+        rest = sys.argv[1:i] + sys.argv[i + 2:]   # drop the W operand
+        args = [a for a in rest if not a.startswith("--")]
+        n = int(args[0]) if args else 500
+        row, baseline = run_worlds(n, w)
+        print(json.dumps(baseline))
+        print(json.dumps(row))
     elif "--pipeline" in sys.argv:
         # chunked production-loop protocol with the async-pipeline edge
         # model on/off and the host-edge overhead breakdown

@@ -205,8 +205,8 @@ class Metrics:
         self.coca_total = 0
         self.coca_max = 0
         self.coca_combined = 0.0
-        from ..utils import datalog
-        self.logger = datalog.defineLogger(
+        # per-sim registry: W multi-world sims keep separate METLOGs
+        self.logger = sim.datalog.define_event(
             "METLOG",
             "Metrics log: metric name, then metric-specific columns "
             "(CoCa cell rows: cell-id, n, centroid-lat/lon, combined, "

@@ -340,3 +340,254 @@ def run_steps_edge_keep(state: SimState, cfg: SimConfig, nsteps: int,
 
 
 step_jit = jax.jit(step, static_argnames=("cfg",))
+
+
+# --------------------------------------------------------------- multi-world
+# Batched multi-world stepping: the same scan with a leading WORLD axis
+# on the whole SimState pytree, so ONE device program advances W
+# independent scenarios per dispatch (docs/PERF_ANALYSIS.md
+# §multi-world).  Per-world scalars (simt, rng, nconf/nlos, the guard
+# word) ride the pytree and become [W]-vectors for free; per-world
+# clocks may differ, so worlds at different sim times batch together.
+# One compile per (nmax-bucket, chunk-length, cfg) key serves every
+# fleet of compatible scenarios — the serving layer packs compatible
+# BATCH pieces into exactly these batches (network/server.py).
+
+
+def _check_worlds_cfg(cfg: SimConfig):
+    """World batching composes with single-device configs only: the
+    mesh decompositions put per-DEVICE structure on the aircraft axis
+    (spatial stripes are a property of one world's sorted layout), so
+    they compose with the world axis later, not now."""
+    if cfg.cd_mesh is not None or cfg.cd_shard_mode == "spatial":
+        raise ValueError(
+            "world-batched stepping runs single-device per world: "
+            "cd_mesh must be None and cd_shard_mode != 'spatial' "
+            "(pack refuses sharded pieces — see WORLDS docs)")
+
+
+def stack_worlds(states) -> SimState:
+    """Stack a list of same-shape SimStates into one [W, ...] pytree."""
+    states = list(states)
+    if not states:
+        raise ValueError("stack_worlds: need at least one world")
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
+
+
+def world_slice(wtree, w: int):
+    """World ``w``'s slice of any stacked pytree (state or telemetry)."""
+    return jax.tree_util.tree_map(lambda x: x[w], wtree)
+
+
+def unstack_worlds(wstate: SimState):
+    """Split a stacked state back into per-world SimStates."""
+    nw = int(wstate.simt.shape[0])
+    return [world_slice(wstate, w) for w in range(nw)]
+
+
+def _select_worlds(mask, new_tree, old_tree):
+    """Per-world select: ``mask`` is [W] bool, tree leaves are [W, ...];
+    worlds where mask is False keep their old leaves bit-exactly."""
+    def sel(new, old):
+        m = mask.reshape(mask.shape + (1,) * (new.ndim - 1))
+        return jnp.where(m, new, old)
+    return jax.tree_util.tree_map(sel, new_tree, old_tree)
+
+
+def step_worlds(state: SimState, cfg: SimConfig) -> SimState:
+    """One simdt for every world of a stacked [W, ...] state.
+
+    Semantically ``jax.vmap(step)`` — and bit-identical to it (the W=1
+    parity test pins this against the UNBATCHED step) — but with the
+    time-staggered gates hoisted out of the vmap: under plain vmap a
+    ``lax.cond`` lowers to a select that runs BOTH branches every step,
+    so the 1 Hz ASAS interval and the ~1 s FMS update would burn their
+    full cost every 0.05 s step in every world (~20x the arithmetic —
+    measured 25x slower than unbatched, the opposite of batching).
+    Here each gate is a scalar ``any world due`` cond around the
+    vmapped branch plus a per-world select, so a step where NO world
+    hits the gate (19 of 20 at the default cadences) skips the branch
+    exactly like the single-world scan does; packed scenarios share
+    their cadence by construction (same SimConfig), so the union
+    schedule stays the single-world schedule even for worlds at
+    different sim times.
+    """
+    simt = state.simt                             # [W]
+
+    # ---------- Atmosphere ----------
+    state = state.replace(
+        ac=jax.vmap(kinematics.update_atmosphere)(state.ac))
+
+    # ---------- ADS-B broadcast model ----------
+    if cfg.noise.turb_active or cfg.noise.adsb_transnoise:
+        rng, k_adsb, k_turb = jax.vmap(
+            lambda k: tuple(jax.random.split(k, 3)))(state.rng)
+    else:
+        rng = k_adsb = k_turb = state.rng
+    state = state.replace(
+        rng=rng,
+        adsb=jax.vmap(lambda a, ac, k, t: noise.adsb_update(
+            a, ac, k, t, cfg.noise))(state.adsb, state.ac, k_adsb, simt))
+
+    # ---------- FMS / autopilot, gated at fms_dt ----------
+    fms_due = (state.fms_t0 + cfg.fms_dt < simt) | (simt < state.fms_t0) \
+        | (simt < cfg.fms_dt)                     # [W]
+
+    def run_fms_worlds(s):
+        new = jax.vmap(autopilot.update_fms)(s)
+        new = new.replace(fms_t0=simt)
+        return _select_worlds(fms_due, new, s)
+
+    state = jax.lax.cond(jnp.any(fms_due), run_fms_worlds,
+                         lambda s: s, state)
+    state = jax.vmap(autopilot.update_continuous)(state)
+
+    # ---------- ASAS CD&R, gated at dtasas ----------
+    if cfg.asas.swasas:
+        if cfg.cd_backend not in ("dense", "tiled", "pallas", "sparse"):
+            raise ValueError(
+                f"Unknown SimConfig.cd_backend {cfg.cd_backend!r}; "
+                "expected 'dense', 'tiled', 'pallas' or 'sparse'.")
+        if cfg.cd_backend == "dense" and state.asas.resopairs.size == 0:
+            raise ValueError(
+                "State was allocated with pair_matrix=False (no [N,N] "
+                "resopairs) but SimConfig.cd_backend is 'dense'. Use "
+                "SimConfig(cd_backend='tiled') or allocate "
+                "Traffic(pair_matrix=True).")
+        asas_due = simt >= state.asas_tnext       # [W]
+
+        def run_asas_worlds(s):
+            def one(sw):
+                if cfg.cd_backend in ("tiled", "pallas", "sparse"):
+                    impl = asasmod.impl_for_backend(cfg.cd_backend)
+                    s2, _cd = asasmod.update_tiled(
+                        sw, cfg.asas, block=cfg.cd_block, impl=impl,
+                        mesh=cfg.cd_mesh, mesh_axis=cfg.cd_mesh_axis,
+                        shard_mode=cfg.cd_shard_mode,
+                        halo_blocks=cfg.cd_halo_blocks)
+                else:
+                    s2, _cd = asasmod.update(sw, cfg.asas)
+                return s2.replace(
+                    asas_tnext=sw.asas_tnext
+                    + jnp.asarray(cfg.asas.dtasas, sw.asas_tnext.dtype))
+            return _select_worlds(asas_due, jax.vmap(one)(s), s)
+
+        state = jax.lax.cond(jnp.any(asas_due), run_asas_worlds,
+                             lambda s: s, state)
+
+    # ---------- Pilot arbitration / perf / kinematics / noise ----------
+    def tail(sw, kt):
+        if cfg.use_wind:
+            windn, winde = windmod.getdata(sw.wind, sw.ac.lat,
+                                           sw.ac.lon, sw.ac.alt)
+        else:
+            windn = winde = None
+        sw = pilot.ap_or_asas(sw, windn, winde)
+        new_perf, bank = perfmod.update(sw.perf, sw.ac.tas, sw.ac.vs,
+                                        sw.ac.alt)
+        sw = sw.replace(perf=new_perf, ac=sw.ac.replace(bank=bank))
+        sw = pilot.apply_limits(sw)
+        accel = perfmod.acceleration(sw.perf.phase)
+        ac = kinematics.update_airspeed(sw.ac, sw.pilot, accel,
+                                        jnp.asarray(cfg.simdt,
+                                                    sw.simt.dtype))
+        ac = kinematics.update_groundspeed(ac, windn, winde)
+        ac = kinematics.update_position(ac, sw.pilot,
+                                        jnp.asarray(cfg.simdt,
+                                                    sw.simt.dtype))
+        ac = noise.turbulence_woosh(ac, kt, jnp.asarray(
+            cfg.simdt, sw.simt.dtype), cfg.noise)
+        live = ac.active
+        frz = lambda new, old: jnp.where(live, new, old)
+        ac = ac.replace(
+            lat=frz(ac.lat, sw.ac.lat), lon=frz(ac.lon, sw.ac.lon),
+            alt=frz(ac.alt, sw.ac.alt), hdg=frz(ac.hdg, sw.ac.hdg),
+            trk=frz(ac.trk, sw.ac.trk), tas=frz(ac.tas, sw.ac.tas),
+            gs=frz(ac.gs, sw.ac.gs), vs=frz(ac.vs, sw.ac.vs))
+        return sw.replace(ac=ac, simt=sw.simt + jnp.asarray(
+            cfg.simdt, sw.simt.dtype))
+
+    return jax.vmap(tail)(state, k_turb)
+
+
+def _scan_steps_worlds(state: SimState, cfg: SimConfig, nsteps: int,
+                       checked: bool):
+    """The chunk scan with a leading world axis: a scan of the batched
+    step (ONE scan, the batch dim pushed into the body), with the
+    integrity guard widened to a [W] vector of first-bad-step indices
+    (-1 clean) so a trip pins the (world, step) pair."""
+    vstep = lambda s: step_worlds(s, cfg)
+    if checked:
+        nworlds = state.simt.shape[0]
+        vfinite = jax.vmap(state_finite)
+
+        def body(carry, i):
+            s, bad = carry
+            s = vstep(s)
+            bad = jnp.where(bad >= 0, bad,
+                            jnp.where(vfinite(s), -1, i))
+            return (s, bad), None
+
+        (state, bad), _ = jax.lax.scan(
+            body, (state, jnp.full((nworlds,), -1, jnp.int32)),
+            jnp.arange(nsteps, dtype=jnp.int32))
+        return state, bad
+
+    def body(s, _):
+        return vstep(s), None
+
+    state, _ = jax.lax.scan(body, state, None, length=nsteps)
+    return state, None
+
+
+@partial(jax.jit, static_argnames=("cfg", "nsteps"), donate_argnums=0)
+def run_steps_worlds(state: SimState, cfg: SimConfig,
+                     nsteps: int) -> SimState:
+    """``run_steps`` over a stacked [W, ...] state: W scenarios advance
+    nsteps in one compiled scan.  W=1 is bit-identical to the unbatched
+    path (tests/test_worlds.py pins this)."""
+    _check_worlds_cfg(cfg)
+    state, _ = _scan_steps_worlds(state, cfg, nsteps, checked=False)
+    return state
+
+
+@partial(jax.jit, static_argnames=("cfg", "nsteps"), donate_argnums=0)
+def run_steps_worlds_checked(state: SimState, cfg: SimConfig,
+                             nsteps: int):
+    """Guarded multi-world scan: returns ``(state, bad)`` where ``bad``
+    is [W] int32 — per world, the FIRST step index whose post-step
+    state had a non-finite guarded value on a live row, or -1 for a
+    clean world.  One fused isfinite reduce per world per step; the
+    host response (rollback/quarantine) stays per-world because the
+    faulty (world, step) pair is pinned without re-running anything."""
+    _check_worlds_cfg(cfg)
+    return _scan_steps_worlds(state, cfg, nsteps, checked=True)
+
+
+def _edge_scan_worlds(state: SimState, cfg: SimConfig, nsteps: int,
+                      checked: bool):
+    state, bad = _scan_steps_worlds(state, cfg, nsteps, checked)
+    if bad is None:
+        bad = jnp.full((state.simt.shape[0],), -1, jnp.int32)
+    return state, jax.vmap(pack_telemetry)(state, bad)
+
+
+@partial(jax.jit, static_argnames=("cfg", "nsteps", "checked"),
+         donate_argnums=0)
+def run_steps_worlds_edge(state: SimState, cfg: SimConfig, nsteps: int,
+                          checked: bool = False):
+    """Multi-world ``run_steps_edge``: ``(state, EdgeTelemetry)`` with a
+    leading world axis on every telemetry field.  ``world_slice(telem,
+    w)`` is a plain per-world EdgeTelemetry — the serving layer demuxes
+    the pack back to the individual BATCH pieces with it."""
+    _check_worlds_cfg(cfg)
+    return _edge_scan_worlds(state, cfg, nsteps, checked)
+
+
+@partial(jax.jit, static_argnames=("cfg", "nsteps", "checked"))
+def run_steps_worlds_edge_keep(state: SimState, cfg: SimConfig,
+                               nsteps: int, checked: bool = False):
+    """``run_steps_worlds_edge`` without input donation (snapshot
+    capture overlapping the dispatched chunk, as run_steps_edge_keep)."""
+    _check_worlds_cfg(cfg)
+    return _edge_scan_worlds(state, cfg, nsteps, checked)

@@ -32,8 +32,8 @@ class IntegrityGuard:
         self.policy = str(getattr(settings, "guard_policy",
                                   "quarantine")).lower()
         self.trips = []           # [{simt, bad_step, ids, action}]
-        from ..utils import datalog
-        self.logger = datalog.defineLogger(
+        # per-sim registry: W multi-world sims keep separate FAULTLOGs
+        self.logger = sim.datalog.define_event(
             "FAULTLOG", "State-integrity guard trips: acid, action")
 
     def reset(self):

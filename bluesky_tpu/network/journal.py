@@ -39,6 +39,15 @@ Record types (one JSON object per line, ``rec`` selects the type):
   ``resumed``     {pending, completed, quarantined}  replay marker
   ``shutdown``    {}                        clean server exit
 
+Packed world-batches (WORLDS packing, network/server.py): a pack of W
+compatible pieces dispatches to ONE worker; its ``dispatched`` records
+carry ``world`` (index in the pack) and ``pack`` (pack size), and each
+per-world completion journals its OWN ``completed`` record (``world``
+audit field) as the worker's BATCHWORLD events arrive.  Replay needs no
+pack awareness: owed copies stay queued-minus-completed per content
+key, so a crash mid-pack requeues exactly the worlds whose pieces never
+completed.
+
 Piece identity is content-addressed (sha256 over the canonical JSON of
 ``(scentime, scencmd)``), so keys are stable across restarts and across
 servers.
@@ -131,13 +140,22 @@ class BatchJournal:
         sweeps."""
         self._write([self._queued_rec(p) for p in pieces])
 
-    def dispatched(self, piece, worker: bytes = b""):
-        self.append("dispatched", key=self.piece_key(piece),
-                    worker=worker.hex())
+    def dispatched(self, piece, worker: bytes = b"", world=None,
+                   pack=None):
+        """``world``/``pack`` mark a piece dispatched INSIDE a packed
+        world-batch (world index, pack size) — audit detail only:
+        replay folds packed pieces exactly like solo ones (queued minus
+        completed per content key)."""
+        rec = dict(key=self.piece_key(piece), worker=worker.hex())
+        if world is not None:
+            rec.update(world=int(world), pack=int(pack or 0))
+        self.append("dispatched", **rec)
 
-    def completed(self, piece, worker: bytes = b""):
-        self.append("completed", key=self.piece_key(piece),
-                    worker=worker.hex())
+    def completed(self, piece, worker: bytes = b"", world=None):
+        rec = dict(key=self.piece_key(piece), worker=worker.hex())
+        if world is not None:
+            rec["world"] = int(world)
+        self.append("completed", **rec)
 
     def crashed(self, piece, crashes: int):
         self.append("crashed", key=self.piece_key(piece),
@@ -147,9 +165,11 @@ class BatchJournal:
         self.append("quarantined", key=self.piece_key(piece),
                     crashes=int(crashes))
 
-    def preempted(self, piece, worker: bytes = b""):
-        self.append("preempted", key=self.piece_key(piece),
-                    worker=worker.hex())
+    def preempted(self, piece, worker: bytes = b"", world=None):
+        rec = dict(key=self.piece_key(piece), worker=worker.hex())
+        if world is not None:
+            rec["world"] = int(world)
+        self.append("preempted", **rec)
 
     def hedged(self, piece, worker: bytes = b"",
                hedge_worker: bytes = b""):

@@ -160,6 +160,29 @@ class FairQueue:
         return self._flat()[i]
 
 
+class WorldPack:
+    """A packed world-batch assignment: n compatible BATCH pieces in
+    flight on ONE worker, stepped there as a single stacked device
+    program (simulation/worlds.py).  The server tracks per-world
+    completion (``done``: world index -> status) from the worker's
+    ``BATCHWORLD`` events so demux back to the individual pieces is
+    exactly-once — a crash mid-pack requeues only the worlds whose
+    pieces never completed."""
+
+    def __init__(self, picks):
+        self.owners = [o for o, _ in picks]
+        self.pieces = [p for _, p in picks]
+        self.done = {}                     # world index -> status str
+
+    def __len__(self):
+        return len(self.pieces)
+
+    def remaining(self):
+        """(world, owner, piece) for every world not yet demuxed."""
+        return [(i, self.owners[i], self.pieces[i])
+                for i in range(len(self.pieces)) if i not in self.done]
+
+
 class Server(threading.Thread):
     """Runs the broker loop in a thread (reference: Server(Thread))."""
 
@@ -169,7 +192,8 @@ class Server(threading.Thread):
                  restart_crashed=True, max_piece_crashes=None,
                  journal_path=None, resume_journal=None,
                  straggler_timeout=None, hedge_enabled=None,
-                 batch_queue_max=None):
+                 batch_queue_max=None, world_pack=None,
+                 world_batch_max=None):
         super().__init__(daemon=True)
         self.server_id = make_id()
         self.headless = headless
@@ -226,6 +250,22 @@ class Server(threading.Thread):
             else getattr(_settings, "batch_queue_max", 4096)
         self.hb_busy_multiplier = getattr(_settings,
                                           "hb_busy_multiplier", 10.0)
+        # ----- multi-world packing (docs/PERF_ANALYSIS.md §multi-world):
+        # compatible BATCH pieces are packed into world-batches — one
+        # worker steps W scenarios per device dispatch — and demuxed
+        # back per piece.  WORLDS stack/client command flips at runtime.
+        self.world_pack = world_pack if world_pack is not None \
+            else bool(getattr(_settings, "world_pack", False))
+        self.world_batch_max = world_batch_max \
+            if world_batch_max is not None \
+            else int(getattr(_settings, "world_batch_max", 8))
+        self.packed_pieces = 0             # pieces dispatched inside packs
+        self.world_batches = 0             # packed dispatches sent
+        self._pack_fill_sum = 0.0          # sum of per-dispatch fill
+        self.worlds_refused_spatial = 0    # spatial pieces kept out of packs
+        self.worlds_failed = 0             # per-world failure reports
+        self.worlds_demux_s = 0.0          # host time spent demuxing
+        self.worlds_demux_events = 0
         self.worker_progress = {}          # wid -> {simt, chunks, rate,
         #                                    t (last report), advance_t}
         self.hedge_by = {}                 # primary wid -> hedge wid
@@ -357,12 +397,26 @@ class Server(threading.Thread):
 
     @staticmethod
     def _piece_name(piece):
+        if isinstance(piece, WorldPack):
+            return (f"worlds[{len(piece.done)}/{len(piece)} done: "
+                    + ", ".join(Server._piece_name(p)
+                                for p in piece.pieces[:4])
+                    + (", ..." if len(piece) > 4 else "") + "]")
         for cmd in piece[1]:
             c = cmd.strip()
             if c.upper().startswith("SCEN"):
                 parts = c.split(None, 1)
                 return parts[1] if len(parts) > 1 else c
         return f"<{len(piece[1])}-command piece>"
+
+    @staticmethod
+    def _piece_spatial(piece):
+        """Does this piece request the spatial shard mode?  Spatial
+        stripes are a per-world layout property and compose with the
+        world axis later, not now — packing refuses such pieces with a
+        structured echo (WORLDSREFUSED) and dispatches them solo."""
+        return any("SHARD" in c.upper() and "SPATIAL" in c.upper()
+                   for c in piece[1])
 
     def _report_clients(self, text, name=b"ECHO", data=None):
         """Fan a server-originated event out to every connected client
@@ -391,13 +445,27 @@ class Server(threading.Thread):
         ``max_piece_crashes`` consecutive times, in which case it is
         circuit-broken: quarantined server-side and reported to every
         client (ECHO + a machine-readable BATCHQUARANTINE event)
-        instead of being requeued into an infinite crash loop."""
+        instead of being requeued into an infinite crash loop.
+
+        A lost WORLD-PACK demuxes first: only the worlds whose pieces
+        never completed (no ``BATCHWORLD`` ack, no ``completed``
+        journal record) are requeued/striked — the finished worlds'
+        pieces stay exactly-once done."""
         self._cancel_pending.pop(wid, None)
         piece = self.inflight.pop(wid, None)
         owner = self.inflight_owner.pop(wid, b"")
         self.inflight_t.pop(wid, None)
         self.worker_progress.pop(wid, None)
         if piece is None:
+            return
+        if isinstance(piece, WorldPack):
+            lost = piece.remaining()
+            print(f"server: packed worker {wid.hex()} lost — "
+                  f"{len(piece.done)}/{len(piece)} world(s) were "
+                  f"complete, requeueing {len(lost)}")
+            # reversed: push_front per piece keeps the original order
+            for _i, powner, p in reversed(lost):
+                self._piece_failed(p, powner)
             return
         if self._drop_hedge_links(wid) is not None:
             # the hedge partner still runs a copy of this piece: the
@@ -407,6 +475,12 @@ class Server(threading.Thread):
             print(f"server: hedged worker {wid.hex()} lost — partner "
                   f"still running the piece, no requeue")
             return
+        self._piece_failed(piece, owner)
+
+    def _piece_failed(self, piece, owner=b""):
+        """One circuit-breaker strike against a piece (its worker died
+        or its world failed): requeue it, or quarantine it once it has
+        struck out ``max_piece_crashes`` consecutive times."""
         key = self._piece_key(piece)
         count = self.piece_crashes.get(key, 0) + 1
         self.piece_crashes[key] = count
@@ -502,7 +576,26 @@ class Server(threading.Thread):
                 # (parity: server.py:234-247)
                 if state < 2:
                     piece = self.inflight.pop(sender, None)
-                    if piece is not None:   # piece completed cleanly:
+                    if isinstance(piece, WorldPack):
+                        # packed piece retired cleanly: per-world
+                        # BATCHWORLD events arrived first (FIFO pair),
+                        # so normally nothing remains — but a world the
+                        # worker finished without reporting is counted
+                        # completed exactly once HERE, never dropped
+                        t0 = time.perf_counter()
+                        self.inflight_owner.pop(sender, None)
+                        self.inflight_t.pop(sender, None)
+                        for i, _owner, p in piece.remaining():
+                            piece.done[i] = "completed"
+                            self.piece_crashes.pop(self._piece_key(p),
+                                                   None)
+                            if self.journal:
+                                self.journal.completed(p, sender,
+                                                       world=i)
+                        self._completion_stamps.append(time.monotonic())
+                        self.worlds_demux_s += time.perf_counter() - t0
+                        self.worlds_demux_events += 1
+                    elif piece is not None:   # piece completed cleanly:
                         # reset its consecutive-crash count
                         self.inflight_owner.pop(sender, None)
                         self.inflight_t.pop(sender, None)
@@ -535,6 +628,44 @@ class Server(threading.Thread):
             data = unpackb(payload) if payload else None
             if isinstance(data, dict) and "simt" in data:
                 self._note_progress(sender, data)
+        elif name == b"BATCHWORLD" and from_worker:
+            # per-world completion report from a packed assignment: the
+            # demux leg of exactly-once — journal THAT piece completed
+            # (or strike/requeue it on a per-world failure) while the
+            # rest of the pack keeps running
+            t0 = time.perf_counter()
+            pack = self.inflight.get(sender)
+            data = unpackb(payload) if payload else None
+            if isinstance(pack, WorldPack) and isinstance(data, dict):
+                i = int(data.get("world", -1))
+                status = str(data.get("status", "completed"))
+                if 0 <= i < len(pack) and i not in pack.done:
+                    pack.done[i] = status
+                    p = pack.pieces[i]
+                    if status == "completed":
+                        self.piece_crashes.pop(self._piece_key(p), None)
+                        self._completion_stamps.append(time.monotonic())
+                        if self.journal:
+                            self.journal.completed(p, sender, world=i)
+                    else:
+                        self.worlds_failed += 1
+                        self._report_clients(
+                            f"world {i} of packed piece on worker "
+                            f"{sender.hex()} {status} — piece striked")
+                        self._piece_failed(p, pack.owners[i])
+                    self.worlds_demux_s += time.perf_counter() - t0
+                    self.worlds_demux_events += 1
+        elif name == b"WORLDS":
+            # WORLDS stack/client command: set the packing knobs
+            # (payload dict) and/or read them back HEALTH-style
+            data = unpackb(payload) if payload else None
+            if isinstance(data, dict):
+                if "pack" in data:
+                    self.world_pack = bool(data["pack"])
+                if "max" in data:
+                    self.world_batch_max = max(1, int(data["max"]))
+            sock.send_multipart(
+                [sender, b"WORLDS", packb(self.worlds_payload())])
         elif name == b"BATCHCANCELLED" and from_worker:
             # hedge loser acked the cancel (it had NOT completed: a
             # completion would have arrived first on the FIFO pair)
@@ -553,6 +684,18 @@ class Server(threading.Thread):
             piece = self.inflight.pop(sender, None)
             owner = self.inflight_owner.pop(sender, b"")
             self.inflight_t.pop(sender, None)
+            if isinstance(piece, WorldPack):
+                # preemption mid-pack is capacity churn, not a piece
+                # fault: requeue ONLY the unfinished worlds' pieces,
+                # no circuit-breaker strikes (completed worlds were
+                # already journaled by their BATCHWORLD events)
+                for i, powner, p in reversed(piece.remaining()):
+                    self.scenarios.push_front(p, powner)
+                    if self.journal:
+                        self.journal.preempted(p, sender, world=i)
+                while self.avail_workers and self.scenarios:
+                    self._send_pending_scenario()
+                piece = None
             if piece is not None and self._drop_hedge_links(sender) \
                     is not None:
                 # the hedge partner still runs this piece — a preempted
@@ -613,21 +756,87 @@ class Server(threading.Thread):
                 self.fe_event.send_multipart([cid, sender, name, payload])
 
     def _send_pending_scenario(self):
-        if self.avail_workers and self.scenarios:
-            wid = self.avail_workers.pop(0)
+        if not (self.avail_workers and self.scenarios):
+            return
+        wid = self.avail_workers.pop(0)
+        # World packing (WORLDS command / settings.world_pack): fill up
+        # to world_batch_max compatible pieces into ONE assignment.
+        # Compatibility is per worker-bucket by construction (every
+        # world sim shares the worker's nmax); a piece requesting
+        # shard_mode=spatial never joins a pack — it dispatches solo
+        # with a structured WORLDSREFUSED echo instead of a crash.
+        wmax = max(1, int(self.world_batch_max)) if self.world_pack \
+            else 1
+        if wmax > 1 and self.avail_workers:
+            # spread across the idle fleet: pack only the share the
+            # OTHER idle workers can't take — packing exists to
+            # oversubscribe one device, not to starve idle ones
+            share = -(-len(self.scenarios)
+                      // (len(self.avail_workers) + 1))
+            wmax = max(1, min(wmax, share))
+        picks = []
+        while len(picks) < wmax and self.scenarios:
             owner, piece = self.scenarios.pop_next()
+            if self.world_pack and wmax > 1 \
+                    and self._piece_spatial(piece) and picks:
+                # pack already filling: refuse the spatial piece from
+                # THIS pack with a structured echo — exactly once,
+                # because the piece keeps its fairness turn and takes
+                # the worker SOLO (a requeue would let the FairQueue
+                # rotation re-refuse it on every pack fill); the
+                # pieces already picked go back to their owners' queue
+                # heads and pack on the next idle worker.  A spatial
+                # piece popped with the pack still empty just takes
+                # the 1-piece solo path below: nothing was refused.
+                self.worlds_refused_spatial += 1
+                pname = self._piece_name(piece)
+                msg = (f"WORLDS: piece '{pname}' requests "
+                       "shard_mode=spatial — refused from the world-"
+                       "batch, dispatching it unpacked (world-batching "
+                       "and spatial stripes compose later, not now)")
+                print(f"server: {msg}")
+                self._report_clients(msg)
+                self._report_clients(
+                    msg, name=b"WORLDSREFUSED",
+                    data={"piece": pname, "reason": "shard_mode=spatial",
+                          "scencmd": list(piece[1])})
+                for powner, p in reversed(picks):
+                    self.scenarios.push_front(p, powner)
+                picks = [(owner, piece)]
+                break
+            picks.append((owner, piece))
+            if self.world_pack and wmax > 1 \
+                    and self._piece_spatial(piece):
+                break    # spatial piece dispatches solo, never packs
+        self.inflight_t[wid] = time.monotonic()
+        prog = self.worker_progress.get(wid)
+        if prog is not None:               # straggler clock restarts at
+            prog["advance_t"] = self.inflight_t[wid]   # dispatch
+        if len(picks) == 1:
+            owner, piece = picks[0]
             self.inflight[wid] = piece     # held until the worker leaves OP
             self.inflight_owner[wid] = owner
-            self.inflight_t[wid] = time.monotonic()
-            prog = self.worker_progress.get(wid)
-            if prog is not None:           # straggler clock restarts at
-                prog["advance_t"] = self.inflight_t[wid]   # dispatch
             if self.journal:
                 self.journal.dispatched(piece, wid)
             scentime, scencmd = piece
             self.be_event.send_multipart(
                 [wid, b"BATCH", packb({"scentime": scentime,
                                        "scencmd": scencmd})])
+            return
+        pack = WorldPack(picks)
+        self.inflight[wid] = pack
+        self.inflight_owner[wid] = b""     # owners tracked per world
+        self.packed_pieces += len(pack)
+        self.world_batches += 1
+        self._pack_fill_sum += len(pack) / wmax
+        if self.journal:
+            for i, (_owner, p) in enumerate(picks):
+                self.journal.dispatched(p, wid, world=i,
+                                        pack=len(pack))
+        self.be_event.send_multipart(
+            [wid, b"BATCH",
+             packb({"worlds": [{"scentime": p[0], "scencmd": p[1]}
+                               for _o, p in picks]})])
 
     # ------------------------------------------- stragglers / introspection
     def _note_progress(self, wid, data):
@@ -684,6 +893,10 @@ class Server(threading.Thread):
         for wid, piece in list(self.inflight.items()):
             if not self.avail_workers:
                 return
+            if isinstance(piece, WorldPack):
+                continue                   # packs are not hedged: a
+                #                            second copy would duplicate
+                #                            W pieces for one straggler
             if wid in self.hedge_by or wid in self.hedge_of:
                 continue                   # one hedge per piece
             prog = self.worker_progress.get(wid)
@@ -763,6 +976,34 @@ class Server(threading.Thread):
             return round(min(max(n_new / rate, 1.0), 600.0), 1)
         return float(getattr(_settings, "batch_retry_after", 5.0))
 
+    def worlds_payload(self):
+        """Machine-readable world-batch state (the ``WORLDS`` command):
+        packing knobs + packed-dispatch counters, with a human ``text``
+        rendering — the HEALTH-style readback contract."""
+        avg_fill = self._pack_fill_sum / self.world_batches \
+            if self.world_batches else 0.0
+        demux_ms = 1e3 * self.worlds_demux_s / self.worlds_demux_events \
+            if self.worlds_demux_events else 0.0
+        d = {"pack": bool(self.world_pack),
+             "batch_max": int(self.world_batch_max),
+             "world_batches": self.world_batches,
+             "packed_pieces": self.packed_pieces,
+             "fill_ratio": round(avg_fill, 3),
+             "refused_spatial": self.worlds_refused_spatial,
+             "worlds_failed": self.worlds_failed,
+             "demux_events": self.worlds_demux_events,
+             "demux_ms_avg": round(demux_ms, 3)}
+        d["text"] = (
+            f"WORLDS: packing {'ON' if d['pack'] else 'OFF'}, max "
+            f"{d['batch_max']} pieces/dispatch; {d['world_batches']} "
+            f"world-batch(es) sent carrying {d['packed_pieces']} "
+            f"piece(s), fill {d['fill_ratio']:.0%}; "
+            f"{d['refused_spatial']} spatial refusal(s), "
+            f"{d['worlds_failed']} world failure(s); demux "
+            f"{d['demux_events']} event(s), avg {d['demux_ms_avg']:.2f} "
+            "ms")
+        return d
+
     def health_payload(self):
         """Machine-readable serving-fabric health (the ``HEALTH``
         command): queue depth and per-client split, per-worker
@@ -807,6 +1048,8 @@ class Server(threading.Thread):
             "quarantined": len(self.quarantined),
             "straggler_timeout": self.straggler_timeout,
             "hedge_enabled": bool(self.hedge_enabled),
+            "worlds": {k: v for k, v in self.worlds_payload().items()
+                       if k != "text"},
         }
         data["text"] = self._health_text(data)
         return data
@@ -825,6 +1068,15 @@ class Server(threading.Thread):
                  f"admission: {d['rejected_batches']} BATCH submission"
                  f"(s) rejected; stream drops: {d['stream_drops']}; "
                  f"quarantined: {d['quarantined']}"]
+        w = d.get("worlds")
+        if w:
+            lines.append(
+                f"worlds: packing {'ON' if w['pack'] else 'OFF'} "
+                f"(max {w['batch_max']}), {w['world_batches']} "
+                f"batch(es)/{w['packed_pieces']} packed piece(s), "
+                f"fill {w['fill_ratio']:.0%}, "
+                f"{w['refused_spatial']} spatial refusal(s), "
+                f"demux avg {w['demux_ms_avg']:.2f} ms")
         for wid, w in d["workers"].items():
             line = (f"  {wid[:8]}: state {w['state']}, "
                     f"hb {w['hb_age']:.1f}s ago")

@@ -205,8 +205,7 @@ class PluginManager:
                               stackfuns.items()]
         # Loggers the plugin created get their auto stack command
         # (FLSTLOG ON/OFF...; datalog.py:106-110 contract)
-        from ..utils import datalog
-        datalog.register_stack_commands(self.sim)
+        self.sim.datalog.register_stack_commands(self.sim)
         return True, f"Successfully loaded plugin {name}"
 
     def remove(self, name):

@@ -70,8 +70,7 @@ class Area:
         self.work = np.zeros(nmax)
         self.create_time = np.zeros(nmax)
         self.last_t = float(sim.simt)
-        from ..utils import datalog
-        self.logger = datalog.defineLogger("FLSTLOG", FLST_HEADER)
+        self.logger = sim.datalog.define_event("FLSTLOG", FLST_HEADER)
         traf.create_hooks.append(self.on_create)
         traf.delete_hooks.append(self.on_delete)
 

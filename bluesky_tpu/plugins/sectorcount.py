@@ -32,8 +32,7 @@ class SectorCount:
         self.sim = sim
         self.sectors = []
         self.previnside = []
-        from ..utils import datalog
-        self.logger = datalog.defineLogger(
+        self.logger = sim.datalog.define_event(
             "OCCUPANCYLOG", "Sector count log: sector, count, "
             "entered, left")
 

@@ -102,6 +102,19 @@ stream_sndhwm = 1000              # [msgs] send buffer bound on the stream
 quarantine_report_cap = 64        # BATCHQUARANTINE replay history kept
                                   # for late-joining clients
 
+# ----- multi-world serving (docs/PERF_ANALYSIS.md §multi-world)
+world_pack = False                # pack compatible BATCH pieces into
+                                  # world-batches: one worker steps W
+                                  # scenarios per device dispatch
+                                  # (vmapped world axis, core/step.py).
+                                  # WORLDS stack command at runtime.
+world_batch_max = 8               # max pieces per world-batch dispatch
+                                  # (the per-bucket packing width; 1 =
+                                  # packing effectively off).  Every
+                                  # (nmax-bucket, chunk-length) pair
+                                  # compiles one stacked scan program
+                                  # per distinct W it sees.
+
 # ----- multi-chip decomposition (docs/PERF_ANALYSIS.md §multi-chip)
 shard_mode = "off"                # "off" | "replicate" (row-interleaved
                                   # kernels vs replicated O(N) columns) |

@@ -175,14 +175,32 @@ class Simulation:
 
     def __init__(self, nmax: int = 1024, wmax: int = 32, dtype=None,
                  openap_path: Optional[str] = None, rng_seed: int = 0,
-                 chunk_steps: Optional[int] = None):
+                 chunk_steps: Optional[int] = None,
+                 datalog_registry=None, world_tag: str = ""):
         dtype = dtype or jnp.float32
+        # Multi-world identity (simulation/worlds.py): a non-empty tag
+        # marks this sim as one world of a packed BATCH piece — spliced
+        # into preempt-checkpoint filenames and log output so W worlds
+        # sharing a process never collide on disk.
+        self.world_tag = str(world_tag)
+        # per-process uniquifier for on-disk names when this sim has no
+        # .node of its own (world sims of a packed piece): the runner
+        # sets it to the owning worker's node id so two workers sharing
+        # a snapshot dir never clobber each other's checkpoints
+        self.host_tag = ""
         self.traf = Traffic(nmax=nmax, wmax=wmax, dtype=dtype,
                             openap_path=openap_path, rng_seed=rng_seed)
         self.routes = RouteManager(self.traf, wmax)
         self.scr = Screen()
         self.cfg = SimConfig()
         self.state_flag = INIT
+        # Per-sim datalog registry (utils/datalog.LogRegistry): assigned
+        # BEFORE metrics/guard construction — both define event loggers
+        # into it.  Standalone sims share the process default registry;
+        # multi-world sims get their own tagged one.
+        from ..utils import datalog as _datalog
+        self.datalog = datalog_registry if datalog_registry is not None \
+            else _datalog.default_registry()
         from .. import settings as _pipe_settings
         # Interactive device-chunk length: settings knob + CHUNKSTEPS
         # stack command (ctor arg overrides for embedded use)
@@ -298,13 +316,13 @@ class Simulation:
         for pname in getattr(_settings, "enabled_plugins", []):
             self.plugins.load(pname.upper())
         # Periodic loggers (reference traffic.py:86-89 defaults: SNAPLOG/
-        # INSTLOG/SKYLOG) + their auto-registered stack commands.
-        from ..utils import datalog
+        # INSTLOG/SKYLOG) + their auto-registered stack commands, in
+        # this sim's own registry.
         for name, dt in (("SNAPLOG", 30.0), ("INSTLOG", 30.0),
                          ("SKYLOG", 60.0)):
-            if datalog.getlogger(name) is None:
-                datalog.definePeriodicLogger(name, f"{name} logfile.", dt)
-        datalog.register_stack_commands(self)
+            if self.datalog.getlogger(name) is None:
+                self.datalog.define_periodic(name, f"{name} logfile.", dt)
+        self.datalog.register_stack_commands(self)
 
     @property
     def navdb(self):
@@ -405,8 +423,7 @@ class Simulation:
     def stop(self):
         self._retire_edge("stop")
         self.state_flag = END
-        from ..utils import datalog
-        datalog.reset()
+        self.datalog.reset()
         return True
 
     def reset_traffic(self):
@@ -445,8 +462,7 @@ class Simulation:
         self.dtmult = 1.0
         self.ffmode = False
         self.stack.reset()
-        from ..utils import datalog
-        datalog.reset()
+        self.datalog.reset()
         self.scr.reset()
         self.metrics.reset()
         self.snap_ring.clear()
@@ -575,7 +591,11 @@ class Simulation:
         d = getattr(_settings, "preempt_snapshot_dir", "") \
             or _settings.log_path
         tag = getattr(getattr(self, "node", None), "node_id",
-                      b"").hex()[:8] or "sim"
+                      b"").hex()[:8] or self.host_tag or "sim"
+        if self.world_tag:
+            # one checkpoint file per world of a packed piece — W
+            # worlds sharing a process must not clobber one path
+            tag = f"{tag}-{self.world_tag}"
         path = os.path.join(d, f"preempt-{tag}.snap")
         self.pause()
         try:
@@ -652,7 +672,33 @@ class Simulation:
         """
         if self.state_flag == END:
             return False
+        plan = self._plan_chunk(max_chunk)
+        if plan is None:
+            return True
+        chunk, simt = plan
 
+        reasons = self._sync_reasons(simt, chunk)
+        if reasons:
+            self._retire_edge(reasons[0])
+            self.pipe_stats["sync_reasons"][reasons[0]] = \
+                self.pipe_stats["sync_reasons"].get(reasons[0], 0) + 1
+            self._step_sync(chunk, self.simt)
+        else:
+            self._step_pipelined(chunk, simt)
+
+        self._after_chunk()
+        return True
+
+    def _plan_chunk(self, max_chunk: Optional[int] = None):
+        """The host pre-chunk phase of ``step()``: pump external command
+        sources, process the stack, decide whether a device chunk runs
+        this iteration and how long it is.  Returns ``(chunk, simt)``
+        ready for dispatch, or ``None`` when this iteration is already
+        handled without a chunk (HOLD, straggle stall/debt, FF horizon
+        reached, stack-only work).  Split out of ``step()`` so the
+        multi-world runner (simulation/worlds.py) can plan every
+        world's chunk first and dispatch the compatible ones as ONE
+        stacked device program."""
         if self._shard_fallback:
             self._shard_fallback = False
             nd = self.shard_mesh.shape["ac"] if self.shard_mesh else 0
@@ -682,7 +728,7 @@ class Simulation:
 
         if self.state_flag != OP:
             self._retire_edge("hold")
-            return True
+            return None
 
         # FAULT STRAGGLE STALL: skip the device chunk entirely — simt
         # freezes while the host loop keeps pumping events, so progress
@@ -691,7 +737,7 @@ class Simulation:
         # on (a SILENT worker is the watchdog/busy-budget case instead).
         if self.straggle_stall:
             time.sleep(0.02)
-            return True
+            return None
 
         # FAULT STRAGGLE <factor>: pay outstanding throttle debt in
         # SMALL slices, one per host-loop iteration, instead of one
@@ -703,7 +749,7 @@ class Simulation:
             pay = min(self._straggle_debt, 0.05)
             self._straggle_debt -= pay
             time.sleep(pay)
-            return True
+            return None
 
         # Benchmark bookkeeping
         if self.benchdt > 0.0 and self.bencht == 0.0:
@@ -772,7 +818,7 @@ class Simulation:
             steps_to_stop = int(round((self.ffstop - simt) / self.cfg.simdt))
             if steps_to_stop <= 0:
                 self._end_ff()
-                return True
+                return None
             limit = min(limit, steps_to_stop)
         # Quantize to the ladder — EXCEPT when the binding constraint is
         # a dt clamp, which runs exactly (a 0.1 s plugin interval gives
@@ -815,19 +861,14 @@ class Simulation:
             # cannot be trusted past them
             self._last_edge = None
 
-        reasons = self._sync_reasons(simt, chunk)
-        if reasons:
-            self._retire_edge(reasons[0])
-            self.pipe_stats["sync_reasons"][reasons[0]] = \
-                self.pipe_stats["sync_reasons"].get(reasons[0], 0) + 1
-            self._step_sync(chunk, self.simt)
-        else:
-            self._step_pipelined(chunk, simt)
+        return chunk, simt
 
+    def _after_chunk(self):
+        """Post-dispatch horizon check shared by ``step()`` and the
+        multi-world runner."""
         if self.ffstop is not None \
                 and self.simt_planned >= self.ffstop - 1e-9:
             self._end_ff()
-        return True
 
     # ------------------------------------------------- chunk dispatch/edges
     def _sync_reasons(self, simt: float, chunk: int):
@@ -851,8 +892,7 @@ class Simulation:
             reasons.append("plot")          # PLOT samples live attrs
         if self.plugins.has_due(t_edge):
             reasons.append("plugin")        # update hook at the edge
-        from ..utils import datalog
-        if datalog.any_due(t_edge):
+        if self.datalog.any_due(t_edge):
             reasons.append("datalog")       # periodic logger samples
         if self.ffstop is not None and t_edge >= self.ffstop - 1e-9:
             reasons.append("ff-stop")       # _end_ff timing boundary
@@ -876,6 +916,15 @@ class Simulation:
         the *input* state buffers to stay valid (snapshot-ring capture
         overlapping the dispatched chunk).
         """
+        state = self._pre_dispatch_refresh(state, simt)
+        from ..core.step import run_steps_edge, run_steps_edge_keep
+        runner = run_steps_edge_keep if keep else run_steps_edge
+        return runner(state, self.cfg, chunk, checked=self.guard.enabled)
+
+    def _pre_dispatch_refresh(self, state, simt: float):
+        """The (due) chunk-edge spatial-sort refresh — split from
+        ``_dispatch_chunk`` so the multi-world runner can refresh each
+        world's layout before stacking them into one joint dispatch."""
         if self.cfg.cd_backend in ("tiled", "pallas", "sparse"):
             due = self.cfg.asas.sort_every * self.cfg.asas.dtasas
             # Also force a refresh when the backend changed: 'sparse'
@@ -896,9 +945,7 @@ class Simulation:
                         impl=impl_for_backend(self.cfg.cd_backend))
                 self._sort_simt = simt
                 self._sort_backend = self.cfg.cd_backend
-        from ..core.step import run_steps_edge, run_steps_edge_keep
-        runner = run_steps_edge_keep if keep else run_steps_edge
-        return runner(state, self.cfg, chunk, checked=self.guard.enabled)
+        return state
 
     def _fold_clock(self, t0: float, chunk: int) -> float:
         """Predict the device clock after ``chunk`` steps by folding the
@@ -949,6 +996,15 @@ class Simulation:
         self.pipe_stats["sync_chunks"] += 1
         state, telem = self._dispatch_chunk(self.traf.state, chunk,
                                             keep=False, simt=simt)
+        self._apply_chunk_result(state, telem, chunk)
+
+    def _apply_chunk_result(self, state, telem, chunk: int):
+        """Install one synchronously-completed chunk's result and run
+        every edge subsystem against it — the post-dispatch half of
+        ``_step_sync``.  The multi-world runner calls this per world
+        with that world's slice of the joint stacked dispatch, so guard
+        response (rollback/quarantine), conditionals, trails, loggers
+        and ring captures all stay per-world."""
         self.traf.state = state
         self._step_count += chunk
         self._straggle_charge(chunk)
@@ -982,8 +1038,7 @@ class Simulation:
         self.plotter.update(self.simt)
         self.metrics.update()
         self.traf.trails.update(self.simt)
-        from ..utils import datalog
-        datalog.postupdate(self)
+        self.datalog.postupdate(self)
         if plugins_due:
             self._last_edge = None
 

@@ -28,6 +28,13 @@ def _make_simnode_class(base):
             self.sim = Simulation(**simkw)
             self.sim.scr = ScreenIO(self.sim, self)
             self.sim.node = self
+            # Packed multi-world BATCH (simulation/worlds.py): the
+            # server may dispatch a world-batch of compatible pieces as
+            # ONE assignment; while it runs, step() drives the runner
+            # instead of the main sim.  Construction kwargs are kept so
+            # every world sim shares the worker's nmax bucket.
+            self.worlds = None
+            self._world_simkw = dict(simkw)
             # Subsystems constructed before the swap hold the headless
             # Screen; repoint them at the streaming ScreenIO
             self.sim.areas.scr = self.sim.scr
@@ -75,6 +82,46 @@ def _make_simnode_class(base):
             sim.stop()
             self.quit()
 
+        # ------------------------------------------------------ multi-world
+        def _start_worlds(self, worlds_payload):
+            """A packed BATCH assignment: run the worlds through the
+            joint-dispatch WorldBatch runner.  Per-world completion is
+            reported upstream as ``BATCHWORLD`` events the server
+            journals per piece (exactly-once demux); per-world echo
+            output streams with a ``[wNN]`` prefix."""
+            from .worlds import WorldBatch
+            self.sim.reset()
+            pieces = [(p["scentime"], p["scencmd"])
+                      for p in worlds_payload]
+            self.worlds = WorldBatch(
+                pieces, simkw=self._world_simkw,
+                host_tag=self.node_id.hex()[:8],
+                on_world_done=lambda w, status, info=None:
+                    self.send_event(b"BATCHWORLD",
+                                    dict({"world": w, "status": status},
+                                         **(info or {}))),
+                on_echo=lambda w, text:
+                    self.sim.scr.echo(f"[w{w:02d}] {text}"))
+            self.prev_state = OP
+            self.send_event(b"STATECHANGE", OP)
+
+        def _finish_worlds(self):
+            self.worlds = None
+            self.prev_state = HOLD
+            self.send_event(b"STATECHANGE", HOLD)
+
+        def _preempt_worlds(self):
+            """Preemption mid-pack: checkpoint every active world (one
+            tagged file each), tell the server which worlds were
+            already done (only the unfinished pieces requeue) and
+            leave cleanly."""
+            self.sim.preempt_requested = False
+            info = self.worlds.handle_preempt()
+            self.send_event(b"PREEMPTED", info)
+            self.worlds = None
+            self.sim.stop()
+            self.quit()
+
         # --------------------------------------------------------- heartbeat
         def heartbeat_payload(self, stamp):
             """Progress piggybacked on the PONG reply: sim-time and
@@ -83,6 +130,12 @@ def _make_simnode_class(base):
             long device chunk or first compile (no heartbeats at all —
             this loop is blocked, and the busy-PING budget applies)."""
             sim = self.sim
+            if self.worlds is not None:
+                # packed piece: aggregate progress — the slowest active
+                # world's clock advances monotonically while the pack
+                # runs, which is exactly the advance signal the
+                # straggler detector needs
+                return dict({"stamp": stamp}, **self.worlds.progress())
             # "ff" gates the server's RATE-based hedging: sim-s/wall-s
             # is only comparable across workers running full speed — a
             # wall-clock-paced piece reports ~dtmult by design, which
@@ -120,9 +173,13 @@ def _make_simnode_class(base):
                 self.send_event(b"STEP", None,
                                 list(reversed(sender_route)) or None)
             elif name == b"BATCH":
-                sim.reset()
-                sim.stack.set_scendata(data["scentime"], data["scencmd"])
-                sim.op()
+                if isinstance(data, dict) and data.get("worlds"):
+                    self._start_worlds(data["worlds"])
+                else:
+                    sim.reset()
+                    sim.stack.set_scendata(data["scentime"],
+                                           data["scencmd"])
+                    sim.op()
             elif name == b"BATCHCANCEL":
                 # the server hedged this piece and the other copy won:
                 # ack FIRST (the FIFO event pair is how the server
@@ -130,6 +187,10 @@ def _make_simnode_class(base):
                 # abandon the piece — the reset's STATECHANGE makes
                 # this worker available again
                 self.send_event(b"BATCHCANCELLED", None)
+                if self.worlds is not None:
+                    self.worlds = None
+                    self.prev_state = sim.state_flag
+                    self.send_event(b"STATECHANGE", HOLD)
                 sim.reset()
             elif name == b"BATCHREJECTED":
                 d = data or {}
@@ -142,6 +203,11 @@ def _make_simnode_class(base):
                 txt = data.get("text") if isinstance(data, dict) \
                     else str(data)
                 sim.scr.echo(txt or "no health data")
+            elif name == b"WORLDS":
+                # reply to the stack WORLDS command's server query/set
+                txt = data.get("text") if isinstance(data, dict) \
+                    else str(data)
+                sim.scr.echo(txt or "no worlds data")
             elif name == b"GETSIMSTATE":
                 self.send_event(b"SIMSTATE", {
                     "state": sim.state_flag, "simt": sim.simt_planned,
@@ -156,6 +222,14 @@ def _make_simnode_class(base):
             import time as _time
             sim = self.sim
             sim.scr.update()
+            if self.worlds is not None:
+                running = self.worlds.step()
+                if sim.preempt_requested and self.running:
+                    self._preempt_worlds()
+                    return
+                if not running:
+                    self._finish_worlds()
+                return
             alive = sim.step()
             if sim.preempt_requested and self.running:
                 self._preempt_shutdown()

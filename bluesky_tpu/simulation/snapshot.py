@@ -76,6 +76,11 @@ def state_blob(sim, state=None) -> dict:
         state=state_np,
         ids=list(traf.ids), types=list(traf.types),
         autoid=traf._autoid,
+        # provenance for packed multi-world runs: which world of the
+        # pack this blob captured (empty for standalone sims) — the
+        # per-world preempt checkpoints carry it so operators can map
+        # preempt-<id>-wNN.snap files back to their pieces
+        world=sim.world_tag,
         cfg=dict(simdt=sim.cfg.simdt, cd_backend=sim.cfg.cd_backend,
                  asas=sim.cfg.asas._asdict()),
         dtmult=sim.dtmult,

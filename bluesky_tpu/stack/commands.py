@@ -1104,6 +1104,49 @@ def register_all(stack):
                          if getattr(sim, 'straggle_stall', False)
                          else ""))
 
+    def worldscmd(arg=None, val=None):
+        """WORLDS [ON/OFF | max n]: multi-world BATCH packing — pack
+        compatible pieces into world-batches stepped as one stacked
+        device program per worker (docs/PERF_ANALYSIS.md §multi-world).
+        Bare WORLDS reads the server's packing state and counters back
+        HEALTH-style; on a detached sim it reports the local settings
+        defaults a future server would inherit."""
+        from .. import settings as _settings
+        node = getattr(sim, "node", None)
+        networked = node is not None \
+            and getattr(node, "event_io", None) is not None
+        if arg is None:
+            if networked:
+                node.send_event(b"WORLDS", None)  # empty route -> server
+                return True, "WORLDS requested from the server"
+            return True, (
+                f"detached sim: WORLDS packing "
+                f"{'ON' if getattr(_settings, 'world_pack', False) else 'OFF'}"
+                f", max {getattr(_settings, 'world_batch_max', 8)} "
+                "pieces/dispatch (settings.world_pack / "
+                "settings.world_batch_max; a server inherits these)")
+        a = str(arg).upper()
+        if a in ("ON", "OFF", "TRUE", "FALSE", "1", "0"):
+            on = a in ("ON", "TRUE", "1")
+            _settings.world_pack = on
+            if networked:
+                node.send_event(b"WORLDS", {"pack": on})
+                return True, f"WORLDS packing {'ON' if on else 'OFF'} sent"
+            return True, f"WORLDS packing {'ON' if on else 'OFF'}"
+        if a == "MAX":
+            try:
+                n = int(float(val))
+            except (TypeError, ValueError):
+                return False, "WORLDS MAX n: need an integer n >= 1"
+            if n < 1:
+                return False, f"WORLDS MAX: need n >= 1, got {n}"
+            _settings.world_batch_max = n
+            if networked:
+                node.send_event(b"WORLDS", {"max": n})
+                return True, f"WORLDS max {n} pieces/dispatch sent"
+            return True, f"WORLDS max {n} pieces/dispatch"
+        return False, "WORLDS [ON/OFF | MAX n]"
+
     def snapshot(sub, fname=None):
         """SNAPSHOT SAVE/LOAD fname: binary pytree state checkpoint
         (device-state snapshot the reference lacks, SURVEY 5.4)."""
@@ -1428,6 +1471,9 @@ def register_all(stack):
                   "latitude-stripe decomposition (readback bare)"],
         "SNAPSHOT": ["SNAPSHOT SAVE/LOAD fname", "txt,[word]", snapshot,
                      "Save/restore a binary state snapshot"],
+        "WORLDS": ["WORLDS [ON/OFF | MAX n]", "[txt,txt]", worldscmd,
+                   "Multi-world BATCH packing: world-batch size + "
+                   "per-bucket packing on/off (readback bare)"],
         "SCREENSHOT": ["SCREENSHOT [fname.svg]", "[word]", screenshot,
                        "Render the radar picture to an SVG file"],
         "ZOOM": ["ZOOM IN/OUT or factor", "txt", zoom,

@@ -11,6 +11,16 @@ TPU-first: the reference intercepts ``__setattr__`` with a class swap to
 capture variable groups (datalog.py:112-139).  Here variables are plain
 named getters over the state pytree; sampling pulls one device->host
 transfer per logged chunk edge (never inside the jitted step).
+
+Registry scoping: loggers live in a ``LogRegistry``.  Historically the
+registry was module-global (one set of loggers per process), which is a
+singleton in the hot path once multiple Simulations share a process —
+the multi-world serving path (simulation/worlds.py) runs W independent
+scenario worlds per worker, and their datalog output must demux into
+per-world files instead of interleaving in shared ones.  Every
+``Simulation`` therefore owns a registry (``sim.datalog``); standalone
+sims share the module default so the classic one-sim-per-process
+behavior — and the module-level function API — is unchanged.
 """
 import os
 import time
@@ -20,8 +30,6 @@ import numpy as np
 
 from .. import settings
 
-_loggers: Dict[str, "CSVLogger"] = {}
-
 
 def log_dir() -> str:
     """Output directory for logs — reads ``settings.log_path`` at call
@@ -30,9 +38,73 @@ def log_dir() -> str:
     return settings.log_path
 
 
+class LogRegistry:
+    """One named-logger namespace: define/get loggers, sample the due
+    ones at chunk edges, register their stack commands.
+
+    ``tag`` is spliced into every log filename (``SNAPLOG_w03_...``) so
+    W world registries sharing one output directory stay separable —
+    the datalog leg of the multi-world demux.
+    """
+
+    def __init__(self, tag: str = ""):
+        self.tag = str(tag)
+        self._loggers: Dict[str, "CSVLogger"] = {}
+
+    # ------------------------------------------------------------ loggers
+    def getlogger(self, name: str) -> Optional["CSVLogger"]:
+        return self._loggers.get(name.upper())
+
+    def define_periodic(self, name: str, header: str,
+                        dt: float) -> "CSVLogger":
+        return CSVLogger(name, header, dt, _traf_getters(), registry=self)
+
+    def define_event(self, name: str, header: str) -> "EventLogger":
+        """Create-or-get an event logger (reference datalog.defineLogger)."""
+        lg = self.getlogger(name)
+        if lg is None:
+            lg = EventLogger(name, header, registry=self)
+        return lg
+
+    def crelog(self, name: str, header: str, getters=None) -> "CSVLogger":
+        return CSVLogger(name, header, 0.0, getters, registry=self)
+
+    # ----------------------------------------------------------- sampling
+    def postupdate(self, sim):
+        """Sample due periodic loggers (called at chunk edges by the sim)."""
+        simt = sim.simt
+        for lg in self._loggers.values():
+            if lg.active and lg.dt > 0 and simt >= lg.tlog:
+                lg.tlog += lg.dt
+                lg.log(sim)
+
+    def any_due(self, simt: float) -> bool:
+        """Any active periodic logger due at (or before) ``simt``?  The
+        pipelined chunk loop asks this before dispatching: logger getters
+        read live sim state, so a due sample forces a synchronous edge."""
+        return any(lg.active and lg.dt > 0 and simt >= lg.tlog
+                   for lg in self._loggers.values())
+
+    def reset(self):
+        for lg in self._loggers.values():
+            lg.stop()
+
+    def register_stack_commands(self, sim):
+        """Give every logger its own stack command (datalog.py:106-110)."""
+        cmds = {}
+        for name, lg in self._loggers.items():
+            cmds[name] = [
+                f"{name} ON/OFF,[dt] or LISTVARS or SELECTVARS var1,...",
+                "[txt,...]",
+                (lambda l: lambda *args: l.stackio(sim, *args))(lg),
+                lg.header]
+        sim.stack.append_commands(cmds)
+
+
 class CSVLogger:
     def __init__(self, name: str, header: str, dt: float = 0.0,
-                 getters: Optional[Dict[str, Callable]] = None):
+                 getters: Optional[Dict[str, Callable]] = None,
+                 registry: Optional[LogRegistry] = None):
         self.name = name.upper()
         self.header = header
         self.dt = dt
@@ -41,7 +113,8 @@ class CSVLogger:
         self.file = None
         self.getters = getters or {}
         self.selvars = list(self.getters.keys())
-        _loggers[self.name] = self
+        self.registry = registry if registry is not None else _default
+        self.registry._loggers[self.name] = self
 
     # ----------------------------------------------------------- control
     def start(self, sim, dt: Optional[float] = None):
@@ -49,14 +122,16 @@ class CSVLogger:
             self.dt = dt
         os.makedirs(log_dir(), exist_ok=True)
         scen = sim.stack.scenname or "untitled"
+        tag = f"{self.registry.tag}_" if self.registry.tag else ""
         stamp = time.strftime("%Y%m%d_%H-%M-%S")
-        fname = os.path.join(log_dir(), f"{self.name}_{scen}_{stamp}.log")
+        fname = os.path.join(log_dir(),
+                             f"{self.name}_{tag}{scen}_{stamp}.log")
         # never truncate an existing log (two starts in the same
         # wall-clock second would share the timestamped name)
         k = 1
         while os.path.exists(fname):
             fname = os.path.join(
-                log_dir(), f"{self.name}_{scen}_{stamp}_{k}.log")
+                log_dir(), f"{self.name}_{tag}{scen}_{stamp}_{k}.log")
             k += 1
         self.file = open(fname, "w")
         self.file.write(f"# {self.header}\n")
@@ -149,8 +224,10 @@ class EventLogger(CSVLogger):
     of sampled through getters (the reference ``datalog.defineLogger``
     pattern used by the AREA plugin's FLST log, plugins/area.py:99,144)."""
 
-    def __init__(self, name: str, header: str):
-        super().__init__(name, header, dt=0.0, getters={})
+    def __init__(self, name: str, header: str,
+                 registry: Optional[LogRegistry] = None):
+        super().__init__(name, header, dt=0.0, getters={},
+                         registry=registry)
 
     def log(self, sim, *columns, simt=None):
         """Write one row per element; columns are arrays/lists of equal
@@ -176,14 +253,6 @@ class EventLogger(CSVLogger):
             self.file.write(", ".join(vals) + "\n")
 
 
-def defineLogger(name: str, header: str) -> "EventLogger":
-    """Create-or-get an event logger (reference datalog.defineLogger)."""
-    lg = getlogger(name)
-    if lg is None:
-        lg = EventLogger(name, header)
-    return lg
-
-
 def _traf_getters():
     """Default per-aircraft variable getters (SNAPLOG group,
     traffic.py:94-125)."""
@@ -203,47 +272,45 @@ def _traf_getters():
     return g
 
 
+# ------------------------------------------------- module-level default
+# The process-wide default registry: standalone sims and the module
+# function API below share it, preserving the classic behavior.  Multi-
+# world sims pass their own LogRegistry to Simulation instead.
+_default = LogRegistry()
+_loggers = _default._loggers      # legacy alias (tests/introspection)
+
+
+def default_registry() -> LogRegistry:
+    return _default
+
+
+def defineLogger(name: str, header: str) -> "EventLogger":
+    return _default.define_event(name, header)
+
+
 def definePeriodicLogger(name: str, header: str, dt: float) -> CSVLogger:
-    return CSVLogger(name, header, dt, _traf_getters())
+    return _default.define_periodic(name, header, dt)
 
 
 def crelog(name: str, header: str, getters=None) -> CSVLogger:
-    return CSVLogger(name, header, 0.0, getters)
+    return _default.crelog(name, header, getters)
 
 
 def getlogger(name: str) -> Optional[CSVLogger]:
-    return _loggers.get(name.upper())
+    return _default.getlogger(name)
 
 
 def postupdate(sim):
-    """Sample due periodic loggers (called at chunk edges by the sim)."""
-    simt = sim.simt
-    for lg in _loggers.values():
-        if lg.active and lg.dt > 0 and simt >= lg.tlog:
-            lg.tlog += lg.dt
-            lg.log(sim)
+    return _default.postupdate(sim)
 
 
 def any_due(simt: float) -> bool:
-    """Any active periodic logger due at (or before) ``simt``?  The
-    pipelined chunk loop asks this before dispatching: logger getters
-    read live sim state, so a due sample forces a synchronous edge."""
-    return any(lg.active and lg.dt > 0 and simt >= lg.tlog
-               for lg in _loggers.values())
+    return _default.any_due(simt)
 
 
 def reset():
-    for lg in _loggers.values():
-        lg.stop()
+    _default.reset()
 
 
 def register_stack_commands(sim):
-    """Give every logger its own stack command (datalog.py:106-110)."""
-    cmds = {}
-    for name, lg in _loggers.items():
-        cmds[name] = [
-            f"{name} ON/OFF,[dt] or LISTVARS or SELECTVARS var1,...",
-            "[txt,...]",
-            (lambda l: lambda *args: l.stackio(sim, *args))(lg),
-            lg.header]
-    sim.stack.append_commands(cmds)
+    _default.register_stack_commands(sim)

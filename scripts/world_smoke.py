@@ -1,0 +1,120 @@
+"""CI perf-smoke: W=8 packed-BATCH parity run, journal-verified.
+
+Drives the REAL serving fabric in one process: a broker with world
+packing on, one SimNode worker, and a BATCH of 8 compatible pieces.
+Verifies the three multi-world serving contracts cheaply enough for
+every PR (the perf-smoke lane, .github/workflows/ci.yml):
+
+1. the 8 pieces dispatch as ONE world-batch to the single worker;
+2. the journal demux is exactly-once: replay owes nothing, every
+   piece completed exactly once;
+3. bit-exact parity: each world's final state equals an independent
+   single-piece Simulation run of the same scenario.
+
+Exits non-zero on any violation.
+"""
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+W = 8
+
+
+def main():
+    import numpy as np
+    import jax
+
+    from bluesky_tpu.network.client import Client
+    from bluesky_tpu.network.journal import BatchJournal
+    from bluesky_tpu.network.server import Server
+    from bluesky_tpu.simulation.simnode import SimNode
+    from tests.test_network import free_ports, wait_for
+
+    tmp = tempfile.mkdtemp(prefix="world-smoke-")
+    scn = os.path.join(tmp, "mc.scn")
+    with open(scn, "w") as f:
+        for i in range(W):
+            f.write(f"00:00:00.00>SCEN CASE_{i}\n")
+            f.write(f"00:00:00.00>CRE AC{i} B744 {48 + i} {3 + i} "
+                    f"{30 * i} FL200 250\n")
+            f.write("00:00:00.00>FF 5\n")
+    journal = os.path.join(tmp, "batch.jsonl")
+
+    ev, st, wev, wst = free_ports(4)
+    server = Server(headless=True,
+                    ports=dict(event=ev, stream=st, wevent=wev,
+                               wstream=wst),
+                    spawn_workers=False, world_pack=True,
+                    world_batch_max=W, journal_path=journal)
+    server.start()
+    time.sleep(0.2)
+    node = SimNode(event_port=wev, stream_port=wst, nmax=16)
+    t = threading.Thread(target=node.run, daemon=True)
+    t.start()
+    client = Client()
+    client.connect(event_port=ev, stream_port=st, timeout=5.0)
+    try:
+        assert wait_for(lambda: (client.receive(10),
+                                 len(client.nodes) >= 1)[1]), \
+            "worker never registered"
+        # keep a handle on the runner before it retires: poll for it
+        client.stack(f"BATCH {scn}")
+        runner = {}
+
+        def catch_runner():
+            client.receive(10)
+            if node.worlds is not None:
+                runner["wb"] = node.worlds
+            return server.packed_pieces == W and not server.inflight \
+                and not server.scenarios
+        assert wait_for(catch_runner, timeout=300), "pack never drained"
+        assert server.world_batches == 1, \
+            f"expected 1 world-batch, got {server.world_batches}"
+        wb = runner.get("wb")
+        assert wb is not None and wb.nworlds == W
+
+        state = BatchJournal.replay(journal)
+        assert len(state["completed"]) == W and not state["pending"], \
+            (f"journal demux not exactly-once: "
+             f"{len(state['completed'])} completed, "
+             f"{len(state['pending'])} pending")
+        print(f"world-smoke: journal exactly-once OK "
+              f"({W} completed, 0 pending)")
+
+        # bit-exact parity vs independent single-piece runs
+        from bluesky_tpu.simulation.sim import Simulation, OP
+        piece_cmds = [[f"SCEN CASE_{i}",
+                       f"CRE AC{i} B744 {48 + i} {3 + i} {30 * i} "
+                       "FL200 250", "FF 5"] for i in range(W)]
+        for i in range(W):
+            ref = Simulation(nmax=16)
+            ref.pipeline_enabled = False
+            ref.stack.set_scendata([0.0] * 3, piece_cmds[i])
+            ref.op()
+            it = 0
+            while ref.state_flag == OP and it < 5000:
+                ref.step()
+                it += 1
+            got = wb.sims[i].traf.state
+            for a, b in zip(jax.tree_util.tree_leaves(ref.traf.state),
+                            jax.tree_util.tree_leaves(got)):
+                assert np.array_equal(np.asarray(a), np.asarray(b),
+                                      equal_nan=True), \
+                    f"world {i}: packed state != solo state"
+        print(f"world-smoke: W={W} packed-vs-solo state parity OK")
+        print("world-smoke: PASS")
+    finally:
+        node.quit()
+        t.join(timeout=5)
+        server.stop()
+        server.join(timeout=5)
+        client.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,224 @@
+"""Packed multi-world BATCH serving over the real fabric.
+
+The server packs compatible BATCH pieces into world-batches (ONE worker
+steps W scenarios as a stacked device program, simulation/worlds.py)
+and demuxes per-world completion back to the individual pieces with
+exactly-once journal semantics — including the chaos case: a worker
+killed mid-pack requeues ONLY the worlds whose pieces never completed.
+"""
+import os
+import threading
+import time
+
+import pytest
+
+zmq = pytest.importorskip("zmq")
+
+from bluesky_tpu.network.client import Client
+from bluesky_tpu.network.journal import BatchJournal
+from bluesky_tpu.network.server import Server, WorldPack
+from bluesky_tpu.simulation.simnode import SimNode
+from tests.test_network import free_ports, wait_for
+
+
+def _write_scn(path, pieces):
+    """pieces: list of (name, lat, ff_seconds, extra_cmds)."""
+    with open(path, "w") as f:
+        for name, lat, ff, extra in pieces:
+            f.write(f"00:00:00.00>SCEN {name}\n")
+            for cmd in extra:
+                f.write(f"00:00:00.00>{cmd}\n")
+            f.write(f"00:00:00.00>CRE {name}1 B744 {lat} 4 90 "
+                    "FL200 250\n")
+            f.write(f"00:00:00.00>FF {ff}\n")
+
+
+def _fabric(tmp_path, n_nodes=1, **serverkw):
+    ev, st, wev, wst = free_ports(4)
+    journal = str(tmp_path / "batch.jsonl")
+    server = Server(headless=True,
+                    ports=dict(event=ev, stream=st, wevent=wev,
+                               wstream=wst),
+                    spawn_workers=False, journal_path=journal,
+                    **serverkw)
+    server.start()
+    time.sleep(0.2)
+    nodes = [SimNode(event_port=wev, stream_port=wst, nmax=16)
+             for _ in range(n_nodes)]
+    threads = [threading.Thread(target=n.run, daemon=True)
+               for n in nodes]
+    for t in threads:
+        t.start()
+    client = Client()
+    client.connect(event_port=ev, stream_port=st, timeout=5.0)
+    assert wait_for(lambda: (client.receive(10),
+                             len(client.nodes) >= n_nodes)[1])
+    return server, nodes, threads, client, journal
+
+
+def _teardown(server, nodes, threads, client):
+    for n in nodes:
+        n.quit()
+    for t in threads:
+        t.join(timeout=5)
+    server.stop()
+    server.join(timeout=5)
+    client.close()
+
+
+def test_pack_dispatch_and_exactly_once_demux(tmp_path):
+    """4 compatible pieces pack onto ONE worker; every piece completes
+    exactly once in the journal (replay owes nothing) and the WORLDS
+    counters reflect the pack."""
+    scn = tmp_path / "mc.scn"
+    _write_scn(scn, [(f"CASE_{i}", 50 + i, 5, []) for i in range(4)])
+    server, nodes, threads, client, journal = _fabric(
+        tmp_path, world_pack=True, world_batch_max=8)
+    try:
+        client.stack(f"BATCH {scn}")
+
+        def done():
+            client.receive(10)
+            return server.packed_pieces == 4 and not server.inflight \
+                and not server.scenarios
+        assert wait_for(done, timeout=120)
+        assert server.world_batches == 1
+        # all four worlds ran on the single worker
+        w = server.worlds_payload()
+        assert w["packed_pieces"] == 4 and w["fill_ratio"] == 0.5
+        assert w["demux_events"] >= 4
+        state = BatchJournal.replay(journal)
+        assert len(state["completed"]) == 4
+        assert not state["pending"]
+        # HEALTH carries the world-batch counters
+        h = server.health_payload()
+        assert h["worlds"]["world_batches"] == 1
+        assert "worlds:" in h["text"]
+    finally:
+        _teardown(server, nodes, threads, client)
+
+
+def test_pack_crash_requeues_only_unfinished(tmp_path):
+    """Chaos: kill the worker mid-pack after some worlds completed —
+    the journal replay owes exactly the unfinished pieces, and the
+    live server requeues only those."""
+    scn = tmp_path / "mc.scn"
+    # worlds 0/1 finish in 2 sim-s; world 2 fast-forwards effectively
+    # forever (the crash interrupts it)
+    _write_scn(scn, [("FAST_A", 50, 2, []), ("FAST_B", 51, 2, []),
+                     ("SLOW_C", 52, 100000, [])])
+    server, nodes, threads, client, journal = _fabric(
+        tmp_path, world_pack=True, world_batch_max=8,
+        restart_crashed=False)
+    try:
+        client.stack(f"BATCH {scn}")
+
+        def two_done():
+            client.receive(10)
+            pack = next(iter(server.inflight.values()), None)
+            return isinstance(pack, WorldPack) and len(pack.done) >= 2
+        assert wait_for(two_done, timeout=120)
+        # kill the worker mid-pack (thread-mode stand-in for kill -9:
+        # the node's teardown STATECHANGE(-1) is the same lost-worker
+        # path _reap_dead_workers funnels into)
+        nodes[0].quit()
+        threads[0].join(timeout=10)
+
+        def requeued():
+            client.receive(10)
+            return len(server.scenarios) == 1 and not server.inflight
+        assert wait_for(requeued, timeout=30)
+        # only the unfinished world's piece is owed
+        pending = [server._piece_name(p) for p in server.scenarios]
+        assert pending == ["SLOW_C"]
+        state = BatchJournal.replay(journal)
+        assert len(state["completed"]) == 2
+        assert [Server._piece_name(p) for p in state["pending"]] \
+            == ["SLOW_C"]
+        # the crash cost the unfinished piece one strike, not the
+        # completed ones
+        assert list(state["crashes"].values()) == [1]
+    finally:
+        _teardown(server, nodes, threads, client)
+
+
+def test_spatial_piece_refused_from_pack(tmp_path):
+    """A piece requesting shard_mode=spatial never joins a pack: it
+    dispatches solo with a structured WORLDSREFUSED echo (not a
+    crash), and the rest still pack."""
+    scn = tmp_path / "mc.scn"
+    _write_scn(scn, [("PLAIN_A", 50, 2, []),
+                     ("SPATIAL_B", 51, 2, ["SHARD SPATIAL"]),
+                     ("PLAIN_C", 52, 2, [])])
+    server, nodes, threads, client, journal = _fabric(
+        tmp_path, world_pack=True, world_batch_max=8)
+    refusals = []
+    client.event_handlers = getattr(client, "event_handlers", {})
+
+    try:
+        client.stack(f"BATCH {scn}")
+
+        def all_done():
+            client.receive(10)
+            return not server.inflight and not server.scenarios \
+                and server.worlds_refused_spatial >= 1
+        assert wait_for(all_done, timeout=120)
+        state = BatchJournal.replay(journal)
+        assert len(state["completed"]) == 3 and not state["pending"]
+        # the spatial piece was dispatched OUTSIDE any pack
+        assert server.packed_pieces <= 2
+        assert server.worlds_refused_spatial >= 1
+    finally:
+        _teardown(server, nodes, threads, client)
+
+
+def test_worlds_knob_event_roundtrip(tmp_path):
+    """The WORLDS event sets the packing knobs at runtime and reads
+    them back HEALTH-style."""
+    server, nodes, threads, client, journal = _fabric(
+        tmp_path, world_pack=False, world_batch_max=4)
+    try:
+        client.send_event(b"WORLDS", {"pack": True, "max": 16},
+                          target=b"")
+        assert wait_for(lambda: (client.receive(10),
+                                 server.world_pack
+                                 and server.world_batch_max == 16)[1])
+        w = server.worlds_payload()
+        assert w["pack"] is True and w["batch_max"] == 16
+        assert "packing ON" in w["text"]
+    finally:
+        _teardown(server, nodes, threads, client)
+
+
+def test_worlds_stack_command_detached():
+    """Bare WORLDS on a detached sim reads the local settings back."""
+    from bluesky_tpu.simulation.sim import Simulation
+    sim = Simulation(nmax=8)
+    sim.stack.stack("WORLDS")
+    sim.stack.process()
+    assert any("WORLDS packing" in line for line in sim.scr.echobuf)
+    sim.stack.stack("WORLDS MAX 32")
+    sim.stack.process()
+    from bluesky_tpu import settings
+    assert settings.world_batch_max == 32
+    settings.world_batch_max = 8
+    sim.stack.stack("WORLDS ON")
+    sim.stack.process()
+    assert settings.world_pack is True
+    settings.world_pack = False
+
+
+def test_journal_replay_packed_records(tmp_path):
+    """Replay folds packed dispatched/completed records exactly like
+    solo ones: a crash after 2 of 3 world completions owes 1 piece."""
+    j = BatchJournal(str(tmp_path / "j.jsonl"), fsync=False)
+    pieces = [([0.0], [f"SCEN P{i}"]) for i in range(3)]
+    j.queued_many(pieces)
+    for i, p in enumerate(pieces):
+        j.dispatched(p, b"\x00wrk1", world=i, pack=3)
+    j.completed(pieces[0], b"\x00wrk1", world=0)
+    j.completed(pieces[1], b"\x00wrk1", world=1)
+    j.close()
+    state = BatchJournal.replay(str(tmp_path / "j.jsonl"))
+    assert len(state["completed"]) == 2
+    assert state["pending"] == [([0.0], ["SCEN P2"])]
