@@ -17,7 +17,11 @@ CPU (BlueSky ICRAT-2016 paper §IX; BASELINE.md) at simdt=0.05 =>
 writes the dense/tiled/pallas/sparse crossover table to
 BENCH_DETAIL.json (rows that fail the plausibility guard or crash are
 recorded with failed=True); ``python bench.py --sharded [N]`` runs the
-mesh-sharded tiled path.
+mesh-sharded tiled path; ``python bench.py --grad [N]`` measures the
+differentiable scan (forward+backward vs forward-only steps/s) into
+BENCH_GRAD.json.  Every JSON-writing mode honours a shared ``--out
+<file>`` flag, and sweep scripts reuse ``write_bench_json`` /
+``platform_tag`` instead of duplicating the tagging boilerplate.
 """
 import json
 import sys
@@ -26,6 +30,44 @@ import time
 import numpy as np
 
 BASELINE_AC_STEPS_PER_SEC = 700 * 20.0
+
+
+def platform_tag():
+    """The repo's bench row convention: ``backend:device_kind`` (so
+    tpu:v5e history and cpu:cpu rows coexist in one file)."""
+    import jax
+    return (f"{jax.default_backend()}:"
+            f"{jax.devices()[0].device_kind.lower()}")
+
+
+def write_bench_json(path, rows, **extra):
+    """Shared BENCH_*.json writer: platform-tag every measured row and
+    write ``{"rows": rows, **extra}`` — the boilerplate every sweep
+    script used to duplicate (scripts/world_sweep.py now calls this).
+    Rows that already carry a tag (history, projections) keep it."""
+    tag = platform_tag()
+    for r in rows:
+        if isinstance(r, dict) and not r.get("projected"):
+            r.setdefault("platform", tag)
+    out = {"rows": rows}
+    out.update({k: v for k, v in extra.items() if v is not None})
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"wrote {path}")
+    return out
+
+
+def pop_out_flag(argv, default):
+    """Consume ``--out <file>`` from argv (shared by every bench mode),
+    returning the output path."""
+    if "--out" in argv:
+        i = argv.index("--out")
+        if i + 1 >= len(argv):
+            raise SystemExit("--out needs a file path")
+        path = argv[i + 1]
+        del argv[i:i + 2]
+        return path
+    return default
 
 
 def _make_traffic(n_ac, geometry, pair_matrix, dtype, nmax=None):
@@ -340,6 +382,85 @@ def run_worlds(n_ac, worlds, nsteps=200, reps=2, backend="dense",
     return row, baseline
 
 
+def run_grad(n_ac=200, tend=400.0, simdt=1.0, chunk=50, reps=2):
+    """Differentiable-simulation bench (ISSUE 7): steps/s of the
+    forward+backward smooth scan vs the forward-only smooth scan vs
+    the hard serving scan, on the conflict demo scene.
+
+    Three rows, same aircraft count and horizon:
+
+    * ``forward_hard``     — run_steps with the exact step (the serving
+                             scan; smooth=None baseline),
+    * ``forward_smooth``   — the checkpointed objective rollout, value
+                             only (what one optimizer line search pays),
+    * ``forward_backward`` — jax.value_and_grad of the same rollout
+                             (one full descent iteration's device work).
+
+    ``bwd_over_fwd`` on the gradient row is the AD overhead factor the
+    docs quote; BENCH_GRAD.json is written by the --grad CLI via the
+    shared ``write_bench_json`` tagger.
+    """
+    import jax
+    import jax.numpy as jnp
+    from bluesky_tpu.core.step import SimConfig, run_steps
+    from bluesky_tpu.diff import objectives
+    from bluesky_tpu.diff import optimize as dopt
+    from bluesky_tpu.diff.smooth import SmoothConfig
+
+    traf, acfg = dopt.conflict_scene(n_ac, dtype=jnp.float32)
+    state = traf.state
+    nsteps = max(1, int(round(tend / simdt)))
+    chunk = max(1, min(chunk, nsteps))
+    nsteps = -(-nsteps // chunk) * chunk
+    cfg_hard = SimConfig(simdt=simdt, asas=acfg._replace(swasas=False),
+                         cd_backend="dense")
+    cfg_sm = cfg_hard._replace(smooth=SmoothConfig())
+    weights = objectives.ObjectiveWeights()
+    nmax = state.ac.lat.shape[0]
+    params = dopt.OffsetParams(jnp.zeros((nmax,), jnp.float32),
+                               jnp.zeros((nmax,), jnp.float32))
+
+    def cost(p, temp):
+        s = dopt.apply_offsets(state, p, float(acfg.rpz))
+        acc, _, _ = dopt._rollout(s, cfg_sm, nsteps, chunk, weights,
+                                  temp, False)
+        return acc
+
+    fwd_hard = lambda: run_steps(jax.tree_util.tree_map(jnp.copy, state),
+                                 cfg_hard, nsteps)
+    fwd_smooth = jax.jit(cost)
+    fwd_bwd = jax.jit(jax.value_and_grad(cost))
+    temp = jnp.asarray(0.2, jnp.float32)
+
+    def bench_one(fn, label):
+        jax.block_until_ready(fn())          # warmup/compile
+        best = None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn())
+            dt = time.perf_counter() - t0
+            if best is None or dt < best:
+                best = dt
+        return dict(n=n_ac, mode=label, nsteps=nsteps,
+                    nsteps_chunk=chunk, simdt=simdt,
+                    ac_steps_per_s=round(n_ac * nsteps / best, 1),
+                    wall_s=round(best, 4), reps=f"best-of-{reps}")
+
+    rows = [bench_one(fwd_hard, "forward_hard"),
+            bench_one(lambda: fwd_smooth(params, temp),
+                      "forward_smooth"),
+            bench_one(lambda: fwd_bwd(params, temp),
+                      "forward_backward")]
+    fwd = rows[1]["wall_s"]
+    rows[2]["bwd_over_fwd"] = round(rows[2]["wall_s"] / fwd, 2) \
+        if fwd else None
+    rows[1]["smooth_over_hard"] = round(fwd / rows[0]["wall_s"], 2) \
+        if rows[0]["wall_s"] else None
+    for r in rows:
+        print(json.dumps(r))
+    return rows
+
+
 def cd_pairs_per_s(n_ac, backend, geometry, reps=3):
     """CD&R kernel alone: effective pair rate."""
     import jax
@@ -543,7 +664,22 @@ def sharded(n_ac=4096, n_devices=8, nsteps=100, backend="sparse"):
 
 
 if __name__ == "__main__":
-    if "--detail" in sys.argv:
+    if "--grad" in sys.argv:
+        # differentiable-simulation rows: forward+backward vs
+        # forward-only steps/s of the smooth scan (+ the hard serving
+        # scan for reference) -> BENCH_GRAD.json (or --out <file>)
+        out = pop_out_flag(sys.argv, "BENCH_GRAD.json")
+        args = [a for a in sys.argv[1:] if not a.startswith("--")]
+        n = int(args[0]) if args else 200
+        rows = run_grad(n)
+        gr = rows[2]
+        write_bench_json(out, rows, headline={
+            "n": n, "bwd_over_fwd": gr.get("bwd_over_fwd"),
+            "fwd_bwd_ac_steps_per_s": gr["ac_steps_per_s"],
+            "note": ("one optimizer iteration's device work vs one "
+                     "forward rollout; checkpointed scan keeps "
+                     "backward memory O(chunk)")})
+    elif "--detail" in sys.argv:
         detail()
     elif "--sharded" in sys.argv:
         args = [a for a in sys.argv[1:] if not a.startswith("--")]

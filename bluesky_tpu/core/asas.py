@@ -70,13 +70,37 @@ class AsasConfig(NamedTuple):
         return self.hpz * self.resofacv
 
 
-def update(state: SimState,
-           cfg: AsasConfig) -> Tuple[SimState, ConflictData]:
-    """One ASAS interval: detect, resolve, bookkeep, resume (asas.py:473-504)."""
+def update(state: SimState, cfg: AsasConfig,
+           smooth=None) -> Tuple[SimState, ConflictData]:
+    """One ASAS interval: detect, resolve, bookkeep, resume (asas.py:473-504).
+
+    ``smooth`` (diff.smooth.SmoothConfig; None on the serving path)
+    engages the differentiable-mode relaxations: the hard conflict
+    indicator becomes sigmoid pair weights on the MVP contribution sums
+    (``soft_conflict_weight``), the resolver's min reduction a softmin,
+    and the velocity caps straight-through clips.  The per-aircraft
+    engagement *selection* (``upd``/``active`` gating below) stays
+    hard-forward — both branches of each ``jnp.where`` are
+    differentiable, and the gradient signal rides the smooth weights.
+    MVP is the differentiable resolver; the other methods raise.
+    """
     ac, asas = state.ac, state.asas
 
     cd = cdops.detect(ac.lat, ac.lon, ac.trk, ac.gs, ac.alt, ac.vs,
                       ac.active, cfg.rpz, cfg.hpz, cfg.dtlookahead)
+
+    if smooth is not None and cfg.reso_on \
+            and cfg.reso_method.upper() != "MVP":
+        raise ValueError(
+            "differentiable mode (SimConfig.smooth) relaxes the MVP "
+            f"resolver only, not {cfg.reso_method!r} — use RESO MVP "
+            "(or RESO OFF) for gradient workloads.")
+
+    wconf = None
+    if smooth is not None:
+        from ..diff import smooth as smoothmod
+        wconf = smoothmod.soft_conflict_weight(
+            cd, cfg.rpz, cfg.dtlookahead, smooth)
 
     if cfg.reso_on:
         mvpcfg = cr_mvp.MVPConfig(
@@ -90,7 +114,8 @@ def update(state: SimState,
                 cd, ac.alt, ac.gseast, ac.gsnorth, ac.vs, ac.trk, ac.gs,
                 ac.selalt, state.ap.vs, asas.alt,
                 cfg.vmin, cfg.vmax, cfg.vsmin, cfg.vsmax, mvpcfg,
-                noreso=asas.noreso, resooff=asas.resooff)
+                noreso=asas.noreso, resooff=asas.resooff,
+                wconf=wconf, smooth=smooth)
         if method == "EBY":
             from ..ops import cr_eby
             newtrk, newgs, newvs, newalt = cr_eby.resolve(

@@ -13,16 +13,26 @@ import jax.numpy as jnp
 from ..ops import aero
 
 
-def update_airspeed(ac, pilot, accel, simdt, eps=0.01):
+def update_airspeed(ac, pilot, accel, simdt, eps=0.01, smooth=None):
     """TAS/heading/VS dynamics toward pilot targets (traffic.py:425-454).
 
     Args:
       ac:     AircraftArrays
       pilot:  PilotArrays (arbitrated targets)
       accel:  [N] per-aircraft acceleration magnitude [m/s2] (perf model)
+      smooth: diff.smooth.SmoothConfig or None.  The hard dynamics are
+              bang-bang (``sign(error) * rate`` under a dead-band) —
+              zero gradient in the targets everywhere.  Smooth mode
+              advances by a straight-through-clipped proportional step
+              (diff/smooth.capture_step): identical full-rate steps
+              outside the dead-band, exact capture inside it, and a
+              backward pass that carries d(state)/d(target) through
+              the saturation (docs/PERF_ANALYSIS.md §differentiable).
     Returns updated AircraftArrays (tas/cas/mach, hdg, vs, ax, swhdgsel,
     swaltsel updated).
     """
+    if smooth is not None:
+        return _update_airspeed_smooth(ac, pilot, accel, simdt, eps, smooth)
     # Horizontal acceleration toward commanded TAS, dead-banded at 1 kt
     delta_spd = pilot.tas - ac.tas
     need_ax = jnp.abs(delta_spd) > aero.kts
@@ -49,6 +59,42 @@ def update_airspeed(ac, pilot, accel, simdt, eps=0.01):
     need_az = jnp.abs(delta_vs) > 300.0 * aero.fpm
     az = need_az * jnp.sign(delta_vs) * (300.0 * aero.fpm)
     vs = jnp.where(need_az, ac.vs + az * simdt, target_vs)
+    vs = jnp.where(jnp.isfinite(vs), vs, 0.0)
+
+    return ac.replace(tas=tas, cas=cas, mach=mach, hdg=hdg, vs=vs, ax=ax,
+                      swhdgsel=swhdgsel, swaltsel=swaltsel)
+
+
+def _update_airspeed_smooth(ac, pilot, accel, simdt, eps, smooth):
+    """The differentiable relaxation of ``update_airspeed`` (called only
+    with ``SimConfig.smooth`` set — never on the serving path).  Each
+    bang-bang capture becomes ``capture_step``: same saturated rate
+    toward the target, exact capture instead of dead-band chatter,
+    straight-through backward."""
+    from ..diff.smooth import capture_step
+
+    delta_spd = pilot.tas - ac.tas
+    dtas = capture_step(delta_spd, accel * simdt)
+    tas = ac.tas + dtas
+    ax = dtas / simdt
+    cas = aero.vtas2cas(tas, ac.alt)
+    mach = aero.vtas2mach(tas, ac.alt)
+
+    turnrate = jnp.degrees(aero.g0 * jnp.tan(ac.bank)
+                           / jnp.maximum(tas, eps))
+    delhdg = (pilot.hdg - ac.hdg + 180.0) % 360.0 - 180.0
+    swhdgsel = jnp.abs(delhdg) > jnp.abs(2.0 * simdt * turnrate)
+    hdg = (ac.hdg + capture_step(delhdg, simdt * turnrate)) % 360.0
+
+    # VS toward the rate that would close the altitude error in one
+    # step, capped at the commanded |pilot.vs| (sign falls out of the
+    # error); VS itself still slews at the fixed 300 fpm/s.
+    delta_alt = pilot.alt - ac.alt
+    swaltsel = jnp.abs(delta_alt) > jnp.maximum(
+        10.0 * aero.ft, jnp.abs(2.0 * simdt * jnp.abs(ac.vs)))
+    target_vs = capture_step(delta_alt / simdt, jnp.abs(pilot.vs))
+    vs = ac.vs + capture_step(target_vs - ac.vs,
+                              300.0 * aero.fpm * simdt)
     vs = jnp.where(jnp.isfinite(vs), vs, 0.0)
 
     return ac.replace(tas=tas, cas=cas, mach=mach, hdg=hdg, vs=vs, ax=ax,

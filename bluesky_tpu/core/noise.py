@@ -51,8 +51,15 @@ def make_adsb(nmax: int, dtype=jnp.float32) -> AdsbArrays:
                       trk=z(), tas=z(), gs=z(), vs=z())
 
 
-def turbulence_woosh(ac, key, simdt, cfg: NoiseConfig):
-    """Positional turbulence jitter (turbulence.py:24-46)."""
+def turbulence_woosh(ac, key, simdt, cfg: NoiseConfig, smooth=None):
+    """Positional turbulence jitter (turbulence.py:24-46).
+
+    ``smooth`` (differentiable mode, diff/smooth.py): the gaussian
+    draws are stop-gradiented — they are parameter-independent by
+    construction (the PRNG stream never depends on the optimized
+    offsets), and pinning them keeps the backward pass from
+    differentiating through ``jax.random`` internals while the additive
+    jitter still perturbs the forward rollout."""
     if not cfg.turb_active:
         return ac
     n = ac.lat.shape[0]
@@ -64,6 +71,10 @@ def turbulence_woosh(ac, key, simdt, cfg: NoiseConfig):
         * (cfg.turb_sd_hw * timescale)
     turbalt = jax.random.normal(k3, (n,), ac.lat.dtype) \
         * (cfg.turb_sd_vert * timescale)
+    if smooth is not None and smooth.stop_grad_noise:
+        turbhf, turbhw, turbalt = (
+            jax.lax.stop_gradient(turbhf), jax.lax.stop_gradient(turbhw),
+            jax.lax.stop_gradient(turbalt))
 
     trkrad = jnp.radians(ac.trk)
     turblat = jnp.cos(trkrad) * turbhf - jnp.sin(trkrad) * turbhw
@@ -78,16 +89,25 @@ def turbulence_woosh(ac, key, simdt, cfg: NoiseConfig):
                       ac.lon))
 
 
-def adsb_update(adsb: AdsbArrays, ac, key, simt, cfg: NoiseConfig):
+def adsb_update(adsb: AdsbArrays, ac, key, simt, cfg: NoiseConfig,
+                smooth=None):
     """Refresh broadcast state for aircraft whose truncation window elapsed
-    (adsbmodel.py:44-59)."""
+    (adsbmodel.py:44-59).  ``smooth`` stop-gradients the transmission-
+    noise draws like ``turbulence_woosh``."""
     up = adsb.lastupdate + cfg.adsb_trunctime < simt
     if cfg.adsb_transnoise:
         n = ac.lat.shape[0]
         k1, k2, k3 = jax.random.split(key, 3)
-        lat = ac.lat + jax.random.normal(k1, (n,), ac.lat.dtype) * cfg.adsb_err_latlon
-        lon = ac.lon + jax.random.normal(k2, (n,), ac.lat.dtype) * cfg.adsb_err_latlon
-        alt = ac.alt + jax.random.normal(k3, (n,), ac.lat.dtype) * cfg.adsb_err_alt
+        err1 = jax.random.normal(k1, (n,), ac.lat.dtype)
+        err2 = jax.random.normal(k2, (n,), ac.lat.dtype)
+        err3 = jax.random.normal(k3, (n,), ac.lat.dtype)
+        if smooth is not None and smooth.stop_grad_noise:
+            err1, err2, err3 = (jax.lax.stop_gradient(err1),
+                                jax.lax.stop_gradient(err2),
+                                jax.lax.stop_gradient(err3))
+        lat = ac.lat + err1 * cfg.adsb_err_latlon
+        lon = ac.lon + err2 * cfg.adsb_err_latlon
+        alt = ac.alt + err3 * cfg.adsb_err_alt
     else:
         lat, lon, alt = ac.lat, ac.lon, ac.alt
     sel = lambda new, old: jnp.where(up, new, old)

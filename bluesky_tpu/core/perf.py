@@ -162,12 +162,25 @@ def update(perf, tas, vs, alt):
     return new_perf, bank
 
 
-def limits(perf, intent_tas, intent_vs, intent_alt, ax):
-    """Clip pilot intents to the flight envelope (perfoap.py:185-209)."""
+def limits(perf, intent_tas, intent_vs, intent_alt, ax, smooth=None):
+    """Clip pilot intents to the flight envelope (perfoap.py:185-209).
+
+    ``smooth`` (diff.smooth.SmoothConfig, differentiable mode only)
+    swaps the hard CAS clamp for its straight-through estimator: the
+    forward envelope is enforced bit-exactly, but the backward pass
+    treats the clip as identity so gradients keep flowing when an
+    intent is pinned against a limit (the documented perf-clamp STE,
+    docs/PERF_ANALYSIS.md §differentiable).  The vs selections are
+    ``jnp.where`` lattices — already differentiable in both branches.
+    """
     allow_alt = jnp.minimum(intent_alt, perf.hmax)
 
     intent_cas = aero.vtas2cas(intent_tas, allow_alt)
-    allow_cas = jnp.clip(intent_cas, perf.vmin, perf.vmax)
+    if smooth is not None and smooth.ste_caps:
+        from ..diff.smooth import ste_clip
+        allow_cas = ste_clip(intent_cas, perf.vmin, perf.vmax)
+    else:
+        allow_cas = jnp.clip(intent_cas, perf.vmin, perf.vmax)
     allow_tas = aero.vcas2tas(allow_cas, allow_alt)
 
     vs_max_with_acc = (1.0 - ax / perf.axmax) * perf.vsmax

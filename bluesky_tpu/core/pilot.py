@@ -47,9 +47,13 @@ def ap_or_asas(state: SimState, windn=None, winde=None) -> SimState:
     return state.replace(pilot=pilot)
 
 
-def apply_limits(state: SimState) -> SimState:
-    """Clip pilot intents to the performance envelope (pilot.py:65-68)."""
+def apply_limits(state: SimState, smooth=None) -> SimState:
+    """Clip pilot intents to the performance envelope (pilot.py:65-68).
+
+    ``smooth`` threads the differentiable-mode straight-through clamp
+    choice into ``perf.limits`` (None — the serving default — is the
+    exact hard clip)."""
     pilot = state.pilot
     tas, vs, alt = perfmod.limits(state.perf, pilot.tas, pilot.vs, pilot.alt,
-                                  state.ac.ax)
+                                  state.ac.ax, smooth=smooth)
     return state.replace(pilot=pilot.replace(tas=tas, vs=vs, alt=alt))

@@ -66,6 +66,15 @@ class SimConfig(NamedTuple):
     # values cut the boundary exchange and are validated against the
     # exact reach bound + drift margin at every refresh).
     cd_halo_blocks: int = 0
+    # Differentiable mode (bluesky_tpu/diff/): a diff.smooth.SmoothConfig
+    # swaps the hard gates for the documented relaxations (conflict
+    # sigmoid, softmin resolver reductions, straight-through clamps,
+    # stop-gradiented RNG draws) so jax.grad through run_steps carries
+    # useful gradients.  None — the default, and the ONLY value the
+    # serving path ever sets — takes every original code path at trace
+    # time: bit-identical to the pre-relaxation step (tests/test_diff.py
+    # pins this).  A NamedTuple of floats, so the config stays hashable.
+    smooth: object = None
 
 
 def step(state: SimState, cfg: SimConfig) -> SimState:
@@ -86,7 +95,8 @@ def step(state: SimState, cfg: SimConfig) -> SimState:
         rng = k_adsb = k_turb = state.rng
     state = state.replace(
         rng=rng,
-        adsb=noise.adsb_update(state.adsb, state.ac, k_adsb, simt, cfg.noise))
+        adsb=noise.adsb_update(state.adsb, state.ac, k_adsb, simt, cfg.noise,
+                               smooth=cfg.smooth))
 
     # ---------- FMS / autopilot (traffic.py:395), gated at fms_dt ----------
     fms_due = (state.fms_t0 + cfg.fms_dt < simt) | (simt < state.fms_t0) \
@@ -104,6 +114,12 @@ def step(state: SimState, cfg: SimConfig) -> SimState:
             raise ValueError(
                 f"Unknown SimConfig.cd_backend {cfg.cd_backend!r}; "
                 "expected 'dense', 'tiled', 'pallas' or 'sparse'.")
+        if cfg.smooth is not None and cfg.cd_backend != "dense":
+            raise ValueError(
+                "SimConfig.smooth (differentiable mode) relaxes the "
+                "dense CD&R path only: the tiled/pallas/sparse kernels "
+                "carry integer partner tables that do not differentiate."
+                "  Use cd_backend='dense' (diff workloads run small-N).")
         if cfg.cd_shard_mode not in ("replicate", "spatial"):
             raise ValueError(
                 f"Unknown SimConfig.cd_shard_mode {cfg.cd_shard_mode!r}; "
@@ -138,7 +154,7 @@ def step(state: SimState, cfg: SimConfig) -> SimState:
                     shard_mode=cfg.cd_shard_mode,
                     halo_blocks=cfg.cd_halo_blocks)
             else:
-                s2, _cd = asasmod.update(s, cfg.asas)
+                s2, _cd = asasmod.update(s, cfg.asas, smooth=cfg.smooth)
             return s2.replace(
                 asas_tnext=s.asas_tnext
                 + jnp.asarray(cfg.asas.dtasas, s.asas_tnext.dtype))
@@ -159,16 +175,18 @@ def step(state: SimState, cfg: SimConfig) -> SimState:
     state = state.replace(perf=new_perf, ac=state.ac.replace(bank=bank))
 
     # ---------- Envelope limits (traffic.py:404) ----------
-    state = pilot.apply_limits(state)
+    state = pilot.apply_limits(state, smooth=cfg.smooth)
 
     # ---------- Kinematics (traffic.py:406-409) ----------
     accel = perfmod.acceleration(state.perf.phase)
-    ac = kinematics.update_airspeed(state.ac, state.pilot, accel, simdt)
+    ac = kinematics.update_airspeed(state.ac, state.pilot, accel, simdt,
+                                    smooth=cfg.smooth)
     ac = kinematics.update_groundspeed(ac, windn, winde)
     ac = kinematics.update_position(ac, state.pilot, simdt)
 
     # ---------- Turbulence (traffic.py:416) ----------
-    ac = noise.turbulence_woosh(ac, k_turb, simdt, cfg.noise)
+    ac = noise.turbulence_woosh(ac, k_turb, simdt, cfg.noise,
+                                smooth=cfg.smooth)
 
     # Freeze padding slots: inactive rows keep their values bit-exactly so
     # garbage can never leak into streams/logs.
@@ -427,7 +445,8 @@ def step_worlds(state: SimState, cfg: SimConfig) -> SimState:
     state = state.replace(
         rng=rng,
         adsb=jax.vmap(lambda a, ac, k, t: noise.adsb_update(
-            a, ac, k, t, cfg.noise))(state.adsb, state.ac, k_adsb, simt))
+            a, ac, k, t, cfg.noise,
+            smooth=cfg.smooth))(state.adsb, state.ac, k_adsb, simt))
 
     # ---------- FMS / autopilot, gated at fms_dt ----------
     fms_due = (state.fms_t0 + cfg.fms_dt < simt) | (simt < state.fms_t0) \
@@ -466,7 +485,8 @@ def step_worlds(state: SimState, cfg: SimConfig) -> SimState:
                         shard_mode=cfg.cd_shard_mode,
                         halo_blocks=cfg.cd_halo_blocks)
                 else:
-                    s2, _cd = asasmod.update(sw, cfg.asas)
+                    s2, _cd = asasmod.update(sw, cfg.asas,
+                                             smooth=cfg.smooth)
                 return s2.replace(
                     asas_tnext=sw.asas_tnext
                     + jnp.asarray(cfg.asas.dtasas, sw.asas_tnext.dtype))
@@ -486,17 +506,18 @@ def step_worlds(state: SimState, cfg: SimConfig) -> SimState:
         new_perf, bank = perfmod.update(sw.perf, sw.ac.tas, sw.ac.vs,
                                         sw.ac.alt)
         sw = sw.replace(perf=new_perf, ac=sw.ac.replace(bank=bank))
-        sw = pilot.apply_limits(sw)
+        sw = pilot.apply_limits(sw, smooth=cfg.smooth)
         accel = perfmod.acceleration(sw.perf.phase)
         ac = kinematics.update_airspeed(sw.ac, sw.pilot, accel,
                                         jnp.asarray(cfg.simdt,
-                                                    sw.simt.dtype))
+                                                    sw.simt.dtype),
+                                        smooth=cfg.smooth)
         ac = kinematics.update_groundspeed(ac, windn, winde)
         ac = kinematics.update_position(ac, sw.pilot,
                                         jnp.asarray(cfg.simdt,
                                                     sw.simt.dtype))
         ac = noise.turbulence_woosh(ac, kt, jnp.asarray(
-            cfg.simdt, sw.simt.dtype), cfg.noise)
+            cfg.simdt, sw.simt.dtype), cfg.noise, smooth=cfg.smooth)
         live = ac.active
         frz = lambda new, old: jnp.where(live, new, old)
         ac = ac.replace(

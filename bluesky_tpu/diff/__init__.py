@@ -1,0 +1,33 @@
+"""Differentiable simulation: gradients through the step scan.
+
+The whole hot path (core/step.py) is pure JAX, so the simulator is one
+``jax.grad`` away from gradient-based trajectory optimization and ML
+research — the parallelized differentiable traffic-simulation shape of
+arXiv:2412.16750, served on the same fabric as every other workload
+(an ``OPT`` BATCH piece whose journal-logged result is the optimized
+offsets + objective trace, network/server.py).
+
+Three modules:
+
+* ``smooth``     — the documented relaxations that make the step scan
+                   usefully differentiable (``SmoothConfig`` rides on
+                   ``SimConfig.smooth``; ``smooth=None`` — the default
+                   everywhere — is bit-identical to the hard step).
+* ``objectives`` — the differentiable objective library: fuel burn,
+                   soft (sigmoid) LoS count with an annealable
+                   temperature, waypoint-deviation penalties, plus the
+                   HARD LoS trace used to verify optimized plans.
+* ``optimize``   — the trajectory-optimization driver: Adam descent on
+                   per-aircraft lateral-waypoint/time offsets via
+                   ``jax.value_and_grad`` over the chunked scan
+                   (``jax.checkpoint`` across chunk boundaries keeps
+                   memory O(chunk)), with the integrity-guard word
+                   extended over the backward pass and optional
+                   multi-start batching on the PR-6 world axis.
+
+docs/PERF_ANALYSIS.md §differentiable documents the relaxation choices
+and the checkpointing memory model; docs/commands.md the ``OPT`` /
+``GRAD`` stack commands and journal record.
+"""
+from .smooth import SmoothConfig                      # noqa: F401
+from .objectives import ObjectiveWeights              # noqa: F401

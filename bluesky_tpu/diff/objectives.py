@@ -1,0 +1,126 @@
+"""Differentiable objective library for trajectory optimization.
+
+Three cost families (arXiv:2412.16750's shapes), all accumulated INSIDE
+the rollout scan so memory stays O(state), never O(trajectory):
+
+* **Soft LoS count** — the loss-of-separation predicate ``(dist < rpz)
+  & (|dalt| < hpz)`` relaxed to a product of sigmoids
+  (diff/smooth.soft_los_weight) with a DYNAMIC temperature the
+  optimizer anneals without recompiling; summed over unique live pairs
+  and steps.  ``temp -> 0`` recovers the hard per-step pair count.
+* **Fuel burn** — the per-step integral of the performance model's
+  ``fuelflow`` column over live aircraft: already smooth (core/perf.py
+  computes it from the drag polar / thrust ratio every step).
+* **Waypoint-deviation penalty** — quadratic regularizer on the
+  optimized offsets in natural units (lateral in protected-zone radii,
+  time shifts in ``TSHIFT_SCALE`` seconds), keeping optimized plans
+  close to the filed ones.
+
+The HARD metrics (``hard_los_count`` / the rollout trace in
+diff/optimize.py) evaluate the exact serving predicate — optimized
+plans are verified against the hard metric, never the relaxation.
+"""
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from ..ops import geo
+from .smooth import soft_los_weight
+
+
+#: natural scale of the per-aircraft departure-time offsets [s]
+TSHIFT_SCALE = 60.0
+
+
+class ObjectiveWeights(NamedTuple):
+    """Objective mix (hashable -> jit-static)."""
+    w_los: float = 1.0       # soft LoS count (the safety term)
+    w_fuel: float = 1e-6     # [1/kg] fuel burn
+    w_dev: float = 1e-3      # waypoint/time deviation regularizer
+
+
+def _pair_geometry(ac, eps_m2=1.0):
+    """Flat-earth pairwise horizontal distance [m] + altitude gap [m].
+
+    Same small-angle geometry as the resume-nav predicates
+    (ops/cr_mvp.resume_displacement); ``eps_m2`` regularizes the sqrt
+    at the (masked) diagonal so gradients stay finite.
+    """
+    lat, lon = ac.lat, ac.lon
+    dist_e = geo.REARTH * (jnp.radians(lon[None, :] - lon[:, None])
+                           * jnp.cos(0.5 * jnp.radians(lat[None, :]
+                                                       + lat[:, None])))
+    dist_n = geo.REARTH * jnp.radians(lat[None, :] - lat[:, None])
+    dist = jnp.sqrt(dist_e * dist_e + dist_n * dist_n + eps_m2)
+    dalt = ac.alt[None, :] - ac.alt[:, None]
+    return dist, dalt
+
+
+def _pairmask(ac):
+    n = ac.lat.shape[0]
+    eye = jnp.eye(n, dtype=bool)
+    return (ac.active[:, None] & ac.active[None, :]) & ~eye
+
+
+def soft_los_cost(state, rpz, hpz, temp):
+    """Soft (sigmoid) LoS count of one state: sum over unique live
+    pairs of ``soft_los_weight`` — the annealable safety objective.
+    ``temp`` is traced (annealed without recompiling)."""
+    dist, dalt = _pair_geometry(state.ac)
+    w = soft_los_weight(dist, dalt, rpz, hpz, temp)
+    mask = _pairmask(state.ac)
+    return 0.5 * jnp.sum(jnp.where(mask, w, 0.0))
+
+
+def fuel_cost(state, simdt):
+    """Fuel burned this step [kg]: fuelflow integral over live rows."""
+    live = state.ac.active
+    return jnp.sum(jnp.where(live, state.perf.fuelflow, 0.0)) * simdt
+
+
+def step_cost(state, rpz, hpz, weights: ObjectiveWeights, temp, simdt):
+    """Per-step objective increment, accumulated in the rollout carry.
+
+    ``rpz``/``hpz`` are the SOFT zone sizes — the driver inflates them
+    by ``los_margin`` over the verification zone so plans carry a
+    buffer against the smooth-vs-hard model mismatch (measured < 1 km
+    over a 400 s rollout; diff/optimize.hard_los_trace)."""
+    c = weights.w_los * soft_los_cost(state, rpz, hpz, temp)
+    if weights.w_fuel:
+        c = c + weights.w_fuel * fuel_cost(state, simdt)
+    return c
+
+
+def deviation_penalty(lateral_m, tshift_s, rpz,
+                      weights: ObjectiveWeights):
+    """Quadratic waypoint/time-deviation regularizer in natural units
+    (lateral in protected-zone radii, time in TSHIFT_SCALE seconds)."""
+    return weights.w_dev * (jnp.sum((lateral_m / rpz) ** 2)
+                            + jnp.sum((tshift_s / TSHIFT_SCALE) ** 2))
+
+
+# ----------------------------------------------------------- hard metrics
+def hard_los_matrix(state, rpz, hpz):
+    """The EXACT serving LoS predicate (ops/cd.detect's ``swlos``:
+    great-circle pair distance, hard comparisons) — the verification
+    metric for optimized plans."""
+    ac = state.ac
+    _, distnm = geo.qdrdist_matrix(ac.lat, ac.lon, ac.lat, ac.lon)
+    dist = distnm * geo.nm
+    dalt = ac.alt[None, :] - ac.alt[:, None]
+    return (dist < rpz) & (jnp.abs(dalt) < hpz) & _pairmask(state.ac)
+
+
+def hard_los_count(state, rpz, hpz):
+    """Directional hard-LoS pair count of one state (int32) — matches
+    ``nlos_cur``'s counting convention (core/asas.py)."""
+    return jnp.sum(hard_los_matrix(state, rpz, hpz), dtype=jnp.int32)
+
+
+def anneal_schedule(temp0, temp1, iters):
+    """Geometric temperature annealing schedule (host-side list)."""
+    import numpy as np
+    if iters <= 1:
+        return [float(temp1)]
+    r = (float(temp1) / float(temp0)) ** (1.0 / (iters - 1))
+    return [float(temp0) * r ** k for k in range(iters)]

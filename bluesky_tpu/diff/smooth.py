@@ -1,0 +1,142 @@
+"""Documented relaxations of the step scan's hard gates.
+
+``SimConfig.smooth`` (core/step.py) carries a ``SmoothConfig`` — or
+``None``, the default, in which case every call site takes its original
+code path at TRACE time, so the serving scan is bit-identical to the
+pre-relaxation step (tests/test_diff.py pins this; the relaxations can
+never leak into the serving path).
+
+Relaxation inventory (each one is a *documented choice*, not a silent
+approximation — docs/PERF_ANALYSIS.md §differentiable):
+
+1. **Conflict indicator → sigmoid with temperature.**  The hard pair
+   predicate ``swconfl`` (ops/cd.detect: four chained comparisons on
+   CPA geometry) becomes a product of sigmoids on the same margins
+   (``soft_conflict_weight``), so a pair approaching conflict
+   contributes a smoothly growing repulsion instead of a step.  The
+   per-aircraft engagement *selection* stays hard-forward (both
+   branches of the ``jnp.where`` are differentiable); the gradient
+   signal rides the contribution weights.
+2. **Resolver min/max → softmin/softmax.**  MVP's per-ownship vertical
+   solve time (``min`` over conflict pairs) becomes a weighted softmin
+   (``softmin_weighted``); the velocity caps in
+   ``cr_mvp.resolve_from_sums`` become straight-through clips.
+3. **Hard performance-limit clamps → straight-through estimators.**
+   ``perf.limits`` / the resolver caps keep their exact forward values
+   (``ste_clip``: forward = ``jnp.clip``, backward = identity), so the
+   envelope is enforced bit-exactly while gradients keep flowing when
+   an intent is pinned against a limit.
+4. **Bang-bang kinematic captures → clipped proportional steps.**  The
+   turn / TAS / VS dynamics (core/kinematics.update_airspeed) advance
+   by ``sign(error) * rate`` under a dead-band — zero gradient
+   everywhere.  Smooth mode advances by ``ste_clip(error, ±rate·dt)``:
+   outside the dead-band the forward value is identical (full-rate
+   step toward the target), inside it the state captures exactly
+   instead of chattering, and the clip's straight-through backward
+   carries d(state)/d(target) ≈ 1 through the saturation.
+5. **RNG noise stop-gradiented.**  Turbulence/ADS-B draws are wrapped
+   in ``lax.stop_gradient`` (core/noise.py): the draws are
+   parameter-independent by construction, and pinning them keeps the
+   backward pass from ever differentiating through ``jax.random``
+   internals.
+
+Temperatures are *static* (part of the hashable config — they change at
+optimizer-schedule cadence, and the soft-LoS objective anneals its OWN
+dynamic temperature; see diff/objectives.py).
+"""
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SmoothConfig(NamedTuple):
+    """Relaxation temperatures (hashable → jit-static on SimConfig).
+
+    ``temp_conf`` scales the conflict-indicator sigmoids in units of
+    the natural margin (rpz² for the CPA distance, lookahead for the
+    times); ``temp_min`` is the softmin sharpness for resolver
+    reductions in units of the reduced quantity's scale.
+    """
+    temp_conf: float = 0.1     # conflict sigmoid temperature [x margin]
+    temp_min: float = 0.05     # softmin temperature [x tlookahead]
+    ste_caps: bool = True      # straight-through resolver/perf clamps
+    stop_grad_noise: bool = True  # lax.stop_gradient on RNG draws
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def ste_clip(x, lo, hi):
+    """Straight-through clip: forward ``jnp.clip(x, lo, hi)``, backward
+    identity — the documented STE for hard performance/velocity caps."""
+    return x + jax.lax.stop_gradient(jnp.clip(x, lo, hi) - x)
+
+
+def softmin_weighted(x, w, temp, big=1e9):
+    """Weighted softmin over the last axis: smooth stand-in for
+    ``min(where(mask, x, big))``.
+
+    ``w`` in [0, 1] are the (sigmoid) pair weights; entries with w≈0
+    drop out exactly like masked entries of the hard min.  ``temp`` is
+    the softmin temperature in x's units.  Returns the hard masked min
+    as ``temp -> 0``.
+    """
+    xe = jnp.where(w > 0.0, x, big)
+    xmin = jnp.min(xe, axis=-1, keepdims=True)
+    # log-sum-exp softmin, weight-scaled; fully masked rows return big
+    e = w * jnp.exp(-(xe - xmin) / temp)
+    den = jnp.sum(e, axis=-1)
+    num = jnp.sum(e * xe, axis=-1)
+    return jnp.where(den > 1e-30, num / jnp.maximum(den, 1e-30),
+                     jnp.squeeze(xmin, -1))
+
+
+def softmax_weighted(x, w, temp, big=1e9):
+    """Weighted softmax reduction — the dual of ``softmin_weighted``
+    (the documented resolver min/max relaxation family; the MVP path
+    only reduces with min today, so this is the library's max side)."""
+    return -softmin_weighted(-x, w, temp, big=big)
+
+
+def soft_conflict_weight(cd, rpz, tlookahead, smooth: SmoothConfig):
+    """Sigmoid relaxation of the hard conflict predicate
+    (ops/cd.detect: ``swconfl = swhorconf & (tin <= tout) & (tout > 0)
+    & (tin < tlookahead) & pairmask``) on the SAME CPA geometry.
+
+    Each comparison margin becomes a sigmoid at its natural scale:
+    the CPA miss distance against rpz² (scale ``temp_conf * rpz²``)
+    and the window times against the lookahead (scale ``temp_conf *
+    tlookahead``).  Masked/diagonal pairs carry the detect kernel's
+    1e9 exclusion offsets, which drive every sigmoid to 0 exactly.
+    Returns a [N, N] weight in [0, 1]; ``temp_conf -> 0`` recovers the
+    boolean predicate a.e.
+    """
+    r2 = rpz * rpz
+    th = smooth.temp_conf * r2
+    tt = smooth.temp_conf * tlookahead
+    w = sigmoid((r2 - cd.dcpa2) / th)
+    w = w * sigmoid((cd.toutconf - cd.tinconf) / tt)
+    w = w * sigmoid(cd.toutconf / tt)
+    w = w * sigmoid((tlookahead - cd.tinconf) / tt)
+    return w
+
+
+def soft_los_weight(dist, dalt, rpz, hpz, temp):
+    """Sigmoid relaxation of the LoS predicate ``(dist < rpz) &
+    (|dalt| < hpz)`` — the soft-LoS objective kernel
+    (diff/objectives.py).  ``temp`` is DYNAMIC (annealed by the
+    optimizer without recompiling): a fraction of the zone size.
+    """
+    wh = sigmoid((rpz - dist) / (temp * rpz))
+    wv = sigmoid((hpz - jnp.abs(dalt)) / (temp * hpz))
+    return wh * wv
+
+
+def capture_step(error, max_step):
+    """Relaxed bang-bang capture: advance toward the target by the
+    full-rate step, saturating exactly at the error (no overshoot /
+    chatter), with a straight-through backward (see module docstring
+    item 4).  ``max_step`` = rate * dt >= 0."""
+    return ste_clip(error, -max_step, max_step)

@@ -32,6 +32,9 @@ class Client:
         #                             admission-control refusal carries
         #                             queue depth + a retry-after hint)
         self.last_health = None     # latest HEALTH reply payload
+        self.opt_results = []       # BATCHOPT reports (OPT-piece
+        #                             trajectory-optimization results:
+        #                             offsets + objective trace)
         ctx = zmq.Context.instance()
         self.event_io = ctx.socket(zmq.DEALER)
         self.event_io.setsockopt(zmq.IDENTITY, self.client_id)
@@ -169,6 +172,8 @@ class Client:
                 self.last_rejection = data   # retry logic reads this
             elif name == b"HEALTH":
                 self.last_health = data
+            elif name == b"BATCHOPT":
+                self.opt_results.append(data)
             sender = route[0] if route else b""
             self.event_received.emit(name, data, sender)
 

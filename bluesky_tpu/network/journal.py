@@ -36,6 +36,13 @@ Record types (one JSON object per line, ``rec`` selects the type):
                                             content twice must not have
                                             its second copy consumed by
                                             a hedge duplicate)
+  ``opt_result``  {key, worker, result}     trajectory-optimization
+                                            output of an OPT piece
+                                            (diff/optimize.py: offsets,
+                                            objective trace, hard-LoS
+                                            before/after, guard word) —
+                                            audit only, queue math
+                                            ignores it
   ``resumed``     {pending, completed, quarantined}  replay marker
   ``shutdown``    {}                        clean server exit
 
@@ -181,6 +188,17 @@ class BatchJournal:
         self.append("dup_completed", key=self.piece_key(piece),
                     worker=worker.hex())
 
+    def opt_result(self, piece, worker: bytes = b"", result=None):
+        """Trajectory-optimization result of an OPT piece
+        (diff/optimize.OptResult.to_payload: optimized offsets,
+        objective trace, hard-LoS before/after, guard word).  AUDIT
+        data: replay surfaces it under ``opt_results`` but the queue
+        math ignores it (the piece's own ``completed`` record still
+        governs exactly-once)."""
+        self.append("opt_result", key=self.piece_key(piece),
+                    worker=worker.hex(),
+                    result=result if isinstance(result, dict) else None)
+
     def shutdown(self):
         # clean-exit marker — only if this run ever journaled anything
         # (a server that never saw a BATCH must not litter log_path
@@ -220,6 +238,7 @@ class BatchJournal:
         n_queued, n_completed = {}, {}
         quarantined_keys = set()
         crashes, qcrashes = {}, {}
+        opt_results = []
         torn = 0
         # errors="replace": disk-level byte corruption must surface as
         # skipped torn lines, not a UnicodeDecodeError that escapes the
@@ -262,6 +281,11 @@ class BatchJournal:
                     quarantined_keys.add(key)
                     qcrashes[key] = int(r.get("crashes", 0))
                     crashes.pop(key, None)
+                elif rec == "opt_result":
+                    # audit record of an OPT piece's optimization output
+                    # — surfaced for inspection, ignored by queue math
+                    opt_results.append({"key": key,
+                                        "result": r.get("result")})
 
         def owed(k):
             if k in quarantined_keys:
@@ -277,5 +301,6 @@ class BatchJournal:
                          if k in quarantined_keys],
             crashes={k: c for k, c in crashes.items() if owed(k) > 0},
             quarantined_crashes=qcrashes,
+            opt_results=opt_results,
             torn_lines=torn,
         )

@@ -263,6 +263,7 @@ class Server(threading.Thread):
         self.world_batches = 0             # packed dispatches sent
         self._pack_fill_sum = 0.0          # sum of per-dispatch fill
         self.worlds_refused_spatial = 0    # spatial pieces kept out of packs
+        self.worlds_refused_opt = 0        # OPT/GRAD pieces kept out of packs
         self.worlds_failed = 0             # per-world failure reports
         self.worlds_demux_s = 0.0          # host time spent demuxing
         self.worlds_demux_events = 0
@@ -277,6 +278,7 @@ class Server(threading.Thread):
         self.hedges_cancelled = 0          # losers that acked the cancel
         self.dup_completions = 0           # losers that finished anyway
         self.rejected_batches = 0          # BATCHREJECTED sent
+        self.opt_results = 0               # OPTRESULT reports journaled
         self.stream_drops = 0              # stream frames dropped at HWM
         self._completion_stamps = collections.deque(maxlen=64)
         # ----- durable BATCH state: append-only JSONL journal (WAL)
@@ -417,6 +419,27 @@ class Server(threading.Thread):
         structured echo (WORLDSREFUSED) and dispatches them solo."""
         return any("SHARD" in c.upper() and "SPATIAL" in c.upper()
                    for c in piece[1])
+
+    @staticmethod
+    def _piece_solo_reason(piece):
+        """Reason string when a piece must dispatch UNPACKED, or None.
+
+        * ``shard_mode=spatial`` — stripes compose with the world axis
+          later, not now;
+        * ``opt`` — an OPT piece's result event (``OPTRESULT``) and its
+          journal record need the worker's own event socket, which the
+          world sims of a packed assignment do not have; the optimizer
+          already batches its multi-start particles on the world axis
+          INTERNALLY (diff/optimize.py), so packing it again wins
+          nothing.
+        """
+        if Server._piece_spatial(piece):
+            return "shard_mode=spatial"
+        for c in piece[1]:
+            head = c.strip().upper().replace(",", " ").split(None, 1)
+            if head and head[0] in ("OPT", "GRAD"):
+                return "opt"
+        return None
 
     def _report_clients(self, text, name=b"ECHO", data=None):
         """Fan a server-originated event out to every connected client
@@ -655,6 +678,32 @@ class Server(threading.Thread):
                         self._piece_failed(p, pack.owners[i])
                     self.worlds_demux_s += time.perf_counter() - t0
                     self.worlds_demux_events += 1
+        elif name == b"OPTRESULT" and from_worker:
+            # Trajectory-optimization result from an OPT BATCH piece
+            # (diff/optimize.py via the OPT stack command): journal it
+            # against the in-flight piece BEFORE the piece's completion
+            # lands (the FIFO pair guarantees OPTRESULT precedes the
+            # STATECHANGE out of OP), and fan a machine-readable
+            # BATCHOPT report out to the clients.  The journal record
+            # is audit data: replay ignores it for the queue math.
+            data = unpackb(payload) if payload else None
+            piece = self.inflight.get(sender)
+            self.opt_results += 1
+            if self.journal and piece is not None \
+                    and not isinstance(piece, WorldPack):
+                self.journal.opt_result(piece, sender, data)
+            d = data if isinstance(data, dict) else {}
+            msg = (f"OPT result from worker {sender.hex()}: objective "
+                   f"{d.get('objective_first', '?')} -> "
+                   f"{d.get('objective_last', '?')} in "
+                   f"{d.get('iters', '?')} iters, hard LoS "
+                   f"{d.get('hard_los_before', '?')} -> "
+                   f"{d.get('hard_los_after', '?')}"
+                   + (f", guard word {d['bad']}"
+                      if d.get("bad", -1) != -1 else ""))
+            print(f"server: {msg}")
+            self._report_clients(msg)
+            self._report_clients(msg, name=b"BATCHOPT", data=data)
         elif name == b"WORLDS":
             # WORLDS stack/client command: set the packing knobs
             # (payload dict) and/or read them back HEALTH-style
@@ -777,37 +826,47 @@ class Server(threading.Thread):
         picks = []
         while len(picks) < wmax and self.scenarios:
             owner, piece = self.scenarios.pop_next()
-            if self.world_pack and wmax > 1 \
-                    and self._piece_spatial(piece) and picks:
-                # pack already filling: refuse the spatial piece from
+            solo_why = self._piece_solo_reason(piece) \
+                if self.world_pack and wmax > 1 else None
+            if solo_why and picks:
+                # pack already filling: refuse the solo-only piece from
                 # THIS pack with a structured echo — exactly once,
                 # because the piece keeps its fairness turn and takes
                 # the worker SOLO (a requeue would let the FairQueue
                 # rotation re-refuse it on every pack fill); the
                 # pieces already picked go back to their owners' queue
-                # heads and pack on the next idle worker.  A spatial
+                # heads and pack on the next idle worker.  A solo-only
                 # piece popped with the pack still empty just takes
                 # the 1-piece solo path below: nothing was refused.
-                self.worlds_refused_spatial += 1
+                if solo_why == "shard_mode=spatial":
+                    self.worlds_refused_spatial += 1
+                else:
+                    self.worlds_refused_opt += 1
                 pname = self._piece_name(piece)
-                msg = (f"WORLDS: piece '{pname}' requests "
-                       "shard_mode=spatial — refused from the world-"
-                       "batch, dispatching it unpacked (world-batching "
-                       "and spatial stripes compose later, not now)")
+                why_txt = ("requests shard_mode=spatial — refused from "
+                           "the world-batch, dispatching it unpacked "
+                           "(world-batching and spatial stripes compose "
+                           "later, not now)"
+                           if solo_why == "shard_mode=spatial" else
+                           "is an OPT/GRAD piece — refused from the "
+                           "world-batch, dispatching it unpacked (the "
+                           "optimizer multi-starts on the world axis "
+                           "internally and its OPTRESULT needs the "
+                           "worker's own event socket)")
+                msg = f"WORLDS: piece '{pname}' {why_txt}"
                 print(f"server: {msg}")
                 self._report_clients(msg)
                 self._report_clients(
                     msg, name=b"WORLDSREFUSED",
-                    data={"piece": pname, "reason": "shard_mode=spatial",
+                    data={"piece": pname, "reason": solo_why,
                           "scencmd": list(piece[1])})
                 for powner, p in reversed(picks):
                     self.scenarios.push_front(p, powner)
                 picks = [(owner, piece)]
                 break
             picks.append((owner, piece))
-            if self.world_pack and wmax > 1 \
-                    and self._piece_spatial(piece):
-                break    # spatial piece dispatches solo, never packs
+            if solo_why:
+                break    # solo-only piece dispatches alone, never packs
         self.inflight_t[wid] = time.monotonic()
         prog = self.worker_progress.get(wid)
         if prog is not None:               # straggler clock restarts at
@@ -990,6 +1049,8 @@ class Server(threading.Thread):
              "packed_pieces": self.packed_pieces,
              "fill_ratio": round(avg_fill, 3),
              "refused_spatial": self.worlds_refused_spatial,
+             "refused_opt": self.worlds_refused_opt,
+             "opt_results": self.opt_results,
              "worlds_failed": self.worlds_failed,
              "demux_events": self.worlds_demux_events,
              "demux_ms_avg": round(demux_ms, 3)}
@@ -998,7 +1059,8 @@ class Server(threading.Thread):
             f"{d['batch_max']} pieces/dispatch; {d['world_batches']} "
             f"world-batch(es) sent carrying {d['packed_pieces']} "
             f"piece(s), fill {d['fill_ratio']:.0%}; "
-            f"{d['refused_spatial']} spatial refusal(s), "
+            f"{d['refused_spatial']} spatial + {d['refused_opt']} "
+            f"OPT/GRAD refusal(s), "
             f"{d['worlds_failed']} world failure(s); demux "
             f"{d['demux_events']} event(s), avg {d['demux_ms_avg']:.2f} "
             "ms")
@@ -1075,7 +1137,9 @@ class Server(threading.Thread):
                 f"(max {w['batch_max']}), {w['world_batches']} "
                 f"batch(es)/{w['packed_pieces']} packed piece(s), "
                 f"fill {w['fill_ratio']:.0%}, "
-                f"{w['refused_spatial']} spatial refusal(s), "
+                f"{w['refused_spatial']} spatial + "
+                f"{w['refused_opt']} OPT/GRAD refusal(s), "
+                f"{w['opt_results']} OPT result(s), "
                 f"demux avg {w['demux_ms_avg']:.2f} ms")
         for wid, w in d["workers"].items():
             line = (f"  {wid[:8]}: state {w['state']}, "

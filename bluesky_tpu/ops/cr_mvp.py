@@ -146,7 +146,7 @@ def pair_contrib_trig(sin_qdr, cos_qdr, dist, tcpa, tlos,
 def resolve(cd, alt, gseast, gsnorth, vs, trk, gs,
             selalt, ap_vs, prev_alt,
             vmin, vmax, vsmin, vsmax, cfg,
-            noreso=None, resooff=None):
+            noreso=None, resooff=None, wconf=None, smooth=None):
     """Compute per-aircraft resolution commands from the conflict matrix.
 
     Args mirror the data the reference resolver reads from ``traf``/``asas``:
@@ -158,6 +158,16 @@ def resolve(cd, alt, gseast, gsnorth, vs, trk, gs,
       vmin..vsmax:  ASAS velocity caps (scalars or [N])
       noreso:       [N] bool — aircraft nobody needs to avoid (MVP.py:52-56)
       resooff:      [N] bool — aircraft that do not resolve (MVP.py:58-61)
+      wconf:        [N,N] float in [0,1] or None — differentiable-mode
+                    SIGMOID conflict weights (diff/smooth.py) replacing
+                    the hard ``cd.swconfl`` mask on the contribution
+                    sums: a pair approaching conflict contributes a
+                    smoothly growing repulsion.  None (default) is the
+                    exact boolean path.
+      smooth:       diff.smooth.SmoothConfig or None — softmin for the
+                    per-ownship vertical solve time (the resolver's
+                    hard min reduction) and straight-through velocity
+                    caps in ``resolve_from_sums``.
 
     Returns (newtrk, newgs, newvs, newalt, asase, asasn): the ASAS command
     arrays (reference stores these on the asas object, MVP.py:103-143).
@@ -171,7 +181,15 @@ def resolve(cd, alt, gseast, gsnorth, vs, trk, gs,
     if noreso is not None:
         mask = mask & ~noreso[None, :]
 
-    maskf = mask.astype(dve_p.dtype)
+    if wconf is not None:
+        # sigmoid weights; excluded/diagonal pairs carry the detect
+        # kernel's 1e9 offsets, which drive their weight to exactly 0
+        # (the pair fields there are finite masked garbage, so 0 * x
+        # stays 0 — no NaN leakage)
+        maskf = wconf if noreso is None \
+            else wconf * (~noreso[None, :]).astype(dve_p.dtype)
+    else:
+        maskf = mask.astype(dve_p.dtype)
     vmaskf = maskf
     if cfg.swprio and cfg.priocode != "FF1":
         # Priority rules (MVP.py:235-300), as per-directional-pair apply
@@ -213,20 +231,27 @@ def resolve(cd, alt, gseast, gsnorth, vs, trk, gs,
     sum_dvv = jnp.sum(dvv_p * vmaskf, axis=1)
 
     # Vertical solve time: min over this ownship's conflicts (MVP.py:41-42)
-    tsolv = jnp.min(jnp.where(mask, tsolv_p, 1e9), axis=1)
+    # — the resolver's hard min reduction; softmin in differentiable
+    # mode (the documented resolver min/max relaxation, diff/smooth.py)
+    if wconf is not None and smooth is not None:
+        from ..diff.smooth import softmin_weighted
+        tsolv = softmin_weighted(tsolv_p, maskf,
+                                 smooth.temp_min * cfg.tlookahead)
+    else:
+        tsolv = jnp.min(jnp.where(mask, tsolv_p, 1e9), axis=1)
 
     return resolve_from_sums(
         sum_dve, sum_dvn, sum_dvv, tsolv,
         alt, gseast, gsnorth, vs, trk, gs,
         selalt, ap_vs, prev_alt, vmin, vmax, vsmin, vsmax, cfg,
-        resooff=resooff)
+        resooff=resooff, smooth=smooth)
 
 
 def resolve_from_sums(sum_dve, sum_dvn, sum_dvv, tsolv,
                       alt, gseast, gsnorth, vs, trk, gs,
                       selalt, ap_vs, prev_alt,
                       vmin, vmax, vsmin, vsmax, cfg,
-                      resooff=None):
+                      resooff=None, smooth=None):
     """Per-aircraft command synthesis from accumulated pair contributions.
 
     ``sum_dv*`` are the plain sums over conflict pairs of the per-pair MVP
@@ -268,9 +293,16 @@ def resolve_from_sums(sum_dve, sum_dvn, sum_dvv, tsolv,
     else:
         newtrk, newgs_, newvs = full_trk, full_gs, newv_v
 
-    # Velocity caps (MVP.py:106-109)
-    newgs_ = jnp.clip(newgs_, vmin, vmax)
-    newvs = jnp.clip(newvs, vsmin, vsmax)
+    # Velocity caps (MVP.py:106-109) — straight-through in
+    # differentiable mode (exact forward, identity backward: the
+    # documented clamp STE, diff/smooth.py)
+    if smooth is not None and smooth.ste_caps:
+        from ..diff.smooth import ste_clip
+        newgs_ = ste_clip(newgs_, vmin, vmax)
+        newvs = ste_clip(newvs, vsmin, vsmax)
+    else:
+        newgs_ = jnp.clip(newgs_, vmin, vmax)
+        newvs = jnp.clip(newvs, vsmin, vsmax)
 
     # Resolution vector for display/streams (MVP.py:117-118)
     asase = jnp.where(has_reso, newgs_ * jnp.sin(jnp.radians(newtrk)), 0.0)

@@ -129,6 +129,28 @@ shard_halo_blocks = 0             # spatial halo width in 256-slot blocks
                                   # reach bound + drift margin at every
                                   # refresh)
 
+# ----- differentiable simulation (bluesky_tpu/diff/; OPT/GRAD stack
+# commands; docs/PERF_ANALYSIS.md §differentiable).  The OPT driver
+# descends on per-aircraft waypoint/time offsets with jax.value_and_grad
+# over the smooth step scan; these are its defaults (stack-command
+# arguments override per run).
+opt_tend = 600.0                  # [sim s] optimization rollout horizon
+opt_simdt = 1.0                   # [s] smooth-rollout step (coarser than
+                                  # the serving 0.05 s; the hard-metric
+                                  # verification runs at opt_verify_dt)
+opt_chunk = 50                    # steps per jax.checkpoint chunk —
+                                  # backward memory stays O(chunk)
+opt_iters = 40                    # Adam iterations
+opt_lr = 0.15                     # Adam LR (normalized offset units)
+opt_temp0 = 0.3                   # soft-LoS temperature: anneal start
+opt_temp1 = 0.05                  # ... and end (fractions of rpz/hpz)
+opt_restarts = 1                  # multi-start particles batched on the
+                                  # PR-6 world axis (best particle wins)
+opt_los_margin = 1.2              # soft-zone inflation over the hard
+                                  # rpz: buffer against the measured
+                                  # <1 km smooth-vs-hard model mismatch
+opt_verify_dt = 0.05              # [s] hard-metric verification step
+
 # ----- durable runs (preemption-safe checkpoints + BATCH journal)
 snapshot_autosave_dt = 0.0        # [sim s] between on-disk autosnapshots
                                   # of the newest ring entry (0 = off)

@@ -652,6 +652,59 @@ class Simulation:
         self.op()
         return True
 
+    # -------------------------------------------- differentiable workloads
+    def optimize_trajectories(self, tend=None, iters=None, lr=None,
+                              restarts=None, **kw):
+        """Gradient-based trajectory optimization of the CURRENT fleet
+        (the OPT stack command; bluesky_tpu/diff/optimize.py).
+
+        Drains the pipeline + flushes pending creations so the
+        optimizer sees the true state, descends on per-aircraft lateral
+        waypoint / departure-time offsets against the soft-LoS + fuel
+        objective, verifies against the hard metric, and routes any
+        guard trip (non-finite forward step, objective or gradient —
+        the run_steps_checked word extended over the backward pass)
+        through the integrity guard's trip log.  Returns the
+        diff.optimize.OptResult.
+        """
+        from .. import settings as _s
+        from ..diff import optimize as diffopt
+        self.drain_pipeline()
+        self.traf.flush()
+        result = diffopt.optimize(
+            self.traf.state, self.cfg.asas,
+            tend=float(tend if tend is not None
+                       else getattr(_s, "opt_tend", 600.0)),
+            simdt=float(kw.pop("simdt", getattr(_s, "opt_simdt", 1.0))),
+            chunk=int(kw.pop("chunk", getattr(_s, "opt_chunk", 50))),
+            iters=int(iters if iters is not None
+                      else getattr(_s, "opt_iters", 40)),
+            lr=float(lr if lr is not None
+                     else getattr(_s, "opt_lr", 0.15)),
+            temp0=float(kw.pop("temp0", getattr(_s, "opt_temp0", 0.3))),
+            temp1=float(kw.pop("temp1", getattr(_s, "opt_temp1", 0.05))),
+            restarts=int(restarts if restarts is not None
+                         else getattr(_s, "opt_restarts", 1)),
+            los_margin=float(kw.pop("los_margin",
+                                    getattr(_s, "opt_los_margin", 1.2))),
+            verify_simdt=float(kw.pop("verify_simdt",
+                                      getattr(_s, "opt_verify_dt",
+                                              0.05))),
+            **kw)
+        if result.bad != -1:
+            # backward-pass guard trip: record through the SAME
+            # machinery forward trips use (fault/guard.py), so FAULTLOG
+            # consumers and tests see one trip stream
+            self.guard.trips.append({
+                "simt": self.simt, "bad_step": int(result.bad),
+                "ids": [], "action": "opt_halt",
+                "source": "diff.optimize backward guard"})
+            self.scr.echo(
+                f"OPT: integrity-guard trip (word {result.bad}: "
+                f"{'non-finite gradients' if result.bad == -3 else 'non-finite objective' if result.bad == -2 else 'forward step'})"
+                " — descent halted at the last finite iterate")
+        return result
+
     # ----------------------------------------------------------------- step
     def step(self, max_chunk: Optional[int] = None):
         """One host iteration: scenario triggers + stack + a device chunk.

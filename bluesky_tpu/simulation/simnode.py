@@ -6,6 +6,16 @@ Event surface (same tokens as the reference): STACKCMD, STEP, BATCH, QUIT,
 GETSIMSTATE.  State changes are reported to the server via STATECHANGE so
 the BATCH farm can schedule the next scenario piece on this worker when it
 finishes (server.py:234-247 semantics).
+
+OPT BATCH pieces (differentiable workloads, bluesky_tpu/diff/): a piece
+whose scenario runs the OPT stack command blocks this loop for the
+optimization's duration — the server's busy-PING budget
+(hb_busy_multiplier) covers it exactly like a long first compile — then
+sends its OPTRESULT upstream on this node's event socket and HOLDs, so
+the piece's ``completed`` record follows the journaled ``opt_result``
+on the FIFO pair.  The server never packs OPT pieces into world-batches
+(the optimizer multi-starts on the world axis internally; see
+network/server.py _piece_solo_reason).
 """
 from .. import settings
 from ..network import node as netnode

@@ -1104,6 +1104,63 @@ def register_all(stack):
                          if getattr(sim, 'straggle_stall', False)
                          else ""))
 
+    def optcmd(tend=None, iters=None, lr=None, restarts=None):
+        """OPT [tend,iters,lr,restarts]: gradient-based trajectory
+        optimization of the current fleet (bluesky_tpu/diff/) — Adam
+        descent on per-aircraft lateral-waypoint/time offsets via
+        jax.value_and_grad over the checkpointed smooth step scan,
+        verified against the hard LoS metric.  On a networked worker
+        the result (optimized offsets + objective trace) is reported
+        upstream as an OPTRESULT event the server journals against the
+        in-flight BATCH piece; the sim then HOLDs, completing the
+        piece.  Defaults from settings.opt_* knobs."""
+        if traf.ntraf == 0:
+            return False, "OPT: no traffic to optimize"
+        try:
+            res = sim.optimize_trajectories(tend, iters, lr, restarts)
+        except (ValueError, RuntimeError) as e:
+            return False, f"OPT: {e}"
+        slots = np.nonzero(np.asarray(st().ac.active))[0].tolist()
+        payload = res.to_payload(traf.ids, slots)
+        node = getattr(sim, "node", None)
+        if node is not None and getattr(node, "event_io", None) \
+                is not None:
+            node.send_event(b"OPTRESULT", payload)
+        sim.pause()      # leave OP: a BATCH piece completes here
+        ok = res.bad == -1
+        return ok, (
+            f"OPT: objective {res.objective[0]:.3f} -> "
+            f"{res.objective[-1]:.3f} in {res.iters} iters "
+            f"({res.restarts} restart(s), best {res.best_restart}); "
+            f"hard LoS {res.hard_los_before} -> {res.hard_los_after}; "
+            f"max |lateral| {float(np.abs(res.lateral_m).max()):.0f} m, "
+            f"max |tshift| {float(np.abs(res.tshift_s).max()):.1f} s"
+            + ("" if ok else f"; GUARD TRIP word {res.bad}"))
+
+    def gradcmd(tend=None):
+        """GRAD [tend]: one checked value_and_grad evaluation of the
+        soft-LoS+fuel objective at zero offsets — reports the
+        objective, gradient norm and the (backward-extended) guard
+        word without descending."""
+        if traf.ntraf == 0:
+            return False, "GRAD: no traffic"
+        from .. import settings as _settings
+        from ..diff import optimize as diffopt
+        sim.drain_pipeline()
+        traf.flush()
+        try:
+            v, gnorm, bad = diffopt.grad_once(
+                st(), sim.cfg.asas,
+                tend=float(tend) if tend is not None
+                else getattr(_settings, "opt_tend", 600.0),
+                simdt=getattr(_settings, "opt_simdt", 1.0),
+                chunk=getattr(_settings, "opt_chunk", 50))
+        except (ValueError, RuntimeError) as e:
+            return False, f"GRAD: {e}"
+        return bad == -1, (
+            f"GRAD: objective {v:.4f}, |grad| {gnorm:.4g}, guard "
+            + ("clean" if bad == -1 else f"TRIPPED (word {bad})"))
+
     def worldscmd(arg=None, val=None):
         """WORLDS [ON/OFF | max n]: multi-world BATCH packing — pack
         compatible pieces into world-batches stepped as one stacked
@@ -1329,6 +1386,15 @@ def register_all(stack):
         "NORESO": ["NORESO [acid]", "[txt]", noreso,
                    "Toggle no-avoidance for an aircraft"],
         "OP": ["OP", "", op, "Start/resume the simulation"],
+        "OPT": ["OPT [tend,iters,lr,restarts]",
+                "[float,int,float,int]", optcmd,
+                "Gradient-based trajectory optimization: descend on "
+                "per-aircraft waypoint/time offsets to zero LoS "
+                "(bluesky_tpu/diff/; result journaled as an OPT BATCH "
+                "piece record)"],
+        "GRAD": ["GRAD [tend]", "[float]", gradcmd,
+                 "One checked value_and_grad of the soft-LoS+fuel "
+                 "objective (reports objective, |grad|, guard word)"],
         "ORIG": ["ORIG acid,latlon", "acid,[latlon]",
                  lambda idx, pos=None: dest_orig("ORIG", idx, pos),
                  "Set origin"],

@@ -1,0 +1,69 @@
+"""CI perf-smoke: differentiable-simulation contract, cheap enough for
+every PR (the perf-smoke lane, .github/workflows/ci.yml).
+
+A tiny 2-aircraft head-on scene is optimized to ZERO hard-metric LoS by
+gradient descent on waypoint/time offsets (the ISSUE-7 demo at CI
+scale), asserting the three contracts:
+
+1. the objective DECREASES (first -> last iterate);
+2. every gradient is finite: the extended guard word stays -1 through
+   forward AND backward passes;
+3. the hard verification scan (exact step, serving dt) confirms the
+   optimized plan: LoS before > 0, after == 0.
+
+Then a micro ``bench.run_grad`` writes BENCH_GRAD.json (uploaded as a
+CI artifact) so forward+backward vs forward-only steps/s regressions
+show in the job log.  Exits non-zero on any violation.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    from bluesky_tpu.diff import optimize as dopt
+
+    traf, acfg = dopt.conflict_scene(2, dtype=jnp.float64)
+    res = dopt.optimize(traf.state, acfg, tend=400.0, simdt=1.0,
+                        chunk=50, iters=25)
+    print(f"grad-smoke: objective {res.objective[0]:.4f} -> "
+          f"{res.objective[-1]:.4f} in {res.iters} iters, "
+          f"guard word {res.bad}, hard LoS "
+          f"{res.hard_los_before} -> {res.hard_los_after}")
+    assert res.bad == -1, \
+        f"integrity-guard trip in the forward/backward pass: {res.bad}"
+    assert all(g == g and abs(g) != float("inf")
+               for g in res.grad_norm), "non-finite gradient norm"
+    assert res.objective[-1] < res.objective[0], \
+        "objective did not decrease"
+    assert res.hard_los_before > 0, \
+        "smoke scene lost its conflict (bad baseline)"
+    assert res.hard_los_after == 0, \
+        f"optimized plan still has {res.hard_los_after} hard LoS"
+    print("grad-smoke: optimize-to-zero-LoS OK")
+
+    # micro fwd+bwd vs fwd-only rows -> BENCH_GRAD.json (CI artifact)
+    import bench
+    out = bench.pop_out_flag(sys.argv, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_GRAD.json"))
+    rows = bench.run_grad(n_ac=50, tend=200.0, simdt=1.0, chunk=50,
+                          reps=1)
+    gr = rows[2]
+    bench.write_bench_json(out, rows, headline={
+        "n": 50, "bwd_over_fwd": gr.get("bwd_over_fwd"),
+        "fwd_bwd_ac_steps_per_s": gr["ac_steps_per_s"],
+        "note": ("CI smoke numbers (runner-noisy, informational); "
+                 "chip rows come from `bench.py --grad` on real "
+                 "hardware")})
+    print("grad-smoke: PASS")
+
+
+if __name__ == "__main__":
+    main()

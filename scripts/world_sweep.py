@@ -32,11 +32,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 
 def sweep(quick=False):
-    import jax
     import bench
 
-    platform = f"{jax.default_backend()}:" \
-        f"{jax.devices()[0].device_kind.lower()}"
+    platform = bench.platform_tag()
     rows = []
     if quick:
         matrix = {100: ((8, 100),), 500: ((8, 50),)}
@@ -119,9 +117,11 @@ def chip_projection():
 
 
 def main():
+    import bench
     quick = "--quick" in sys.argv
-    path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "BENCH_WORLDS.json")
+    path = bench.pop_out_flag(sys.argv, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_WORLDS.json"))
     if "--reproject" in sys.argv:
         # refresh the calibrated projection/headline over the existing
         # measured rows without re-running the sweep
@@ -131,15 +131,12 @@ def main():
                          if "platform" in r), "cpu:cpu")
     else:
         rows, platform = sweep(quick=quick)
-    out = {"rows": rows}
-    proj = chip_projection()
-    if proj is not None:
-        out["projected_chip_headline"] = proj
     # measured headline: the largest N=500 batched row vs its baseline
+    measured = None
     n500 = [r for r in rows if r["n"] == 500 and r.get("worlds", 1) > 1]
     if n500:
         best = max(n500, key=lambda r: r["worlds"])
-        out["measured_headline"] = {
+        measured = {
             "platform": platform, "n": 500, "worlds": best["worlds"],
             "speedup": best.get("speedup"),
             "note": ("single-core CPU boxes are compute-saturated by "
@@ -147,9 +144,10 @@ def main():
                      "lanes — see projected_chip_headline")
             if platform.startswith("cpu") else None,
         }
-    with open(path, "w") as f:
-        json.dump(out, f, indent=1)
-    print(f"wrote {path}")
+    # shared tagging + writing boilerplate lives in bench.py now
+    bench.write_bench_json(path, rows,
+                           projected_chip_headline=chip_projection(),
+                           measured_headline=measured)
 
 
 if __name__ == "__main__":

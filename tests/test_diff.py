@@ -1,0 +1,475 @@
+"""Differentiable simulation (bluesky_tpu/diff/, ISSUE 7).
+
+Pins the four contracts of the new subsystem:
+
+* **smooth=off parity** — ``SimConfig.smooth=None`` (the only value the
+  serving path ever sets) is bit-identical to the pre-relaxation scan,
+  so the relaxations can never leak into serving results.
+* **gradient correctness** — finite differences agree with ``jax.grad``
+  through the full rollout for each relaxed gate (conflict sigmoid,
+  softmin resolver, perf-clamp STE) on 3-aircraft scenes at float64.
+* **guard extension** — the run_steps_checked guard word covers the
+  backward pass: non-finite gradients trip ``GUARD_BAD_GRADS``, poisoned
+  forward states keep their step index, and the Simulation driver
+  records trips through the existing fault/guard machinery.
+* **the optimizer works** — a conflict scene reaches ZERO hard-metric
+  LoS by descent on waypoint/time offsets (the 50-aircraft headline demo
+  is the slow-marked case; a 4-aircraft version runs in tier-1), and an
+  OPT BATCH piece round-trips the serving fabric with its result
+  journal-logged (`opt_result` record) exactly-once.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bluesky_tpu.core.noise import NoiseConfig
+from bluesky_tpu.core.step import SimConfig, run_steps
+from bluesky_tpu.diff import objectives, smooth as smoothmod
+from bluesky_tpu.diff import optimize as dopt
+from bluesky_tpu.diff.smooth import SmoothConfig
+
+
+def _leaves_equal(a, b):
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        if not np.array_equal(np.asarray(x), np.asarray(y),
+                              equal_nan=True):
+            return False
+    return True
+
+
+# ------------------------------------------------------------ parity pins
+def test_simconfig_smooth_default_is_none():
+    assert SimConfig().smooth is None
+
+
+def test_serving_path_never_sets_smooth():
+    from bluesky_tpu.simulation.sim import Simulation
+    sim = Simulation(nmax=4)
+    assert sim.cfg.smooth is None
+    sim.reset()
+    assert sim.cfg.smooth is None
+
+
+def test_smooth_off_bit_identical_and_smooth_engages():
+    """smooth=None must take every ORIGINAL code path (bit-identical
+    states, RNG stream untouched), while an actual SmoothConfig must
+    change the trajectory (the relaxations really engage).  The
+    elementwise oracle parity of the default path is additionally
+    pinned by the golden suites (test_step/test_cr_mvp), which run the
+    same post-refactor code."""
+    traf, acfg = dopt.conflict_scene(4, dtype=jnp.float64)
+    cfg = SimConfig(simdt=1.0, cd_backend="dense",
+                    asas=acfg, noise=NoiseConfig(turb_active=True))
+    s1 = run_steps(jax.tree_util.tree_map(jnp.copy, traf.state), cfg, 30)
+    cfg2 = SimConfig(simdt=1.0, cd_backend="dense",
+                     asas=acfg, noise=NoiseConfig(turb_active=True),
+                     smooth=None)
+    s2 = run_steps(jax.tree_util.tree_map(jnp.copy, traf.state), cfg2, 30)
+    assert _leaves_equal(s1, s2)
+    cfg3 = cfg2._replace(smooth=SmoothConfig())
+    s3 = run_steps(jax.tree_util.tree_map(jnp.copy, traf.state), cfg3, 30)
+    assert not _leaves_equal(s1, s3), \
+        "SmoothConfig did not change the trajectory — relaxations dead?"
+
+
+def test_smooth_requires_dense_backend():
+    traf, acfg = dopt.conflict_scene(2, dtype=jnp.float64)
+    cfg = SimConfig(cd_backend="tiled", asas=acfg,
+                    smooth=SmoothConfig())
+    with pytest.raises(ValueError, match="dense"):
+        run_steps(traf.state, cfg, 1)
+
+
+# --------------------------------------------------- FD vs grad per gate
+def _fd_check(cost, params, coords, eps=1e-5, rtol=5e-3, atol=1e-7):
+    """Central finite differences vs jax.grad on selected coordinates."""
+    g = jax.grad(cost)(params)
+    for leaf_name, idx in coords:
+        base = getattr(params, leaf_name)
+        e = jnp.zeros_like(base).at[idx].set(eps)
+        up = params._replace(**{leaf_name: base + e})
+        dn = params._replace(**{leaf_name: base - e})
+        fd = (float(cost(up)) - float(cost(dn))) / (2 * eps)
+        ad = float(getattr(g, leaf_name)[idx])
+        assert np.isfinite(fd) and np.isfinite(ad)
+        assert abs(fd - ad) <= atol + rtol * max(abs(fd), abs(ad)), \
+            f"{leaf_name}[{idx}]: FD {fd} vs AD {ad}"
+    return g
+
+
+def _scene3(**kw):
+    """3-aircraft float64 scene: one head-on pair + one bystander."""
+    traf, acfg = dopt.conflict_scene(4, dtype=jnp.float64, **kw)
+    return traf.state, acfg
+
+
+def test_fd_vs_grad_conflict_sigmoid_objective():
+    """The conflict/LoS sigmoid gate: soft-LoS rollout gradient wrt
+    lateral/time offsets matches finite differences (swasas off — the
+    pure objective path)."""
+    state, acfg = _scene3()
+    cfg = SimConfig(simdt=1.0, cd_backend="dense",
+                    asas=acfg._replace(swasas=False),
+                    smooth=SmoothConfig())
+    w = objectives.ObjectiveWeights()
+    rpz = float(acfg.rpz)
+
+    def cost(p):
+        # 200 x 1 s: the head-on pair actually crosses inside the
+        # horizon, so the LoS sigmoids carry real gradient signal
+        s = dopt.apply_offsets(state, p, rpz)
+        acc, _, _ = dopt._rollout(s, cfg, 200, 50, w,
+                                  jnp.asarray(0.3, jnp.float64), False)
+        return acc
+
+    nmax = state.ac.lat.shape[0]
+    params = dopt.OffsetParams(
+        jnp.asarray([0.25, -0.15, 0.1, 0.0][:nmax], jnp.float64),
+        jnp.asarray([0.05, -0.1, 0.0, 0.0][:nmax], jnp.float64))
+    g = _fd_check(cost, params, [("lateral", 0), ("lateral", 1),
+                                 ("tshift", 0)])
+    assert float(jnp.abs(g.lateral[:2]).min()) > 0.0, \
+        "zero deconfliction gradient on an in-conflict pair"
+
+
+def test_fd_vs_grad_softmin_resolver():
+    """The resolver path: sigmoid conflict weights + softmin solve time
+    + STE caps (with_asas=True, smooth MVP) stays FD-consistent."""
+    state, acfg = _scene3()
+    cfg = SimConfig(simdt=1.0, cd_backend="dense", asas=acfg,
+                    smooth=SmoothConfig())
+    w = objectives.ObjectiveWeights()
+    rpz = float(acfg.rpz)
+
+    def cost(p):
+        s = dopt.apply_offsets(state, p, rpz)
+        acc, _, _ = dopt._rollout(s, cfg, 40, 20, w,
+                                  jnp.asarray(0.3, jnp.float64), False)
+        return acc
+
+    nmax = state.ac.lat.shape[0]
+    params = dopt.OffsetParams(
+        jnp.asarray([0.2, -0.3, 0.05, 0.0][:nmax], jnp.float64),
+        jnp.zeros((nmax,), jnp.float64))
+    _fd_check(cost, params, [("lateral", 0), ("lateral", 1)],
+              rtol=2e-2)
+
+
+def test_softmin_weighted_unit():
+    x = jnp.asarray([3.0, 1.0, 7.0], jnp.float64)
+    wgt = jnp.asarray([1.0, 1.0, 0.0], jnp.float64)
+    # temp -> 0 recovers the masked hard min
+    assert float(smoothmod.softmin_weighted(x, wgt, 1e-4)) \
+        == pytest.approx(1.0, abs=1e-6)
+    # fully-masked rows return big (like the hard min over empties)
+    assert float(smoothmod.softmin_weighted(
+        x, jnp.zeros(3, jnp.float64), 0.5)) == pytest.approx(1e9)
+    # FD vs AD at a generic temperature
+    f = lambda v: smoothmod.softmin_weighted(v, wgt, 0.7)
+    g = jax.grad(lambda v: f(v))(x)
+    eps = 1e-6
+    for i in range(3):
+        e = jnp.zeros(3, jnp.float64).at[i].set(eps)
+        fd = (float(f(x + e)) - float(f(x - e))) / (2 * eps)
+        assert abs(fd - float(g[i])) < 1e-5
+    # softmax is the exact dual
+    assert float(smoothmod.softmax_weighted(x, wgt, 1e-4)) \
+        == pytest.approx(3.0, abs=1e-6)
+
+
+def test_perf_clamp_ste():
+    """Perf-limit clamps: forward values are the EXACT hard clip,
+    backward is identity (gradient survives a pinned intent)."""
+    from bluesky_tpu.core import perf as perfmod
+    state, _ = _scene3()
+    p = state.perf
+
+    def allowed_tas(intent, sm):
+        tas, _, _ = perfmod.limits(p, intent, state.pilot.vs,
+                                   state.pilot.alt, state.ac.ax,
+                                   smooth=sm)
+        return tas
+
+    # pin intent far above vmax so the clamp is ACTIVE
+    intent = jnp.full_like(state.ac.tas, 500.0)
+    hard = allowed_tas(intent, None)
+    soft = allowed_tas(intent, SmoothConfig())
+    assert np.allclose(np.asarray(hard), np.asarray(soft)), \
+        "STE changed the forward clamp value"
+    g_hard = jax.grad(lambda x: jnp.sum(allowed_tas(x, None)))(intent)
+    g_soft = jax.grad(lambda x: jnp.sum(
+        allowed_tas(x, SmoothConfig())))(intent)
+    assert float(jnp.abs(g_hard).max()) == 0.0, \
+        "hard clamp should kill the gradient when pinned"
+    assert float(jnp.abs(g_soft).min()) > 0.0, \
+        "STE clamp should pass gradient through the pin"
+    # ste_clip unit contract
+    x = jnp.asarray([-2.0, 0.5, 3.0], jnp.float64)
+    y = smoothmod.ste_clip(x, 0.0, 1.0)
+    assert np.allclose(np.asarray(y), [0.0, 0.5, 1.0])
+    gy = jax.grad(lambda v: jnp.sum(smoothmod.ste_clip(v, 0.0, 1.0)))(x)
+    assert np.allclose(np.asarray(gy), 1.0)
+
+
+# -------------------------------------------------- temperature annealing
+def test_soft_los_annealing_monotone_and_converges():
+    """Annealing contract of the soft-LoS objective: as the temperature
+    decreases, in-LoS pair weights rise monotonically toward 1 and
+    out-of-LoS weights fall monotonically toward 0 — so the soft count
+    converges to the hard count."""
+    rpz, hpz = 9260.0, 304.8
+    temps = [1.0, 0.5, 0.2, 0.1, 0.02]
+    w_in = [float(smoothmod.soft_los_weight(
+        jnp.asarray(0.5 * rpz), jnp.asarray(0.0), rpz, hpz, t))
+        for t in temps]
+    w_out = [float(smoothmod.soft_los_weight(
+        jnp.asarray(2.0 * rpz), jnp.asarray(0.0), rpz, hpz, t))
+        for t in temps]
+    assert all(b >= a for a, b in zip(w_in, w_in[1:]))
+    assert all(b <= a for a, b in zip(w_out, w_out[1:]))
+    assert w_in[-1] > 0.999 and w_out[-1] < 1e-3
+
+    state, acfg = _scene3()
+    hard = float(objectives.hard_los_count(state, rpz, hpz))
+    soft = float(objectives.soft_los_cost(state, rpz, hpz, 1e-3))
+    assert soft == pytest.approx(hard / 2.0, abs=1e-3)  # unique pairs
+
+
+# ------------------------------------------------------- guard extension
+def test_checked_value_and_grad_words():
+    def clean(p, _b, _t):
+        return jnp.sum(p.lateral ** 2), {"bad": jnp.full((), -1,
+                                                         jnp.int32)}
+
+    def grad_blows(p, _b, _t):
+        # sqrt at 0: value finite, derivative infinite
+        return jnp.sum(jnp.sqrt(jnp.abs(p.lateral))), \
+            {"bad": jnp.full((), -1, jnp.int32)}
+
+    def fwd_bad(p, _b, _t):
+        return jnp.sum(p.lateral), {"bad": jnp.full((), 7, jnp.int32)}
+
+    params = dopt.OffsetParams(jnp.zeros(3), jnp.zeros(3))
+    _, _, _, bad = dopt.checked_value_and_grad(clean)(params, None, 0.0)
+    assert int(bad) == -1
+    _, _, _, bad = dopt.checked_value_and_grad(grad_blows)(
+        params, None, 0.0)
+    assert int(bad) == dopt.GUARD_BAD_GRADS
+    _, _, _, bad = dopt.checked_value_and_grad(fwd_bad)(params, None, 0.0)
+    assert int(bad) == 7, "forward step index must win over grad word"
+
+
+def test_optimize_forward_poison_trips_guard_via_sim():
+    """A NaN-poisoned fleet trips the FORWARD guard word inside the
+    rollout; the Simulation driver halts the descent and records the
+    trip through the existing fault/guard machinery."""
+    from bluesky_tpu.simulation.sim import Simulation
+    sim = Simulation(nmax=4, dtype=jnp.float64)
+    sim.traf.create(2, "B744", 6000.0, 200.0, None,
+                    [48.0, 48.0], [3.5, 4.5], [90.0, 270.0])
+    sim.traf.flush()
+    st = sim.traf.state
+    sim.traf.state = st.replace(ac=st.ac.replace(
+        lat=st.ac.lat.at[0].set(jnp.nan)))
+    res = sim.optimize_trajectories(tend=20.0, iters=2,
+                                    simdt=1.0, chunk=10)
+    assert res.bad >= 0, f"expected a forward guard word, got {res.bad}"
+    assert res.iters == 1, "descent should halt on the first trip"
+    assert any(t.get("action") == "opt_halt" for t in sim.guard.trips)
+    # "halt at the last finite iterate": the tripping Adam update (fed
+    # non-finite gradients) must NOT contaminate the returned offsets
+    assert np.all(np.isfinite(res.lateral_m))
+    assert np.all(np.isfinite(res.tshift_s))
+
+
+# ----------------------------------------------------------- the driver
+def test_optimize_converges_to_zero_los_small():
+    """Tier-1-sized headline: a 4-aircraft (2 head-on pairs) scene
+    reaches zero hard-metric LoS by descent on waypoint offsets."""
+    traf, acfg = dopt.conflict_scene(4, dtype=jnp.float64)
+    res = dopt.optimize(traf.state, acfg, tend=300.0, simdt=1.0,
+                        chunk=50, iters=25)
+    assert res.bad == -1
+    assert res.hard_los_before > 0
+    assert res.hard_los_after == 0
+    assert res.objective[-1] < res.objective[0]
+    assert all(np.isfinite(res.grad_norm))
+    # padding rows stay at zero offsets
+    assert np.all(res.lateral_m[np.asarray(
+        ~np.asarray(traf.state.ac.active))] == 0.0)
+
+
+def test_optimize_multi_start_worlds_axis():
+    """restarts > 1 batches perturbed particles on the PR-6 world axis
+    (one stacked smooth scan) and returns the best particle."""
+    traf, acfg = dopt.conflict_scene(2, dtype=jnp.float64)
+    res = dopt.optimize(traf.state, acfg, tend=120.0, simdt=1.0,
+                        chunk=30, iters=4, restarts=3)
+    assert res.bad == -1
+    assert res.restarts == 3
+    assert 0 <= res.best_restart < 3
+    assert res.lateral_m.shape == (traf.state.ac.lat.shape[0],)
+
+
+def test_opt_result_payload_roundtrip():
+    traf, acfg = dopt.conflict_scene(2, dtype=jnp.float64)
+    res = dopt.optimize(traf.state, acfg, tend=60.0, simdt=1.0,
+                        chunk=30, iters=3)
+    payload = res.to_payload(traf.ids, [0, 1])
+    js = json.loads(json.dumps(payload))
+    assert js["iters"] == 3
+    assert len(js["objective_trace"]) == 3
+    assert js["acid"] == [traf.ids[0], traf.ids[1]]
+    assert len(js["lateral_m"]) == 2
+
+
+def test_server_refuses_opt_pieces_from_packs():
+    from bluesky_tpu.network.server import Server
+    assert Server._piece_solo_reason(
+        ([0.0], ["SCEN A", "OPT 300 10"])) == "opt"
+    assert Server._piece_solo_reason(
+        ([0.0], ["SCEN A", "GRAD 100"])) == "opt"
+    assert Server._piece_solo_reason(
+        ([0.0], ["SCEN A", "SHARD SPATIAL"])) == "shard_mode=spatial"
+    assert Server._piece_solo_reason(
+        ([0.0], ["SCEN A", "FF 5"])) is None
+    assert Server._piece_solo_reason(
+        ([0.0], ["SCEN A", "OPTIONS X"])) is None  # no prefix aliasing
+
+
+# ------------------------------------------------------- serving e2e
+def _opt_scenario(tmp, n_pairs=1, tend=120.0, iters=5):
+    """Scenario file: head-on pairs with LNAV-direct waypoints + OPT."""
+    lines = ["00:00:00.00>SCEN OPTCASE"]
+    for k in range(n_pairs):
+        la = 48.0 + 0.8 * k
+        lines += [
+            f"00:00:00.00>CRE OA{k:02d} B744 {la} 3.5 90 FL200 250",
+            f"00:00:00.00>CRE OB{k:02d} B744 {la} 4.5 270 FL200 250",
+            f"00:00:00.00>ADDWPT OA{k:02d} {la},4.5",
+            f"00:00:00.00>ADDWPT OB{k:02d} {la},3.5",
+        ]
+    lines.append(f"00:00:00.00>OPT {tend},{iters}")
+    scn = os.path.join(tmp, "opt.scn")
+    with open(scn, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return scn
+
+
+def test_opt_batch_piece_journal(tmp_path):
+    """An OPT BATCH piece through the REAL fabric: the worker runs the
+    optimization, the server journals ``opt_result`` BEFORE the
+    piece's ``completed`` record, clients get the BATCHOPT report, and
+    replay stays exactly-once."""
+    from bluesky_tpu.network.client import Client
+    from bluesky_tpu.network.journal import BatchJournal
+    from bluesky_tpu.network.server import Server
+    from bluesky_tpu.simulation.simnode import SimNode
+    from tests.test_network import free_ports, wait_for
+
+    journal = str(tmp_path / "batch.jsonl")
+    scn = _opt_scenario(str(tmp_path))
+    ev, st_, wev, wst = free_ports(4)
+    server = Server(headless=True,
+                    ports=dict(event=ev, stream=st_, wevent=wev,
+                               wstream=wst),
+                    spawn_workers=False, journal_path=journal)
+    server.start()
+    time.sleep(0.2)
+    node = SimNode(event_port=wev, stream_port=wst, nmax=8)
+    t = threading.Thread(target=node.run, daemon=True)
+    t.start()
+    client = Client()
+    client.connect(event_port=ev, stream_port=st_, timeout=5.0)
+    try:
+        assert wait_for(lambda: (client.receive(10),
+                                 len(client.nodes) >= 1)[1]), \
+            "worker never registered"
+        client.stack(f"BATCH {scn}")
+        assert wait_for(lambda: (client.receive(10),
+                                 server.opt_results >= 1
+                                 and not server.inflight
+                                 and not server.scenarios)[1],
+                        timeout=300), "OPT piece never completed"
+        client.receive(10)
+        assert client.opt_results, "client never saw the BATCHOPT report"
+        rep = client.opt_results[0]
+        assert rep["iters"] == 5
+        assert rep["bad"] == -1
+        assert rep["objective_last"] <= rep["objective_first"] * 1.05
+
+        recs = [json.loads(ln) for ln in open(journal)]
+        kinds = [r["rec"] for r in recs]
+        assert "opt_result" in kinds and "completed" in kinds
+        assert kinds.index("opt_result") < kinds.index("completed"), \
+            "opt_result must journal before the piece completes"
+        state = BatchJournal.replay(journal)
+        assert len(state["completed"]) == 1 and not state["pending"]
+        assert len(state["opt_results"]) == 1
+        assert state["opt_results"][0]["result"]["iters"] == 5
+    finally:
+        node.quit()
+        t.join(timeout=5)
+        server.stop()
+        server.join(timeout=5)
+        client.close()
+
+
+@pytest.mark.slow
+def test_demo_50_aircraft_zero_los_journal_verified(tmp_path):
+    """THE headline demo (ISSUE 7 acceptance): a 50-aircraft conflict
+    scene reaches zero hard-metric LoS by gradient descent on waypoint
+    offsets, run as an OPT BATCH piece and verified from the journal's
+    ``opt_result`` record."""
+    from bluesky_tpu.network.client import Client
+    from bluesky_tpu.network.journal import BatchJournal
+    from bluesky_tpu.network.server import Server
+    from bluesky_tpu.simulation.simnode import SimNode
+    from tests.test_network import free_ports, wait_for
+
+    journal = str(tmp_path / "batch.jsonl")
+    scn = _opt_scenario(str(tmp_path), n_pairs=25, tend=400.0, iters=40)
+    ev, st_, wev, wst = free_ports(4)
+    server = Server(headless=True,
+                    ports=dict(event=ev, stream=st_, wevent=wev,
+                               wstream=wst),
+                    spawn_workers=False, journal_path=journal)
+    server.start()
+    time.sleep(0.2)
+    node = SimNode(event_port=wev, stream_port=wst, nmax=64)
+    t = threading.Thread(target=node.run, daemon=True)
+    t.start()
+    client = Client()
+    client.connect(event_port=ev, stream_port=st_, timeout=5.0)
+    try:
+        assert wait_for(lambda: (client.receive(10),
+                                 len(client.nodes) >= 1)[1])
+        client.stack(f"BATCH {scn}")
+        assert wait_for(lambda: (client.receive(10),
+                                 server.opt_results >= 1
+                                 and not server.inflight
+                                 and not server.scenarios)[1],
+                        timeout=900), "OPT demo piece never completed"
+        state = BatchJournal.replay(journal)
+        assert len(state["opt_results"]) == 1
+        result = state["opt_results"][0]["result"]
+        assert result["bad"] == -1
+        assert result["hard_los_before"] > 0
+        assert result["hard_los_after"] == 0, \
+            (f"demo did not reach zero LoS: {result['hard_los_after']} "
+             f"(objective {result['objective_first']} -> "
+             f"{result['objective_last']})")
+        assert len(state["completed"]) == 1 and not state["pending"]
+    finally:
+        node.quit()
+        t.join(timeout=5)
+        server.stop()
+        server.join(timeout=5)
+        client.close()
