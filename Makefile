@@ -1,7 +1,7 @@
 # Developer entry points (parity: /root/reference/Makefile — test/lint/
 # build/dist/clean/install; bench and check are this framework's own).
 .PHONY: all test test-fast lint build dist clean install uninstall \
-	bench check ext chaos
+	bench check ext chaos mesh-chaos
 
 PYTHON=python3
 
@@ -34,6 +34,17 @@ chaos:
 	$(PYTHON) -m pytest tests/test_chaos.py tests/test_durability.py \
 	tests/test_overload.py tests/test_fabric_hardening.py \
 	tests/test_world_serving.py -q $(XDIST)
+
+# Mesh-epoch recovery lane (docs/FAULT_TOLERANCE.md §mesh epochs):
+# MeshGuard unit + MESHKILL e2e + re-shard parity, the journal-replay
+# fuzz suite, and the real-process chaos cases — 2-process gloo mesh
+# with one host SIGKILLed mid-BATCH, in-fabric FAULT MESHKILL, and the
+# heartbeat-only partition no-double-count case.  The gloo test spawns
+# its own 4-device subprocesses, so no xdist here.
+mesh-chaos:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PYTHON) -m pytest tests/test_meshguard.py tests/test_journal_fuzz.py \
+	tests/test_meshchaos.py -q
 
 lint:
 	@$(PYTHON) -m pyflakes bluesky_tpu tests 2>/dev/null \

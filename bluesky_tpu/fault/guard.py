@@ -106,6 +106,22 @@ class IntegrityGuard:
             self.logger.log(sim, ids or ["-"], [action])
         return rec
 
+    def mesh_trip(self, action: str, **extra):
+        """Record a structured mesh-epoch event (``mesh_lost`` /
+        ``resharded``) in the trip log.  Unlike ``trip`` this does not
+        touch aircraft state — the mesh-recovery layer
+        (simulation/sim._handle_mesh_lost) owns the response; the guard
+        just gives the event the same audit trail (``guard.trips`` +
+        FAULTLOG) as every other fault class."""
+        sim = self.sim
+        rec = dict(simt=float(sim.simt_planned), bad_step=-1,
+                   chunk=int(sim._step_count), ids=[],
+                   action=str(action), source="mesh_guard", **extra)
+        self.trips.append(rec)
+        if self.logger.active:
+            self.logger.log(sim, ["-"], [str(action)])
+        return rec
+
     def _delete_slots(self, slots):
         if slots:
             self.sim.traf.delete(list(slots))

@@ -21,6 +21,12 @@ Usage (stack/commands.py registers it):
   FAULT KILL                 SIGKILL this worker (no goodbye)
   FAULT PREEMPT [delay]      preemption notice (SIGTERM model): drain
                              the chunk, checkpoint, notify, exit
+  FAULT MESHKILL [group]     mark one device group of the active mesh
+                             dead (host-loss model): the MeshGuard trips
+                             mesh_lost at the next chunk dispatch and
+                             the sim re-forms a survivor mesh
+  FAULT PARTITION [OFF]      heartbeat-only network partition: PONGs
+                             dropped, completions still delivered
   FAULT SNAPTRUNC fname [keep]  truncate a snapshot file (torn write)
   FAULT LIST                 guard trip history
 
@@ -52,11 +58,21 @@ def _status(sim):
                      f"delayed {sock.n_delayed})")
     else:
         lines.append("transport: clean")
+    if isinstance(sock, injectors.FlakySocket) and sock.drop_names:
+        names = ",".join(n.decode("ascii", "replace")
+                         for n in sock.drop_names)
+        lines.append(f"partition: dropping [{names}] "
+                     f"({sock.n_name_dropped} suppressed)")
     if getattr(sim, "straggle_stall", False):
         lines.append("straggle: STALLED (progress frozen)")
     elif getattr(sim, "straggle_factor", 0.0) > 0:
         lines.append(f"straggle: throttled +{sim.straggle_factor:g} "
                      f"wall s per sim s")
+    mh = sim.mesh_health()
+    if mh["mode"] != "off" or mh["epoch"] > 0:
+        lines.append(f"mesh: epoch {mh['epoch']}, {mh['devices']} "
+                     f"device(s), mode {mh['mode']}"
+                     + (" [degraded]" if mh["degraded"] else ""))
     return True, "\n".join(lines)
 
 
@@ -175,6 +191,34 @@ def fault_command(sim, *args):
                       + " — the node will drain the current chunk, "
                         "write a final checkpoint and exit")
 
+    if sub == "MESHKILL":
+        if sim.shard_mode == "off" or sim.shard_mesh is None:
+            return False, "FAULT MESHKILL: no active mesh (SHARD first)"
+        try:
+            group = int(float(rest[0])) if rest else 1
+        except ValueError:
+            return False, "FAULT MESHKILL [group]"
+        try:
+            devs = sim.mesh_guard.kill_group(group)
+        except ValueError as e:
+            return False, f"FAULT MESHKILL: {e}"
+        return True, (f"FAULT: device group {group} ({len(devs)} "
+                      f"device(s)) marked dead — mesh_lost trips at "
+                      f"the next chunk dispatch")
+
+    if sub == "PARTITION":
+        node = _node(sim)
+        if node is None:
+            return False, "FAULT PARTITION: no network node (detached sim)"
+        if rest and rest[0].upper() in ("OFF", "0"):
+            injectors.partition(node, names=())
+            return True, "FAULT: partition healed (heartbeats flowing)"
+        flaky = injectors.partition(node)
+        names = ",".join(n.decode("ascii", "replace")
+                         for n in flaky.drop_names)
+        return True, (f"FAULT: network partition — dropping [{names}]; "
+                      f"worker alive, completions still delivered")
+
     if sub == "SNAPTRUNC":
         if not rest:
             return False, "FAULT SNAPTRUNC filename [keep_fraction]"
@@ -198,4 +242,5 @@ def fault_command(sim, *args):
 
     return False, ("FAULT NAN/INF [acid] | GUARD .. | RING .. | DROP/DUP/"
                    "DELAY p | NETOFF | STALL s | STRAGGLE f/STALL/OFF | "
-                   "KILL | PREEMPT [s] | SNAPTRUNC f | LIST")
+                   "KILL | PREEMPT [s] | MESHKILL [g] | PARTITION [OFF] | "
+                   "SNAPTRUNC f | LIST")

@@ -55,17 +55,23 @@ class FlakySocket:
     (``n_sent/n_dropped/n_duped/n_delayed``) make the chaos observable.
     """
 
-    def __init__(self, sock, p_drop=0.0, p_dup=0.0, delay_s=0.0, seed=0):
+    def __init__(self, sock, p_drop=0.0, p_dup=0.0, delay_s=0.0, seed=0,
+                 drop_names=()):
         self._sock = sock
         self.p_drop = float(p_drop)
         self.p_dup = float(p_dup)
         self.delay_s = float(delay_s)
+        # selective drop by event name (the network-partition model:
+        # heartbeats lost, everything else delivered) — frame layout is
+        # [route..., name, payload], so the name rides frames[-2]
+        self.drop_names = tuple(drop_names)
         self._rng = np.random.default_rng(seed)
         self._held = []            # [(release_time, frames, kwargs)]
         self.n_sent = 0
         self.n_dropped = 0
         self.n_duped = 0
         self.n_delayed = 0
+        self.n_name_dropped = 0
 
     def __getattr__(self, name):
         return getattr(self._sock, name)
@@ -88,6 +94,12 @@ class FlakySocket:
 
     def send_multipart(self, frames, **kwargs):
         self.flush()
+        if self.drop_names:
+            fl = list(frames)
+            name = fl[-2] if len(fl) >= 2 else (fl[0] if fl else b"")
+            if name in self.drop_names:
+                self.n_name_dropped += 1
+                return
         if self.p_drop > 0 and self._rng.random() < self.p_drop:
             self.n_dropped += 1
             return
@@ -111,10 +123,21 @@ def install_flaky(endpoint, attr="event_io", **kw):
         sock.p_drop = float(kw.get("p_drop", sock.p_drop))
         sock.p_dup = float(kw.get("p_dup", sock.p_dup))
         sock.delay_s = float(kw.get("delay_s", sock.delay_s))
+        if "drop_names" in kw:
+            sock.drop_names = tuple(kw["drop_names"])
         return sock
     flaky = FlakySocket(sock, **kw)
     setattr(endpoint, attr, flaky)
     return flaky
+
+
+def partition(endpoint, names=(b"PONG",), attr="event_io"):
+    """Heartbeat-only network partition (FAULT PARTITION): the worker
+    stays alive and keeps computing, its completions and state changes
+    still arrive, but its PING replies are silently dropped — the
+    half-dead link the server cannot distinguish from a dead worker.
+    ``names=()`` heals the partition (other flaky settings survive)."""
+    return install_flaky(endpoint, attr=attr, drop_names=tuple(names))
 
 
 def remove_flaky(endpoint, attr="event_io"):

@@ -22,6 +22,17 @@ Record types (one JSON object per line, ``rec`` selects the type):
   ``quarantined`` {key, piece, crashes}     circuit-broken: never requeue
   ``preempted``   {key, worker}             worker preempted mid-piece:
                                             requeue WITHOUT a strike
+  ``mesh_lost``   {key, worker, epoch, lost}  a device group of the
+                                            worker's sharded mesh died
+                                            mid-piece (audit; if the
+                                            worker could not recover the
+                                            piece is requeued WITHOUT a
+                                            strike, PREEMPTED-style)
+  ``resharded``   {key, worker, epoch, ndev, mode}  the worker re-formed
+                                            a survivor mesh and resumed
+                                            the SAME piece from its last
+                                            checksummed snapshot — audit
+                                            only, queue math ignores it
   ``hedged``      {key, worker, hedge_worker}  speculative straggler
                                             re-dispatch: a SECOND copy
                                             of an in-flight piece went
@@ -178,6 +189,36 @@ class BatchJournal:
             rec["world"] = int(world)
         self.append("preempted", **rec)
 
+    def mesh_lost(self, piece, worker: bytes = b"", world=None,
+                  epoch=None, lost=None):
+        """A device group of the worker's sharded mesh died mid-piece.
+        Audit record: queue math ignores it — an unrecovered loss also
+        requeues the piece (push_front, no strike), and replay already
+        counts that via queued - completed."""
+        rec = dict(key=self.piece_key(piece), worker=worker.hex())
+        if world is not None:
+            rec["world"] = int(world)
+        if epoch is not None:
+            rec["epoch"] = int(epoch)
+        if lost is not None:
+            rec["lost"] = list(lost)
+        self.append("mesh_lost", **rec)
+
+    def resharded(self, piece, worker: bytes = b"", world=None,
+                  epoch=None, ndev=None, mode=None):
+        """The worker re-formed a survivor mesh (new epoch) and resumed
+        the SAME piece from its last checksummed snapshot.  Audit only."""
+        rec = dict(key=self.piece_key(piece), worker=worker.hex())
+        if world is not None:
+            rec["world"] = int(world)
+        if epoch is not None:
+            rec["epoch"] = int(epoch)
+        if ndev is not None:
+            rec["ndev"] = int(ndev)
+        if mode is not None:
+            rec["mode"] = str(mode)
+        self.append("resharded", **rec)
+
     def hedged(self, piece, worker: bytes = b"",
                hedge_worker: bytes = b""):
         self.append("hedged", key=self.piece_key(piece),
@@ -263,13 +304,15 @@ class BatchJournal:
                 elif key not in pieces:
                     continue              # marker records / unknown key
                 elif rec in ("dispatched", "preempted", "hedged",
-                             "dup_completed"):
+                             "dup_completed", "mesh_lost", "resharded"):
                     # owed copies = queued - completed.  A hedge is a
                     # duplicate of an already-dispatched copy, and a
                     # dup_completed is the hedge loser finishing after
                     # the winner — counting either as a dispatch or a
                     # completion would break exactly-once for repeat-
                     # trial sweeps (identical content queued N times).
+                    # mesh_lost/resharded likewise narrate one copy's
+                    # mesh-epoch transitions, never its queue state.
                     pass
                 elif rec == "crashed":
                     crashes[key] = int(r.get("crashes",

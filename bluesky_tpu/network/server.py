@@ -765,6 +765,77 @@ class Server(threading.Thread):
                    + (" — piece requeued" if piece is not None else ""))
             print(f"server: {msg}")
             self._report_clients(msg)
+        elif name == b"MESHLOST" and from_worker:
+            # a sharded worker lost a device group mid-piece.  Two
+            # shapes: recovered=True — the worker re-formed a survivor
+            # mesh, restored its last checksummed snapshot and is STILL
+            # running the same piece (audit records only, the piece
+            # stays in flight); recovered=False — the worker could not
+            # re-form a mesh: requeue WITHOUT a circuit-breaker strike,
+            # PREEMPTED-style (device-group loss is capacity churn, not
+            # a piece fault)
+            data = unpackb(payload) if payload else None
+            ev = data if isinstance(data, dict) else {}
+            epoch = ev.get("epoch")
+            lost = ev.get("lost_groups")
+            if ev.get("recovered", True):
+                piece = self.inflight.get(sender)
+                if self.journal and piece is not None:
+                    if isinstance(piece, WorldPack):
+                        for i, _powner, p in piece.remaining():
+                            self.journal.mesh_lost(p, sender, world=i,
+                                                   epoch=epoch,
+                                                   lost=lost)
+                            self.journal.resharded(
+                                p, sender, world=i, epoch=epoch,
+                                ndev=ev.get("ndev"),
+                                mode=ev.get("mode"))
+                    else:
+                        self.journal.mesh_lost(piece, sender,
+                                               epoch=epoch, lost=lost)
+                        self.journal.resharded(piece, sender,
+                                               epoch=epoch,
+                                               ndev=ev.get("ndev"),
+                                               mode=ev.get("mode"))
+                msg = (f"worker {sender.hex()} mesh epoch {epoch}: "
+                       f"lost group(s) {lost}, resharded to "
+                       f"{ev.get('ndev')} device(s) "
+                       f"({ev.get('mode')})"
+                       + (" [degraded]" if ev.get("degraded") else "")
+                       + (", restored from snapshot"
+                          if ev.get("restored") else "")
+                       + " — piece continues")
+            else:
+                piece = self.inflight.pop(sender, None)
+                owner = self.inflight_owner.pop(sender, b"")
+                self.inflight_t.pop(sender, None)
+                if isinstance(piece, WorldPack):
+                    for i, powner, p in reversed(piece.remaining()):
+                        self.scenarios.push_front(p, powner)
+                        if self.journal:
+                            self.journal.mesh_lost(p, sender, world=i,
+                                                   epoch=epoch,
+                                                   lost=lost)
+                    while self.avail_workers and self.scenarios:
+                        self._send_pending_scenario()
+                    piece = None
+                if piece is not None and self._drop_hedge_links(sender) \
+                        is not None:
+                    piece = None
+                if piece is not None:
+                    self.scenarios.push_front(piece, owner)
+                    if self.journal:
+                        self.journal.mesh_lost(piece, sender,
+                                               epoch=epoch, lost=lost)
+                    while self.avail_workers and self.scenarios:
+                        self._send_pending_scenario()
+                msg = (f"worker {sender.hex()} mesh lost "
+                       f"(epoch {epoch}, group(s) {lost}) — no "
+                       f"survivor mesh"
+                       + (", piece requeued" if piece is not None
+                          else ""))
+            print(f"server: {msg}")
+            self._report_clients(msg)
         elif name == b"BATCH":
             data = unpackb(payload)
             pieces = split_scenarios(data["scentime"], data["scencmd"])
@@ -914,7 +985,8 @@ class Server(threading.Thread):
                 "simt": simt, "chunks": chunks, "rate": 0.0,
                 "t": now, "advance_t": now,
                 "state": data.get("state"),
-                "ff": bool(data.get("ff", False))}
+                "ff": bool(data.get("ff", False)),
+                "mesh": data.get("mesh")}
             return
         dt = now - prev["t"]
         if chunks > prev["chunks"] or simt > prev["simt"] + 1e-9:
@@ -925,7 +997,8 @@ class Server(threading.Thread):
             prev["advance_t"] = now
         prev.update(simt=simt, chunks=chunks, t=now,
                     state=data.get("state"),
-                    ff=bool(data.get("ff", False)))
+                    ff=bool(data.get("ff", False)),
+                    mesh=data.get("mesh", prev.get("mesh")))
 
     def _check_stragglers(self, now):
         """Speculative straggler re-dispatch: an in-flight piece whose
@@ -1091,7 +1164,18 @@ class Server(threading.Thread):
                 w["simt"] = round(prog["simt"], 3)
                 w["rate"] = round(prog["rate"], 4)
                 w["stalled_for"] = round(now - prog["advance_t"], 3)
+                if isinstance(prog.get("mesh"), dict):
+                    w["mesh"] = prog["mesh"]
             workers[wid.hex()] = w
+        # fleet mesh summary: the most advanced epoch any worker
+        # reports (after a loss that is the worker that re-formed)
+        mesh = None
+        for w in workers.values():
+            m = w.get("mesh")
+            if isinstance(m, dict) and (
+                    mesh is None
+                    or m.get("epoch", 0) > mesh.get("epoch", 0)):
+                mesh = m
         data = {
             "queue_depth": len(self.scenarios),
             "queue_limit": self.batch_queue_max,
@@ -1113,6 +1197,8 @@ class Server(threading.Thread):
             "worlds": {k: v for k, v in self.worlds_payload().items()
                        if k != "text"},
         }
+        if mesh is not None:
+            data["mesh"] = mesh
         data["text"] = self._health_text(data)
         return data
 
@@ -1141,6 +1227,14 @@ class Server(threading.Thread):
                 f"{w['refused_opt']} OPT/GRAD refusal(s), "
                 f"{w['opt_results']} OPT result(s), "
                 f"demux avg {w['demux_ms_avg']:.2f} ms")
+        m = d.get("mesh")
+        if m:
+            lines.append(
+                f"mesh: epoch {m.get('epoch', 0)}, "
+                f"{m.get('devices', 0)} device(s), "
+                f"mode {m.get('mode', 'off')}, last refresh "
+                f"{m.get('last_refresh_ms', 0):g} ms"
+                + (" [DEGRADED]" if m.get("degraded") else ""))
         for wid, w in d["workers"].items():
             line = (f"  {wid[:8]}: state {w['state']}, "
                     f"hb {w['hb_age']:.1f}s ago")
@@ -1151,6 +1245,10 @@ class Server(threading.Thread):
             if "rate" in w:
                 line += (f", rate {w['rate']:g} sim-s/s, last advance "
                          f"{w['stalled_for']:.1f}s ago")
+            wm = w.get("mesh")
+            if isinstance(wm, dict) and wm.get("mode", "off") != "off":
+                line += (f", mesh e{wm.get('epoch', 0)} "
+                         f"D{wm.get('devices', 0)} {wm.get('mode')}")
             lines.append(line)
         return "\n".join(lines)
 

@@ -35,6 +35,8 @@ Two decompositions for the sparse backend's shard_map kernels
   (tests/test_spatial.py) with zero O(N) column all-gathers on the
   compiled HLO (tests/test_hlo_collectives.py).
 """
+import threading
+import time
 from functools import partial
 
 import jax
@@ -220,3 +222,220 @@ def stack_replicas(states):
     """Stack a list of equal-shape SimStates into one leading replica axis."""
     import jax.numpy as jnp
     return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *states)
+
+
+# --------------------------------------------------------------------------
+# Mesh-epoch recovery (ISSUE-10): losing a device group ends the EPOCH,
+# not the run.  MeshGuard is the liveness sentinel a sharded sim consults
+# at every chunk dispatch; on a trip the sim tears the epoch down,
+# reloads the last checksummed snapshot onto the survivor mesh and steps
+# on degraded (simulation/sim._handle_mesh_lost).
+# --------------------------------------------------------------------------
+
+class MeshLostError(RuntimeError):
+    """A device group of the active mesh is dead or unreachable.
+
+    Carries the lost group indices and the surviving device list so the
+    recovery layer can re-form a smaller mesh without re-deriving the
+    topology from a wedged runtime.
+    """
+
+    def __init__(self, msg, lost_groups=(), survivors=None):
+        super().__init__(msg)
+        self.lost_groups = tuple(lost_groups)
+        self.survivors = list(survivors) if survivors is not None else []
+
+
+class MeshGuard:
+    """Liveness sentinel for one mesh epoch.
+
+    Device groups model the unit of correlated failure: on a real
+    multi-process mesh they are the per-process device partitions (a
+    host dying takes its whole group); on a single-process (virtual)
+    mesh the device list splits into two contiguous halves so chaos
+    tests can kill "host 1" of the 8-device CPU mesh (``FAULT MESHKILL
+    1`` -> devices 4-7 dead, survivors 0-3).
+
+    Detection is two-pronged:
+
+    * ``check()`` — cheap dispatch-time precheck: raises
+      ``MeshLostError`` for any group marked dead (the ``FAULT
+      MESHKILL`` injector, or a stale peer heartbeat observed earlier).
+    * ``guarded_ready(x)`` — heartbeat-stamped collective timeout
+      wrapper around a device sync: ``jax.block_until_ready`` runs in a
+      side thread while this process keeps stamping its own heartbeat
+      file; if the wait exceeds ``timeout`` (a collective blocked on a
+      dead peer never returns) the peer stamps decide who died.
+    """
+
+    def __init__(self, mesh=None, heartbeat_dir=None, timeout=0.0,
+                 hb_timeout=10.0):
+        self.timeout = float(timeout)        # collective wait budget [s]
+        self.hb_timeout = float(hb_timeout)  # peer stamp staleness [s]
+        self.heartbeat_dir = heartbeat_dir
+        self.epoch = 0
+        self._killed = set()
+        self.groups = []
+        self.mesh = None
+        self.set_mesh(mesh)
+
+    # ------------------------------------------------------------ topology
+    def set_mesh(self, mesh):
+        """Bind a (new) mesh: recompute device groups, clear kill marks
+        — a re-formed survivor mesh starts its epoch healthy."""
+        self.mesh = mesh
+        self._killed = set()
+        devs = list(mesh.devices.flat) if mesh is not None else []
+        self.groups = self._partition(devs)
+
+    @staticmethod
+    def _partition(devs):
+        if not devs:
+            return []
+        try:
+            nproc = jax.process_count()
+        except RuntimeError:
+            nproc = 1
+        if nproc > 1:
+            by_proc = {}
+            for d in devs:
+                by_proc.setdefault(getattr(d, "process_index", 0),
+                                   []).append(d)
+            return [by_proc[k] for k in sorted(by_proc)]
+        if len(devs) < 2:
+            return [devs]
+        half = (len(devs) + 1) // 2
+        return [devs[:half], devs[half:]]
+
+    @property
+    def survivors(self):
+        """Devices of every still-live group, in mesh order."""
+        return [d for k, g in enumerate(self.groups)
+                if k not in self._killed for d in g]
+
+    # ---------------------------------------------------------- injection
+    def kill_group(self, k):
+        """Mark device group ``k`` dead (the FAULT MESHKILL injector).
+        The fault surfaces at the next ``check()``/``guarded_ready()``,
+        i.e. the next chunk dispatch — like a real host loss, nothing
+        happens until the fabric next touches the mesh."""
+        k = int(k)
+        if not 0 <= k < len(self.groups):
+            raise ValueError(f"no device group {k} "
+                             f"(mesh has {len(self.groups)})")
+        if len(self.groups) - len(self._killed | {k}) < 1:
+            raise ValueError("cannot kill the last live device group")
+        self._killed.add(k)
+        return self.groups[k]
+
+    # ---------------------------------------------------------- detection
+    def check(self):
+        """Dispatch-time precheck: raise MeshLostError if any group of
+        the bound mesh is marked dead."""
+        if self.mesh is None or not self._killed:
+            return
+        lost = sorted(self._killed)
+        raise MeshLostError(
+            f"mesh epoch {self.epoch}: device group(s) "
+            f"{','.join(map(str, lost))} dead "
+            f"({len(self.survivors)} device(s) survive)",
+            lost_groups=lost, survivors=self.survivors)
+
+    # ------------------------------------------------- cross-process pulse
+    def _hb_path(self, pid=None):
+        import os
+        if not self.heartbeat_dir:
+            return None
+        if pid is None:
+            try:
+                pid = jax.process_index()
+            except RuntimeError:
+                pid = 0
+        return os.path.join(self.heartbeat_dir, f"meshhb-{pid}")
+
+    def stamp(self):
+        """Refresh this process's heartbeat file (mtime is the pulse)."""
+        import os
+        path = self._hb_path()
+        if path is None:
+            return
+        os.makedirs(self.heartbeat_dir, exist_ok=True)
+        with open(path, "w") as f:
+            f.write(f"{time.time():.3f}\n")
+
+    def stale_peers(self, hb_timeout=None):
+        """Process indices whose heartbeat stamp is older than
+        ``hb_timeout`` (missing stamps are NOT stale: a peer that never
+        stamped may simply not have started)."""
+        import os
+        if not self.heartbeat_dir or not os.path.isdir(self.heartbeat_dir):
+            return []
+        budget = self.hb_timeout if hb_timeout is None else float(hb_timeout)
+        try:
+            me = jax.process_index()
+        except RuntimeError:
+            me = 0
+        now = time.time()
+        stale = []
+        for name in sorted(os.listdir(self.heartbeat_dir)):
+            if not name.startswith("meshhb-"):
+                continue
+            try:
+                pid = int(name.split("-", 1)[1])
+            except ValueError:
+                continue
+            if pid == me:
+                continue
+            try:
+                age = now - os.path.getmtime(
+                    os.path.join(self.heartbeat_dir, name))
+            except OSError:
+                continue
+            if age > budget:
+                stale.append(pid)
+        return stale
+
+    def guarded_ready(self, x):
+        """``jax.block_until_ready(x)`` under the heartbeat-stamped
+        collective timeout: the wait runs in a daemon thread while this
+        process keeps stamping; past ``timeout`` seconds (0 = block
+        forever) — or if the wait errors out with a peer already stale —
+        the epoch is declared lost."""
+        self.check()
+        if self.timeout <= 0:
+            self.stamp()
+            return jax.block_until_ready(x)
+        box = {}
+
+        def _wait():
+            try:
+                box["out"] = jax.block_until_ready(x)
+            except Exception as e:          # noqa: BLE001 — the backend
+                box["err"] = e              # aborts in its own way
+        t = threading.Thread(target=_wait, daemon=True)
+        t.start()
+        deadline = time.monotonic() + self.timeout
+        beat = max(0.05, min(1.0, self.timeout / 4.0))
+        while True:
+            t.join(beat)
+            self.stamp()
+            if not t.is_alive():
+                break
+            stale = self.stale_peers()
+            if stale or time.monotonic() > deadline:
+                raise MeshLostError(
+                    f"mesh epoch {self.epoch}: collective wait exceeded "
+                    f"{self.timeout:.1f}s"
+                    + (f", peer process(es) {stale} silent "
+                       f"> {self.hb_timeout:.1f}s" if stale else ""),
+                    lost_groups=stale, survivors=self.survivors)
+        if "err" in box:
+            stale = self.stale_peers()
+            if stale:
+                raise MeshLostError(
+                    f"mesh epoch {self.epoch}: collective failed "
+                    f"({box['err']}) with peer process(es) {stale} "
+                    "silent", lost_groups=stale,
+                    survivors=self.survivors) from box["err"]
+            raise box["err"]
+        return box["out"]

@@ -129,6 +129,21 @@ shard_halo_blocks = 0             # spatial halo width in 256-slot blocks
                                   # reach bound + drift margin at every
                                   # refresh)
 
+# ----- mesh-epoch recovery (docs/FAULT_TOLERANCE.md §mesh epochs):
+# losing a device group ends the mesh epoch, not the run — survivors
+# re-form a smaller mesh and resume from the last checksummed snapshot
+mesh_guard_enabled = True         # MeshGuard dead-peer check at every
+                                  # chunk dispatch of a sharded sim
+mesh_dispatch_timeout = 0.0       # [wall s] collective-wait budget per
+                                  # chunk edge; exceeding it with stale
+                                  # peer heartbeats trips mesh_lost
+                                  # (0 = block forever, single-host)
+mesh_heartbeat_dir = ""           # shared dir for cross-process mesh
+                                  # heartbeat stamps ("" = off; set for
+                                  # multi-host meshes, e.g. an NFS path)
+mesh_heartbeat_timeout = 10.0     # [wall s] peer stamp staleness before
+                                  # the peer counts as dead
+
 # ----- differentiable simulation (bluesky_tpu/diff/; OPT/GRAD stack
 # commands; docs/PERF_ANALYSIS.md §differentiable).  The OPT driver
 # descends on per-aircraft waypoint/time offsets with jax.value_and_grad

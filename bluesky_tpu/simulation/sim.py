@@ -282,6 +282,27 @@ class Simulation:
             lambda slots: setattr(self, "_sort_simt", -1.0)
             if self.shard_mode == "spatial" else None)
         self._shard_fallback = False
+        # Mesh-epoch recovery (docs/FAULT_TOLERANCE.md, ISSUE-10): a
+        # sharded run is a sequence of mesh EPOCHS — (device set, shard
+        # layout, snapshot provenance).  The MeshGuard liveness sentinel
+        # is consulted at every chunk dispatch; losing a device group
+        # ends the epoch (structured mesh_lost trip, snapshot re-shard
+        # onto the survivors in _handle_mesh_lost), not the run.
+        from ..parallel.sharding import MeshGuard as _MeshGuard
+        self.mesh_epoch = 0
+        self.mesh_degraded = False
+        self.mesh_events = []        # pending MESHLOST notices (simnode)
+        self._mesh_refresh_ms = 0.0  # wall ms of the last shard refresh
+        self.mesh_guard_enabled = bool(getattr(
+            _fault_settings, "mesh_guard_enabled", True))
+        self.mesh_guard = _MeshGuard(
+            heartbeat_dir=str(getattr(_fault_settings,
+                                      "mesh_heartbeat_dir", "") or "")
+            or None,
+            timeout=float(getattr(_fault_settings,
+                                  "mesh_dispatch_timeout", 0.0)),
+            hb_timeout=float(getattr(_fault_settings,
+                                     "mesh_heartbeat_timeout", 10.0)))
         # Multi-chip decomposition (docs/PERF_ANALYSIS.md §multi-chip):
         # 'off' | 'replicate' (interleaved rows vs replicated columns) |
         # 'spatial' (device-owned latitude stripes + halo exchange).
@@ -459,6 +480,13 @@ class Simulation:
         self.shard_mode, self.shard_mesh = "off", None
         self.shard_stats = {}
         self._shard_fallback = False
+        # a new scenario starts a fresh mesh-epoch history
+        self.mesh_guard.set_mesh(None)
+        self.mesh_guard.epoch = 0
+        self.mesh_epoch = 0
+        self.mesh_degraded = False
+        self.mesh_events = []
+        self._mesh_refresh_ms = 0.0
         self.dtmult = 1.0
         self.ffmode = False
         self.stack.reset()
@@ -478,9 +506,13 @@ class Simulation:
         return True
 
     # -------------------------------------------------------------- sharding
-    def set_shard(self, mode: str, ndev: int = 0, halo_blocks: int = 0):
+    def set_shard(self, mode: str, ndev: int = 0, halo_blocks: int = 0,
+                  devices=None):
         """Select the multi-chip mode: ``off`` | ``replicate`` |
         ``spatial`` over the first ``ndev`` devices (0 = all).
+        ``devices`` overrides the device list — the mesh-epoch recovery
+        path passes the SURVIVORS of a lost group so the re-formed mesh
+        excludes the dead devices.
 
         ``replicate``: the round-4 scheme — state sharded on the
         aircraft axis, sparse/pallas kernels row-split with replicated
@@ -508,16 +540,17 @@ class Simulation:
             self.traf.state = shd.unprepare_spatial(self.traf.state)
         if mode == "off":
             self.shard_mode, self.shard_mesh = "off", None
+            self.mesh_guard.set_mesh(None)
             self.cfg = self.cfg._replace(cd_mesh=None,
                                          cd_shard_mode="replicate")
             self._sort_simt = -1.0
             return True
-        devs = _jax.devices()
+        devs = list(devices) if devices is not None else _jax.devices()
         ndev = ndev or len(devs)
         if ndev > len(devs):
             raise ValueError(f"SHARD: {ndev} devices requested, "
                              f"{len(devs)} available")
-        mesh = shd.make_mesh(ndev)
+        mesh = shd.make_mesh(ndev, devices=devs)
         if mode == "spatial":
             state, newslot, info = shd.prepare_spatial(
                 self.traf.state, mesh, self.cfg.asas,
@@ -533,6 +566,9 @@ class Simulation:
             self.traf.state = shd.shard_state(self.traf.state, mesh)
             self._sort_simt = -1.0
         self.shard_mode, self.shard_mesh = mode, mesh
+        # bind the liveness sentinel to the new mesh (clears any kill
+        # marks: a freshly formed mesh starts its epoch healthy)
+        self.mesh_guard.set_mesh(mesh)
         if mode == "spatial":
             # pin the (auto-sized) halo so every interval compiles
             # against the exact window the refresh validated
@@ -551,11 +587,13 @@ class Simulation:
         occupancy/halo guards read scalars) — paid once per
         ``sort_every`` intervals."""
         from ..core.asas import refresh_spatial_shard
+        _t0 = time.perf_counter()
         try:
             state, newslot, info = refresh_spatial_shard(
                 state, self.cfg.asas, self.shard_mesh.shape["ac"],
                 block=min(self.cfg.cd_block, 256),
                 halo_blocks=self.cfg.cd_halo_blocks)
+            self._mesh_refresh_ms = (time.perf_counter() - _t0) * 1e3
         except RuntimeError as e:
             # The geometry broke the spatial contract (stripe occupancy
             # past a shard's capacity, or reach past the halo window).
@@ -570,6 +608,128 @@ class Simulation:
         self.shard_stats = info
         self._last_edge = None          # slots moved: ACDATA cache stale
         return state
+
+    # ------------------------------------------------- mesh-epoch recovery
+    def _handle_mesh_lost(self, err):
+        """End the current mesh epoch after a device-group loss and form
+        the next one (docs/FAULT_TOLERANCE.md §mesh epochs).
+
+        Sequence: record a structured ``mesh_lost`` trip through the
+        integrity-guard trip log; void the in-flight edge (it rode the
+        dead mesh); pick the restore point — newest snapshot-ring entry,
+        else the on-disk autosave (checksum-verified, shard header
+        checked before unpickling); tear the mesh down; restore; re-form
+        a smaller mesh from the survivors, degrading
+        spatial -> replicate -> single-chip until one layout holds; then
+        record the ``resharded`` trip, bump the epoch and queue a
+        MESHLOST notice for the owning node.  Restoring onto a different
+        D forces the full re-sort/re-bucket + conservative halo
+        re-validation (snapshot.restore_blob cross-mesh detection).
+        """
+        from . import snapshot as snap
+        old_epoch = self.mesh_epoch
+        old_mode = self.shard_mode
+        old_nd = self.shard_mesh.shape["ac"] if self.shard_mesh else 0
+        lost = list(getattr(err, "lost_groups", ()))
+        survivors = list(getattr(err, "survivors", ()) or [])
+        # the in-flight chunk rode the dead mesh: its edge is void
+        self._pending_edge = None
+        self._last_edge = None
+        self.scr.echo(f"MESH LOST (epoch {old_epoch}): {err}")
+        self.guard.mesh_trip("mesh_lost", epoch=old_epoch,
+                             lost_groups=lost, ndev=old_nd,
+                             mode=old_mode, error=str(err))
+        # restore point: newest ring entry first (in-memory, most
+        # recent), else the on-disk autosave — surfaced shard header
+        # first so a corrupt/mismatched file is diagnosed pre-unpickle
+        blob = self.snap_ring.newest()
+        src = "ring"
+        if blob is None:
+            path = self._autosave_path()
+            if os.path.isfile(path):
+                hdr, herr = snap.peek_shard(path)
+                if herr:
+                    self.scr.echo(f"mesh recovery: autosave header "
+                                  f"unusable ({herr})")
+                else:
+                    if hdr is not None and hdr.get("ndev", 0) != old_nd:
+                        self.scr.echo(
+                            "mesh recovery: autosave captured on a "
+                            f"{hdr.get('ndev')}-device "
+                            f"{hdr.get('mode')} mesh — re-shard will "
+                            "re-sort/re-bucket")
+                    blob, rerr = snap.read_blob(path)
+                    src = path
+                    if blob is None:
+                        self.scr.echo(f"mesh recovery: autosave "
+                                      f"unusable ({rerr})")
+        # epoch teardown: leave the dead mesh entirely (state back on
+        # the default device, spatial tables unsized)
+        try:
+            self.set_shard("off")
+        except (ValueError, RuntimeError) as e:  # pragma: no cover
+            self.scr.echo(f"mesh teardown failed: {e}")
+        restored = False
+        if blob is not None:
+            ok, msg = snap.restore_blob(self, blob, full_reset=False)
+            restored = bool(ok)
+            self.scr.echo(f"mesh recovery: {msg}" if ok else
+                          f"mesh recovery restore FAILED: {msg}")
+        else:
+            self.scr.echo("mesh recovery: no checksummed snapshot — "
+                          "re-sharding the live state")
+        # epoch re-formation: survivors form a smaller mesh; a mode
+        # whose contract the survivors cannot satisfy (spatial stripes
+        # need nmax % D == 0 and halo-valid occupancy) degrades
+        nd = len(survivors)
+        new_mode = "off"
+        if nd >= 1:
+            chain = ["replicate"] if old_mode == "replicate" \
+                else [old_mode, "replicate"]
+            for m in chain:
+                try:
+                    self.set_shard(m, nd, devices=survivors)
+                    new_mode = m
+                    break
+                except (ValueError, RuntimeError) as e:
+                    self.scr.echo(f"mesh recovery: SHARD "
+                                  f"{m.upper()} {nd} failed ({e})")
+        nd_now = self.shard_mesh.shape["ac"] if self.shard_mesh else 1
+        self.mesh_epoch = old_epoch + 1
+        self.mesh_guard.epoch = self.mesh_epoch
+        self.mesh_degraded = (new_mode != old_mode) or (nd_now < old_nd)
+        self.guard.mesh_trip("resharded", epoch=self.mesh_epoch,
+                             mode=new_mode, ndev=int(nd_now),
+                             restored=restored,
+                             restore_src=(src if blob is not None
+                                          else None))
+        self.scr.echo(
+            f"MESH EPOCH {self.mesh_epoch}: "
+            f"{new_mode.upper() if new_mode != 'off' else 'SINGLE-CHIP'}"
+            f" on {nd_now} device(s)"
+            + (" [degraded]" if self.mesh_degraded else "")
+            + (f", restored from {src}" if restored else
+               ", continuing on live state"))
+        # notice for the owning node -> server (MESHLOST event):
+        # recovered epochs keep their piece in flight (audit records
+        # only); an unrecovered one requeues it PREEMPTED-style
+        self.mesh_events.append(dict(
+            recovered=True, epoch=self.mesh_epoch,
+            prev_epoch=old_epoch, lost_groups=lost,
+            mode=new_mode, ndev=int(nd_now),
+            prev_mode=old_mode, prev_ndev=int(old_nd),
+            degraded=bool(self.mesh_degraded), restored=restored,
+            simt=float(self.simt_planned)))
+
+    def mesh_health(self):
+        """The HEALTH ``mesh`` section: epoch, device count, shard
+        mode, last shard-refresh wall ms, degradation state."""
+        nd = self.shard_mesh.shape["ac"] if self.shard_mesh else 0
+        return dict(epoch=int(self.mesh_epoch), devices=int(nd),
+                    mode=str(self.shard_mode),
+                    last_refresh_ms=round(float(self._mesh_refresh_ms),
+                                          3),
+                    degraded=bool(self.mesh_degraded))
 
     # ----------------------------------------------------- preempt/autosave
     def request_preempt(self):
@@ -730,14 +890,19 @@ class Simulation:
             return True
         chunk, simt = plan
 
-        reasons = self._sync_reasons(simt, chunk)
-        if reasons:
-            self._retire_edge(reasons[0])
-            self.pipe_stats["sync_reasons"][reasons[0]] = \
-                self.pipe_stats["sync_reasons"].get(reasons[0], 0) + 1
-            self._step_sync(chunk, self.simt)
-        else:
-            self._step_pipelined(chunk, simt)
+        from ..parallel.sharding import MeshLostError
+        try:
+            reasons = self._sync_reasons(simt, chunk)
+            if reasons:
+                self._retire_edge(reasons[0])
+                self.pipe_stats["sync_reasons"][reasons[0]] = \
+                    self.pipe_stats["sync_reasons"].get(reasons[0], 0) + 1
+                self._step_sync(chunk, self.simt)
+            else:
+                self._step_pipelined(chunk, simt)
+        except MeshLostError as e:
+            # a device group died: end the mesh epoch, not the run
+            self._handle_mesh_lost(e)
 
         self._after_chunk()
         return True
@@ -969,6 +1134,12 @@ class Simulation:
         the *input* state buffers to stay valid (snapshot-ring capture
         overlapping the dispatched chunk).
         """
+        # Mesh-epoch liveness precheck: a dead device group (FAULT
+        # MESHKILL, or a peer whose heartbeat stamp went stale) must
+        # surface BEFORE the chunk is enqueued onto the dead mesh —
+        # raising MeshLostError here routes into _handle_mesh_lost.
+        if self.shard_mesh is not None and self.mesh_guard_enabled:
+            self.mesh_guard.check()
         state = self._pre_dispatch_refresh(state, simt)
         from ..core.step import run_steps_edge, run_steps_edge_keep
         runner = run_steps_edge_keep if keep else run_steps_edge
@@ -1024,10 +1195,16 @@ class Simulation:
         # point?  Then this dispatch must NOT donate its input buffers:
         # they hold exactly the post-chunk state that goes into the
         # ring, and the device->host copy overlaps the dispatched chunk.
-        capture_now = (pend is not None and self.guard.enabled
-                       and self.guard.policy == "rollback"
-                       and ring.dt > 0
+        # Captures feed the rollback policy AND the mesh-epoch recovery
+        # restore point: under an active mesh the ring must keep
+        # filling regardless of guard policy, or a device-group loss
+        # would have nothing checksummed to re-shard from.
+        capture_due = (ring.dt > 0
                        and simt - ring.t_last >= ring.dt - 1e-9)
+        capture_now = (pend is not None and capture_due
+                       and ((self.guard.enabled
+                             and self.guard.policy == "rollback")
+                            or self.shard_mode != "off"))
         state_in = self.traf.state
         new_state, telem = self._dispatch_chunk(
             state_in, chunk, keep=capture_now, simt=simt)
@@ -1097,12 +1274,15 @@ class Simulation:
 
         # Periodic snapshot-ring capture: the post-chunk state is
         # verified finite when the guard is on, so ring entries are
-        # always healthy restore points.  Only the rollback policy ever
-        # consumes the ring, and a capture is a full device->host copy
-        # of the state pytree (tens of MB at 100k aircraft) — so other
-        # configurations must not keep paying for it.
-        if self.state_flag == OP and self.guard.enabled \
-                and self.guard.policy == "rollback":
+        # always healthy restore points.  The rollback policy consumes
+        # the ring, and the mesh-epoch recovery restores its newest
+        # entry after a device-group loss — a capture is a full
+        # device->host copy of the state pytree (tens of MB at 100k
+        # aircraft), so configurations needing neither must not pay.
+        if self.state_flag == OP \
+                and ((self.guard.enabled
+                      and self.guard.policy == "rollback")
+                     or self.shard_mode != "off"):
             self.snap_ring.maybe_capture(self)
 
         # Periodic on-disk autosnapshot (snapshot_autosave_dt, off by
@@ -1191,8 +1371,10 @@ class Simulation:
             # The retired edge state IS the live state again (nothing
             # was dispatched after it), so a due ring capture can use
             # the classic path at this sync boundary.
-            if self.state_flag == OP and self.guard.enabled \
-                    and self.guard.policy == "rollback":
+            if self.state_flag == OP \
+                    and ((self.guard.enabled
+                          and self.guard.policy == "rollback")
+                         or self.shard_mode != "off"):
                 self.snap_ring.maybe_capture(self)
         finally:
             self._retiring = False

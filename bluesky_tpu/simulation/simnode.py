@@ -153,10 +153,15 @@ def _make_simnode_class(base):
             # planned clock: a device read here would block the event
             # loop on the in-flight pipelined chunk, turning "busy" into
             # "silent" for the server's straggler detector
-            return {"stamp": stamp, "simt": sim.simt_planned,
+            info = {"stamp": stamp, "simt": sim.simt_planned,
                     "chunks": sim._step_count,
                     "state": sim.state_flag, "ntraf": sim.traf.ntraf,
                     "ff": bool(sim.ffmode)}
+            # mesh-epoch health rides the heartbeat so HEALTH can show
+            # the fleet's shard state without a round-trip per worker
+            if sim.shard_mode != "off" or sim.mesh_epoch > 0:
+                info["mesh"] = sim.mesh_health()
+            return info
 
         # ------------------------------------------------------------ events
         def event(self, name, data, sender_route):
@@ -241,6 +246,12 @@ def _make_simnode_class(base):
                     self._finish_worlds()
                 return
             alive = sim.step()
+            # mesh-epoch transitions (device-group loss + recovery)
+            # queued by sim._handle_mesh_lost — tell the server so it
+            # journals the mesh_lost/resharded audit pair (or requeues
+            # the piece PREEMPTED-style when recovery failed)
+            while sim.mesh_events:
+                self.send_event(b"MESHLOST", sim.mesh_events.pop(0))
             if sim.preempt_requested and self.running:
                 self._preempt_shutdown()
                 return

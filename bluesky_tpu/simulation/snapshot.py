@@ -25,20 +25,25 @@ Two consumers share the blob format:
   the full ``reset``), so logs record the recovery instead of being
   truncated by it.
 
-On-disk format v3 (durable runs, docs/FAULT_TOLERANCE.md):
+On-disk format v4 (durable runs, docs/FAULT_TOLERANCE.md):
 
-    BSTPUSNAP3\\n <sha256-hex>\\n <pickled blob bytes>
+    BSTPUSNAP4\\n <sha256-hex>\\n <shard-layout json>\\n <pickled blob>
 
 written atomically — tmp file in the same directory, flush + fsync,
 ``os.replace`` onto the final name — so a crash mid-save can only leave
 a stale tmp file, never a torn file under the final name.  ``load``
 verifies the digest before unpickling: a bit-flipped blob that would
 still unpickle (failure class #2, torn write / silent corruption) is
-rejected instead of restored.  Plain-pickle v2 files (no magic) keep
-loading for back-compat.
+rejected instead of restored.  The v4 header line carries the CAPTURING
+shard layout (mode, device count D, halo blocks) in plain JSON, so a
+mesh-epoch restore onto a different device count is detected from the
+header (``peek_shard``) BEFORE the multi-hundred-MB payload is
+unpickled.  v3 files (digest, no shard line) and plain-pickle v2 files
+keep loading for back-compat.
 """
 import collections
 import hashlib
+import json
 import os
 import pickle
 
@@ -46,9 +51,24 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-FORMAT = 3
-COMPAT_FORMATS = (2, 3)         # blob formats restore_blob accepts
-MAGIC = b"BSTPUSNAP3\n"         # v3 file header (v2 = bare pickle)
+FORMAT = 4
+COMPAT_FORMATS = (2, 3, 4)      # blob formats restore_blob accepts
+MAGIC3 = b"BSTPUSNAP3\n"        # v3 file header (v2 = bare pickle)
+MAGIC4 = b"BSTPUSNAP4\n"        # v4: + shard-layout header line
+MAGIC = MAGIC3                  # back-compat alias (v3 readers)
+
+
+def shard_meta(sim) -> dict:
+    """The sim's active shard layout as plain-JSON metadata: rides every
+    blob (and the v4 file header) so a restore onto a different device
+    count / mode is detectable without touching the payload."""
+    mesh = getattr(sim, "shard_mesh", None)
+    return dict(
+        mode=str(getattr(sim, "shard_mode", "off")),
+        ndev=int(mesh.shape["ac"]) if mesh is not None else 0,
+        halo_blocks=int(getattr(getattr(sim, "cfg", None),
+                                "cd_halo_blocks", 0) or 0),
+    )
 
 
 def state_blob(sim, state=None) -> dict:
@@ -81,6 +101,10 @@ def state_blob(sim, state=None) -> dict:
         # per-world preempt checkpoints carry it so operators can map
         # preempt-<id>-wNN.snap files back to their pieces
         world=sim.world_tag,
+        # capturing shard layout (mode, D, halo): snapshot-ring entries
+        # carry it, and write_blob lifts it into the v4 file header so
+        # a cross-mesh restore is detected pre-unpickle
+        shard=shard_meta(sim),
         cfg=dict(simdt=sim.cfg.simdt, cd_backend=sim.cfg.cd_backend,
                  asas=sim.cfg.asas._asdict()),
         dtmult=sim.dtmult,
@@ -136,6 +160,23 @@ def restore_blob(sim, blob, full_reset: bool = True):
             sort_perm=jnp.arange(traf.nmax, dtype=jnp.int32),
             partners_s=jnp.full_like(old_table, -1)))
         sim._sort_simt = -1.0
+    # Cross-MESH blobs (mesh-epoch recovery): a blob captured at a
+    # different device count or shard mode carries stripe bucketing
+    # keyed to the CAPTURING mesh even when the table shapes happen to
+    # match.  The shard metadata makes the mismatch explicit: reset the
+    # sorted-space caches to the known-good identity layout and force
+    # the full re-sort/re-bucket + conservative halo re-validation
+    # before the next chunk.
+    bshard = blob.get("shard")
+    if bshard is not None:
+        cur = shard_meta(sim)
+        if (bshard.get("ndev"), bshard.get("mode")) \
+                != (cur["ndev"], cur["mode"]):
+            traf.state = traf.state.replace(asas=traf.state.asas.replace(
+                sort_perm=jnp.arange(traf.nmax, dtype=jnp.int32),
+                partners_s=jnp.full_like(traf.state.asas.partners_s,
+                                         -1)))
+            sim._sort_simt = -1.0
     # Restore under an active mesh: re-place the (host-restored) arrays
     # with the mode's canonical shardings, and in spatial mode force a
     # re-bucketing refresh before the next chunk — the restored
@@ -198,10 +239,14 @@ def write_blob(blob, fname):
     """
     payload = pickle.dumps(blob, protocol=pickle.HIGHEST_PROTOCOL)
     digest = hashlib.sha256(payload).hexdigest().encode("ascii")
+    shard_line = json.dumps(
+        blob.get("shard") or dict(mode="off", ndev=0, halo_blocks=0),
+        sort_keys=True).encode("ascii")
     tmp = f"{fname}.tmp.{os.getpid()}"
     try:
         with open(tmp, "wb") as f:
-            f.write(MAGIC + digest + b"\n" + payload)
+            f.write(MAGIC4 + digest + b"\n" + shard_line + b"\n"
+                    + payload)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, fname)
@@ -216,24 +261,63 @@ def write_blob(blob, fname):
 
 def save(sim, fname):
     """Write an atomic, checksummed snapshot of the complete simulation
-    state (format v3).  Raises ``OSError`` on disk-full/bad path — the
+    state (format v4).  Raises ``OSError`` on disk-full/bad path — the
     SNAPSHOT stack command catches it and degrades to a command error,
     symmetric with the hardened ``load``."""
     return write_blob(state_blob(sim), fname)
 
 
+def _split_v4(raw):
+    """Split a v4 byte stream into (digest, shard_meta, payload) —
+    raises on a malformed header (caught by the callers' hardening)."""
+    digest_end = raw.index(b"\n", len(MAGIC4))
+    digest = raw[len(MAGIC4):digest_end].decode("ascii")
+    shard_end = raw.index(b"\n", digest_end + 1)
+    shard = json.loads(raw[digest_end + 1:shard_end].decode("ascii"))
+    if not isinstance(shard, dict):
+        raise ValueError("shard header is not a JSON object")
+    return digest, shard, raw[shard_end + 1:]
+
+
+def peek_shard(fname):
+    """Surface a v4 snapshot's shard-layout header WITHOUT unpickling:
+    ``(shard_dict, None)`` for v4 files, ``(None, None)`` for
+    pre-shard-header formats (v2/v3 — readable, layout unknown), or
+    ``(None, errmsg)`` on an unreadable/malformed file.  The mesh-epoch
+    restore path uses this to detect a D/mode mismatch from the header
+    instead of after unpickling the payload."""
+    try:
+        with open(fname, "rb") as f:
+            head = f.read(64 * 1024)
+        if not head.startswith(MAGIC4):
+            return None, None
+        _, shard, _ = _split_v4(head)
+        return shard, None
+    except (OSError, ValueError, UnicodeDecodeError) as exc:
+        return None, (f"corrupt or truncated snapshot header "
+                      f"({type(exc).__name__}: {exc})")
+
+
 def read_blob(fname):
     """Read + verify a snapshot file; returns ``(blob, None)`` or
-    ``(None, errmsg)``.  v3 files are checksum-verified BEFORE
+    ``(None, errmsg)``.  v3/v4 files are checksum-verified BEFORE
     unpickling, so a bit-flipped payload that would still unpickle is
-    rejected; files without the v3 magic fall back to the v2 plain
-    pickle for back-compat."""
+    rejected; v4 files additionally surface the shard-layout header
+    into ``blob["shard"]``; files without a magic fall back to the v2
+    plain pickle for back-compat."""
+    hdr_shard = None
     try:
         with open(fname, "rb") as f:
             raw = f.read()
-        if raw.startswith(MAGIC):
-            header_end = raw.index(b"\n", len(MAGIC))
-            digest = raw[len(MAGIC):header_end].decode("ascii")
+        if raw.startswith(MAGIC4):
+            digest, hdr_shard, payload = _split_v4(raw)
+            if hashlib.sha256(payload).hexdigest() != digest:
+                return None, ("corrupt or truncated snapshot "
+                              "(checksum mismatch)")
+            blob = pickle.loads(payload)
+        elif raw.startswith(MAGIC3):
+            header_end = raw.index(b"\n", len(MAGIC3))
+            digest = raw[len(MAGIC3):header_end].decode("ascii")
             payload = raw[header_end + 1:]
             if hashlib.sha256(payload).hexdigest() != digest:
                 return None, ("corrupt or truncated snapshot "
@@ -243,12 +327,14 @@ def read_blob(fname):
             blob = pickle.loads(raw)        # v2: bare pickle, no digest
     except (OSError, EOFError, pickle.UnpicklingError, AttributeError,
             MemoryError, ImportError, IndexError, KeyError,
-            ValueError) as exc:
+            UnicodeDecodeError, ValueError) as exc:
         return None, (f"corrupt or truncated snapshot "
                       f"({type(exc).__name__}: {exc})")
     if not isinstance(blob, dict) \
             or blob.get("format") not in COMPAT_FORMATS:
         return None, "unsupported snapshot format"
+    if hdr_shard is not None:
+        blob.setdefault("shard", hdr_shard)
     return blob, None
 
 

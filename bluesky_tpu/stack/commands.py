@@ -1095,6 +1095,13 @@ def register_all(stack):
             node.send_event(b"HEALTH", None)   # empty route -> server
             return True, "HEALTH requested from the server"
         ps = sim.pipe_stats
+        mh = sim.mesh_health()
+        mesh_line = ""
+        if mh["mode"] != "off" or mh["epoch"] > 0:
+            mesh_line = (f"\nmesh: epoch {mh['epoch']}, "
+                         f"{mh['devices']} device(s), mode {mh['mode']}"
+                         f", last refresh {mh['last_refresh_ms']:g} ms"
+                         + (" [DEGRADED]" if mh["degraded"] else ""))
         return True, (f"detached sim: state {sim.state_flag}, simt "
                       f"{sim.simt_planned:.1f} s, {traf.ntraf} aircraft, "
                       f"{sim._step_count} steps done, chunks "
@@ -1102,7 +1109,7 @@ def register_all(stack):
                       f"{ps['sync_chunks']} sync"
                       + (", straggle STALLED"
                          if getattr(sim, 'straggle_stall', False)
-                         else ""))
+                         else "") + mesh_line)
 
     def optcmd(tend=None, iters=None, lr=None, restarts=None):
         """OPT [tend,iters,lr,restarts]: gradient-based trajectory
@@ -1525,7 +1532,8 @@ def register_all(stack):
                     "JAX trace capture and per-kernel timings"],
         "FAULT": ["FAULT NAN/INF [acid] | GUARD ../RING .. | DROP/DUP/"
                   "DELAY p | NETOFF | STALL s | STRAGGLE f/STALL/OFF | "
-                  "KILL | PREEMPT [s] | SNAPTRUNC f | LIST",
+                  "KILL | PREEMPT [s] | MESHKILL [g] | PARTITION [OFF] "
+                  "| SNAPTRUNC f | LIST",
                   "[word,...]", faultcmd,
                   "Fault-injection harness (chaos testing)"],
         "HEALTH": ["HEALTH", "", healthcmd,

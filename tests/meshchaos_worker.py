@@ -1,0 +1,138 @@
+"""Worker process for the 2-process mesh-loss chaos test
+(tests/test_meshchaos.py): a gloo mesh host that journals a BATCH
+piece, snapshots every chunk, and couples to its peer through a
+MeshGuard-wrapped collective.
+
+Process 1 is the victim: it stamps its heartbeat and answers the
+collective until the parent SIGKILLs it.  Process 0 is the host under
+test: it runs a real Simulation chunk loop; each chunk writes a
+checksummed v4 snapshot (shard header: the 8-device replicate layout)
+and then runs one cross-process collective under
+``MeshGuard.guarded_ready``.  When the peer dies, the collective hangs
+(or aborts) with the peer's heartbeat stamp stale — process 0 journals
+``mesh_lost`` and exits 0.  The parent then resumes the piece from the
+last snapshot on a degraded 4-device mesh (test_meshchaos.py phase 2).
+
+Usage: python meshchaos_worker.py <pid> <coord_port> <workdir>
+
+Keep env setup inside main(): the parent test imports PIECE from this
+module, and a top-level ``os.environ`` write would leak 4-device
+XLA_FLAGS into the 8-device test process.
+"""
+import os
+import sys
+import time
+
+# The BATCH piece under test — journal keys are content-addressed over
+# exactly this (scentime, scencmd) pair, so the parent (which writes
+# the resharded/completed records in phase 2) imports it from here.
+PIECE = ([0.0, 0.0, 0.0, 0.0],
+         ["SCEN MESHCHAOS",
+          "CRE AAA1 B744 52 4 90 FL200 250",
+          "CRE AAA2 B744 52.2 4.2 90 FL200 250",
+          "FF"])
+
+
+def main():
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # noqa: BLE001 — flag spelling varies by version
+        pass
+
+    pid, port, workdir = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    from bluesky_tpu.parallel import sharding
+    from bluesky_tpu.parallel.sharding import MeshGuard, MeshLostError
+
+    sharding.init_multihost(coordinator_address=f"127.0.0.1:{port}",
+                            num_processes=2, process_id=pid)
+    assert len(jax.devices()) == 8, "job mesh must span both processes"
+
+    guard = MeshGuard(mesh=sharding.make_mesh(),
+                      heartbeat_dir=os.path.join(workdir, "hb"),
+                      timeout=3.0, hb_timeout=1.0)
+    guard.stamp()
+
+    def collective():
+        return multihost_utils.process_allgather(np.arange(4.0),
+                                                 tiled=True)
+
+    class _Coll:
+        # lazy handle: guarded_ready runs block_until_ready in a side
+        # thread, so the collective itself must happen inside it
+        def block_until_ready(self):
+            return collective()
+
+    if pid != 0:
+        # the victim: pulse and answer collectives until SIGKILLed
+        while True:
+            guard.stamp()
+            collective()
+            time.sleep(0.1)
+
+    from bluesky_tpu.network.journal import BatchJournal
+    from bluesky_tpu.simulation import snapshot as snap
+    from bluesky_tpu.simulation.sim import Simulation
+
+    journal = BatchJournal(os.path.join(workdir, "batch.jsonl"))
+    journal.queued(PIECE)
+    journal.dispatched(PIECE, b"\x00")
+    sim = Simulation(nmax=16)
+    sim.stack.set_scendata(list(PIECE[0]), list(PIECE[1]))
+    sim.op()
+    snap_path = os.path.join(workdir, "ring.snap")
+
+    def mesh_lost(reason):
+        journal.mesh_lost(PIECE, b"\x00", epoch=0,
+                          lost=list(getattr(reason, "lost_groups", ()))
+                          or [1])
+        journal.close()
+        with open(os.path.join(workdir, "meshlost"), "w") as f:
+            f.write(f"{reason}\n")
+        print(f"worker 0: mesh lost ({reason})", flush=True)
+        sys.exit(0)
+
+    try:
+        for chunk in range(1, 601):
+            sim.step()
+            blob = snap.state_blob(sim)
+            # the layout this piece runs on: the v4 header makes the
+            # parent's degraded restore detect the D mismatch
+            blob["shard"] = dict(mode="replicate", ndev=8,
+                                 halo_blocks=0)
+            snap.write_blob(blob, snap_path)
+            with open(os.path.join(workdir, "progress"), "w") as f:
+                f.write(f"{chunk} {float(sim.simt_planned)}\n")
+            guard.guarded_ready(_Coll())
+    except MeshLostError as e:
+        mesh_lost(e)
+    except Exception as e:  # noqa: BLE001 — the gloo transport may
+        # abort the collective before the peer's stamp has gone stale:
+        # wait the staleness budget out, then decide
+        deadline = time.time() + 5.0
+        stale = guard.stale_peers()
+        while time.time() < deadline and not stale:
+            time.sleep(0.2)
+            stale = guard.stale_peers()
+        if stale:
+            mesh_lost(MeshLostError(
+                f"collective failed ({e}) with peer process(es) "
+                f"{stale} silent", lost_groups=stale))
+        raise
+    journal.close()
+    print("worker 0: finished without mesh loss", flush=True)
+    sys.exit(3)
+
+
+if __name__ == "__main__":
+    main()

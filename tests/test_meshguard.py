@@ -1,0 +1,262 @@
+"""Mesh-epoch recovery: MeshGuard detection, FAULT MESHKILL response,
+snapshot shard headers, and the D=8 -> D=4 re-shard parity contract.
+
+The tentpole contract (docs/FAULT_TOLERANCE.md §mesh epochs): losing a
+device group ends the mesh EPOCH, not the run — the survivors re-form a
+smaller mesh, the last checksummed snapshot is restored onto it, and
+the state that results is bit-identical to a fresh run on the smaller
+mesh restored from the same snapshot.  The 2-process gloo variant (a
+real killed host) lives in test_meshchaos.py (slow lane).
+"""
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bluesky_tpu.fault import harness
+from bluesky_tpu.parallel.sharding import MeshGuard, MeshLostError
+from bluesky_tpu.simulation import snapshot as snap
+from bluesky_tpu.simulation.sim import Simulation
+
+
+@pytest.fixture()
+def sim():
+    return Simulation(nmax=16, dtype=jnp.float64)
+
+
+def do(sim, *lines):
+    for line in lines:
+        sim.stack.stack(line)
+    sim.stack.process()
+    out = "\n".join(sim.scr.echobuf)
+    sim.scr.echobuf.clear()
+    return out
+
+
+def _fleet(sim, n=3):
+    for i in range(n):
+        do(sim, f"CRE KL{i} B744 {52 + i} {4 + i} 90 FL{200 + 10 * i} 250")
+    sim.op()
+
+
+def _state_arrays(sim):
+    sim.traf.flush()
+    return [np.asarray(x) for x in jax.tree.leaves(sim.traf.state)]
+
+
+# ----------------------------------------------------------- MeshGuard
+class TestMeshGuard:
+    def test_single_process_partition_is_two_halves(self):
+        groups = MeshGuard._partition(list(range(8)))
+        assert groups == [[0, 1, 2, 3], [4, 5, 6, 7]]
+        assert MeshGuard._partition([1]) == [[1]]
+        assert MeshGuard._partition([]) == []
+
+    def test_kill_group_validates_and_keeps_one_alive(self):
+        g = MeshGuard()
+        g.groups = [[0, 1], [2, 3]]
+        with pytest.raises(ValueError):
+            g.kill_group(2)
+        assert g.kill_group(1) == [2, 3]
+        assert g.survivors == [0, 1]
+        with pytest.raises(ValueError):        # never kill the last
+            g.kill_group(0)
+
+    def test_check_raises_structured_error_only_with_mesh(self):
+        from bluesky_tpu.parallel import sharding as shd
+        g = MeshGuard()
+        g._killed = {0}
+        g.check()                  # no mesh bound: nothing to lose
+        g.set_mesh(shd.make_mesh(8))
+        g.kill_group(1)
+        with pytest.raises(MeshLostError) as ei:
+            g.check()
+        assert ei.value.lost_groups == (1,)
+        assert len(ei.value.survivors) == 4
+
+    def test_set_mesh_clears_kill_marks(self):
+        from bluesky_tpu.parallel import sharding as shd
+        g = MeshGuard(mesh=shd.make_mesh(8))
+        g.kill_group(1)
+        g.set_mesh(shd.make_mesh(4))
+        g.check()                  # new epoch starts healthy
+
+    def test_stale_peers_from_heartbeat_stamps(self, tmp_path):
+        g = MeshGuard(heartbeat_dir=str(tmp_path), hb_timeout=5.0)
+        g.stamp()                               # own stamp: never stale
+        peer = tmp_path / "meshhb-7"
+        peer.write_text("0.0\n")
+        old = time.time() - 60.0
+        os.utime(peer, (old, old))
+        assert g.stale_peers() == [7]
+        assert g.stale_peers(hb_timeout=120.0) == []
+
+    def test_guarded_ready_times_out_on_stale_peer(self, tmp_path):
+        g = MeshGuard(heartbeat_dir=str(tmp_path), timeout=0.3,
+                      hb_timeout=0.1)
+        peer = tmp_path / "meshhb-9"
+        peer.write_text("0.0\n")
+        old = time.time() - 60.0
+        os.utime(peer, (old, old))
+
+        class _Hang:
+            def block_until_ready(self):
+                time.sleep(30.0)
+        with pytest.raises(MeshLostError) as ei:
+            g.guarded_ready(_Hang())
+        assert 9 in ei.value.lost_groups
+
+    def test_guarded_ready_passthrough_when_healthy(self):
+        g = MeshGuard(timeout=5.0)
+        x = jnp.arange(4.0)
+        out = g.guarded_ready(x)
+        assert np.allclose(np.asarray(out), np.arange(4.0))
+
+
+# --------------------------------------------------- FAULT MESHKILL e2e
+@pytest.mark.skipif(jax.device_count() < 8, reason="needs 8 devices")
+class TestMeshkillRecovery:
+    def test_meshkill_trips_and_resharding_recovers(self, sim):
+        _fleet(sim)
+        do(sim, "SHARD REPLICATE 8")
+        sim.snap_ring.dt = 1.0        # force frequent ring captures
+        sim.run(until_simt=4.0)
+        assert len(sim.snap_ring)     # a restore point exists
+        out = do(sim, "FAULT MESHKILL 1")
+        assert "marked dead" in out
+        sim.run(until_simt=6.0)       # trips at the next dispatch
+        actions = [t["action"] for t in sim.guard.trips]
+        assert actions == ["mesh_lost", "resharded"]
+        lost = next(t for t in sim.guard.trips
+                    if t["action"] == "mesh_lost")
+        assert lost["source"] == "mesh_guard" and lost["ndev"] == 8
+        assert sim.mesh_epoch == 1
+        assert sim.shard_mode == "replicate"
+        assert sim.shard_mesh.shape["ac"] == 4
+        assert sim.traf.ntraf == 3    # fleet survived the epoch change
+        mh = sim.mesh_health()
+        assert mh == dict(epoch=1, devices=4, mode="replicate",
+                          last_refresh_ms=mh["last_refresh_ms"],
+                          degraded=True)
+        # the MESHLOST notice for the owning node is queued
+        (ev,) = sim.mesh_events
+        assert ev["recovered"] and ev["prev_ndev"] == 8 \
+            and ev["ndev"] == 4 and ev["degraded"]
+
+    def test_meshkill_requires_an_active_mesh(self, sim):
+        ok, msg = harness.fault_command(sim, "MESHKILL")
+        assert not ok and "SHARD first" in msg
+
+    def test_fault_status_reports_mesh_epoch(self, sim):
+        _fleet(sim)
+        do(sim, "SHARD REPLICATE 8")
+        ok, msg = harness.fault_command(sim)
+        assert ok and "mesh: epoch 0, 8 device(s)" in msg
+
+    def test_health_detached_includes_mesh_section(self, sim):
+        _fleet(sim)
+        do(sim, "SHARD REPLICATE 8")
+        out = do(sim, "HEALTH")
+        assert "mesh: epoch 0" in out and "mode replicate" in out
+
+    def test_reshard_parity_with_fresh_small_mesh_run(self, sim):
+        """Acceptance: state stepped after a forced D=8 -> D=4 re-shard
+        is bit-identical to a fresh D=4 run restored from the SAME
+        snapshot."""
+        sim.pipeline_enabled = False
+        _fleet(sim)
+        do(sim, "SHARD REPLICATE 8")
+        sim.snap_ring.dt = 1.0
+        sim.run(until_simt=4.0)
+        blob = sim.snap_ring.newest()
+        assert blob is not None
+        assert blob["shard"] == dict(mode="replicate", ndev=8,
+                                     halo_blocks=0)
+        restore_simt = float(np.asarray(blob["state"].simt))
+        sim.mesh_guard.kill_group(1)
+        sim.run(until_simt=restore_simt + 3.0)   # lose + recover + step
+        assert sim.mesh_epoch == 1 and sim.shard_mesh.shape["ac"] == 4
+        a = _state_arrays(sim)
+        t_a = sim.simt
+
+        fresh = Simulation(nmax=16, dtype=jnp.float64)
+        fresh.pipeline_enabled = False
+        ok, msg = snap.restore_blob(fresh, blob, full_reset=False)
+        assert ok, msg
+        fresh.set_shard("replicate", 4,
+                        devices=jax.devices()[:4])   # = the survivors
+        fresh.op()
+        fresh.run(until_simt=restore_simt + 3.0)
+        b = _state_arrays(fresh)
+        assert abs(t_a - fresh.simt) < 1e-9
+        assert len(a) == len(b)
+        for xa, xb in zip(a, b):
+            np.testing.assert_array_equal(xa, xb)
+
+
+# ----------------------------------------------------- snapshot headers
+class TestSnapshotShardHeader:
+    def test_v4_roundtrip_carries_shard_layout(self, sim, tmp_path):
+        _fleet(sim)
+        do(sim, "SHARD REPLICATE 8")
+        path = str(tmp_path / "mesh.snap")
+        blob = snap.state_blob(sim)
+        assert blob["shard"] == dict(mode="replicate", ndev=8,
+                                     halo_blocks=0)
+        snap.write_blob(blob, path)
+        shard, err = snap.peek_shard(path)
+        assert err is None
+        assert shard == blob["shard"]
+        back, err = snap.read_blob(path)
+        assert err is None and back["shard"] == blob["shard"]
+
+    def test_peek_shard_flags_corruption_pre_unpickle(self, tmp_path):
+        path = str(tmp_path / "bad.snap")
+        with open(path, "wb") as f:
+            f.write(snap.MAGIC4 + b"00" * 32 + b"\nnot-json\npayload")
+        shard, err = snap.peek_shard(path)
+        assert shard is None and err is not None
+
+    def test_cross_mesh_restore_resets_sort_caches(self, sim):
+        _fleet(sim)
+        do(sim, "SHARD REPLICATE 8")
+        sim.run(until_simt=2.0)
+        blob = snap.state_blob(sim)
+        other = Simulation(nmax=16, dtype=jnp.float64)
+        ok, msg = snap.restore_blob(other, blob, full_reset=False)
+        assert ok, msg
+        assert other._sort_simt == -1.0       # re-sort/re-bucket forced
+        pn = np.asarray(other.traf.state.asas.partners_s)
+        assert (pn == -1).all()
+
+
+# --------------------------------------------------------- FAULT PARTITION
+class TestPartitionCommand:
+    def test_partition_needs_a_network_node(self, sim):
+        ok, msg = harness.fault_command(sim, "PARTITION")
+        assert not ok and "no network node" in msg
+
+    def test_partition_injector_drops_heartbeats_only(self):
+        from bluesky_tpu.fault import injectors
+
+        sent = []
+
+        class _Sock:
+            def send_multipart(self, frames, **kw):
+                sent.append(list(frames))
+
+        class _Node:
+            event_io = _Sock()
+
+        node = _Node()
+        flaky = injectors.partition(node)
+        node.event_io.send_multipart([b"PONG", b"payload"])
+        node.event_io.send_multipart([b"BATCHWORLD", b"payload"])
+        assert sent == [[b"BATCHWORLD", b"payload"]]
+        assert flaky.n_name_dropped == 1
+        injectors.partition(node, names=())     # heal
+        node.event_io.send_multipart([b"PONG", b"payload"])
+        assert sent[-1] == [b"PONG", b"payload"]
