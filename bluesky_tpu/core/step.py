@@ -291,6 +291,13 @@ class EdgeTelemetry(NamedTuple):
       per-field ``np.asarray`` pulls metrics/ScreenIO used to issue per
       chunk edge; ``bad`` alone is a one-scalar poll (the deferred
       guard word).
+
+    Observability contract (docs/OBSERVABILITY.md): the flight
+    recorder's chunk-sequence correlation tag is HOST-side state on
+    ``simulation.pipeline.ChunkEdge``, stamped at dispatch — it must
+    NOT become a field here.  Adding a device op for telemetry would
+    break the recorder-off guarantee (zero added device ops,
+    bit-identical stepped state, pinned by tests/test_obs.py).
     """
     simt: jnp.ndarray       # [s] sim time at the chunk edge
     bad: jnp.ndarray        # int32 first bad step in chunk, -1 = clean

@@ -104,6 +104,14 @@ class IntegrityGuard:
                      f"[{names}]")
         if self.logger.active:
             self.logger.log(sim, ids or ["-"], [action])
+        # Observability: count the trip, mark it on the flight-recorder
+        # timeline, and dump the ring so the spans LEADING UP TO the
+        # incident survive it (throttled; docs/OBSERVABILITY.md).
+        sim.obs.counter("sim_guard_trips").inc()
+        sim.recorder.instant("guard_trip", bad_step=int(bad_step),
+                             chunk=int(chunk), action=action,
+                             nbad=len(ids), world=sim.world_tag)
+        sim.recorder.auto_dump("guard_trip")
         return rec
 
     def mesh_trip(self, action: str, **extra):
@@ -120,6 +128,13 @@ class IntegrityGuard:
         self.trips.append(rec)
         if self.logger.active:
             self.logger.log(sim, ["-"], [str(action)])
+        # Same observability treatment as state trips: the mesh_lost /
+        # resharded pair brackets the recovery on the merged timeline.
+        sim.obs.counter("sim_mesh_trips").inc()
+        tags = {k: v for k, v in extra.items()
+                if isinstance(v, (int, float, str, bool, list))}
+        sim.recorder.instant(str(action), world=sim.world_tag, **tags)
+        sim.recorder.auto_dump("mesh_trip")
         return rec
 
     def _delete_slots(self, slots):

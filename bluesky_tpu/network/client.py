@@ -32,6 +32,8 @@ class Client:
         #                             admission-control refusal carries
         #                             queue depth + a retry-after hint)
         self.last_health = None     # latest HEALTH reply payload
+        self.last_metrics = None    # latest METRICS (telemetry) reply
+        self.last_trace = None      # latest TRACE reply (dump path)
         self.opt_results = []       # BATCHOPT reports (OPT-piece
         #                             trajectory-optimization results:
         #                             offsets + objective trace)
@@ -132,6 +134,12 @@ class Client:
         ``self.last_health``)."""
         self.send_event(b"HEALTH", target=b"")
 
+    def request_metrics(self):
+        """Ask the server for its telemetry registries (broker + fleet
+        aggregate); the reply arrives as a ``METRICS`` event (cached in
+        ``self.last_metrics``)."""
+        self.send_event(b"METRICS", target=b"")
+
     def subscribe(self, streamname: bytes, node_id: bytes = b""):
         self.stream_in.setsockopt(zmq.SUBSCRIBE, streamname + node_id)
 
@@ -172,6 +180,10 @@ class Client:
                 self.last_rejection = data   # retry logic reads this
             elif name == b"HEALTH":
                 self.last_health = data
+            elif name == b"METRICS":
+                self.last_metrics = data
+            elif name == b"TRACE":
+                self.last_trace = data
             elif name == b"BATCHOPT":
                 self.opt_results.append(data)
             sender = route[0] if route else b""

@@ -126,13 +126,18 @@ class BatchJournal:
     def _write(self, records):
         if self._dead or not records:
             return
+        from ..obs.trace import get_recorder
         try:
-            f = self._open()
-            for r in records:
-                f.write(json.dumps(r, separators=(",", ":")) + "\n")
-            f.flush()
-            if self.fsync:
-                os.fsync(f.fileno())
+            with get_recorder().span("journal_append", cat="server",
+                                     nrecords=len(records),
+                                     rec=records[0].get("rec", "?"),
+                                     fsync=self.fsync):
+                f = self._open()
+                for r in records:
+                    f.write(json.dumps(r, separators=(",", ":")) + "\n")
+                f.flush()
+                if self.fsync:
+                    os.fsync(f.fileno())
         except OSError as e:
             self._dead = True
             print(f"batch journal: disabled after write failure "

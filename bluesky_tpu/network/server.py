@@ -96,6 +96,11 @@ class FairQueue:
     def __init__(self):
         self._queues = {}                  # owner -> deque of pieces
         self._rr = collections.deque()     # owner service rotation
+        # queue-wait bookkeeping (docs/OBSERVABILITY.md): admission
+        # stamp per piece object, read off at pop.  Keyed by id() —
+        # the same list pair flows from push to dispatch unchanged.
+        self._enq_t = {}                   # id(piece) -> monotonic stamp
+        self.last_wait_s = None            # wait of the last pop_next
 
     def _ensure(self, owner):
         q = self._queues.get(owner)
@@ -106,14 +111,19 @@ class FairQueue:
 
     def push(self, piece, owner=b""):
         self._ensure(owner).append(piece)
+        self._enq_t[id(piece)] = time.monotonic()
 
     def push_front(self, piece, owner=b""):
         """Requeue (crash/preempt/resume): the piece goes back to the
         FRONT of its owner's sub-queue, keeping sweep order."""
         self._ensure(owner).appendleft(piece)
+        self._enq_t[id(piece)] = time.monotonic()
 
     def extend(self, pieces, owner=b""):
         self._ensure(owner).extend(pieces)
+        now = time.monotonic()
+        for p in pieces:
+            self._enq_t[id(p)] = now
 
     def pop_next(self):
         """``(owner, piece)`` from the next owner in rotation with work
@@ -123,7 +133,11 @@ class FairQueue:
             self._rr.rotate(-1)
             q = self._queues.get(owner)
             if q:
-                return owner, q.popleft()
+                piece = q.popleft()
+                t0 = self._enq_t.pop(id(piece), None)
+                self.last_wait_s = (None if t0 is None
+                                    else time.monotonic() - t0)
+                return owner, piece
         return None
 
     def depth_by_owner(self):
@@ -183,8 +197,50 @@ class WorldPack:
                 for i in range(len(self.pieces)) if i not in self.done]
 
 
+def _obs_counter(name, help=""):
+    """Registry-backed broker counter exposed as a plain int attribute:
+    reads stay ints (tests/operators compare with ``==``), writes
+    (``+= 1``) land in ``self.obs`` so METRICS DUMP, the Prometheus
+    export and HEALTH all read ONE source of truth."""
+    def fget(self):
+        return int(self.obs.counter(name, help=help).value)
+
+    def fset(self, v):
+        self.obs.counter(name, help=help)._set(v)
+    return property(fget, fset)
+
+
 class Server(threading.Thread):
     """Runs the broker loop in a thread (reference: Server(Thread))."""
+
+    # broker counters, backed by the server metrics registry
+    packed_pieces = _obs_counter(
+        "server_packed_pieces", "pieces dispatched inside world-packs")
+    world_batches = _obs_counter(
+        "server_world_batches", "packed world-batch dispatches sent")
+    worlds_refused_spatial = _obs_counter(
+        "server_worlds_refused_spatial",
+        "spatial-shard pieces kept out of packs")
+    worlds_refused_opt = _obs_counter(
+        "server_worlds_refused_opt", "OPT/GRAD pieces kept out of packs")
+    worlds_failed = _obs_counter(
+        "server_worlds_failed", "per-world failure reports")
+    hedges_started = _obs_counter(
+        "server_hedges_started", "speculative straggler re-dispatches")
+    hedges_won_hedge = _obs_counter(
+        "server_hedges_won_hedge", "hedge copy finished first")
+    hedges_won_primary = _obs_counter(
+        "server_hedges_won_primary", "primary recovered and won")
+    hedges_cancelled = _obs_counter(
+        "server_hedges_cancelled", "hedge losers that acked the cancel")
+    dup_completions = _obs_counter(
+        "server_dup_completions", "hedge losers that finished anyway")
+    rejected_batches = _obs_counter(
+        "server_rejected_batches", "BATCHREJECTED admission refusals")
+    opt_results = _obs_counter(
+        "server_opt_results", "OPTRESULT reports journaled")
+    stream_drops = _obs_counter(
+        "server_stream_drops", "stream frames dropped at SNDHWM")
 
     def __init__(self, headless=False, discoverable=False,
                  ports=None, max_nnodes=None, spawn_workers=True,
@@ -195,6 +251,23 @@ class Server(threading.Thread):
                  batch_queue_max=None, world_pack=None,
                  world_batch_max=None):
         super().__init__(daemon=True)
+        # Observability (ISSUE-11, docs/OBSERVABILITY.md): the broker's
+        # own registry (counters above, demux/queue series below), the
+        # FLEET registry that heartbeat metric deltas from every worker
+        # merge into, and the per-process flight recorder.
+        from ..obs.metrics import (DEFAULT_S_BUCKETS, Registry)
+        from ..obs.trace import get_recorder
+        self.obs = Registry()
+        self.fleet = Registry()
+        self.recorder = get_recorder()
+        self.obs.histogram(
+            "server_demux_ms",
+            help="world-pack demux (BATCHWORLD/retirement) host ms")
+        self.obs.histogram(
+            "server_queue_wait_s", buckets=DEFAULT_S_BUCKETS,
+            help="piece admission -> dispatch queue wait")
+        self.obs.gauge("server_queue_depth",
+                       help="pending BATCH pieces")
         self.server_id = make_id()
         self.headless = headless
         self.ports = dict(DEFAULT_PORTS, **(ports or {}))
@@ -265,8 +338,6 @@ class Server(threading.Thread):
         self.worlds_refused_spatial = 0    # spatial pieces kept out of packs
         self.worlds_refused_opt = 0        # OPT/GRAD pieces kept out of packs
         self.worlds_failed = 0             # per-world failure reports
-        self.worlds_demux_s = 0.0          # host time spent demuxing
-        self.worlds_demux_events = 0
         self.worker_progress = {}          # wid -> {simt, chunks, rate,
         #                                    t (last report), advance_t}
         self.hedge_by = {}                 # primary wid -> hedge wid
@@ -616,8 +687,8 @@ class Server(threading.Thread):
                                 self.journal.completed(p, sender,
                                                        world=i)
                         self._completion_stamps.append(time.monotonic())
-                        self.worlds_demux_s += time.perf_counter() - t0
-                        self.worlds_demux_events += 1
+                        self._observe_demux(t0, kind="pack_retire",
+                                            worker=sender.hex())
                     elif piece is not None:   # piece completed cleanly:
                         # reset its consecutive-crash count
                         self.inflight_owner.pop(sender, None)
@@ -676,8 +747,8 @@ class Server(threading.Thread):
                             f"world {i} of packed piece on worker "
                             f"{sender.hex()} {status} — piece striked")
                         self._piece_failed(p, pack.owners[i])
-                    self.worlds_demux_s += time.perf_counter() - t0
-                    self.worlds_demux_events += 1
+                    self._observe_demux(t0, kind="world", world=i,
+                                        worker=sender.hex())
         elif name == b"OPTRESULT" and from_worker:
             # Trajectory-optimization result from an OPT BATCH piece
             # (diff/optimize.py via the OPT stack command): journal it
@@ -723,6 +794,21 @@ class Server(threading.Thread):
         elif name == b"HEALTH":
             sock.send_multipart(
                 [sender, b"HEALTH", packb(self.health_payload())])
+        elif name == b"METRICS":
+            # METRICS DUMP (stack/commands.py): broker registry + the
+            # fleet aggregate merged from worker heartbeat deltas
+            sock.send_multipart(
+                [sender, b"METRICS", packb(self.metrics_payload())])
+        elif name == b"TRACE":
+            # TRACE DUMP reached the broker: dump ITS ring too, so the
+            # report merger gets the server half of the timeline
+            path = self.recorder.dump(reason="manual", proc="server") \
+                if self.recorder.enabled and len(self.recorder) else None
+            sock.send_multipart(
+                [sender, b"TRACE",
+                 packb({"path": path,
+                        "enabled": bool(self.recorder.enabled),
+                        "events": len(self.recorder)})])
         elif name == b"PREEMPTED" and from_worker:
             # a preempted worker drained its chunk, wrote a checkpoint
             # and is exiting: requeue its piece WITHOUT a circuit-
@@ -897,6 +983,9 @@ class Server(threading.Thread):
         picks = []
         while len(picks) < wmax and self.scenarios:
             owner, piece = self.scenarios.pop_next()
+            if self.scenarios.last_wait_s is not None:
+                self.obs.get("server_queue_wait_s").observe(
+                    self.scenarios.last_wait_s)
             solo_why = self._piece_solo_reason(piece) \
                 if self.world_pack and wmax > 1 else None
             if solo_why and picks:
@@ -977,6 +1066,12 @@ class Server(threading.Thread):
         count — monotonic per worker process — is the advance signal;
         simt deltas feed the rate."""
         now = time.monotonic()
+        # fleet telemetry: heartbeats piggyback the worker's metric
+        # increments since its last report; merging deltas commutes,
+        # so out-of-order arrivals from W workers aggregate exactly
+        obs_delta = data.get("obs")
+        if obs_delta:
+            self.fleet.merge(obs_delta)
         simt = float(data.get("simt", 0.0))
         chunks = int(data.get("chunks", 0))
         prev = self.worker_progress.get(wid)
@@ -1055,6 +1150,10 @@ class Server(threading.Thread):
         self.hedge_by[wid] = hwid
         self.hedge_of[hwid] = wid
         self.hedges_started += 1
+        self.recorder.instant("hedge", cat="server",
+                              piece=self._piece_name(piece),
+                              primary=wid.hex(), hedge=hwid.hex(),
+                              why=str(why))
         prog = self.worker_progress.get(hwid)
         if prog is not None:
             prog["advance_t"] = self.inflight_t[hwid]
@@ -1114,8 +1213,9 @@ class Server(threading.Thread):
         rendering — the HEALTH-style readback contract."""
         avg_fill = self._pack_fill_sum / self.world_batches \
             if self.world_batches else 0.0
-        demux_ms = 1e3 * self.worlds_demux_s / self.worlds_demux_events \
-            if self.worlds_demux_events else 0.0
+        # demux latency comes from the registry histogram (windowed
+        # p50/p95, not just a lifetime running mean — ISSUE-11 fix)
+        dh = self.obs.get("server_demux_ms")
         d = {"pack": bool(self.world_pack),
              "batch_max": int(self.world_batch_max),
              "world_batches": self.world_batches,
@@ -1125,8 +1225,10 @@ class Server(threading.Thread):
              "refused_opt": self.worlds_refused_opt,
              "opt_results": self.opt_results,
              "worlds_failed": self.worlds_failed,
-             "demux_events": self.worlds_demux_events,
-             "demux_ms_avg": round(demux_ms, 3)}
+             "demux_events": dh.count,
+             "demux_ms_avg": round(dh.mean, 3),
+             "demux_ms_p50": round(dh.percentile(0.5), 3),
+             "demux_ms_p95": round(dh.percentile(0.95), 3)}
         d["text"] = (
             f"WORLDS: packing {'ON' if d['pack'] else 'OFF'}, max "
             f"{d['batch_max']} pieces/dispatch; {d['world_batches']} "
@@ -1136,7 +1238,31 @@ class Server(threading.Thread):
             f"OPT/GRAD refusal(s), "
             f"{d['worlds_failed']} world failure(s); demux "
             f"{d['demux_events']} event(s), avg {d['demux_ms_avg']:.2f} "
-            "ms")
+            f"ms, p95 {d['demux_ms_p95']:.2f} ms")
+        return d
+
+    def _observe_demux(self, t0, **tags):
+        """Book one demux leg: the registry histogram (windowed
+        p50/p95) + a demux span on the flight-recorder timeline."""
+        now = time.perf_counter()
+        self.obs.get("server_demux_ms").observe((now - t0) * 1e3)
+        rec = self.recorder
+        if rec.enabled:
+            rec.complete("demux", rec.wall_us(t0), (now - t0) * 1e6,
+                         cat="server", **tags)
+
+    def metrics_payload(self):
+        """Machine-readable telemetry (the ``METRICS DUMP`` command):
+        the broker's own registry plus the fleet aggregate merged from
+        worker heartbeat deltas, with a human ``text`` rendering."""
+        self.obs.gauge("server_queue_depth").set(len(self.scenarios))
+        d = {"server": self.obs.snapshot(),
+             "fleet": self.fleet.snapshot()}
+        fl = self.fleet.text()
+        d["text"] = ("== server ==\n" + self.obs.text()
+                     + ("\n== fleet (aggregated from worker "
+                        "heartbeats) ==\n" + fl
+                        if len(self.fleet) else ""))
         return d
 
     def health_payload(self):
@@ -1396,6 +1522,9 @@ class Server(threading.Thread):
                 self._next_hb = now + self.hb_interval
                 self._reap_dead_workers()
                 self._check_stragglers(now)
+                self.obs.gauge("server_queue_depth").set(
+                    len(self.scenarios))
+                self.obs.maybe_export()
             if self.link is not None and self.link in events:
                 try:
                     self._handle_link(self.link.recv_multipart())

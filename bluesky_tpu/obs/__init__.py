@@ -1,0 +1,25 @@
+"""Unified telemetry for the sim/worker/server stack (ISSUE-11).
+
+Two dependency-free halves:
+
+* ``obs.metrics`` — counters / gauges / fixed-bucket histograms in a
+  ``Registry``.  Every ``Simulation`` owns one (so two sims in one
+  process never mix series), the server owns one for broker-side
+  series plus a second *fleet* registry that folds the metric deltas
+  riding worker heartbeats.  ``METRICS DUMP`` / the server ``METRICS``
+  event export them; ``settings.metrics_export_path`` adds an
+  atomically-rewritten Prometheus text dump.
+
+* ``obs.trace`` — the flight recorder: a bounded ring of typed span
+  events with correlation tags (piece id, world index, chunk seq, mesh
+  epoch), dumped on demand (``TRACE DUMP``) or automatically on
+  guard/mesh trips as Chrome/Perfetto trace-event JSON.
+  ``scripts/trace_report.py`` merges dumps from several processes onto
+  one timeline.
+
+Overhead contract (docs/OBSERVABILITY.md): recorder off ⇒ zero added
+device ops and bit-identical stepped state; recorder on ⇒ <2% wall
+overhead (BENCH_OBS.json).
+"""
+from .metrics import Registry, get_registry          # noqa: F401
+from .trace import Recorder, get_recorder            # noqa: F401

@@ -175,6 +175,26 @@ preempt_snapshot_dir = ""         # "" -> log_path; SIGTERM / FAULT
 batch_journal_fsync = True        # fsync each BATCH journal record (WAL
                                   # durability vs append latency)
 
+# ----- observability (docs/OBSERVABILITY.md; bluesky_tpu/obs/)
+trace_enabled = False             # flight recorder on at startup (the
+                                  # TRACE stack command toggles at
+                                  # runtime; PROFILE TRACE is a synonym)
+trace_ring_size = 4096            # bounded event ring per process —
+                                  # older spans fall off, dumps stay
+                                  # incident-sized
+trace_dir = ""                    # TRACE DUMP / auto-dump target dir
+                                  # ("" -> log_path)
+trace_autodump = True             # dump the ring on guard/mesh trips
+                                  # (throttled to 1/s) so the spans
+                                  # leading up to an incident survive it
+metrics_export_path = ""          # Prometheus text-format dump file
+                                  # ("" = off); rewritten atomically at
+                                  # most every metrics_export_dt wall-s.
+                                  # Set per process (sim and server
+                                  # processes each export their own).
+metrics_export_dt = 10.0          # [wall s] min interval between
+                                  # metrics-export rewrites
+
 _overrides = {}                   # file/CLI values for late-registered keys
 
 

@@ -19,7 +19,16 @@ exposes it with two-stage laziness:
 Thread note: ScreenIO may fetch an edge from the node thread while the
 sim thread retires the next one; ``fetch`` is idempotent and the object
 is never mutated after construction, so the race is benign.
+
+Observability (ISSUE-11): the chunk-sequence correlation tag lives
+HERE, on the host edge object, not in the device pack — the recorder's
+off-path contract forbids adding device ops, and a host counter stamped
+at dispatch identifies the chunk just as uniquely.  ``t_dispatch``
+anchors the chunk-latency series and the chunk_edge trace span; the
+bulk ``fetch`` reports its wall cost to the owning sim's
+``sim_edge_pull_ms`` histogram through ``obs_sink``.
 """
+import time
 from typing import Optional
 
 import jax
@@ -30,12 +39,20 @@ class ChunkEdge:
     """One retired-or-pending chunk edge: telemetry + host bookkeeping."""
 
     def __init__(self, telemetry, chunk: int,
-                 simt_planned: Optional[float] = None):
+                 simt_planned: Optional[float] = None,
+                 seq: int = -1, obs_sink=None):
         self._telemetry = telemetry
         self.chunk = int(chunk)
         self._simt_planned = simt_planned
         self._np = None
         self._bad = None
+        # correlation tag: per-sim monotonic dispatch sequence number
+        # (host-side by design — see module docstring)
+        self.seq = int(seq)
+        self.t_dispatch = time.perf_counter()
+        # Histogram fed by fetch() (the owning sim's registry); None
+        # keeps the pre-obs behavior for standalone edges.
+        self._obs_sink = obs_sink
 
     # ------------------------------------------------------------- fetch
     @property
@@ -51,7 +68,10 @@ class ChunkEdge:
     def fetch(self):
         """The whole pack as host NumPy arrays — one device_get, cached."""
         if self._np is None:
+            t0 = time.perf_counter()
             self._np = jax.device_get(self._telemetry)
+            if self._obs_sink is not None:
+                self._obs_sink((time.perf_counter() - t0) * 1e3)
         return self._np
 
     @property

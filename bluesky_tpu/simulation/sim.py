@@ -35,10 +35,104 @@ from ..core.noise import NoiseConfig
 from ..core.route import RouteManager
 from ..core.step import SimConfig
 from ..core.traffic import Traffic
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 from .pipeline import ChunkEdge
 
 # Sim states (reference bluesky/__init__.py:12)
 INIT, HOLD, OP, END = range(4)
+
+
+class _SyncReasonsView:
+    """dict-like view over the ``sim_sync_reason_<r>`` registry
+    counters — keeps the historical ``pipe_stats["sync_reasons"]``
+    read/write surface while the data lives in the metrics registry."""
+    _PREFIX = "sim_sync_reason_"
+
+    def __init__(self, reg):
+        self._reg = reg
+
+    def __getitem__(self, k):
+        m = self._reg.get(self._PREFIX + k)
+        if m is None:
+            raise KeyError(k)
+        return int(m.value)
+
+    def __setitem__(self, k, v):
+        self._reg.counter(self._PREFIX + k)._set(v)
+
+    def get(self, k, default=None):
+        m = self._reg.get(self._PREFIX + k)
+        return default if m is None else int(m.value)
+
+    def __contains__(self, k):
+        return self._reg.get(self._PREFIX + k) is not None
+
+    def __iter__(self):
+        for m in self._reg:
+            if isinstance(m, obs_metrics.Counter) \
+                    and m.name.startswith(self._PREFIX):
+                yield m.name[len(self._PREFIX):]
+
+    def keys(self):
+        return list(self)
+
+    def items(self):
+        return [(k, self[k]) for k in self]
+
+    def __len__(self):
+        return sum(1 for _ in self)
+
+    def __eq__(self, other):
+        return dict(self.items()) == other
+
+    def __repr__(self):
+        return repr(dict(self.items()))
+
+
+class _PipeStatsView:
+    """The historical ``sim.pipe_stats`` dict surface, backed by the
+    sim's metrics registry (ISSUE-11 migration): reads/writes go to the
+    ``sim_chunks_*`` counters, ``"sync_reasons"`` to the per-reason
+    counter family, so HEALTH/CHUNKSTEPS readbacks, tests and the
+    multi-world runner keep working unchanged."""
+    _COUNTERS = {"pipelined_chunks": "sim_chunks_pipelined",
+                 "sync_chunks": "sim_chunks_sync",
+                 "deferred_trips": "sim_deferred_trips"}
+
+    def __init__(self, reg):
+        self._reg = reg
+        self._reasons = _SyncReasonsView(reg)
+        for name in self._COUNTERS.values():
+            reg.counter(name)
+
+    def __getitem__(self, k):
+        if k == "sync_reasons":
+            return self._reasons
+        return int(self._reg.counter(self._COUNTERS[k]).value)
+
+    def __setitem__(self, k, v):
+        self._reg.counter(self._COUNTERS[k])._set(v)
+
+    def get(self, k, default=None):
+        try:
+            return self[k]
+        except KeyError:
+            return default
+
+    def keys(self):
+        return list(self._COUNTERS) + ["sync_reasons"]
+
+    def items(self):
+        return [(k, self[k]) for k in self.keys()]
+
+    def __contains__(self, k):
+        return k in self._COUNTERS or k == "sync_reasons"
+
+    def __repr__(self):
+        return repr({k: (dict(v.items())
+                         if k == "sync_reasons" else v)
+                     for k, v in self.items()})
 
 
 class DisplayState:
@@ -217,8 +311,38 @@ class Simulation:
         self._simt_next = 0.0        # predicted clock after that chunk
         self._last_edge = None       # newest retired edge (ACDATA cache)
         self._retiring = False       # reentrancy guard for drains
-        self.pipe_stats = {"pipelined_chunks": 0, "sync_chunks": 0,
-                           "deferred_trips": 0, "sync_reasons": {}}
+        # Observability (ISSUE-11, docs/OBSERVABILITY.md): a PER-SIM
+        # metrics registry (two sims in one process — tests, W-world
+        # packs — must not mix series) + the per-process flight
+        # recorder.  pipe_stats is a compatibility view over the
+        # registry counters.
+        self.obs = obs_metrics.Registry()
+        self.recorder = obs_trace.get_recorder()
+        if bool(getattr(_pipe_settings, "trace_enabled", False)):
+            self.recorder.enable()
+        self.pipe_stats = _PipeStatsView(self.obs)
+        self.obs.counter("sim_guard_trips",
+                         help="integrity-guard trips (all policies)")
+        self.obs.counter("sim_mesh_trips",
+                         help="mesh-epoch events (mesh_lost+resharded)")
+        _h = self.obs.histogram
+        _h("sim_chunk_latency_ms",
+           help="chunk dispatch -> edge retirement wall ms")
+        _h("sim_dispatch_gap_ms",
+           help="host gap between consecutive chunk dispatches")
+        _h("sim_edge_pull_ms",
+           help="bulk edge-telemetry device->host pull wall ms")
+        _h("sim_sort_refresh_ms",
+           help="spatial-sort refresh wall ms (ROADMAP item 1)")
+        _h("sim_snapshot_capture_ms",
+           help="snapshot-ring capture wall ms")
+        self._edge_pull_sink = \
+            self.obs.get("sim_edge_pull_ms").observe
+        self._chunk_seq = 0          # host-side dispatch sequence tag
+        #                              (correlation id; the edge pack
+        #                              stays device-op-free by design)
+        self._seq_dispatched = 0     # tag of the newest dispatch
+        self._last_dispatch_end = None   # wall stamp: dispatch-gap series
         self.dtmult = 1.0
         self.ffmode = False
         self.ffstop: Optional[float] = None
@@ -633,6 +757,11 @@ class Simulation:
         lost = list(getattr(err, "lost_groups", ()))
         survivors = list(getattr(err, "survivors", ()) or [])
         # the in-flight chunk rode the dead mesh: its edge is void
+        if self._pending_edge is not None:
+            self.recorder.instant(
+                "chunk_voided", seq=self._pending_edge.seq,
+                chunk=self._pending_edge.chunk, epoch=old_epoch,
+                world=self.world_tag)
         self._pending_edge = None
         self._last_edge = None
         self.scr.echo(f"MESH LOST (epoch {old_epoch}): {err}")
@@ -895,8 +1024,13 @@ class Simulation:
             reasons = self._sync_reasons(simt, chunk)
             if reasons:
                 self._retire_edge(reasons[0])
-                self.pipe_stats["sync_reasons"][reasons[0]] = \
-                    self.pipe_stats["sync_reasons"].get(reasons[0], 0) + 1
+                # every co-occurring cause counts (a chunk held back by
+                # cond AND datalog is one sync chunk but two reasons) —
+                # recording only reasons[0] silently under-reported the
+                # later list entries
+                sync_hist = self.pipe_stats["sync_reasons"]
+                for r in reasons:
+                    sync_hist[r] = sync_hist.get(r, 0) + 1
                 self._step_sync(chunk, self.simt)
             else:
                 self._step_pipelined(chunk, simt)
@@ -1087,6 +1221,9 @@ class Simulation:
         if self.ffstop is not None \
                 and self.simt_planned >= self.ffstop - 1e-9:
             self._end_ff()
+        # rate-limited Prometheus text dump (metrics_export_path knob;
+        # no-op when unset)
+        self.obs.maybe_export()
 
     # ------------------------------------------------- chunk dispatch/edges
     def _sync_reasons(self, simt: float, chunk: int):
@@ -1134,16 +1271,41 @@ class Simulation:
         the *input* state buffers to stay valid (snapshot-ring capture
         overlapping the dispatched chunk).
         """
-        # Mesh-epoch liveness precheck: a dead device group (FAULT
-        # MESHKILL, or a peer whose heartbeat stamp went stale) must
-        # surface BEFORE the chunk is enqueued onto the dead mesh —
-        # raising MeshLostError here routes into _handle_mesh_lost.
-        if self.shard_mesh is not None and self.mesh_guard_enabled:
-            self.mesh_guard.check()
-        state = self._pre_dispatch_refresh(state, simt)
-        from ..core.step import run_steps_edge, run_steps_edge_keep
-        runner = run_steps_edge_keep if keep else run_steps_edge
-        return runner(state, self.cfg, chunk, checked=self.guard.enabled)
+        rec = self.recorder
+        t0 = time.perf_counter()
+        if self._last_dispatch_end is not None:
+            self.obs.get("sim_dispatch_gap_ms").observe(
+                (t0 - self._last_dispatch_end) * 1e3)
+        seq = self._next_seq()
+        with rec.span("chunk_dispatch", seq=seq, chunk=chunk,
+                      simt=simt, world=self.world_tag,
+                      epoch=self.mesh_epoch):
+            # Mesh-epoch liveness precheck: a dead device group (FAULT
+            # MESHKILL, or a peer whose heartbeat stamp went stale) must
+            # surface BEFORE the chunk is enqueued onto the dead mesh —
+            # raising MeshLostError here routes into _handle_mesh_lost.
+            if self.shard_mesh is not None and self.mesh_guard_enabled:
+                with rec.span("mesh_check", seq=seq,
+                              epoch=self.mesh_epoch,
+                              world=self.world_tag):
+                    self.mesh_guard.check()
+            state = self._pre_dispatch_refresh(state, simt)
+            from ..core.step import run_steps_edge, run_steps_edge_keep
+            runner = run_steps_edge_keep if keep else run_steps_edge
+            out = runner(state, self.cfg, chunk,
+                         checked=self.guard.enabled)
+        self._last_dispatch_end = time.perf_counter()
+        return out
+
+    def _next_seq(self) -> int:
+        """Bump and return the host-side chunk-sequence correlation tag
+        (docs/OBSERVABILITY.md): one per dispatched chunk, stamped onto
+        the ChunkEdge and every span of that chunk.  Host-side by
+        design — the EdgeTelemetry device pack must not grow an op for
+        it (the recorder-off path is bit-identical)."""
+        self._chunk_seq += 1
+        self._seq_dispatched = self._chunk_seq
+        return self._chunk_seq
 
     def _pre_dispatch_refresh(self, state, simt: float):
         """The (due) chunk-edge spatial-sort refresh — split from
@@ -1158,15 +1320,22 @@ class Simulation:
             if (simt - self._sort_simt >= due
                     or self._sort_simt < 0
                     or self._sort_backend != self.cfg.cd_backend):
-                if self.shard_mode == "spatial":
-                    state = self._spatial_refresh(state)
-                else:
-                    from ..core.asas import impl_for_backend, \
-                        refresh_spatial_sort
-                    state = refresh_spatial_sort(
-                        state, self.cfg.asas,
-                        block=self.cfg.cd_block,
-                        impl=impl_for_backend(self.cfg.cd_backend))
+                t0 = time.perf_counter()
+                with self.recorder.span("sort_refresh",
+                                        backend=self.cfg.cd_backend,
+                                        shard=self.shard_mode,
+                                        world=self.world_tag):
+                    if self.shard_mode == "spatial":
+                        state = self._spatial_refresh(state)
+                    else:
+                        from ..core.asas import impl_for_backend, \
+                            refresh_spatial_sort
+                        state = refresh_spatial_sort(
+                            state, self.cfg.asas,
+                            block=self.cfg.cd_block,
+                            impl=impl_for_backend(self.cfg.cd_backend))
+                self.obs.get("sim_sort_refresh_ms").observe(
+                    (time.perf_counter() - t0) * 1e3)
                 self._sort_simt = simt
                 self._sort_backend = self.cfg.cd_backend
         return state
@@ -1213,7 +1382,9 @@ class Simulation:
         self._straggle_charge(chunk)
         self._simt_next = self._fold_clock(simt, chunk)
         self._pending_edge = ChunkEdge(telem, chunk,
-                                       simt_planned=self._simt_next)
+                                       simt_planned=self._simt_next,
+                                       seq=self._seq_dispatched,
+                                       obs_sink=self._edge_pull_sink)
         self.pipe_stats["pipelined_chunks"] += 1
         if pend is not None:
             self._finish_edge(
@@ -1228,17 +1399,23 @@ class Simulation:
                                             keep=False, simt=simt)
         self._apply_chunk_result(state, telem, chunk)
 
-    def _apply_chunk_result(self, state, telem, chunk: int):
+    def _apply_chunk_result(self, state, telem, chunk: int,
+                            seq: Optional[int] = None):
         """Install one synchronously-completed chunk's result and run
         every edge subsystem against it — the post-dispatch half of
         ``_step_sync``.  The multi-world runner calls this per world
         with that world's slice of the joint stacked dispatch, so guard
         response (rollback/quarantine), conditionals, trails, loggers
-        and ring captures all stay per-world."""
+        and ring captures all stay per-world (it passes each world its
+        own ``seq`` correlation tag from the shared dispatch)."""
         self.traf.state = state
         self._step_count += chunk
         self._straggle_charge(chunk)
-        edge = ChunkEdge(telem, chunk)      # device clock, no prediction
+        if seq is None:
+            seq = self._seq_dispatched
+        edge = ChunkEdge(telem, chunk,      # device clock, no prediction
+                         seq=seq, obs_sink=self._edge_pull_sink)
+        t_ret0 = time.perf_counter()
         tripped = False
         if self.guard.enabled:
             # Integrity-guarded chunk: the isfinite check rides the scan
@@ -1293,6 +1470,7 @@ class Simulation:
                 and self.simt - self._autosave_t \
                 >= self.autosave_dt - 1e-9:
             self._autosave()
+        self._edge_retired(edge, t_ret0)
 
     def _straggle_charge(self, chunk: int):
         # FAULT STRAGGLE <factor>: every simulated second OWES `factor`
@@ -1308,6 +1486,7 @@ class Simulation:
         one-scalar completion fence), respond to a late trip, then run
         the passive edge consumers off the fused telemetry pack.  Runs
         while the next chunk computes on the device."""
+        t_ret0 = time.perf_counter()
         bad = edge.bad_step
         if self.guard.enabled and bad >= 0:
             self._deferred_trip(edge, bad)
@@ -1337,6 +1516,23 @@ class Simulation:
             self.snap_ring.capture(self, state=capture_state,
                                    simt=edge.simt)
         self._last_edge = edge
+        self._edge_retired(edge, t_ret0)
+
+    def _edge_retired(self, edge, t_ret0: float):
+        """Book one retired edge into the registry + recorder: the
+        chunk-latency series (dispatch stamp -> retirement done) and a
+        chunk_edge span covering the retirement work itself."""
+        now = time.perf_counter()
+        self.obs.get("sim_chunk_latency_ms").observe(
+            (now - edge.t_dispatch) * 1e3)
+        rec = self.recorder
+        if rec.enabled:
+            rec.complete("chunk_edge", rec.wall_us(t_ret0),
+                         (now - t_ret0) * 1e6,
+                         seq=edge.seq, chunk=edge.chunk,
+                         world=self.world_tag,
+                         latency_ms=round(
+                             (now - edge.t_dispatch) * 1e3, 3))
 
     def _deferred_trip(self, edge, bad: int):
         """A guard word that came back tripped one chunk LATE (the

@@ -145,7 +145,11 @@ def _make_simnode_class(base):
                 # world's clock advances monotonically while the pack
                 # runs, which is exactly the advance signal the
                 # straggler detector needs
-                return dict({"stamp": stamp}, **self.worlds.progress())
+                info = dict({"stamp": stamp}, **self.worlds.progress())
+                obs = self.worlds.obs_delta()
+                if obs:
+                    info["obs"] = obs
+                return info
             # "ff" gates the server's RATE-based hedging: sim-s/wall-s
             # is only comparable across workers running full speed — a
             # wall-clock-paced piece reports ~dtmult by design, which
@@ -161,6 +165,12 @@ def _make_simnode_class(base):
             # the fleet's shard state without a round-trip per worker
             if sim.shard_mode != "off" or sim.mesh_epoch > 0:
                 info["mesh"] = sim.mesh_health()
+            # fleet telemetry: ship the metric increments since the
+            # last heartbeat; the server merges them into its fleet
+            # registry (METRICS DUMP shows the aggregate)
+            obs = sim.obs.delta()
+            if obs:
+                info["obs"] = obs
             return info
 
         # ------------------------------------------------------------ events
@@ -223,6 +233,19 @@ def _make_simnode_class(base):
                 txt = data.get("text") if isinstance(data, dict) \
                     else str(data)
                 sim.scr.echo(txt or "no worlds data")
+            elif name == b"METRICS":
+                # reply to METRICS DUMP's server query: broker + fleet
+                # registries rendered server-side
+                txt = data.get("text") if isinstance(data, dict) \
+                    else str(data)
+                sim.scr.echo(txt or "no metrics data")
+            elif name == b"TRACE":
+                # reply to TRACE DUMP's server-side ring dump
+                d = data if isinstance(data, dict) else {}
+                sim.scr.echo(
+                    f"server trace: {d.get('path') or 'ring empty'}"
+                    if d.get("enabled")
+                    else "server trace: recorder disabled")
             elif name == b"GETSIMSTATE":
                 self.send_event(b"SIMSTATE", {
                     "state": sim.state_flag, "simt": sim.simt_planned,

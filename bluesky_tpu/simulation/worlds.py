@@ -104,6 +104,17 @@ class WorldBatch:
             "worlds_done": self.nworlds - len(act),
         }
 
+    def obs_delta(self) -> dict:
+        """Summed metric increments of every world sim since the last
+        call — the pack's contribution to the worker heartbeat's fleet
+        telemetry (counters/histograms add exactly; gauges last-world).
+        """
+        from ..obs.metrics import Registry
+        agg = Registry()
+        for sim in self.sims:
+            agg.merge(sim.obs.delta())
+        return agg.delta()
+
     # -------------------------------------------------------------- step
     def step(self) -> bool:
         """One joint host iteration: plan every active world, dispatch
@@ -152,8 +163,17 @@ class WorldBatch:
             chunk = min(m[2] for m in members)
             states = [sim._pre_dispatch_refresh(sim.traf.state, simt)
                       for i, sim, c, simt in members]
-            wstate, telem = run_steps_worlds_edge(
-                stack_worlds(states), cfg, chunk, checked=checked)
+            # one dispatch, W worlds: each member still gets its OWN
+            # seq correlation tag, so the per-world chunk_edge spans
+            # demux cleanly on the merged timeline
+            seqs = [sim._next_seq() for i, sim, c, simt in members]
+            rec = members[0][1].recorder     # per-process singleton
+            with rec.span("chunk_dispatch", cat="worlds",
+                          chunk=chunk, nworlds=len(members),
+                          worlds=[i for i, s, c, t in members],
+                          seqs=seqs):
+                wstate, telem = run_steps_worlds_edge(
+                    stack_worlds(states), cfg, chunk, checked=checked)
             self.stats["joint_dispatches"] += 1
             self.stats["worlds_stepped"] += len(members)
             self.stats["max_group"] = max(self.stats["max_group"],
@@ -168,7 +188,8 @@ class WorldBatch:
                         / max(sim.dtmult, 1e-9)
                 sim.pipe_stats["sync_chunks"] += 1
                 sim._apply_chunk_result(world_slice(wstate, k),
-                                        world_slice(telem, k), chunk)
+                                        world_slice(telem, k), chunk,
+                                        seq=seqs[k])
                 sim._after_chunk()
                 self._drain_echo(i)
                 self._maybe_finish(i)

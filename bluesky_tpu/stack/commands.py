@@ -976,11 +976,52 @@ def register_all(stack):
         return True, f"Radar snapshot written to {fname}"
 
     def metricscmd(flag=None, dt=None):
+        """Bare/OFF/1/2 keep the reference sector-metrics behavior;
+        METRICS DUMP reads the ISSUE-11 telemetry registry — the local
+        sim's series, plus (networked) the server's broker + fleet
+        aggregate, which arrives as a METRICS event."""
+        if flag is not None and str(flag).upper() == "DUMP":
+            node = getattr(sim, "node", None)
+            if node is not None and getattr(node, "event_io", None) \
+                    is not None:
+                node.send_event(b"METRICS", None)  # -> server registries
+                return True, ("sim registry:\n" + sim.obs.text()
+                              + "\n(server+fleet registries requested "
+                                "— echoed when the reply arrives)")
+            return True, "sim registry:\n" + sim.obs.text()
         return sim.metrics.toggle(flag, dt)
 
+    def tracecmd(sub=None):
+        """TRACE [ON/OFF/DUMP]: the flight recorder (obs/trace.py) —
+        bounded span ring dumped as Chrome/Perfetto trace-event JSON;
+        merge multi-process dumps with scripts/trace_report.py."""
+        rec = sim.recorder
+        if sub is None:
+            return True, (f"TRACE {'ON' if rec.enabled else 'OFF'} "
+                          f"({len(rec)}/{rec.maxlen} events buffered)")
+        s = str(sub).upper()
+        if s in ("ON", "1", "TRUE"):
+            rec.enable()
+            return True, "Flight recorder ON"
+        if s in ("OFF", "0", "FALSE"):
+            rec.disable()
+            return True, (f"Flight recorder OFF "
+                          f"({len(rec)} buffered events kept)")
+        if s == "DUMP":
+            path = rec.dump(reason="manual", proc="sim")
+            node = getattr(sim, "node", None)
+            if node is not None and getattr(node, "event_io", None) \
+                    is not None:
+                node.send_event(b"TRACE", None)  # server dumps its ring
+            if path is None:
+                return True, "TRACE DUMP: ring is empty, nothing written"
+            return True, f"Trace written to {path}"
+        return False, "TRACE [ON/OFF/DUMP]"
+
     def profile(sub=None, arg=None):
-        """PROFILE START [dir] / STOP / KERNELS [nsteps]
-        (jax.profiler trace + per-kernel timing report)."""
+        """PROFILE START [dir] / STOP / KERNELS [nsteps] / TRACE ...
+        (jax.profiler trace + per-kernel timing report; TRACE is a
+        synonym for the flight-recorder command)."""
         from ..utils import profiler
         s = (sub or "KERNELS").upper()
         if s == "START":
@@ -989,12 +1030,20 @@ def register_all(stack):
         if s == "STOP":
             profiler.stop_trace()
             return True, "JAX trace stopped"
+        if s == "TRACE":
+            return tracecmd(arg)
         if s == "KERNELS":
             if traf.ntraf == 0:
                 return False, "PROFILE KERNELS: no traffic"
             nsteps = int(float(arg)) if arg else 50
             return True, profiler.report(sim, nsteps)
-        return False, "PROFILE START [dir] / STOP / KERNELS [nsteps]"
+        if s == "DEEP":
+            # the round-3 decomposition sweep (ex scripts/profile_r3.py)
+            if traf.ntraf == 0:
+                return False, "PROFILE DEEP: no traffic"
+            return True, profiler.deep_report(sim)
+        return False, ("PROFILE START [dir] / STOP / KERNELS [nsteps] "
+                       "/ DEEP / TRACE [ON/OFF/DUMP]")
 
     def faultcmd(*args):
         """FAULT: chaos-injection harness (fault/harness.py) — poison
@@ -1524,12 +1573,19 @@ def register_all(stack):
         "PLOT": ["PLOT [x],y,[dt],[color]", "[txt,txt,float,txt]",
                  sim.plotter.plot,
                  "Create a plot of variables x versus y"],
-        "METRICS": ["METRICS OFF/1/2 [dt]", "[txt,float]", metricscmd,
+        "METRICS": ["METRICS OFF/1/2 [dt] | DUMP", "[txt,float]",
+                    metricscmd,
                     "Sector metrics: 1=CoCa cell occupancy, "
-                    "2=HB conflict-geometry complexity"],
-        "PROFILE": ["PROFILE START [dir]/STOP/KERNELS [nsteps]",
+                    "2=HB conflict-geometry complexity; DUMP reads "
+                    "the telemetry registry (sim + server + fleet)"],
+        "PROFILE": ["PROFILE START [dir]/STOP/KERNELS [nsteps]/DEEP/"
+                    "TRACE [ON/OFF/DUMP]",
                     "[txt,word]", profile,
-                    "JAX trace capture and per-kernel timings"],
+                    "JAX trace capture, per-kernel timings and the "
+                    "flight recorder"],
+        "TRACE": ["TRACE [ON/OFF/DUMP]", "[txt]", tracecmd,
+                  "Flight recorder: bounded span ring dumped as "
+                  "Perfetto trace JSON (readback bare)"],
         "FAULT": ["FAULT NAN/INF [acid] | GUARD ../RING .. | DROP/DUP/"
                   "DELAY p | NETOFF | STALL s | STRAGGLE f/STALL/OFF | "
                   "KILL | PREEMPT [s] | MESHKILL [g] | PARTITION [OFF] "

@@ -6,6 +6,14 @@ and the SIMINFO rate stream; here the PROFILE stack command adds
 per-kernel timing report that times the pipeline pieces separately —
 the scanned step chunk, the CD kernel, and the MVP resolution — so the
 benchmark number can be decomposed.
+
+``deep_timings`` (PROFILE DEEP) carries the round-3 profiling sweep
+that used to live in scripts/profile_r3.py: the CD program-overhead
+probe (all-inactive fleet — every tile skips, what remains is grid +
+DMA overhead), the no-prefilter variant (pair-cost slope with the
+reach skip defeated), the cached spatial-sort argsort, and the MVP
+resolve-from-sums + partner-bookkeeping tail.  PROFILE TRACE drives
+the ISSUE-11 flight recorder (obs/trace.py) instead of jax.profiler.
 """
 import time
 
@@ -110,4 +118,107 @@ def report(sim, nsteps=50):
     if "per_sim_step" in t and t["per_sim_step"] > 0:
         rate = n * 1000.0 / t["per_sim_step"]
         lines.append(f"  -> {rate:,.0f} aircraft-steps/s")
+    return "\n".join(lines)
+
+
+def deep_timings(sim, reps=3):
+    """The round-3 decomposition sweep (ex scripts/profile_r3.py), run
+    against the CURRENT traffic state: program-overhead and pair-cost
+    probes for the tiled/pallas CD kernels, the spatial argsort, and
+    the MVP tail.  Dense backend gets only the sort + tail (its kernel
+    has no tile-skip structure to probe)."""
+    import jax
+    import jax.numpy as jnp
+    from ..ops import cd_pallas, cd_tiled, cr_mvp
+
+    sim.traf.flush()
+    state = sim.traf.state
+    ac = state.ac
+    asas = state.asas
+    acfg = sim.cfg.asas
+    mcfg = cr_mvp.MVPConfig(rpz_m=acfg.rpz_m, hpz_m=acfg.hpz_m,
+                            tlookahead=acfg.dtlookahead)
+
+    def best(make):
+        fn = jax.jit(make)
+        jax.block_until_ready(fn())          # compile
+        t = np.inf
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn())
+            t = min(t, time.perf_counter() - t0)
+        return t * 1000.0
+
+    timings = {}
+
+    # the cached Morton argsort (the sort_refresh cost the sim pays
+    # every sort_every * dtasas sim seconds)
+    timings["spatial_permutation"] = best(
+        lambda: cd_tiled.spatial_permutation(ac.lat, ac.lon, ac.active))
+
+    backend = sim.cfg.cd_backend
+    if backend in ("tiled", "pallas"):
+        mod = cd_pallas if backend == "pallas" else cd_tiled
+        kern = (mod.detect_resolve_pallas if backend == "pallas"
+                else mod.detect_resolve_tiled)
+        perm = jax.block_until_ready(
+            cd_tiled.spatial_permutation(ac.lat, ac.lon, ac.active)
+            .astype(jnp.int32))
+        args = (ac.lat, ac.lon, ac.trk, ac.gs, ac.alt, ac.vs,
+                ac.gseast, ac.gsnorth)
+        common = dict(block=sim.cfg.cd_block)
+
+        timings["cd_sweep"] = best(
+            lambda: kern(*args, ac.active, asas.noreso,
+                         acfg.rpz, acfg.hpz, acfg.dtlookahead, mcfg,
+                         perm=perm, **common).inconf)
+        # all-inactive probe: every tile skips via the pair mask, so
+        # what is left is pure grid + DMA program overhead
+        inact = jnp.zeros_like(ac.active)
+        timings["cd_all_inactive"] = best(
+            lambda: kern(*args, inact, asas.noreso,
+                         acfg.rpz, acfg.hpz, acfg.dtlookahead, mcfg,
+                         perm=perm, **common).inconf)
+        # no-prefilter variant: the reach skip defeated — the slope of
+        # sweep-vs-this is the cost actually bought by sorting
+        timings["cd_unsorted"] = best(
+            lambda: kern(*args, ac.active, asas.noreso,
+                         acfg.rpz, acfg.hpz, acfg.dtlookahead, mcfg,
+                         perm=perm, spatial_sort=False, **common).inconf)
+
+        # the ASAS tail: resolve-from-sums + partner bookkeeping
+        rd = jax.block_until_ready(jax.jit(
+            lambda: kern(*args, ac.active, asas.noreso,
+                         acfg.rpz, acfg.hpz, acfg.dtlookahead, mcfg,
+                         perm=perm, **common))())
+
+        def tail():
+            out = cr_mvp.resolve_from_sums(
+                rd.sum_dve, rd.sum_dvn, rd.sum_dvv, rd.tsolv,
+                ac.alt, ac.gseast, ac.gsnorth, ac.vs, ac.trk, ac.gs,
+                ac.selalt, state.ap.vs, asas.alt,
+                acfg.vmin, acfg.vmax, acfg.vsmin, acfg.vsmax, mcfg,
+                resooff=asas.resooff)
+            keep = cd_tiled.partner_keep(
+                asas.partners, ac.lat, ac.lon, ac.gseast, ac.gsnorth,
+                ac.trk, ac.active, acfg.rpz, acfg.rpz_m)
+            merged = cd_tiled.merge_partners(
+                cd_tiled.topk_partners(rd, 8), asas.partners, keep)
+            return out[0], merged
+        tailfn = jax.jit(tail)
+        timings["mvp_tail"] = best(lambda: tailfn())
+    return timings
+
+
+def deep_report(sim):
+    t = deep_timings(sim)
+    lines = [f"Deep sweep at N={sim.traf.ntraf} "
+             f"({sim.cfg.cd_backend} backend):"]
+    for name, ms in t.items():
+        lines.append(f"  {name}: {ms:.3f} ms")
+    if "cd_sweep" in t:
+        lines.append(
+            f"  -> overhead floor {t['cd_all_inactive']:.3f} ms, "
+            f"prefilter saves "
+            f"{t['cd_unsorted'] - t['cd_sweep']:.3f} ms/sweep")
     return "\n".join(lines)

@@ -1,0 +1,162 @@
+"""CI obs-smoke: the ISSUE-11 observability contract, measured.
+
+Two halves:
+
+1. Parity — the flight recorder and metrics registry are host-side
+   only: a run with the recorder ENABLED must produce a bit-identical
+   stepped state to a run with it disabled (the instrumentation adds
+   zero device ops).  Hash mismatch is a hard failure.
+
+2. Overhead — best-of-reps wall time for the same scenario with the
+   recorder off vs on.  The contract is <2% added wall; the CI lane
+   flags (non-blocking) above 5% because shared runners are noisy.
+   Rows land in BENCH_OBS.json; a sample merged Perfetto trace is
+   written next to it so every PR ships an openable timeline.
+
+Exit 0 on success, 1 on parity failure or >5% measured overhead.
+
+Usage: python scripts/obs_smoke.py [--reps 3] [--out BENCH_OBS.json]
+"""
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def state_hash(sim):
+    import jax
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(jax.tree.map(np.asarray, sim.traf.state)):
+        h.update(np.ascontiguousarray(leaf).tobytes())
+    h.update(repr([sim.traf.ids, sim.traf.types]).encode())
+    return h.hexdigest()
+
+
+def build(nmax=64):
+    from bluesky_tpu.simulation.sim import Simulation
+    sim = Simulation(nmax=nmax)
+    for cmd in (
+            "CRE KL1 B744 52 4 90 FL200 250",
+            "CRE KL2 B744 52.2 4.3 270 FL210 250",
+            "CRE KL3 B744 52.1 4.1 180 FL205 240",
+            "SCHEDULE 00:00:03 ALT KL1 FL300",
+            "SCHEDULE 00:00:06 CRE KL4 B744 53 5 180 FL100 200",
+            "SCHEDULE 00:00:09 DEL KL2"):
+        sim.stack.stack(cmd)
+    sim.stack.process()
+    sim.op()
+    # op() clears ffmode, so engage fast-forward AFTER it — the timed
+    # reps must be compute-bound, not wall-clock paced, for the
+    # overhead percentage to mean anything
+    sim.fastforward()
+    return sim
+
+
+def run_once(trace: bool, until=20.0):
+    from bluesky_tpu.obs.trace import get_recorder
+    rec = get_recorder()
+    rec.clear()
+    if trace:
+        rec.enable()
+    else:
+        rec.disable()
+    sim = build()
+    t0 = time.perf_counter()
+    sim.run(until_simt=until, max_iters=2000)
+    wall = time.perf_counter() - t0
+    return sim, wall
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--out", default="BENCH_OBS.json")
+    ap.add_argument("--trace-out", default="output/obs")
+    args = ap.parse_args(argv)
+
+    import bluesky_tpu.settings as settings
+    os.makedirs(args.trace_out, exist_ok=True)
+    settings.trace_dir = args.trace_out
+
+    # warmup: pays every jit compile so the timed reps hit cache
+    run_once(False)
+
+    # ---- parity: recorder on must not change the stepped state
+    sim_off, _ = run_once(False)
+    sim_on, _ = run_once(True)
+    h_off, h_on = state_hash(sim_off), state_hash(sim_on)
+    assert h_off == h_on, (
+        f"recorder on/off state hash diverged:\n"
+        f"  off {h_off}\n  on  {h_on}")
+    n_chunks = sim_on.pipe_stats["pipelined_chunks"] \
+        + sim_on.pipe_stats["sync_chunks"]
+    lat = sim_on.obs.get("sim_chunk_latency_ms")
+    assert lat is not None and lat.count > 0, \
+        "chunk-latency histogram never observed a sample"
+    print(f"parity OK: hash {h_off[:16]}..., {n_chunks} chunks, "
+          f"latency p50 {lat.percentile(0.5):.2f} ms")
+
+    # ---- sample trace: dump the enabled run's ring + merge it
+    from bluesky_tpu.obs.trace import get_recorder
+    rec = get_recorder()
+    n_events = len(rec)
+    path = rec.dump(reason="smoke", proc="sim")
+    assert path, "enabled run left an empty trace ring"
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import trace_report
+    events = trace_report.load([path])
+    merged_path = os.path.join(args.trace_out, "trace_sample.json")
+    with open(merged_path, "w") as f:
+        json.dump(trace_report.merge(events), f)
+    rows, _ = trace_report.chunk_table(events)
+    assert rows, "merged trace has no per-chunk rows"
+    print(f"sample trace: {n_events} events, {len(rows)} chunk rows "
+          f"-> {merged_path}")
+    rec.disable()
+    rec.clear()
+
+    # ---- overhead: alternate off/on reps, keep the best of each
+    wall_off, wall_on = np.inf, np.inf
+    for _ in range(args.reps):
+        _, w = run_once(False)
+        wall_off = min(wall_off, w)
+        _, w = run_once(True)
+        wall_on = min(wall_on, w)
+    overhead = (wall_on - wall_off) / wall_off * 100.0
+    row = {
+        "scenario": "obs_smoke 4-aircraft FF to simt=20",
+        "reps": args.reps,
+        "wall_off_s": round(wall_off, 4),
+        "wall_on_s": round(wall_on, 4),
+        "overhead_pct": round(overhead, 2),
+        "trace_events": n_events,
+        "chunks": int(n_chunks),
+        "parity": "bit-identical",
+        "protocol": f"best-of-{args.reps}, alternating off/on, "
+                    f"platform={os.environ.get('JAX_PLATFORMS', '?')}",
+    }
+    with open(args.out, "w") as f:
+        json.dump([row], f, indent=1)
+    print(f"overhead: off {wall_off:.3f}s vs on {wall_on:.3f}s "
+          f"= {overhead:+.2f}% -> {args.out}")
+    if overhead > 5.0:
+        print("OBS SMOKE: overhead above the 5% CI flag line",
+              file=sys.stderr)
+        return 1
+    print("obs smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as e:
+        print(f"OBS SMOKE FAILED: {e}", file=sys.stderr)
+        sys.exit(1)

@@ -35,6 +35,17 @@ def main():
     from bluesky_tpu.simulation.simnode import SimNode
     from tests.test_network import free_ports, wait_for
 
+    # WORLD_SMOKE_TRACE=1: run the whole pass with the flight recorder
+    # ON (obs/trace.py) — the parity check below then doubles as the
+    # proof that tracing never perturbs the stepped state — and leave a
+    # merged Perfetto trace behind as a CI artifact.
+    traced = os.environ.get("WORLD_SMOKE_TRACE") == "1"
+    if traced:
+        import bluesky_tpu.settings as settings
+        from bluesky_tpu.obs.trace import get_recorder
+        settings.trace_dir = os.path.join("output", "obs")
+        get_recorder().enable()
+
     tmp = tempfile.mkdtemp(prefix="world-smoke-")
     scn = os.path.join(tmp, "mc.scn")
     with open(scn, "w") as f:
@@ -107,6 +118,26 @@ def main():
                                       equal_nan=True), \
                     f"world {i}: packed state != solo state"
         print(f"world-smoke: W={W} packed-vs-solo state parity OK")
+        if traced:
+            # one in-process recorder covers the worker AND the broker
+            # thread (tid separates the tracks); merge the dump so the
+            # artifact opens directly in the Perfetto UI
+            import json as _json
+            from bluesky_tpu.obs.trace import get_recorder
+            import trace_report
+            rec = get_recorder()
+            path = rec.dump(reason="world_smoke", proc="fabric")
+            assert path, "traced pass left an empty recorder ring"
+            events = trace_report.load([path])
+            names = {e["name"] for e in events}
+            assert "chunk_dispatch" in names, \
+                f"traced pass recorded no dispatch spans: {sorted(names)}"
+            merged = os.path.join("output", "obs",
+                                  "world_smoke_trace.json")
+            with open(merged, "w") as f:
+                _json.dump(trace_report.merge(events), f)
+            print(f"world-smoke: traced pass OK — {len(events)} events "
+                  f"-> {merged}")
         print("world-smoke: PASS")
     finally:
         node.quit()

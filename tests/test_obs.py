@@ -1,0 +1,445 @@
+"""Observability subsystem (ISSUE-11): metrics registry, flight
+recorder, sim instrumentation, fleet aggregation.
+
+Contracts pinned here:
+
+* Registry units — histogram bucket placement + percentile estimates,
+  delta shipping (increments exactly once), merge commutativity (the
+  fleet aggregate equals the per-worker sums regardless of heartbeat
+  interleaving), Prometheus exposition format.
+* Recorder — ring stays bounded, disabled path is a shared no-op (no
+  events, no allocation), dumps are valid Chrome/Perfetto trace-event
+  JSON.
+* Off-path parity — a run with the recorder ENABLED is bit-identical
+  to one with it disabled: the instrumentation is host-side only.
+* Incident auto-dump — a FAULT NAN guard trip leaves a trace dump on
+  disk with the guard_trip instant in it.
+* Fleet aggregation e2e — one real worker's heartbeat obs deltas land
+  in the server's fleet registry; METRICS round-trips to a client.
+* The multi-reason sync accounting fix — a chunk held back by two
+  co-occurring reasons counts BOTH (the old code recorded reasons[0]
+  only).
+"""
+import hashlib
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bluesky_tpu import settings
+from bluesky_tpu.obs.metrics import (DEFAULT_S_BUCKETS, Counter, Gauge,
+                                     Histogram, Registry)
+from bluesky_tpu.obs.trace import _NULL_SPAN, Recorder, get_recorder
+from bluesky_tpu.simulation.sim import Simulation
+
+
+@pytest.fixture()
+def sim():
+    return Simulation(nmax=16)
+
+
+@pytest.fixture(autouse=True)
+def _recorder_reset():
+    """The recorder is a process singleton: leave it disabled+empty."""
+    rec = get_recorder()
+    yield
+    rec.disable()
+    rec.clear()
+
+
+def do(sim, *lines):
+    for line in lines:
+        sim.stack.stack(line)
+    sim.stack.process()
+    out = "\n".join(sim.scr.echobuf)
+    sim.scr.echobuf.clear()
+    return out
+
+
+def _fleet(sim, n=3):
+    for i in range(n):
+        do(sim, f"CRE KL{i} B744 {52 + i} {4 + i} 90 FL{200 + 10 * i} 250")
+    sim.op()
+    sim.run(until_simt=2.0)
+
+
+def state_hash(sim):
+    import jax
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(jax.tree.map(np.asarray, sim.traf.state)):
+        h.update(np.ascontiguousarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+# ----------------------------------------------------------- registry units
+class TestRegistry:
+    def test_counter_and_gauge(self):
+        reg = Registry()
+        c = reg.counter("reqs", help="requests")
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        g = reg.gauge("depth")
+        g.set(7)
+        g.inc(-2)
+        assert g.value == 5.0
+        # get-or-create returns the same instance
+        assert reg.counter("reqs") is c
+        assert reg.get("depth") is g
+
+    def test_kind_mismatch_raises(self):
+        reg = Registry()
+        reg.counter("x")
+        with pytest.raises(TypeError):
+            reg.gauge("x")
+
+    def test_histogram_buckets_and_percentiles(self):
+        h = Histogram("lat", buckets=(1.0, 10.0, 100.0))
+        for v in (0.5, 0.7, 5.0, 50.0, 500.0):
+            h.observe(v)
+        # bucket ownership: [<=1, <=10, <=100, overflow]
+        assert h.counts == [2, 1, 1, 1]
+        assert h.count == 5 and h.sum == pytest.approx(556.2)
+        assert h.mean == pytest.approx(556.2 / 5)
+        # p50 falls in the (1, 10] bucket; overflow pins to last bound
+        assert 1.0 <= h.percentile(0.5) <= 10.0
+        assert h.percentile(1.0) == 100.0
+        assert Histogram("e").percentile(0.5) == 0.0
+
+    def test_unsorted_buckets_rejected(self):
+        with pytest.raises(ValueError):
+            Histogram("bad", buckets=(10.0, 1.0))
+
+    def test_delta_ships_increments_exactly_once(self):
+        reg = Registry()
+        reg.counter("c").inc(3)
+        reg.histogram("h", buckets=(1.0, 10.0)).observe(5.0)
+        reg.gauge("g").set(4)
+        d1 = reg.delta()
+        assert d1["c"]["value"] == 3
+        assert d1["h"]["count"] == 1 and d1["h"]["counts"] == [0, 1, 0]
+        assert d1["g"]["value"] == 4
+        # no change -> counters/histograms omitted, gauges still ship
+        d2 = reg.delta()
+        assert "c" not in d2 and "h" not in d2 and d2["g"]["value"] == 4
+        # only the increment since the last call ships
+        reg.counter("c").inc(2)
+        assert reg.delta()["c"]["value"] == 2
+
+    def test_merge_is_order_independent(self):
+        """Two workers' interleaved deltas aggregate exactly."""
+        w1, w2 = Registry(), Registry()
+        fleet_a, fleet_b = Registry(), Registry()
+        for i in range(5):
+            w1.counter("chunks").inc()
+            w1.histogram("lat").observe(1.0 + i)
+            w2.counter("chunks").inc(2)
+            w2.histogram("lat").observe(10.0 * (i + 1))
+            d1, d2 = w1.delta(), w2.delta()
+            fleet_a.merge(d1)
+            fleet_a.merge(d2)
+            fleet_b.merge(d2)          # reversed arrival order
+            fleet_b.merge(d1)
+        for fleet in (fleet_a, fleet_b):
+            assert fleet.counter("chunks").value == 15
+            h = fleet.get("lat")
+            assert h.count == 10
+            assert h.sum == pytest.approx(sum(1.0 + i for i in range(5))
+                                          + sum(10.0 * (i + 1)
+                                                for i in range(5)))
+
+    def test_prometheus_text_cumulative_buckets(self):
+        reg = Registry()
+        h = reg.histogram("lat_ms", buckets=(1.0, 10.0))
+        h.observe(0.5)
+        h.observe(5.0)
+        h.observe(50.0)
+        txt = reg.prometheus_text()
+        assert "# TYPE lat_ms histogram" in txt
+        assert 'lat_ms_bucket{le="1"} 1' in txt
+        assert 'lat_ms_bucket{le="10"} 2' in txt       # cumulative
+        assert 'lat_ms_bucket{le="+Inf"} 3' in txt
+        assert "lat_ms_count 3" in txt
+
+    def test_export_atomic(self, tmp_path):
+        reg = Registry()
+        reg.counter("c").inc()
+        p = tmp_path / "metrics" / "prom.txt"
+        assert reg.export(str(p)) == str(p)
+        assert "# TYPE c counter" in p.read_text()
+        # rate limit: second maybe_export inside the interval is a no-op
+        assert reg.maybe_export(str(p), interval=100.0) == str(p)
+        reg.counter("c").inc()
+        assert reg.maybe_export(str(p), interval=100.0) is None
+
+    def test_text_empty_and_snapshot(self):
+        reg = Registry()
+        assert reg.text() == "(no metrics registered)"
+        reg.histogram("h").observe(2.0)
+        snap = reg.snapshot()
+        assert snap["h"]["type"] == "histogram"
+        assert snap["h"]["count"] == 1
+
+
+# --------------------------------------------------------- flight recorder
+class TestRecorder:
+    def test_ring_is_bounded(self):
+        rec = Recorder(maxlen=16)
+        rec.enable()
+        for i in range(50):
+            rec.instant("tick", i=i)
+        assert len(rec) == 16 == rec.maxlen
+        # oldest events were evicted, newest kept
+        assert rec._ring[-1]["args"]["i"] == 49
+
+    def test_disabled_is_a_shared_noop(self):
+        rec = Recorder(maxlen=16)
+        assert rec.span("x") is _NULL_SPAN
+        with rec.span("x", seq=1):
+            pass
+        rec.instant("y")
+        rec.complete("z", 0.0, 1.0)
+        assert len(rec) == 0
+        assert rec.dump() is None          # empty ring -> no file
+
+    def test_events_carry_perfetto_keys(self):
+        rec = Recorder(maxlen=64)
+        rec.enable()
+        with rec.span("chunk_dispatch", seq=3, chunk=20):
+            time.sleep(0.001)
+        rec.instant("guard_trip", cat="sim", action="quarantine")
+        rec.complete("chunk_edge", rec.wall_us(), 123.0, seq=3)
+        evs = list(rec._ring)
+        assert [e["ph"] for e in evs] == ["X", "i", "X"]
+        for e in evs:
+            for key in ("name", "cat", "ph", "ts", "pid", "tid", "args"):
+                assert key in e
+        assert evs[0]["dur"] > 0
+        assert evs[0]["args"]["seq"] == 3
+
+    def test_dump_is_valid_trace_event_json(self, tmp_path):
+        rec = Recorder(maxlen=64)
+        rec.enable()
+        with rec.span("sort_refresh", backend="tiled"):
+            pass
+        rec.instant("hedge", cat="server", piece="CASE_A")
+        p = tmp_path / "t.json"
+        assert rec.dump(str(p)) == str(p)
+        doc = json.loads(p.read_text())
+        assert isinstance(doc["traceEvents"], list)
+        assert len(doc["traceEvents"]) == 2
+        for ev in doc["traceEvents"]:
+            assert ev["ph"] in ("X", "i")
+            assert isinstance(ev["ts"], float)
+            assert isinstance(ev["pid"], int)
+        # the ring is not cleared by a dump
+        assert len(rec) == 2
+
+    def test_trace_report_merges_and_tables(self, tmp_path):
+        rec = Recorder(maxlen=64)
+        rec.enable()
+        with rec.span("chunk_dispatch", seq=1, chunk=20, world=0):
+            pass
+        rec.complete("chunk_edge", rec.wall_us(), 50.0, seq=1,
+                     latency_ms=0.5)
+        rec.instant("chunk_voided", seq=1, epoch=0)
+        p = tmp_path / "a.json"
+        rec.dump(str(p))
+        import sys
+        sys.path.insert(0, "scripts")
+        import trace_report
+        events = trace_report.load([str(p)])
+        assert len(events) == 3
+        rows, loose = trace_report.chunk_table(events)
+        assert len(rows) == 1 and not loose
+        row = next(iter(rows.values()))
+        assert row["chunk"] == 20
+        assert "chunk_dispatch" in row and "chunk_edge" in row
+        assert row["events"] == ["chunk_voided"]
+
+
+# ------------------------------------------------------- sim instrumentation
+class TestSimInstrumentation:
+    def test_chunk_metrics_populate(self, sim):
+        _fleet(sim)
+        lat = sim.obs.get("sim_chunk_latency_ms")
+        assert lat.count > 0
+        assert sim.pipe_stats["pipelined_chunks"] \
+            + sim.pipe_stats["sync_chunks"] == lat.count
+        assert sim.obs.get("sim_dispatch_gap_ms").count >= lat.count - 1
+        # registries are per-sim: a second sim starts clean
+        assert Simulation(nmax=16).obs.get(
+            "sim_chunk_latency_ms").count == 0
+
+    def test_recorder_on_is_bit_identical(self, sim):
+        rec = get_recorder()
+        rec.disable()
+        _fleet(sim)
+        h_off = state_hash(sim)
+        sim2 = Simulation(nmax=16)
+        rec.enable()
+        _fleet(sim2)
+        h_on = state_hash(sim2)
+        assert h_off == h_on
+        assert len(rec) > 0        # the enabled run did record spans
+
+    def test_recorder_on_emits_chunk_spans(self, sim):
+        rec = get_recorder()
+        rec.clear()
+        rec.enable()
+        _fleet(sim)
+        names = {e["name"] for e in rec._ring}
+        assert "chunk_dispatch" in names and "chunk_edge" in names
+        # correlation: every dispatch span carries a seq tag
+        seqs = [e["args"]["seq"] for e in rec._ring
+                if e["name"] == "chunk_dispatch"]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+
+    def test_guard_trip_autodumps(self, sim, tmp_path, monkeypatch):
+        monkeypatch.setattr(settings, "trace_dir", str(tmp_path))
+        rec = get_recorder()
+        rec.clear()
+        rec.enable()
+        sim.pipeline_enabled = False
+        _fleet(sim)
+        do(sim, "FAULT NAN KL1")
+        sim.op()
+        sim.run(until_simt=sim.simt + 1.5)
+        assert len(sim.guard.trips) == 1
+        assert sim.obs.counter("sim_guard_trips").value == 1
+        dumps = list(tmp_path.glob("trace-sim-*-guard_trip.json"))
+        assert len(dumps) == 1
+        doc = json.loads(dumps[0].read_text())
+        trips = [e for e in doc["traceEvents"]
+                 if e["name"] == "guard_trip"]
+        assert trips and trips[0]["args"]["action"]
+
+    def test_autodump_respects_the_knob(self, sim, tmp_path, monkeypatch):
+        monkeypatch.setattr(settings, "trace_dir", str(tmp_path))
+        monkeypatch.setattr(settings, "trace_autodump", False)
+        rec = get_recorder()
+        rec.enable()
+        sim.pipeline_enabled = False
+        _fleet(sim)
+        do(sim, "FAULT NAN KL1")
+        sim.op()
+        sim.run(until_simt=sim.simt + 1.5)
+        assert sim.obs.counter("sim_guard_trips").value == 1
+        assert not list(tmp_path.glob("trace-*.json"))
+
+    def test_mesh_kill_voids_the_inflight_chunk(self, sim, tmp_path,
+                                                monkeypatch):
+        """A device-group loss while a pipelined chunk is in flight
+        leaves the full incident story on the timeline: chunk_voided
+        (the edge that rode the dead mesh) then the mesh_lost ->
+        resharded pair, plus a throttled auto-dump on disk."""
+        monkeypatch.setattr(settings, "trace_dir", str(tmp_path))
+        rec = get_recorder()
+        rec.clear()
+        rec.enable()
+        _fleet(sim)
+        do(sim, "SHARD REPLICATE 8")
+        sim.op()
+        sim.fastforward()
+        for _ in range(3):
+            sim.step()
+        assert sim._pending_edge is not None
+        voided_seq = sim._pending_edge.seq
+        sim.mesh_guard.kill_group(1)       # mid-flight, not at an edge
+        for _ in range(3):
+            sim.step()
+        sim.drain_pipeline()
+        names = [e["name"] for e in rec._ring]
+        i_void = names.index("chunk_voided")
+        i_lost = names.index("mesh_lost")
+        i_resh = names.index("resharded")
+        assert i_void < i_lost < i_resh
+        assert sim.obs.counter("sim_mesh_trips").value == 2
+        void = list(rec._ring)[i_void]
+        assert void["args"]["seq"] == voided_seq
+        assert void["args"]["epoch"] == 0
+        assert list(tmp_path.glob("trace-sim-*-mesh_trip.json"))
+
+    def test_multi_reason_sync_counts_every_reason(self, sim):
+        """A chunk held back by two co-occurring reasons is one sync
+        chunk but TWO reasons (the old code recorded reasons[0] only)."""
+        sim.pipeline_enabled = False          # reason "off"
+        sim.guard.set_policy("halt")          # reason "guard-halt"
+        _fleet(sim)
+        reasons = dict(sim.pipe_stats["sync_reasons"].items())
+        assert reasons["off"] >= 1
+        assert reasons["guard-halt"] == reasons["off"]
+
+    def test_metrics_dump_detached(self, sim):
+        _fleet(sim)
+        out = do(sim, "METRICS DUMP")
+        assert "sim registry:" in out
+        assert "sim_chunk_latency_ms" in out
+        # the bare sector-metrics readback is untouched
+        assert "OFF" in do(sim, "METRICS")
+
+    def test_trace_command_cycle(self, sim, tmp_path, monkeypatch):
+        monkeypatch.setattr(settings, "trace_dir", str(tmp_path))
+        assert "TRACE OFF" in do(sim, "TRACE")
+        do(sim, "TRACE ON")
+        assert get_recorder().enabled
+        _fleet(sim)
+        out = do(sim, "TRACE DUMP")
+        assert "Trace written to" in out
+        assert list(tmp_path.glob("trace-sim-*-manual.json"))
+        do(sim, "TRACE OFF")
+        assert not get_recorder().enabled
+        assert "TRACE OFF" in do(sim, "TRACE")
+
+
+# ------------------------------------------------------ fleet aggregation
+class TestFleetAggregation:
+    def test_worker_deltas_reach_the_server(self):
+        zmq = pytest.importorskip("zmq")  # noqa: F841
+        from bluesky_tpu.network.client import Client
+        from bluesky_tpu.network.server import Server
+        from bluesky_tpu.simulation.simnode import SimNode
+        from tests.test_network import free_ports, wait_for
+
+        ev, st, wev, wst = free_ports(4)
+        server = Server(headless=True,
+                        ports=dict(event=ev, stream=st, wevent=wev,
+                                   wstream=wst),
+                        spawn_workers=False, hb_interval=0.2)
+        server.start()
+        time.sleep(0.2)
+        node = SimNode(event_port=wev, stream_port=wst, nmax=16)
+        thread = threading.Thread(target=node.run, daemon=True)
+        thread.start()
+        client = Client()
+        try:
+            client.connect(event_port=ev, stream_port=st, timeout=5.0)
+            assert wait_for(lambda: (client.receive(10),
+                                     len(client.nodes) >= 1)[1])
+            client.stack("CRE KL1 B744 52 4 90 FL200 250")
+            client.stack("OP")
+            # worker heartbeats piggyback obs deltas; the server merges
+            # them into its fleet registry
+            assert wait_for(
+                lambda: "sim_chunk_latency_ms" in server.fleet.snapshot(),
+                timeout=30)
+            fleet_lat = server.fleet.get("sim_chunk_latency_ms")
+            assert fleet_lat.count > 0
+            # METRICS round-trip: broker + fleet registries to a client
+            client.request_metrics()
+            assert wait_for(lambda: (client.receive(10),
+                                     client.last_metrics is not None)[1],
+                            timeout=10)
+            m = client.last_metrics
+            assert "server" in m and "fleet" in m
+            assert "sim_chunk_latency_ms" in m["fleet"]
+            assert "server_queue_depth" in m["server"]
+            assert "== server ==" in m["text"]
+        finally:
+            node.quit()
+            thread.join(timeout=5)
+            server.stop()
+            server.join(timeout=5)
+            client.close()
