@@ -40,11 +40,65 @@ def platform_tag():
             f"{jax.devices()[0].device_kind.lower()}")
 
 
-def write_bench_json(path, rows, **extra):
+def git_rev():
+    """Short git revision of the repo this bench.py sits in (the
+    BENCH_HISTORY provenance tag); 'unknown' outside a checkout."""
+    import os
+    import subprocess
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        rev = out.stdout.strip()
+        return rev if out.returncode == 0 and rev else "unknown"
+    except Exception:
+        return "unknown"
+
+
+def append_history(series, rows, path=None, rev=None, tag=None):
+    """Append measured rows to the BENCH_HISTORY.jsonl series (the
+    ISSUE-12 perf-regression sentinel's input): one JSON line per row —
+    ``{"series", "ts", "git_rev", "platform", "row"}`` — so
+    ``scripts/bench_history.py compare`` can diff the newest rows
+    against the tracked baseline.  Projected and failed rows are not
+    history (nothing was measured).  Returns the number appended."""
+    if path is None:
+        try:
+            from bluesky_tpu import settings
+            path = getattr(settings, "bench_history_path",
+                           "BENCH_HISTORY.jsonl")
+        except Exception:
+            path = "BENCH_HISTORY.jsonl"
+    if not path:
+        return 0
+    measured = [r for r in rows
+                if isinstance(r, dict)
+                and not r.get("projected") and not r.get("failed")]
+    if not measured:
+        return 0
+    rev = rev or git_rev()
+    tag = tag or platform_tag()
+    ts = round(time.time(), 3)
+    with open(path, "a") as f:
+        for r in measured:
+            f.write(json.dumps(
+                {"series": series, "ts": ts, "git_rev": rev,
+                 "platform": r.get("platform", tag), "row": r},
+                sort_keys=True) + "\n")
+    return len(measured)
+
+
+def write_bench_json(path, rows, history=True, **extra):
     """Shared BENCH_*.json writer: platform-tag every measured row and
     write ``{"rows": rows, **extra}`` — the boilerplate every sweep
     script used to duplicate (scripts/world_sweep.py now calls this).
-    Rows that already carry a tag (history, projections) keep it."""
+    Rows that already carry a tag (history, projections) keep it.
+
+    Unless ``history=False`` (reprojection round-trips, merges of
+    already-recorded rows), the measured rows are also appended to the
+    BENCH_HISTORY.jsonl sentinel series named after the file."""
+    import os
     tag = platform_tag()
     for r in rows:
         if isinstance(r, dict) and not r.get("projected"):
@@ -54,6 +108,9 @@ def write_bench_json(path, rows, **extra):
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(f"wrote {path}")
+    if history:
+        series = os.path.splitext(os.path.basename(path))[0]
+        append_history(series, rows, tag=tag)
     return out
 
 

@@ -368,6 +368,10 @@ def optimize(state, asas_cfg=None, *, tend: float = 600.0,
     v = jax.tree_util.tree_map(jnp.zeros_like, params)
 
     temps = objectives.anneal_schedule(temp0, temp1, iters)
+    # opt_step spans (ISSUE-12 satellite): one per descent iteration —
+    # the optimize driver was missing from the PR-11 span vocabulary
+    from ..obs.trace import get_recorder
+    rec = get_recorder()
     trace, gnorms = [], []
     bad_word = -1
     for it in range(iters):
@@ -375,9 +379,11 @@ def optimize(state, asas_cfg=None, *, tend: float = 600.0,
         # has already folded the non-finite gradients into the NEW
         # params, and "halt at the last finite iterate" must mean it
         params_prev = params
-        params, m, v, value, per, gnorm, bad = opt_iter(
-            params, m, v, it + 1, jnp.asarray(temps[it], dtype))
-        bad_word = int(bad)
+        with rec.span("opt_step", cat="opt", it=it,
+                      restarts=restarts, nsteps=nsteps):
+            params, m, v, value, per, gnorm, bad = opt_iter(
+                params, m, v, it + 1, jnp.asarray(temps[it], dtype))
+            bad_word = int(bad)
         trace.append(float(value))
         gnorms.append(float(gnorm))
         if verbose:

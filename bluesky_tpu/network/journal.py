@@ -54,6 +54,20 @@ Record types (one JSON object per line, ``rec`` selects the type):
                                             before/after, guard word) —
                                             audit only, queue math
                                             ignores it
+  ``perf_regression`` {key, worker, rate, baseline, factor}  serving
+                                            SLO watch (ISSUE-12): an
+                                            in-flight piece's rolling
+                                            steps/s fell below
+                                            ``perf_slo_factor`` x the
+                                            fleet median — audit only,
+                                            queue math/exactly-once
+                                            unaffected; surfaced by
+                                            replay for inspection
+  ``device_profile`` {worker, dir, chunks}  PROFILE DEVICE window: the
+                                            XLA trace dir a worker
+                                            captured (audit; links the
+                                            journal to the Perfetto
+                                            merge)
   ``resumed``     {pending, completed, quarantined}  replay marker
   ``shutdown``    {}                        clean server exit
 
@@ -245,6 +259,31 @@ class BatchJournal:
                     worker=worker.hex(),
                     result=result if isinstance(result, dict) else None)
 
+    def perf_regression(self, piece, worker: bytes = b"", rate=None,
+                        baseline=None, factor=None):
+        """Serving SLO watch (ISSUE-12): a worker's rolling per-piece
+        progress rate dropped below ``perf_slo_factor`` x the fleet
+        median.  AUDIT record — the piece stays in flight (hedging,
+        not this record, is the mitigation) and replay's queue math
+        ignores it; surfaced under ``perf_regressions``."""
+        rec = dict(key=self.piece_key(piece), worker=worker.hex())
+        if rate is not None:
+            rec["rate"] = round(float(rate), 4)
+        if baseline is not None:
+            rec["baseline"] = round(float(baseline), 4)
+        if factor is not None:
+            rec["factor"] = float(factor)
+        self.append("perf_regression", **rec)
+
+    def device_profile(self, worker: bytes = b"", dir="", chunks=None):
+        """A worker opened a PROFILE DEVICE window: journal the XLA
+        trace dir so the sweep's record links to the captured trace.
+        Audit only (no piece key — the window is per-worker)."""
+        rec = dict(worker=worker.hex(), dir=str(dir))
+        if chunks is not None:
+            rec["chunks"] = int(chunks)
+        self.append("device_profile", **rec)
+
     def shutdown(self):
         # clean-exit marker — only if this run ever journaled anything
         # (a server that never saw a BATCH must not litter log_path
@@ -285,6 +324,7 @@ class BatchJournal:
         quarantined_keys = set()
         crashes, qcrashes = {}, {}
         opt_results = []
+        perf_regressions = []
         torn = 0
         # errors="replace": disk-level byte corruption must surface as
         # skipped torn lines, not a UnicodeDecodeError that escapes the
@@ -334,6 +374,15 @@ class BatchJournal:
                     # — surfaced for inspection, ignored by queue math
                     opt_results.append({"key": key,
                                         "result": r.get("result")})
+                elif rec == "perf_regression":
+                    # serving SLO-watch audit record (ISSUE-12) — the
+                    # piece's queue state is untouched (exactly-once
+                    # stays queued-minus-completed); surfaced so a
+                    # resumed sweep can see which pieces ran slow
+                    perf_regressions.append(
+                        {"key": key, "worker": r.get("worker", ""),
+                         "rate": r.get("rate"),
+                         "baseline": r.get("baseline")})
 
         def owed(k):
             if k in quarantined_keys:
@@ -350,5 +399,6 @@ class BatchJournal:
             crashes={k: c for k, c in crashes.items() if owed(k) > 0},
             quarantined_crashes=qcrashes,
             opt_results=opt_results,
+            perf_regressions=perf_regressions,
             torn_lines=torn,
         )

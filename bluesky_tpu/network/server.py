@@ -241,6 +241,9 @@ class Server(threading.Thread):
         "server_opt_results", "OPTRESULT reports journaled")
     stream_drops = _obs_counter(
         "server_stream_drops", "stream frames dropped at SNDHWM")
+    perf_regressions = _obs_counter(
+        "server_perf_regressions",
+        "serving SLO-watch perf_regression records journaled")
 
     def __init__(self, headless=False, discoverable=False,
                  ports=None, max_nnodes=None, spawn_workers=True,
@@ -318,6 +321,17 @@ class Server(threading.Thread):
             else getattr(_settings, "hedge_enabled", True)
         self.hedge_rate_factor = getattr(_settings,
                                          "hedge_rate_factor", 0.2)
+        # serving SLO watch (ISSUE-12): journal a perf_regression audit
+        # record when an in-flight piece's rolling rate drops below
+        # perf_slo_factor x the fleet median (0 = off).  Deliberately
+        # separate from hedging: the hedge MITIGATES, the SLO record
+        # EXPLAINS — and it fires even with hedging off or no idle
+        # worker to hedge onto.
+        self.perf_slo_factor = float(getattr(_settings,
+                                             "perf_slo_factor", 0.0))
+        self._slo_flagged = set()          # (wid, piece key) journaled
+        self._slo_recent = collections.deque(maxlen=8)
+        self._slo_median = None            # last fleet-median FF rate
         self.batch_queue_max = batch_queue_max \
             if batch_queue_max is not None \
             else getattr(_settings, "batch_queue_max", 4096)
@@ -351,6 +365,7 @@ class Server(threading.Thread):
         self.rejected_batches = 0          # BATCHREJECTED sent
         self.opt_results = 0               # OPTRESULT reports journaled
         self.stream_drops = 0              # stream frames dropped at HWM
+        self.perf_regressions = 0          # SLO-watch records journaled
         self._completion_stamps = collections.deque(maxlen=64)
         # ----- durable BATCH state: append-only JSONL journal (WAL)
         # replayed on restart (--resume-batch).  journal_path=None ->
@@ -775,6 +790,19 @@ class Server(threading.Thread):
             print(f"server: {msg}")
             self._report_clients(msg)
             self._report_clients(msg, name=b"BATCHOPT", data=data)
+        elif name == b"DEVPROF" and from_worker:
+            # PROFILE DEVICE on a worker: journal the trace-window dir
+            # (audit record; links the sweep's journal to the captured
+            # XLA trace for scripts/devprof_report.py)
+            data = unpackb(payload) if payload else None
+            d = data if isinstance(data, dict) else {}
+            if self.journal:
+                self.journal.device_profile(sender,
+                                            dir=d.get("dir", ""),
+                                            chunks=d.get("chunks"))
+            self._report_clients(
+                f"worker {sender.hex()} device-profiling "
+                f"{d.get('chunks', '?')} chunk(s) to {d.get('dir', '?')}")
         elif name == b"WORLDS":
             # WORLDS stack/client command: set the packing knobs
             # (payload dict) and/or read them back HEALTH-style
@@ -981,6 +1009,12 @@ class Server(threading.Thread):
                       // (len(self.avail_workers) + 1))
             wmax = max(1, min(wmax, share))
         picks = []
+        # pack_fill span (ISSUE-12 satellite): the world-pack fill loop
+        # — compatibility checks + fairness-queue pops — was invisible
+        # to the PR-11 recorder; a complete event keeps the solo path
+        # (wmax == 1) untouched
+        t_fill0 = time.perf_counter() \
+            if wmax > 1 and self.recorder.enabled else None
         while len(picks) < wmax and self.scenarios:
             owner, piece = self.scenarios.pop_next()
             if self.scenarios.last_wait_s is not None:
@@ -1027,6 +1061,12 @@ class Server(threading.Thread):
             picks.append((owner, piece))
             if solo_why:
                 break    # solo-only piece dispatches alone, never packs
+        if t_fill0 is not None:
+            rec = self.recorder
+            rec.complete("pack_fill", rec.wall_us(t_fill0),
+                         (time.perf_counter() - t_fill0) * 1e6,
+                         cat="server", wmax=wmax, npicks=len(picks),
+                         worker=wid.hex())
         self.inflight_t[wid] = time.monotonic()
         prog = self.worker_progress.get(wid)
         if prog is not None:               # straggler clock restarts at
@@ -1113,10 +1153,7 @@ class Server(threading.Thread):
         # it on "low rate" would burn a second worker on a copy that
         # cannot finish any earlier.  Stall detection (flat progress)
         # still covers non-FF pieces.
-        rates = [p["rate"] for w, p in self.worker_progress.items()
-                 if w in self.inflight and p["rate"] > 0.0
-                 and p.get("ff") and now - p["t"] <= fresh]
-        median = statistics.median(rates) if len(rates) >= 2 else None
+        median = self._fresh_ff_median(now)
         for wid, piece in list(self.inflight.items()):
             if not self.avail_workers:
                 return
@@ -1139,6 +1176,67 @@ class Server(threading.Thread):
                 self._dispatch_hedge(
                     wid, piece, "stalled" if stalled else
                     f"rate {prog['rate']:.2f} << median {median:.2f}")
+
+    def _fresh_ff_median(self, now):
+        """Fleet-median progress rate over fresh fast-forward reports
+        (the hedge detector's yardstick, shared by the SLO watch)."""
+        fresh = 3.0 * self.hb_interval
+        rates = [p["rate"] for w, p in self.worker_progress.items()
+                 if w in self.inflight and p["rate"] > 0.0
+                 and p.get("ff") and now - p["t"] <= fresh]
+        return statistics.median(rates) if len(rates) >= 2 else None
+
+    def _check_perf_slo(self, now):
+        """Serving-side SLO watch (ISSUE-12): journal ONE
+        ``perf_regression`` audit record per (worker, piece) whose
+        rolling FF rate sits below ``perf_slo_factor`` x the fleet
+        median.  Pure observation — the piece stays in flight and the
+        queue math never sees the record; hedging (if enabled) remains
+        the mitigation."""
+        if self.perf_slo_factor <= 0.0:
+            return
+        median = self._fresh_ff_median(now)
+        self._slo_median = median
+        if median is None:
+            return
+        fresh = 3.0 * self.hb_interval
+        from .journal import BatchJournal
+        for wid, piece in list(self.inflight.items()):
+            if isinstance(piece, WorldPack):
+                continue               # pack rates aggregate W pieces
+            prog = self.worker_progress.get(wid)
+            if prog is None or now - prog["t"] > fresh \
+                    or not prog.get("ff") or prog["rate"] <= 0.0:
+                continue
+            if now - self.inflight_t.get(wid, now) \
+                    <= self.straggler_timeout:
+                continue               # dispatch/compile grace period
+            if prog["rate"] >= self.perf_slo_factor * median:
+                continue
+            key = (wid, BatchJournal.piece_key(piece))
+            if key in self._slo_flagged:
+                continue               # once per (worker, piece)
+            self._slo_flagged.add(key)
+            self.perf_regressions += 1
+            pname = self._piece_name(piece)
+            self.recorder.instant("perf_regression", cat="server",
+                                  piece=pname, worker=wid.hex(),
+                                  rate=round(prog["rate"], 4),
+                                  baseline=round(median, 4))
+            if self.journal:
+                self.journal.perf_regression(
+                    piece, wid, rate=prog["rate"], baseline=median,
+                    factor=self.perf_slo_factor)
+            msg = (f"SLO: piece '{pname}' on worker {wid.hex()} "
+                   f"running at {prog['rate']:.2f} sim-s/s vs fleet "
+                   f"median {median:.2f} (< {self.perf_slo_factor:g}x)"
+                   " — perf_regression journaled")
+            print(f"server: {msg}")
+            self._report_clients(msg)
+            self._slo_recent.append(
+                {"worker": wid.hex(), "piece": pname,
+                 "rate": round(prog["rate"], 4),
+                 "baseline": round(median, 4)})
 
     def _dispatch_hedge(self, wid, piece, why):
         """Send a second copy of ``wid``'s in-flight piece to an idle
@@ -1322,6 +1420,21 @@ class Server(threading.Thread):
             "hedge_enabled": bool(self.hedge_enabled),
             "worlds": {k: v for k, v in self.worlds_payload().items()
                        if k != "text"},
+            # serving SLO watch + fleet compile telemetry (ISSUE-12):
+            # the fleet counters arrive merged from worker heartbeat
+            # obs deltas, so HEALTH shows recompiles fleet-wide
+            "perf": {
+                "slo_factor": self.perf_slo_factor,
+                "regressions": self.perf_regressions,
+                "fleet_median_rate": self._slo_median,
+                "recent": list(self._slo_recent),
+                "fleet_offladder_recompiles": int(getattr(
+                    self.fleet.get("devprof_cache_misses_offladder"),
+                    "value", 0) or 0),
+                "fleet_ladder_warmups": int(getattr(
+                    self.fleet.get("devprof_cache_misses_ladder"),
+                    "value", 0) or 0),
+            },
         }
         if mesh is not None:
             data["mesh"] = mesh
@@ -1361,6 +1474,19 @@ class Server(threading.Thread):
                 f"mode {m.get('mode', 'off')}, last refresh "
                 f"{m.get('last_refresh_ms', 0):g} ms"
                 + (" [DEGRADED]" if m.get("degraded") else ""))
+        p = d.get("perf")
+        if p:
+            med = p.get("fleet_median_rate")
+            lines.append(
+                "perf: SLO watch "
+                + (f"{p['slo_factor']:g}x median"
+                   if p["slo_factor"] else "OFF")
+                + f", {p['regressions']} regression record(s)"
+                + (f", fleet median {med:.2f} sim-s/s"
+                   if isinstance(med, (int, float)) else "")
+                + f"; compiles fleet-wide: "
+                  f"{p['fleet_ladder_warmups']} ladder warm-up(s), "
+                  f"{p['fleet_offladder_recompiles']} off-ladder")
         for wid, w in d["workers"].items():
             line = (f"  {wid[:8]}: state {w['state']}, "
                     f"hb {w['hb_age']:.1f}s ago")
@@ -1522,6 +1648,7 @@ class Server(threading.Thread):
                 self._next_hb = now + self.hb_interval
                 self._reap_dead_workers()
                 self._check_stragglers(now)
+                self._check_perf_slo(now)
                 self.obs.gauge("server_queue_depth").set(
                     len(self.scenarios))
                 self.obs.maybe_export()

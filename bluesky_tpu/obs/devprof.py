@@ -1,0 +1,362 @@
+"""Device-level observability (ISSUE-12): compile telemetry, memory
+watermarks, donation accounting and on-demand device-trace windows.
+
+The PR-11 flight recorder stops at host-side span timestamps; this
+module answers the questions those spans can only hint at:
+
+* **What compiles, when?**  A module-level ``jax.monitoring`` duration
+  listener feeds per-compile trace/lower/backend histograms into every
+  subscribed registry, and host-side cache accounting keyed on
+  ``(program, nsteps, nmax, ndev)`` splits compile-cache misses into
+  *ladder warm-up* (``nsteps`` on the sim's ``CHUNK_LADDER``) vs
+  *off-ladder recompiles* (a CHUNKSTEPS value outside the ladder, a
+  changed nmax bucket, a resized mesh).  ``METRICS DUMP`` / ``HEALTH``
+  surface both, so a mid-run recompile storm is visible.
+
+* **How close to memory limits?**  ``sample_memory()`` walks
+  ``jax.live_arrays()`` at chunk edges (throttled by the
+  ``devprof_mem_dt`` knob) into per-device live-byte gauges plus a
+  self-tracked peak — on backends whose ``device.memory_stats()``
+  report a peak the larger of the two wins.  An optional donation
+  check counts input buffers the runner expected XLA to reuse but
+  which survived the dispatch (``devprof_donation_check``; forces a
+  host sync, debug only).
+
+* **Where does a chunk's wall time go?**  ``PROFILE DEVICE [n] [dir]``
+  opens a window over the next ``n`` chunk dispatches: a
+  ``jax.profiler`` trace brackets them (the XLA trace lands in
+  ``dir``), and each windowed chunk is timed in three sub-sections —
+  *compute* (dispatch → device done), *halo* (the pre-dispatch
+  spatial-sort / halo-exchange refresh) and *edge* (host edge-retire
+  work) — emitted as ``devprof_chunk`` complete events on the flight
+  recorder plus three registry histograms.  The window itself is a
+  ``device_profile`` span tagged with the trace dir, so
+  ``scripts/devprof_report.py`` can merge the host dumps with the
+  XLA ``*.trace.json.gz`` onto one Perfetto timeline.  Windowed
+  dispatches block on the device (that is the point: attribution
+  needs the fence), so the window briefly serializes the pipeline.
+
+Contract (docs/OBSERVABILITY.md): with every feature off, the hooks
+are attribute checks only — zero device ops, bit-identical stepped
+state, covered by the obs_smoke <2% overhead gate.
+"""
+import os
+import threading
+import time
+import weakref
+
+# jax.monitoring event names (jax 0.4.x) -> histogram series.  Durations
+# arrive in seconds; the registry ladders are ms.
+_COMPILE_EVENTS = {
+    "/jax/core/compile/jaxpr_trace_duration": "devprof_compile_trace_ms",
+    "/jax/core/compile/jaxpr_to_mlir_module_duration":
+        "devprof_compile_lower_ms",
+    "/jax/core/compile/backend_compile_duration":
+        "devprof_compile_backend_ms",
+}
+
+# Byte-scale bucket ladder for anything we might histogram in bytes —
+# the gauges don't need it, but compile durations can hit many seconds.
+COMPILE_MS_BUCKETS = (1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+                      1000.0, 2500.0, 5000.0, 10000.0, 30000.0, 60000.0)
+
+_SUBSCRIBERS = weakref.WeakSet()     # registries fed by the listener
+_LISTENER_LOCK = threading.Lock()
+_LISTENER_INSTALLED = False
+
+
+def _on_compile_event(event, duration_secs, **kw):
+    name = _COMPILE_EVENTS.get(event)
+    if name is None:
+        return
+    ms = duration_secs * 1e3
+    for reg in list(_SUBSCRIBERS):
+        reg.histogram(name, buckets=COMPILE_MS_BUCKETS).observe(ms)
+        if event.endswith("backend_compile_duration"):
+            reg.counter("devprof_backend_compiles").inc()
+
+
+def install_compile_listener(registry):
+    """Subscribe ``registry`` to the process-wide jax.monitoring compile
+    events.  The listener itself is registered once per process (JAX
+    has no unregister API); subscription is a WeakSet so dead sims drop
+    out on their own.  Returns False when the monitoring API is absent
+    (older/stubbed jax) — telemetry degrades to the host-side cache
+    accounting only."""
+    global _LISTENER_INSTALLED
+    _SUBSCRIBERS.add(registry)
+    if _LISTENER_INSTALLED:
+        return True
+    with _LISTENER_LOCK:
+        if _LISTENER_INSTALLED:
+            return True
+        try:
+            from jax import monitoring
+            monitoring.register_event_duration_secs_listener(
+                _on_compile_event)
+        except Exception:
+            return False
+        _LISTENER_INSTALLED = True
+    return True
+
+
+class DevProf:
+    """Per-sim device observability.  Always present on a Simulation
+    (``sim.devprof``); every hook early-outs on plain attribute checks
+    when its feature is off, so the disabled path adds no device ops.
+    """
+
+    def __init__(self, obs, recorder, ladder=()):
+        self.obs = obs
+        self.recorder = recorder
+        self.ladder = tuple(int(x) for x in ladder)
+        self._seen = set()           # (program, nsteps, nmax, ndev)
+        self._peaks = {}             # device id -> peak live bytes seen
+        self._last_mem = -1e18       # monotonic stamp of last sample
+        self._window = None          # active profile-window dict
+        self._window_req = None      # (n_chunks, logdir) pending
+        self.windows = []            # completed-window records
+        from .. import settings
+        if bool(getattr(settings, "devprof_compile_telemetry", True)):
+            install_compile_listener(obs)
+        obs.counter("devprof_cache_hits",
+                    help="chunk dispatches whose (program, nsteps, "
+                         "nmax, ndev) key was already compiled")
+        obs.counter("devprof_cache_misses_ladder",
+                    help="first-seen dispatch keys with nsteps on the "
+                         "chunk ladder (expected warm-up compiles)")
+        obs.counter("devprof_cache_misses_offladder",
+                    help="first-seen dispatch keys OFF the chunk "
+                         "ladder (accidental/mid-run recompiles)")
+
+    # ------------------------------------------------ compile telemetry
+    def note_dispatch(self, program, nsteps, nmax, ndev):
+        """Host-side compile-cache accounting for one chunk dispatch.
+        jit caches on (program identity, static args, input avals); the
+        key below is the sim-level projection of that, so a first-seen
+        key == one real compile.  A key is counted as a miss exactly
+        once (set semantics), which is what the acceptance test pins."""
+        from .. import settings
+        if not bool(getattr(settings, "devprof_compile_telemetry", True)):
+            return
+        key = (program, int(nsteps), int(nmax), int(ndev))
+        if key in self._seen:
+            self.obs.get("devprof_cache_hits").inc()
+            return
+        self._seen.add(key)
+        if int(nsteps) in self.ladder:
+            self.obs.get("devprof_cache_misses_ladder").inc()
+        else:
+            self.obs.get("devprof_cache_misses_offladder").inc()
+            self.recorder.instant("devprof_recompile", cat="devprof",
+                                  program=program, nsteps=int(nsteps),
+                                  nmax=int(nmax), ndev=int(ndev))
+
+    def compile_summary(self):
+        """One-line HEALTH/METRICS summary of the cache accounting."""
+        g = lambda n: int(getattr(self.obs.get(n), "value", 0) or 0)
+        bc = self.obs.get("devprof_backend_compiles")
+        parts = [f"ladder warm-up {g('devprof_cache_misses_ladder')}",
+                 f"off-ladder {g('devprof_cache_misses_offladder')}",
+                 f"hits {g('devprof_cache_hits')}"]
+        if bc is not None:
+            parts.append(f"backend compiles {int(bc.value)}")
+        return ", ".join(parts)
+
+    # ------------------------------------------------ memory watermarks
+    def sample_memory(self, now=None, force=False):
+        """Per-device live-bytes + peak gauges from ``jax.live_arrays``
+        (throttled by the ``devprof_mem_dt`` knob; 0 = off).  Returns
+        the per-device live-byte dict, or None when skipped."""
+        from .. import settings
+        dt = float(getattr(settings, "devprof_mem_dt", 0.0))
+        if dt <= 0.0 and not force:
+            return None
+        now = time.monotonic() if now is None else now
+        if not force and now - self._last_mem < dt:
+            return None
+        self._last_mem = now
+        import jax
+        per = {}
+        for arr in jax.live_arrays():
+            try:
+                for sh in arr.addressable_shards:
+                    did = sh.device.id
+                    per[did] = per.get(did, 0) + int(sh.data.nbytes)
+            except Exception:
+                devs = list(getattr(arr, "devices", lambda: [])())
+                if not devs:
+                    continue
+                share = int(arr.nbytes) // len(devs)
+                for d in devs:
+                    per[d.id] = per.get(d.id, 0) + share
+        total = 0
+        for did, nbytes in sorted(per.items()):
+            total += nbytes
+            peak = max(self._peaks.get(did, 0), nbytes)
+            # A backend that reports real allocator stats knows the true
+            # peak (transients between our edge samples); trust it when
+            # larger.  CPU reports None — the self-tracked peak stands.
+            try:
+                stats = jax.devices()[did].memory_stats()
+                if stats and stats.get("peak_bytes_in_use"):
+                    peak = max(peak, int(stats["peak_bytes_in_use"]))
+            except Exception:
+                pass
+            self._peaks[did] = peak
+            self.obs.gauge(f"devprof_live_bytes_dev{did}",
+                           help="live device bytes at last chunk-edge "
+                                "sample").set(nbytes)
+            self.obs.gauge(f"devprof_peak_bytes_dev{did}",
+                           help="peak live device bytes seen").set(peak)
+        self.obs.gauge("devprof_live_bytes_total",
+                       help="live device bytes, all devices").set(total)
+        return per
+
+    def watermarks(self):
+        """{device id: (live, peak)} from the gauges (last sample)."""
+        out = {}
+        for did, peak in sorted(self._peaks.items()):
+            g = self.obs.get(f"devprof_live_bytes_dev{did}")
+            out[did] = (int(g.value) if g else 0, int(peak))
+        return out
+
+    def check_donation(self, state_in):
+        """Count input buffers a donating dispatch left alive (XLA
+        declined the donation — usually a layout/alias mismatch).
+        Forces nothing itself, but only meaningful after the dispatch
+        has been consumed; gated on ``devprof_donation_check``."""
+        from .. import settings
+        if not bool(getattr(settings, "devprof_donation_check", False)):
+            return 0
+        import jax
+        missed = 0
+        for leaf in jax.tree_util.tree_leaves(state_in):
+            if hasattr(leaf, "is_deleted") and not leaf.is_deleted():
+                missed += 1
+        if missed:
+            self.obs.counter(
+                "devprof_donation_missed",
+                help="donated input buffers XLA re-allocated instead "
+                     "of reusing").inc(missed)
+            self.recorder.instant("devprof_donation_missed",
+                                  cat="devprof", buffers=missed)
+        return missed
+
+    # ------------------------------------------------- profile windows
+    @property
+    def window_active(self):
+        return self._window is not None
+
+    def request_window(self, n_chunks=1, logdir=None):
+        """Arm a device-trace window over the next ``n_chunks`` chunk
+        dispatches (the PROFILE DEVICE command).  Returns the resolved
+        trace dir."""
+        from .. import settings
+        if not logdir:
+            base = str(getattr(settings, "trace_dir", "") or "") \
+                or str(getattr(settings, "log_path", "output"))
+            logdir = os.path.join(base, "devprof")
+        self._window_req = (max(int(n_chunks), 1), logdir)
+        return logdir
+
+    def begin_chunk(self, seq):
+        """Dispatch-side hook: start the armed window (if any) and
+        report whether this chunk is inside one.  Admission is capped
+        at ``n`` — the pipeline dispatches chunk k+1 before chunk k's
+        edge retires, so without the cap an extra chunk would slip in
+        while the last windowed edges drain."""
+        if self._window_req is not None and self._window is None:
+            n, logdir = self._window_req
+            self._window_req = None
+            try:
+                import jax
+                os.makedirs(logdir, exist_ok=True)
+                jax.profiler.start_trace(logdir)
+            except Exception as e:
+                self.recorder.instant("device_profile_failed",
+                                      cat="devprof", error=str(e)[:200])
+                return False
+            self._window = {"n": n, "left": n, "admitted": 0,
+                            "dir": logdir, "seq0": seq,
+                            "t0": time.perf_counter(), "chunks": {}}
+        w = self._window
+        if w is None or w["admitted"] >= w["n"]:
+            return False
+        w["admitted"] += 1
+        return True
+
+    def note_chunk(self, seq, chunk, compute_ms, halo_ms):
+        """Record the dispatch-side sub-sections of a windowed chunk
+        (edge_ms arrives later via note_edge)."""
+        w = self._window
+        if w is None:
+            return
+        w["chunks"][seq] = {"chunk": chunk,
+                            "compute_ms": round(float(compute_ms), 3),
+                            "halo_ms": round(float(halo_ms), 3),
+                            "t0": time.perf_counter()}
+        self.obs.histogram(
+            "devprof_compute_ms",
+            help="windowed chunk device compute wall ms").observe(
+                compute_ms)
+        self.obs.histogram(
+            "devprof_halo_ms",
+            help="windowed chunk pre-dispatch sort/halo wall ms"
+        ).observe(halo_ms)
+
+    def note_edge(self, seq, edge_ms):
+        """Edge-retire hook: completes one windowed chunk's attribution
+        and closes the window after the n-th edge."""
+        w = self._window
+        if w is None:
+            return
+        c = w["chunks"].get(seq)
+        if c is None:
+            return
+        c["edge_ms"] = round(float(edge_ms), 3)
+        self.obs.histogram(
+            "devprof_edge_ms",
+            help="windowed chunk host edge-retire wall ms").observe(
+                edge_ms)
+        rec = self.recorder
+        if rec.enabled:
+            rec.complete("devprof_chunk", rec.wall_us(c["t0"]),
+                         max(edge_ms, 0.001) * 1e3, cat="devprof",
+                         seq=seq, chunk=c["chunk"],
+                         compute_ms=c["compute_ms"],
+                         halo_ms=c["halo_ms"], edge_ms=c["edge_ms"])
+        w["left"] -= 1
+        if w["left"] <= 0:
+            self._end_window()
+
+    def _end_window(self):
+        w, self._window = self._window, None
+        if w is None:
+            return
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception as e:
+            self.recorder.instant("device_profile_failed",
+                                  cat="devprof", error=str(e)[:200])
+        t1 = time.perf_counter()
+        rec = self.recorder
+        rec.complete("device_profile", rec.wall_us(w["t0"]),
+                     (t1 - w["t0"]) * 1e6, cat="devprof",
+                     dir=w["dir"], n_chunks=w["n"], seq0=w["seq0"])
+        record = {"dir": w["dir"], "n_chunks": w["n"],
+                  "seq0": w["seq0"],
+                  "wall_s": round(t1 - w["t0"], 4),
+                  "chunks": w["chunks"]}
+        self.windows.append(record)
+        self.obs.counter("devprof_windows",
+                         help="completed PROFILE DEVICE windows").inc()
+        return record
+
+    def abort_window(self):
+        """Close a half-open window (drain/shutdown paths)."""
+        if self._window is not None:
+            self._window["left"] = 0
+            self._end_window()
+        self._window_req = None

@@ -39,7 +39,8 @@ from collections import deque
 # docs/OBSERVABILITY.md.
 SPAN_TYPES = ("chunk_dispatch", "chunk_edge", "sort_refresh",
               "snapshot_capture", "mesh_check", "hedge", "demux",
-              "journal_append")
+              "journal_append", "opt_step", "pack_fill",
+              "device_profile", "devprof_chunk")
 
 # Wall anchor: perf_counter() + _EPOCH == time.time() at import, so
 # every process's event clocks share one (NTP-aligned) origin.

@@ -195,6 +195,31 @@ metrics_export_path = ""          # Prometheus text-format dump file
 metrics_export_dt = 10.0          # [wall s] min interval between
                                   # metrics-export rewrites
 
+# ----- device observability + perf sentinel (obs/devprof.py)
+devprof_compile_telemetry = True  # per-compile trace/lower/backend
+                                  # duration histograms + cache hit/miss
+                                  # counters keyed to the CHUNKSTEPS
+                                  # ladder (host-side bookkeeping only)
+devprof_mem_dt = 0.0              # [wall s] min interval between
+                                  # live-bytes/peak watermark samples at
+                                  # chunk edges (0 = off; sampling walks
+                                  # jax.live_arrays(), so keep throttled)
+devprof_donation_check = False    # after a donating dispatch, count
+                                  # input buffers XLA failed to reuse
+                                  # (forces a host sync — debug only)
+perf_slo_factor = 0.0             # serving SLO watch: journal a
+                                  # perf_regression audit record when a
+                                  # worker's FF rate drops below
+                                  # factor * fleet median (0 = off;
+                                  # sensible values sit BELOW the
+                                  # hedge_rate_factor so hedging fires
+                                  # first and the journal explains why)
+bench_history_path = "BENCH_HISTORY.jsonl"
+                                  # append-only bench-row history every
+                                  # write_bench_json() call extends
+                                  # ("" = off); scripts/bench_history.py
+                                  # compares newest rows vs baseline
+
 _overrides = {}                   # file/CLI values for late-registered keys
 
 

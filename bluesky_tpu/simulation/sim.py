@@ -35,6 +35,7 @@ from ..core.noise import NoiseConfig
 from ..core.route import RouteManager
 from ..core.step import SimConfig
 from ..core.traffic import Traffic
+from ..obs import devprof as obs_devprof
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from .pipeline import ChunkEdge
@@ -343,6 +344,11 @@ class Simulation:
         #                              stays device-op-free by design)
         self._seq_dispatched = 0     # tag of the newest dispatch
         self._last_dispatch_end = None   # wall stamp: dispatch-gap series
+        # Device observability (ISSUE-12, obs/devprof.py): compile
+        # telemetry + memory watermarks + PROFILE DEVICE trace windows.
+        # Always present; every hook early-outs when its feature is off.
+        self.devprof = obs_devprof.DevProf(self.obs, self.recorder,
+                                           ladder=self.CHUNK_LADDER)
         self.dtmult = 1.0
         self.ffmode = False
         self.ffstop: Optional[float] = None
@@ -1222,8 +1228,10 @@ class Simulation:
                 and self.simt_planned >= self.ffstop - 1e-9:
             self._end_ff()
         # rate-limited Prometheus text dump (metrics_export_path knob;
-        # no-op when unset)
+        # no-op when unset) + throttled device-memory watermark sample
+        # (devprof_mem_dt knob; off by default)
         self.obs.maybe_export()
+        self.devprof.sample_memory()
 
     # ------------------------------------------------- chunk dispatch/edges
     def _sync_reasons(self, simt: float, chunk: int):
@@ -1289,11 +1297,33 @@ class Simulation:
                               epoch=self.mesh_epoch,
                               world=self.world_tag):
                     self.mesh_guard.check()
+            dp = self.devprof
+            win = dp.begin_chunk(seq)
+            t_h0 = time.perf_counter() if win else 0.0
             state = self._pre_dispatch_refresh(state, simt)
+            halo_s = (time.perf_counter() - t_h0) if win else 0.0
             from ..core.step import run_steps_edge, run_steps_edge_keep
             runner = run_steps_edge_keep if keep else run_steps_edge
+            nd = self.shard_mesh.shape["ac"] if self.shard_mesh else 1
+            dp.note_dispatch(
+                ("edge_keep" if keep else "edge")
+                + ("+checked" if self.guard.enabled else ""),
+                chunk, self.traf.nmax, nd)
             out = runner(state, self.cfg, chunk,
                          checked=self.guard.enabled)
+            if win:
+                # Attribution needs the device fence: block here so the
+                # compute section is the chunk alone, not whatever the
+                # host did next.  Serializes the pipeline for the few
+                # windowed chunks — documented PROFILE DEVICE cost.
+                import jax
+                t_c0 = time.perf_counter()
+                jax.block_until_ready(out)
+                dp.note_chunk(seq, chunk,
+                              (time.perf_counter() - t_c0) * 1e3,
+                              halo_s * 1e3)
+                if not keep:
+                    dp.check_donation(state)
         self._last_dispatch_end = time.perf_counter()
         return out
 
@@ -1525,6 +1555,7 @@ class Simulation:
         now = time.perf_counter()
         self.obs.get("sim_chunk_latency_ms").observe(
             (now - edge.t_dispatch) * 1e3)
+        self.devprof.note_edge(edge.seq, (now - t_ret0) * 1e3)
         rec = self.recorder
         if rec.enabled:
             rec.complete("chunk_edge", rec.wall_us(t_ret0),

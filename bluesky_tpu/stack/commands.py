@@ -1018,8 +1018,8 @@ def register_all(stack):
             return True, f"Trace written to {path}"
         return False, "TRACE [ON/OFF/DUMP]"
 
-    def profile(sub=None, arg=None):
-        """PROFILE START [dir] / STOP / KERNELS [nsteps] / TRACE ...
+    def profile(sub=None, arg=None, arg2=None):
+        """PROFILE START [dir] / STOP / KERNELS [nsteps] / DEVICE ...
         (jax.profiler trace + per-kernel timing report; TRACE is a
         synonym for the flight-recorder command)."""
         from ..utils import profiler
@@ -1032,6 +1032,31 @@ def register_all(stack):
             return True, "JAX trace stopped"
         if s == "TRACE":
             return tracecmd(arg)
+        if s == "DEVICE":
+            # ISSUE-12 device-trace window (obs/devprof.py): bracket the
+            # next n chunk dispatches with a jax.profiler trace and
+            # per-chunk compute/halo/edge attribution; the window is a
+            # device_profile recorder span tagged with the trace dir so
+            # scripts/devprof_report.py merges host + XLA timelines.
+            if sim.devprof.window_active:
+                return False, ("PROFILE DEVICE: a window is already "
+                               "active")
+            try:
+                n = int(float(arg)) if arg else 1
+            except (TypeError, ValueError):
+                return False, "PROFILE DEVICE [n_chunks] [dir]"
+            if n < 1:
+                return False, f"PROFILE DEVICE: need n >= 1, got {n}"
+            logdir = sim.devprof.request_window(n, arg2)
+            node = getattr(sim, "node", None)
+            if node is not None and getattr(node, "event_io", None) \
+                    is not None:
+                # journal the window server-side (audit record, ignored
+                # by replay's queue math)
+                node.send_event(b"DEVPROF", {"dir": logdir,
+                                             "chunks": n})
+            return True, (f"PROFILE DEVICE: tracing the next {n} "
+                          f"chunk(s) to {logdir}")
         if s == "KERNELS":
             if traf.ntraf == 0:
                 return False, "PROFILE KERNELS: no traffic"
@@ -1043,7 +1068,7 @@ def register_all(stack):
                 return False, "PROFILE DEEP: no traffic"
             return True, profiler.deep_report(sim)
         return False, ("PROFILE START [dir] / STOP / KERNELS [nsteps] "
-                       "/ DEEP / TRACE [ON/OFF/DUMP]")
+                       "/ DEEP / DEVICE [n] [dir] / TRACE [ON/OFF/DUMP]")
 
     def faultcmd(*args):
         """FAULT: chaos-injection harness (fault/harness.py) — poison
@@ -1158,7 +1183,8 @@ def register_all(stack):
                       f"{ps['sync_chunks']} sync"
                       + (", straggle STALLED"
                          if getattr(sim, 'straggle_stall', False)
-                         else "") + mesh_line)
+                         else "") + mesh_line
+                      + f"\ncompiles: {sim.devprof.compile_summary()}")
 
     def optcmd(tend=None, iters=None, lr=None, restarts=None):
         """OPT [tend,iters,lr,restarts]: gradient-based trajectory
@@ -1579,10 +1605,10 @@ def register_all(stack):
                     "2=HB conflict-geometry complexity; DUMP reads "
                     "the telemetry registry (sim + server + fleet)"],
         "PROFILE": ["PROFILE START [dir]/STOP/KERNELS [nsteps]/DEEP/"
-                    "TRACE [ON/OFF/DUMP]",
-                    "[txt,word]", profile,
-                    "JAX trace capture, per-kernel timings and the "
-                    "flight recorder"],
+                    "DEVICE [n] [dir]/TRACE [ON/OFF/DUMP]",
+                    "[txt,word,word]", profile,
+                    "JAX trace capture, per-kernel timings, device-"
+                    "trace windows and the flight recorder"],
         "TRACE": ["TRACE [ON/OFF/DUMP]", "[txt]", tracecmd,
                   "Flight recorder: bounded span ring dumped as "
                   "Perfetto trace JSON (readback bare)"],

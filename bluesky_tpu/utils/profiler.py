@@ -221,4 +221,19 @@ def deep_report(sim):
             f"  -> overhead floor {t['cd_all_inactive']:.3f} ms, "
             f"prefilter saves "
             f"{t['cd_unsorted'] - t['cd_sweep']:.3f} ms/sweep")
+    # ISSUE-12: device-memory watermarks (live/peak bytes per device,
+    # forced sample so the column appears even with devprof_mem_dt=0)
+    dp = getattr(sim, "devprof", None)
+    if dp is not None:
+        try:
+            dp.sample_memory(force=True)
+            wm = dp.watermarks()
+        except Exception:
+            wm = {}
+        if wm:
+            lines.append("  device memory (live / peak):")
+            for did in sorted(wm):
+                live, peak = wm[did]
+                lines.append(f"    dev{did}: {live / 1e6:8.2f} MB / "
+                             f"{peak / 1e6:8.2f} MB")
     return "\n".join(lines)

@@ -1,0 +1,187 @@
+"""Perf-regression sentinel over BENCH_HISTORY.jsonl (ISSUE-12).
+
+Every ``bench.write_bench_json`` call appends its measured rows to an
+append-only history file, one JSON line per row::
+
+    {"series": "BENCH_OBS", "ts": ..., "git_rev": "abc1234",
+     "platform": "cpu:cpu", "row": {...}}
+
+``compare`` groups the lines by (series, platform, row identity) —
+identity being the protocol fields that define *which* configuration a
+row measures (n, backend, geometry, worlds, chunk length, pipeline
+mode, ...) — and diffs each group's NEWEST row against the baseline
+built from the earlier rows (median per metric, so one noisy run
+doesn't poison the gate).  A metric that moved in its bad direction by
+more than ``--threshold`` is a regression: the run exits 1 and prints
+a structured report naming every regressed row.
+
+Run:
+    python scripts/bench_history.py compare [HISTORY.jsonl]
+        [--threshold 0.10] [--series BENCH_OBS] [--report out.json]
+    python scripts/bench_history.py list [HISTORY.jsonl]
+
+CI wires ``compare`` into the perf-smoke lane (non-blocking until the
+baseline has three green runs; see .github/workflows/ci.yml).
+"""
+import argparse
+import json
+import statistics
+import sys
+
+# Protocol fields that identify WHICH configuration a row measures —
+# rows only compare within a group that agrees on all of these.
+IDENTITY_FIELDS = ("n", "backend", "geometry", "worlds", "mode",
+                   "scenario", "nsteps_chunk", "nsteps", "chunk",
+                   "pipeline", "shard", "shard_devices", "protocol",
+                   "dense", "D")
+
+# Metric -> direction: +1 = higher is better, -1 = lower is better.
+METRICS = {
+    "ac_steps_per_s": +1,
+    "ac_steps_per_s_unguarded": +1,
+    "ac_steps_per_s_guarded": +1,
+    "x_realtime": +1,
+    "x_realtime_per_world": +1,
+    "speedup": +1,
+    "pairs_per_s_per_device": +1,
+    "overhead_pct": -1,
+    "wall_s": -1,
+    "wall_off_s": -1,
+    "wall_on_s": -1,
+    "bwd_over_fwd": -1,
+    "smooth_over_hard": -1,
+    "imbalance": -1,
+    "kernel_ms_dev": -1,
+}
+
+
+def load(path):
+    """Read the history file; bad lines are skipped with a warning so
+    one torn append can't disable the sentinel."""
+    entries = []
+    try:
+        with open(path) as f:
+            for i, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    e = json.loads(line)
+                except ValueError:
+                    print(f"{path}:{i}: unparseable line skipped",
+                          file=sys.stderr)
+                    continue
+                if isinstance(e, dict) and isinstance(e.get("row"),
+                                                      dict):
+                    entries.append(e)
+    except OSError as e:
+        print(f"cannot read {path}: {e}", file=sys.stderr)
+    return entries
+
+
+def identity(entry):
+    row = entry["row"]
+    ident = tuple((k, row[k]) for k in IDENTITY_FIELDS if k in row)
+    return (entry.get("series", "?"),
+            entry.get("platform", row.get("platform", "?")), ident)
+
+
+def group(entries):
+    groups = {}
+    for e in entries:
+        groups.setdefault(identity(e), []).append(e)
+    for g in groups.values():
+        g.sort(key=lambda e: e.get("ts", 0.0))
+    return groups
+
+
+def compare(entries, threshold=0.10, series=None):
+    """Newest row per group vs the median of the earlier rows.
+    Returns (regressions, checked_groups)."""
+    regressions, checked = [], 0
+    for (ser, platform, ident), g in sorted(group(entries).items()):
+        if series and ser != series:
+            continue
+        if len(g) < 2:
+            continue              # no baseline yet
+        newest, base = g[-1], g[:-1]
+        checked += 1
+        for metric, direction in METRICS.items():
+            nv = newest["row"].get(metric)
+            bvals = [b["row"][metric] for b in base
+                     if isinstance(b["row"].get(metric), (int, float))]
+            if not isinstance(nv, (int, float)) or not bvals:
+                continue
+            bv = statistics.median(bvals)
+            if not bv:
+                continue
+            change = (nv - bv) / abs(bv)
+            if change * direction < -threshold:
+                regressions.append({
+                    "series": ser, "platform": platform,
+                    "identity": dict(ident), "metric": metric,
+                    "baseline": bv, "newest": nv,
+                    "change_pct": round(change * 100.0, 1),
+                    "baseline_runs": len(bvals),
+                    "git_rev": newest.get("git_rev", "?"),
+                })
+    return regressions, checked
+
+
+def cmd_list(entries):
+    for (ser, platform, ident), g in sorted(group(entries).items()):
+        tag = " ".join(f"{k}={v}" for k, v in ident)
+        print(f"{ser:>20} [{platform}] {len(g):>3} run(s)  {tag}")
+    return 0
+
+
+def cmd_compare(entries, threshold, series, report_path):
+    regressions, checked = compare(entries, threshold, series)
+    report = {"checked_groups": checked,
+              "threshold_pct": round(threshold * 100.0, 1),
+              "regressions": regressions}
+    if report_path:
+        with open(report_path, "w") as f:
+            json.dump(report, f, indent=1)
+    if not regressions:
+        print(f"bench history OK: {checked} series group(s) within "
+              f"{threshold * 100:.0f}% of baseline")
+        return 0
+    print(f"PERF REGRESSION: {len(regressions)} metric(s) past the "
+          f"{threshold * 100:.0f}% gate", file=sys.stderr)
+    for r in regressions:
+        tag = " ".join(f"{k}={v}" for k, v in r["identity"].items())
+        print(f"  {r['series']} [{r['platform']}] {tag}: "
+              f"{r['metric']} {r['baseline']:g} -> {r['newest']:g} "
+              f"({r['change_pct']:+.1f}%, rev {r['git_rev']})",
+              file=sys.stderr)
+    print(json.dumps(report), file=sys.stderr)
+    return 1
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("command", choices=("compare", "list"))
+    ap.add_argument("history", nargs="?", default="BENCH_HISTORY.jsonl")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="fractional regression gate (default 0.10)")
+    ap.add_argument("--series", default=None,
+                    help="restrict to one series (e.g. BENCH_OBS)")
+    ap.add_argument("--report", default=None,
+                    help="write the structured JSON report here")
+    args = ap.parse_args(argv)
+
+    entries = load(args.history)
+    if not entries:
+        # An absent/empty history is not a regression — the sentinel
+        # has nothing to say until two runs of one series exist.
+        print(f"no history entries in {args.history}; nothing to do")
+        return 0
+    if args.command == "list":
+        return cmd_list(entries)
+    return cmd_compare(entries, args.threshold, args.series,
+                       args.report)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

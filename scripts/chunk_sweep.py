@@ -26,16 +26,10 @@ sys.path.insert(0, ".")
 import bench  # noqa: E402
 
 
-def _platform():
-    import jax
-    dev = jax.devices()[0]
-    return f"{dev.platform}:{getattr(dev, 'device_kind', '?')}"
-
-
 def main(n_ac=100_000, pipeline="both", total_steps=1000):
     modes = {"on": [True], "off": [False],
              "both": [False, True]}[pipeline]
-    plat = _platform()
+    plat = bench.platform_tag()
     rows = []
     for nsteps in (20, 100, 400, 1000):
         for pipe in modes:
@@ -58,7 +52,9 @@ def main(n_ac=100_000, pipeline="both", total_steps=1000):
 def merge_bench_file(rows, plat, path="BENCH_CHUNK_SWEEP.json"):
     """Replace this platform's rows in BENCH_CHUNK_SWEEP.json, keep the
     rest (the historical TPU sweep stays on record when re-running on
-    CPU and vice versa)."""
+    CPU and vice versa).  Writes through the shared bench writer; only
+    the NEW rows go to BENCH_HISTORY (the kept rows were recorded by
+    the run that measured them)."""
     old = []
     if os.path.isfile(path):
         try:
@@ -66,9 +62,12 @@ def merge_bench_file(rows, plat, path="BENCH_CHUNK_SWEEP.json"):
                 old = json.load(f)
         except (OSError, ValueError):
             old = []
+    if isinstance(old, dict):               # shared writer format
+        old = old.get("rows", [])
     kept = [r for r in old if r.get("platform", "tpu:v5e") != plat]
-    with open(path, "w") as f:
-        json.dump(kept + rows, f, indent=1)
+    bench.write_bench_json(path, kept + rows, history=False)
+    bench.append_history(os.path.splitext(os.path.basename(path))[0],
+                         rows, tag=plat)
 
 
 if __name__ == "__main__":

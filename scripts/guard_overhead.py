@@ -69,7 +69,6 @@ def run_pair(n_ac, nsteps=1000, reps=3, backend=None, geometry=None):
     t_plain = bench_fn(run_steps)
     t_guard = bench_fn(checked)
     rate = lambda t: n_ac * nsteps / t
-    platform = jax.devices()[0].platform
     return dict(
         n=n_ac, backend=backend, geometry=geometry,
         nsteps_chunk=nsteps,
@@ -77,7 +76,7 @@ def run_pair(n_ac, nsteps=1000, reps=3, backend=None, geometry=None):
         ac_steps_per_s_guarded=round(rate(t_guard), 1),
         overhead_pct=round(100.0 * (t_guard - t_plain) / t_plain, 2),
         protocol=(f"best-of-{reps}, host re-sort per chunk, "
-                  f"platform={platform}"),
+                  f"platform={jax.devices()[0].platform}"),
     )
 
 
@@ -88,12 +87,17 @@ def main(n_ac=100_000, nsteps=1000):
     if os.path.isfile("BENCH_GUARD.json"):
         with open("BENCH_GUARD.json") as f:
             rows = json.load(f)
+    if isinstance(rows, dict):              # shared writer format
+        rows = rows.get("rows", [])
     rows = [r for r in rows
             if (r["n"], r["nsteps_chunk"]) != (row["n"],
                                                row["nsteps_chunk"])]
     rows.append(row)
-    with open("BENCH_GUARD.json", "w") as f:
-        json.dump(rows, f, indent=1)
+    # shared writer: platform tag + {"rows": ...} shape; only the new
+    # row is history (the deduped survivors were recorded by their own
+    # runs)
+    bench.write_bench_json("BENCH_GUARD.json", rows, history=False)
+    bench.append_history("BENCH_GUARD", [row])
     os.makedirs("output", exist_ok=True)
     with open("output/guard_overhead.json", "w") as f:
         json.dump(rows, f, indent=1)

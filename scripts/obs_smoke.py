@@ -142,8 +142,10 @@ def main(argv=None):
         "protocol": f"best-of-{args.reps}, alternating off/on, "
                     f"platform={os.environ.get('JAX_PLATFORMS', '?')}",
     }
-    with open(args.out, "w") as f:
-        json.dump([row], f, indent=1)
+    # shared writer: platform tag + BENCH_HISTORY append (the perf
+    # sentinel's obs-overhead series)
+    import bench
+    bench.write_bench_json(args.out, [row])
     print(f"overhead: off {wall_off:.3f}s vs on {wall_on:.3f}s "
           f"= {overhead:+.2f}% -> {args.out}")
     if overhead > 5.0:

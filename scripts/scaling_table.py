@@ -126,10 +126,27 @@ def spatial_stats(lat, lon, gs, alt, vs, ndev, halo_blocks=0):
 
 
 def main():
+    import bench
+    out = bench.pop_out_flag(sys.argv, None)   # e.g. BENCH_SCALING.json
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
     ps_per_pair = 108e-12          # measured v5e pair cost (PERF_ANALYSIS)
     print(f"N = {n}; block {BLOCK}, s_cap {S_CAP}, wmax {WMAX}; "
           f"pair cost {ps_per_pair*1e12:.0f} ps (measured)")
+    out_rows = []
+
+    def record(geom, d, mode, mx, mean, wire_mb, occ):
+        out_rows.append({
+            "n": n, "geometry": geom, "D": d, "mode": mode,
+            "max_pairs_dev": float(mx), "mean_pairs_dev": float(mean),
+            "imbalance": round(float(mx / max(mean, 1)), 3),
+            "kernel_ms_dev": round(float(mx * ps_per_pair * 1e3), 3),
+            "wire_mb_dev": round(float(wire_mb), 3),
+            "occ": None if occ is None else round(float(occ), 3),
+            "protocol": ("schedule-measured on the real round-4 "
+                         "layout; kernel ms from the measured "
+                         f"{ps_per_pair*1e12:.0f} ps/pair v5e cost"),
+        })
+
     for geom in ("continental", "global", "regional"):
         fleet = make_fleet(n, geom)
         per_row, nb, n_over, _, _ = schedule_pairs_per_row(*fleet)
@@ -154,6 +171,8 @@ def main():
             print(f"{d:>3} {'replicate':>9} {mx:>14.3e} {mean:>14.3e} "
                   f"{mx/max(mean,1):>9.2f} {mx*ps_per_pair*1e3:>13.2f} "
                   f"{0.0 if d == 1 else repl_mb:>11.2f} {'-':>5}")
+            record(geom, d, "replicate", mx, mean,
+                   0.0 if d == 1 else repl_mb, None)
             if d == 1:
                 continue
             # SPATIAL: contiguous stripe ownership on the
@@ -166,6 +185,11 @@ def main():
                   f"{smx/max(smean,1):>9.2f} "
                   f"{smx*ps_per_pair*1e3:>13.2f} {wire_mb:>11.2f} "
                   f"{occ:>5.2f}")
+            record(geom, d, "spatial", smx, smean, wire_mb, occ)
+    if out:
+        # shared writer: platform tag + BENCH_HISTORY series so the
+        # perf sentinel watches schedule balance like any other bench
+        bench.write_bench_json(out, out_rows)
 
 
 if __name__ == "__main__":

@@ -122,7 +122,8 @@ def main():
     path = bench.pop_out_flag(sys.argv, os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "BENCH_WORLDS.json"))
-    if "--reproject" in sys.argv:
+    reproject = "--reproject" in sys.argv
+    if reproject:
         # refresh the calibrated projection/headline over the existing
         # measured rows without re-running the sweep
         old = json.load(open(path))
@@ -144,8 +145,11 @@ def main():
                      "lanes — see projected_chip_headline")
             if platform.startswith("cpu") else None,
         }
-    # shared tagging + writing boilerplate lives in bench.py now
-    bench.write_bench_json(path, rows,
+    # shared tagging + writing boilerplate lives in bench.py now; a
+    # reprojection re-derives headlines over rows that were already
+    # recorded, so it must not double-append to BENCH_HISTORY (keeps
+    # --reproject round-trips byte-identical on the JSON too)
+    bench.write_bench_json(path, rows, history=not reproject,
                            projected_chip_headline=chip_projection(),
                            measured_headline=measured)
 

@@ -168,6 +168,8 @@ class TestIntegrityGuard:
                              "BENCH_GUARD.json")
         with open(fname) as f:
             rows = json.load(f)
+        if isinstance(rows, dict):      # shared bench-writer format
+            rows = rows.get("rows", [])
         assert rows, "BENCH_GUARD.json is empty"
         for r in rows:
             for field in ("n", "backend", "geometry", "nsteps_chunk",

@@ -259,6 +259,37 @@ class TestRecorder:
         assert "chunk_dispatch" in row and "chunk_edge" in row
         assert row["events"] == ["chunk_voided"]
 
+    def test_overlapping_dumps_dedupe_on_merge(self, tmp_path):
+        """Two dumps of one ring overlap (dumps never clear the ring):
+        the throttled guard-trip auto-dump and a later manual TRACE
+        DUMP both carry the incident events.  trace_report.load must
+        fold the shared prefix to ONE copy of each event, so a chunk
+        never shows up twice in the merged table."""
+        rec = Recorder(maxlen=64)
+        rec.enable()
+        with rec.span("chunk_dispatch", seq=1, chunk=20):
+            pass
+        rec.instant("guard_trip", cat="sim", action="halt", seq=1)
+        p1 = tmp_path / "auto.json"
+        rec.dump(str(p1), reason="guard_trip")     # auto-dump snapshot
+        # the run continues; the later manual dump repeats both events
+        rec.complete("chunk_edge", rec.wall_us(), 40.0, seq=1,
+                     latency_ms=0.4)
+        with rec.span("chunk_dispatch", seq=2, chunk=20):
+            pass
+        p2 = tmp_path / "manual.json"
+        rec.dump(str(p2), reason="manual")
+        assert len(json.loads(p2.read_text())["traceEvents"]) == 4
+        import sys
+        sys.path.insert(0, "scripts")
+        import trace_report
+        events = trace_report.load([str(p1), str(p2)])
+        assert len(events) == 4            # 2 shared events folded
+        rows, loose = trace_report.chunk_table(events)
+        assert set(k[1] for k in rows) == {1, 2} and not loose
+        row1 = rows[next(k for k in rows if k[1] == 1)]
+        assert row1["events"] == ["guard_trip"]   # once, not twice
+
 
 # ------------------------------------------------------- sim instrumentation
 class TestSimInstrumentation:

@@ -379,6 +379,120 @@ class TestRateBasedHedging:
                 sock.close()
 
 
+class TestServingSLOWatch:
+    """ISSUE-12 serving-side perf sentinel: the SLO watch explains
+    (one audit record per slow (worker, piece)), hedging mitigates —
+    so it must fire with hedging OFF, flag exactly once, skip packs,
+    and leave exactly-once replay untouched."""
+
+    def _inject(self, s, factor=0.5):
+        """Three in-flight FF workers: a/b healthy, slow at ~1/9 the
+        median.  Returns (now, slow wid, slow piece)."""
+        now = time.monotonic()
+        s.perf_slo_factor = factor
+        a, b, slow = (make_id() for _ in range(3))
+        pieces = {}
+        for w, rate in ((a, 10.0), (b, 9.0), (slow, 1.0)):
+            piece = ([0.0], [f"SCEN {w.hex()[:4]}"])
+            pieces[w] = piece
+            s.workers[w] = 2
+            s.last_seen[w] = now
+            s.inflight[w] = piece
+            s.inflight_t[w] = now - 5.0        # past dispatch grace
+            s.worker_progress[w] = {
+                "simt": 1.0, "chunks": 1, "rate": rate, "t": now,
+                "advance_t": now, "state": 2, "ff": True}
+        return now, slow, pieces[slow]
+
+    def test_flags_once_and_journals_audit_record(self, tmp_path):
+        jpath = str(tmp_path / "slo.jsonl")
+        s = Server(headless=True, spawn_workers=False,
+                   journal_path=jpath, hb_interval=0.1,
+                   straggler_timeout=1.0, hedge_enabled=False)
+        try:
+            now, slow, piece = self._inject(s)
+            if s.journal:
+                s.journal.queued(piece)
+                s.journal.dispatched(piece, slow)
+            s._check_perf_slo(now)
+            assert s.perf_regressions == 1
+            assert s.hedges_started == 0       # explain, don't hedge
+            # once per (worker, piece): a second sweep stays quiet
+            s._check_perf_slo(time.monotonic())
+            assert s.perf_regressions == 1
+            recs = [r for r in _records(jpath)
+                    if r["rec"] == "perf_regression"]
+            assert len(recs) == 1
+            r = recs[0]
+            assert r["worker"] == slow.hex()
+            assert r["key"] == BatchJournal.piece_key(piece)
+            assert r["rate"] == 1.0 and r["baseline"] == 9.0
+            assert r["factor"] == 0.5
+            # HEALTH surfaces the watch
+            h = s.health_payload()
+            assert h["perf"]["slo_factor"] == 0.5
+            assert h["perf"]["regressions"] == 1
+            assert h["perf"]["recent"][0]["worker"] == slow.hex()
+            assert "perf: SLO watch 0.5x median" in h["text"]
+            assert "1 regression record(s)" in h["text"]
+        finally:
+            for sock in (s.fe_event, s.fe_stream, s.be_event,
+                         s.be_stream):
+                sock.close()
+            if s.journal:
+                s.journal.close()
+
+    def test_off_by_default_and_skips_packs(self, tmp_path):
+        from bluesky_tpu.network.server import WorldPack
+        s = Server(headless=True, spawn_workers=False, journal_path="",
+                   hb_interval=0.1, straggler_timeout=1.0)
+        try:
+            now, slow, piece = self._inject(s, factor=0.0)
+            s._check_perf_slo(now)             # factor 0 = watch off
+            assert s.perf_regressions == 0
+            # a pack's aggregate rate is not piece-comparable: skipped
+            s.perf_slo_factor = 0.5
+            s.inflight[slow] = WorldPack([(b"", piece), (b"", piece)])
+            s._check_perf_slo(time.monotonic())
+            assert s.perf_regressions == 0
+            assert "SLO watch OFF" not in s.health_payload()["text"]
+        finally:
+            for sock in (s.fe_event, s.fe_stream, s.be_event,
+                         s.be_stream):
+                sock.close()
+
+    def test_replay_surfaces_audit_without_touching_queue(self,
+                                                          tmp_path):
+        """perf_regression + device_profile records ride the journal
+        as pure audit: exactly-once (queued minus completed) is
+        unchanged, the SLO flags come back under perf_regressions."""
+        path = str(tmp_path / "j.jsonl")
+        piece = ([0.0], ["SCEN SLO1"])
+        j = BatchJournal(path)
+        j.queued(piece)
+        j.dispatched(piece, b"\x00AAAA")
+        j.perf_regression(piece, b"\x00AAAA", rate=0.5, baseline=9.0,
+                          factor=0.5)
+        j.device_profile(b"\x00AAAA", dir="/tmp/devprof", chunks=2)
+        j.completed(piece, b"\x00AAAA")
+        j.close()
+        st = BatchJournal.replay(path)
+        assert st["pending"] == [] and len(st["completed"]) == 1
+        (pr,) = st["perf_regressions"]
+        assert pr["key"] == BatchJournal.piece_key(piece)
+        assert pr["rate"] == 0.5 and pr["baseline"] == 9.0
+        # an unfinished flagged piece is still owed exactly one copy
+        path2 = str(tmp_path / "j2.jsonl")
+        j2 = BatchJournal(path2)
+        j2.queued(piece)
+        j2.dispatched(piece, b"\x00AAAA")
+        j2.perf_regression(piece, b"\x00AAAA", rate=0.5, baseline=9.0)
+        j2.close()
+        st2 = BatchJournal.replay(path2)
+        assert len(st2["pending"]) == 1 and not st2["completed"]
+        assert len(st2["perf_regressions"]) == 1
+
+
 class TestJournalHedgeReplay:
     P = ([0.0], ["SCEN H1"])
 

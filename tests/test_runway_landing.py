@@ -43,9 +43,13 @@ def test_dest_runway_creates_rwy_waypoint(sim):
 def test_landing_chain_fires(sim):
     """Fly onto the threshold: the chain must hold heading, decelerate
     after 10 s, and delete the aircraft after 42 s."""
+    # DTMULT lifts the OP-mode realtime pacing (DELAY timers are
+    # simt-scheduled, so the chain is unaffected) — without it this
+    # test sleeps ~180 wall seconds to cover 180 sim seconds
     for cmd in ("CRE KL1 B744 52.0 4.0 90 500 150",
                 "ALT KL1 0",
                 "DEST KL1 TEST/RW09",
+                "DTMULT 50",
                 "OP"):
         sim.stack.stack(cmd)
         sim.stack.process()
