@@ -724,10 +724,8 @@ def update_tiled(state: SimState, cfg: AsasConfig, block: int = 512,
             # caller slots for the gathered VO construction (one scatter
             # + two [N, K] gathers per interval).
             n = ac.lat.shape[0]
-            inv = cd_sched.slot_inverse(perm, n, n_tot)
-            pc = jnp.where(partners_s >= 0,
-                           inv[jnp.clip(partners_s, 0, n_tot)], -1)
-            ptable = pc[jnp.clip(perm, 0, n_tot - 1), :]
+            ptable = cd_sched.partners_to_caller(
+                perm, partners_s, n, n_tot)
             asas = ssd_resolve(asas, ptable)
         if cfg.reso_on and kern_reso == "swarm":
             # Whole swarm follows ASAS once any conflict triggered a

@@ -75,6 +75,14 @@ class SimConfig(NamedTuple):
     # time: bit-identical to the pre-relaxation step (tests/test_diff.py
     # pins this).  A NamedTuple of floats, so the config stays hashable.
     smooth: object = None
+    # In-scan telemetry (obs/scanstats.py): fold per-step device-side
+    # stats through the chunk-scan carry and emit them once per chunk
+    # as extra non-donated outputs next to EdgeTelemetry.  False — the
+    # default — takes the original scan code path at trace time
+    # (bit-identical HLO, pinned by obs_smoke's parity hash); True adds
+    # pure carry folds and ZERO host syncs or in-scan collectives
+    # (tests/test_hlo_collectives.py pins the collective budget).
+    scanstats: bool = False
 
 
 def step(state: SimState, cfg: SimConfig) -> SimState:
@@ -206,7 +214,38 @@ def _scan_steps(state: SimState, cfg: SimConfig, nsteps: int,
     """The ONE chunk-scan body every runner shares: ``checked`` folds
     the integrity guard into the carry (first-bad-step index, -1 clean).
     Single source of truth so the guard semantics measured by
-    guard_overhead.py are exactly the ones the sim runs."""
+    guard_overhead.py are exactly the ones the sim runs.
+
+    Returns ``(state, bad, stats)``: ``bad`` is None unless checked,
+    ``stats`` is None unless ``cfg.scanstats`` rides the in-scan
+    telemetry accumulators (obs/scanstats.py) through the carry.  The
+    flag is jit-static, so the False branch below IS the pre-scanstats
+    scan, character for character — identical traced HLO."""
+    if cfg.scanstats:
+        from ..obs import scanstats as ssmod
+        stats0 = ssmod.init(state, cfg)
+        if checked:
+            def body(carry, i):
+                s, bad, st = carry
+                s = step(s, cfg)
+                bad = jnp.where(bad >= 0, bad,
+                                jnp.where(state_finite(s), -1, i))
+                return (s, bad, ssmod.fold(st, s, cfg)), None
+
+            (state, bad, stats), _ = jax.lax.scan(
+                body, (state, jnp.full((), -1, jnp.int32), stats0),
+                jnp.arange(nsteps, dtype=jnp.int32))
+            return state, bad, stats
+
+        def body(carry, _):
+            s, st = carry
+            s = step(s, cfg)
+            return (s, ssmod.fold(st, s, cfg)), None
+
+        (state, stats), _ = jax.lax.scan(body, (state, stats0), None,
+                                         length=nsteps)
+        return state, None, stats
+
     if checked:
         def body(carry, i):
             s, bad = carry
@@ -218,13 +257,13 @@ def _scan_steps(state: SimState, cfg: SimConfig, nsteps: int,
         (state, bad), _ = jax.lax.scan(
             body, (state, jnp.full((), -1, jnp.int32)),
             jnp.arange(nsteps, dtype=jnp.int32))
-        return state, bad
+        return state, bad, None
 
     def body(s, _):
         return step(s, cfg), None
 
     state, _ = jax.lax.scan(body, state, None, length=nsteps)
-    return state, None
+    return state, None, None
 
 
 @partial(jax.jit, static_argnames=("cfg", "nsteps"), donate_argnums=0)
@@ -235,7 +274,7 @@ def run_steps(state: SimState, cfg: SimConfig, nsteps: int) -> SimState:
     (simulation.py:216-223) as a single device program: host syncs once per
     chunk, matching SURVEY.md §2.10's "lax.scan over k steps inside one jit".
     """
-    state, _ = _scan_steps(state, cfg, nsteps, checked=False)
+    state, _, _ = _scan_steps(state, cfg, nsteps, checked=False)
     return state
 
 
@@ -273,7 +312,8 @@ def run_steps_checked(state: SimState, cfg: SimConfig, nsteps: int):
     for free: the fault is pinned to one simdt without re-running the
     chunk.
     """
-    return _scan_steps(state, cfg, nsteps, checked=True)
+    state, bad, _ = _scan_steps(state, cfg, nsteps, checked=True)
+    return state, bad
 
 
 class EdgeTelemetry(NamedTuple):
@@ -337,8 +377,16 @@ def pack_telemetry(state: SimState, bad=None) -> EdgeTelemetry:
 
 def _edge_scan(state: SimState, cfg: SimConfig, nsteps: int,
                checked: bool):
-    state, bad = _scan_steps(state, cfg, nsteps, checked)
-    return state, pack_telemetry(state, bad)
+    """``(state, telemetry)`` — or ``(state, telemetry, stats)`` when
+    ``cfg.scanstats`` adds the in-scan accumulator pack.  The arity
+    pivots on a jit-STATIC flag, so each config key compiles one fixed
+    output pytree; the stats pack joins the telemetry as extra
+    non-donated outputs and rides the same lazy chunk-edge pull."""
+    state, bad, stats = _scan_steps(state, cfg, nsteps, checked)
+    telem = pack_telemetry(state, bad)
+    if stats is None:
+        return state, telem
+    return state, telem, stats
 
 
 @partial(jax.jit, static_argnames=("cfg", "nsteps", "checked"),
@@ -543,8 +591,43 @@ def _scan_steps_worlds(state: SimState, cfg: SimConfig, nsteps: int,
     """The chunk scan with a leading world axis: a scan of the batched
     step (ONE scan, the batch dim pushed into the body), with the
     integrity guard widened to a [W] vector of first-bad-step indices
-    (-1 clean) so a trip pins the (world, step) pair."""
+    (-1 clean) so a trip pins the (world, step) pair.
+
+    Same ``(state, bad, stats)`` contract as ``_scan_steps``; with
+    ``cfg.scanstats`` the accumulators get a leading [W] axis (vmapped
+    init/fold — worlds are single-device, so every fold stays the P=1
+    flavour) and demux per world via ``world_slice`` like telemetry."""
     vstep = lambda s: step_worlds(s, cfg)
+    if cfg.scanstats:
+        from ..obs import scanstats as ssmod
+        stats0 = jax.vmap(lambda s: ssmod.init(s, cfg))(state)
+        vfold = jax.vmap(lambda st, s: ssmod.fold(st, s, cfg))
+        if checked:
+            nworlds = state.simt.shape[0]
+            vfinite = jax.vmap(state_finite)
+
+            def body(carry, i):
+                s, bad, st = carry
+                s = vstep(s)
+                bad = jnp.where(bad >= 0, bad,
+                                jnp.where(vfinite(s), -1, i))
+                return (s, bad, vfold(st, s)), None
+
+            (state, bad, stats), _ = jax.lax.scan(
+                body, (state, jnp.full((nworlds,), -1, jnp.int32),
+                       stats0),
+                jnp.arange(nsteps, dtype=jnp.int32))
+            return state, bad, stats
+
+        def body(carry, _):
+            s, st = carry
+            s = vstep(s)
+            return (s, vfold(st, s)), None
+
+        (state, stats), _ = jax.lax.scan(body, (state, stats0), None,
+                                         length=nsteps)
+        return state, None, stats
+
     if checked:
         nworlds = state.simt.shape[0]
         vfinite = jax.vmap(state_finite)
@@ -559,13 +642,13 @@ def _scan_steps_worlds(state: SimState, cfg: SimConfig, nsteps: int,
         (state, bad), _ = jax.lax.scan(
             body, (state, jnp.full((nworlds,), -1, jnp.int32)),
             jnp.arange(nsteps, dtype=jnp.int32))
-        return state, bad
+        return state, bad, None
 
     def body(s, _):
         return vstep(s), None
 
     state, _ = jax.lax.scan(body, state, None, length=nsteps)
-    return state, None
+    return state, None, None
 
 
 @partial(jax.jit, static_argnames=("cfg", "nsteps"), donate_argnums=0)
@@ -575,7 +658,7 @@ def run_steps_worlds(state: SimState, cfg: SimConfig,
     nsteps in one compiled scan.  W=1 is bit-identical to the unbatched
     path (tests/test_worlds.py pins this)."""
     _check_worlds_cfg(cfg)
-    state, _ = _scan_steps_worlds(state, cfg, nsteps, checked=False)
+    state, _, _ = _scan_steps_worlds(state, cfg, nsteps, checked=False)
     return state
 
 
@@ -589,15 +672,19 @@ def run_steps_worlds_checked(state: SimState, cfg: SimConfig,
     host response (rollback/quarantine) stays per-world because the
     faulty (world, step) pair is pinned without re-running anything."""
     _check_worlds_cfg(cfg)
-    return _scan_steps_worlds(state, cfg, nsteps, checked=True)
+    state, bad, _ = _scan_steps_worlds(state, cfg, nsteps, checked=True)
+    return state, bad
 
 
 def _edge_scan_worlds(state: SimState, cfg: SimConfig, nsteps: int,
                       checked: bool):
-    state, bad = _scan_steps_worlds(state, cfg, nsteps, checked)
+    state, bad, stats = _scan_steps_worlds(state, cfg, nsteps, checked)
     if bad is None:
         bad = jnp.full((state.simt.shape[0],), -1, jnp.int32)
-    return state, jax.vmap(pack_telemetry)(state, bad)
+    telem = jax.vmap(pack_telemetry)(state, bad)
+    if stats is None:
+        return state, telem
+    return state, telem, stats
 
 
 @partial(jax.jit, static_argnames=("cfg", "nsteps", "checked"),

@@ -1121,7 +1121,8 @@ class Server(threading.Thread):
                 "t": now, "advance_t": now,
                 "state": data.get("state"),
                 "ff": bool(data.get("ff", False)),
-                "mesh": data.get("mesh")}
+                "mesh": data.get("mesh"),
+                "scan": data.get("scan")}
             return
         dt = now - prev["t"]
         if chunks > prev["chunks"] or simt > prev["simt"] + 1e-9:
@@ -1133,7 +1134,8 @@ class Server(threading.Thread):
         prev.update(simt=simt, chunks=chunks, t=now,
                     state=data.get("state"),
                     ff=bool(data.get("ff", False)),
-                    mesh=data.get("mesh", prev.get("mesh")))
+                    mesh=data.get("mesh", prev.get("mesh")),
+                    scan=data.get("scan", prev.get("scan")))
 
     def _check_stragglers(self, now):
         """Speculative straggler re-dispatch: an in-flight piece whose
@@ -1390,6 +1392,8 @@ class Server(threading.Thread):
                 w["stalled_for"] = round(now - prog["advance_t"], 3)
                 if isinstance(prog.get("mesh"), dict):
                     w["mesh"] = prog["mesh"]
+                if isinstance(prog.get("scan"), dict):
+                    w["scan"] = prog["scan"]
             workers[wid.hex()] = w
         # fleet mesh summary: the most advanced epoch any worker
         # reports (after a loss that is the worker that re-formed)
@@ -1400,6 +1404,12 @@ class Server(threading.Thread):
                     mesh is None
                     or m.get("epoch", 0) > mesh.get("epoch", 0)):
                 mesh = m
+        # fleet scan summary: worst case across workers (peaks max,
+        # minima min) — same reduction the worlds pack applies
+        from ..obs import scanstats as _scanstats
+        scan = _scanstats.merge_summaries(
+            [w["scan"] for w in workers.values()
+             if isinstance(w.get("scan"), dict)])
         data = {
             "queue_depth": len(self.scenarios),
             "queue_limit": self.batch_queue_max,
@@ -1438,6 +1448,8 @@ class Server(threading.Thread):
         }
         if mesh is not None:
             data["mesh"] = mesh
+        if scan is not None:
+            data["scan"] = scan
         data["text"] = self._health_text(data)
         return data
 
@@ -1474,6 +1486,16 @@ class Server(threading.Thread):
                 f"mode {m.get('mode', 'off')}, last refresh "
                 f"{m.get('last_refresh_ms', 0):g} ms"
                 + (" [DEGRADED]" if m.get("degraded") else ""))
+        sc = d.get("scan")
+        if sc:
+            ms = sc.get("min_sep_m")
+            lines.append(
+                f"sim: in-scan conflicts peak {sc.get('conf_peak', 0)}"
+                f"/mean {sc.get('conf_mean', 0):g}, LoS peak "
+                f"{sc.get('los_peak', 0)}, min sep "
+                + (f"{ms:g} m" if ms is not None else "n/a")
+                + f", clamp-sat {sc.get('clamp_sat_ratio', 0):.1%}, "
+                  f"occ peak {sc.get('occ_peak', 0)}")
         p = d.get("perf")
         if p:
             med = p.get("fleet_median_rate")
@@ -1501,6 +1523,9 @@ class Server(threading.Thread):
             if isinstance(wm, dict) and wm.get("mode", "off") != "off":
                 line += (f", mesh e{wm.get('epoch', 0)} "
                          f"D{wm.get('devices', 0)} {wm.get('mode')}")
+            ws = w.get("scan")
+            if isinstance(ws, dict) and ws.get("steps"):
+                line += (f", scan conf-peak {ws.get('conf_peak', 0)}")
             lines.append(line)
         return "\n".join(lines)
 

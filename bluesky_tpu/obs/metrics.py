@@ -98,6 +98,24 @@ class Histogram:
         self.sum += v
         self.count += 1
 
+    def add_counts(self, counts, sum=0.0):
+        """Fold a pre-bucketed count vector (same ladder + overflow
+        layout) into this histogram — the scanstats drain path, where
+        the device already histogrammed per-step values with
+        ``searchsorted(side='left')`` (the exact ``bisect_left`` rule
+        ``observe`` uses), so bucket counts merge count-exactly."""
+        if len(counts) != len(self.counts):
+            raise ValueError(
+                f"{self.name}: add_counts got {len(counts)} buckets, "
+                f"ladder has {len(self.counts)}")
+        n = 0
+        for i, c in enumerate(counts):
+            c = int(c)
+            self.counts[i] += c
+            n += c
+        self.sum += float(sum)
+        self.count += n
+
     @property
     def mean(self):
         return self.sum / self.count if self.count else 0.0
@@ -253,9 +271,14 @@ class Registry:
     # ------------------------------------------------------------ export
     def prometheus_text(self):
         """Prometheus exposition-format dump (text/plain version 0.0.4,
-        cumulative ``le`` buckets)."""
+        cumulative ``le`` buckets).  Series are emitted in sorted-name
+        order — NOT registry insertion order, which varies with the
+        code path that first touched each series (lazily-registered
+        series like the scanstats drain would otherwise reshuffle the
+        file between scrapes) — so consecutive ``export()`` files diff
+        cleanly (tests/test_obs.py pins the ordering)."""
         lines = []
-        for m in self:
+        for m in sorted(self, key=lambda m: m.name):
             if isinstance(m, Counter):
                 lines.append(f"# TYPE {m.name} counter")
                 lines.append(f"{m.name} {m.value:g}")

@@ -1,0 +1,374 @@
+"""In-scan telemetry: device-side stats folded through the compiled step.
+
+``EdgeTelemetry`` (core/step.py) packs only the FINAL step's values, so
+a 1000-step FF chunk exposes 0.1% of the simulated dynamics to
+METRICS/HEALTH/the recorder — conflict bursts, closest-approach minima
+and envelope saturation between edges are lost.  ``ScanStats`` closes
+that gap the way large-scale simulators instrument in-kernel counters
+(QarSUMO's per-step congestion statistics, D-AWSIM's per-partition
+occupancy telemetry): a small accumulator pytree rides the chunk-scan
+CARRY, folded once per step from the post-step state, and is emitted
+once per chunk as extra non-donated outputs next to the telemetry pack.
+Zero host syncs are added inside the scan; the host pulls the pack at
+the chunk edge it already retires.
+
+Contracts (tests/test_scanstats.py, tests/test_hlo_collectives.py):
+
+* **Off path is free.**  The fold only exists behind the hashable
+  ``SimConfig.scanstats`` static flag; with it False the chunk scan
+  traces the exact pre-existing HLO (the obs_smoke parity hash pins the
+  stepped state bit-identical either way — folding never writes state).
+* **Fold-exact.**  Every field is a sum/min/max/histogram fold, so a
+  20-step chunk's stats equal the reduction of twenty 1-step-chunk
+  packs (``reduce_packs``) bit-exactly: counts are int32, mins/maxes
+  are order-free, and int sums are associative.
+* **No new collectives.**  Scalar folds (conflict/LoS counts) consume
+  ``asas.nconf_cur``/``nlos_cur``, which the sharded CD kernels already
+  reduce; per-aircraft folds stay ``[P]`` PER-DEVICE PARTIALS via a
+  ``reshape(P, nmax // P)`` row split that GSPMD keeps local (shards
+  align with rows), reduced host-side after the edge pull.  Pair-gather
+  stats (``min_sep_m``) are computed only when ``cd_mesh is None`` —
+  a gather into a sharded array would lower to an all-gather — and
+  report +inf under a mesh (documented in docs/OBSERVABILITY.md).
+
+Semantics under sharding: ``engaged_peak``/``occ_peak`` are per-partial
+peaks over the chunk.  A peak of a global sum is NOT derivable from
+per-device peaks (max_t of a sum != sum of max_t), so the host-side
+``sum`` over partials is exact single-device and an upper bound on the
+fleet-wide peak under spatial stripes — per-stripe peaks themselves are
+the capacity-ladder signal (ROADMAP items 1-2, 5).
+"""
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+#: Per-step conflict/LoS count bucket ladder (upper bounds; one extra
+#: overflow bucket on device and in the registry histogram).  Fine at
+#: the low end — HEALTH cares whether a chunk saw 0, a couple, or a
+#: burst of conflicts — log-spaced into large-N territory.
+COUNT_BUCKETS = (0.0, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0,
+                 500.0, 1000.0, 2000.0, 5000.0)
+
+#: Saturation epsilons: ``perf.limits`` CLIPS, so a saturated command
+#: sits exactly on the bound up to the CAS<->TAS round-trip error; the
+#: epsilon only needs to cover float noise, not physics.
+SAT_EPS_MS = 0.05        # [m/s] CAS round-trip tolerance at vmin/vmax
+SAT_EPS_M = 0.5          # [m] altitude tolerance at hmax
+
+_RE_M = 6371000.0        # mean-earth radius for the flat-earth distance
+
+
+class ScanStats(NamedTuple):
+    """Per-chunk accumulator pytree (the scan-carry resident).
+
+    Scalar fields fold values that are already replicated under any
+    shard mode; ``[P]`` fields are per-device partials (P = mesh size
+    when a device mesh divides nmax, else 1) reduced host-side.
+    """
+    steps: jnp.ndarray           # [] int32 — steps folded
+    conf_peak: jnp.ndarray       # [] int32 — max per-step conflict count
+    conf_sum: jnp.ndarray        # [] int32 — sum of per-step counts
+    conf_hist: jnp.ndarray       # [B+1] int32 — bucketed per-step counts
+    los_peak: jnp.ndarray        # [] int32
+    los_sum: jnp.ndarray         # [] int32
+    los_hist: jnp.ndarray        # [B+1] int32
+    engaged_peak: jnp.ndarray    # [P] int32 — peak resolver-engaged rows
+    occ_peak: jnp.ndarray        # [P] int32 — peak per-stripe occupancy
+    clamp_sat: jnp.ndarray       # [P] int32 — envelope-saturated row-steps
+    live_rowsteps: jnp.ndarray   # [P] int32 — live row-steps (denominator)
+    min_sep_m: jnp.ndarray       # [P] f32 — min engaged-pair separation
+    headroom_min_m: jnp.ndarray  # [P] f32 — min live-row (hmax - alt)
+
+
+#: Host-side reduction schema (``reduce_packs`` + the fold oracle).
+SUM_FIELDS = ("steps", "conf_sum", "conf_hist", "los_sum", "los_hist",
+              "clamp_sat", "live_rowsteps")
+MAX_FIELDS = ("conf_peak", "los_peak", "engaged_peak", "occ_peak")
+MIN_FIELDS = ("min_sep_m", "headroom_min_m")
+
+
+def n_partials(cfg, nmax: int) -> int:
+    """How many per-device partials the ``[P]`` folds keep: the mesh
+    size when a device mesh is configured and divides nmax (the row
+    split then aligns with the 'ac' shards, so per-partial reductions
+    stay local), else 1.  A non-dividing mesh is refused — the sharded
+    preparation paths guarantee divisibility, so this only fires on a
+    hand-built config."""
+    mesh = cfg.cd_mesh
+    if mesh is None:
+        return 1
+    p = int(dict(mesh.shape).get(cfg.cd_mesh_axis, 1))
+    if p <= 1:
+        return 1
+    if nmax % p:
+        raise ValueError(
+            f"scanstats: nmax={nmax} is not divisible by the "
+            f"{p}-device mesh — per-device partial folds need "
+            "shard-aligned rows (prepare_spatial guarantees this)")
+    return p
+
+
+def init(state, cfg) -> ScanStats:
+    """Fresh accumulators for one chunk (built INSIDE the jitted chunk
+    program, so every chunk folds from zero and chunk packs merge by
+    ``reduce_packs``)."""
+    p = n_partials(cfg, int(state.ac.active.shape[-1]))
+    nb = len(COUNT_BUCKETS) + 1
+    z = jnp.zeros((), jnp.int32)
+    zp = jnp.zeros((p,), jnp.int32)
+    inf_p = jnp.full((p,), jnp.inf, jnp.float32)
+    return ScanStats(
+        steps=z, conf_peak=z, conf_sum=z,
+        conf_hist=jnp.zeros((nb,), jnp.int32),
+        los_peak=z, los_sum=z,
+        los_hist=jnp.zeros((nb,), jnp.int32),
+        engaged_peak=zp, occ_peak=zp, clamp_sat=zp, live_rowsteps=zp,
+        min_sep_m=inf_p, headroom_min_m=inf_p)
+
+
+def _dist_m(lat1, lon1, lat2, lon2):
+    """Flat-earth (equirectangular) horizontal separation [m] — the
+    deterministic cheap metric the fold uses everywhere (CD's own
+    predicates stay authoritative for detection; this only ranks)."""
+    coslat = jnp.cos(jnp.radians(0.5 * (lat1 + lat2)))
+    dx = jnp.radians(lon2 - lon1) * coslat * _RE_M
+    dy = jnp.radians(lat2 - lat1) * _RE_M
+    return jnp.hypot(dx, dy)
+
+
+def _partner_min_sep(ac, idx):
+    """[N] per-row min separation to the listed partner rows (-1 =
+    empty slot); +inf where nothing is engaged."""
+    n = ac.lat.shape[0]
+    j = jnp.clip(idx, 0, n - 1)
+    valid = (idx >= 0) & ac.active[:, None] & ac.active[j]
+    d = _dist_m(ac.lat[:, None], ac.lon[:, None], ac.lat[j], ac.lon[j])
+    return jnp.min(jnp.where(valid, d, jnp.inf), axis=1)
+
+
+def _min_sep(state, cfg, p: int):
+    """[P] per-partial min separation among ENGAGED pairs (the pairs
+    the resolver tracks — updated at CD cadence while positions move
+    every step, so the fold captures the true closest approach between
+    ASAS intervals).  Computed only single-device: partner gathers into
+    a sharded row axis would lower to all-gathers, so any ``cd_mesh``
+    reports +inf (docs/OBSERVABILITY.md catalogues the limitation)."""
+    inf = jnp.full((p,), jnp.inf, jnp.float32)
+    if cfg.cd_mesh is not None or not cfg.asas.swasas:
+        return inf
+    ac, asas = state.ac, state.asas
+    if cfg.cd_backend == "dense":
+        if asas.resopairs.size == 0:
+            return inf
+        mask = asas.resopairs & ac.active[:, None] & ac.active[None, :]
+        d = _dist_m(ac.lat[:, None], ac.lon[:, None],
+                    ac.lat[None, :], ac.lon[None, :])
+        row = jnp.min(jnp.where(mask, d, jnp.inf), axis=1)
+    elif cfg.cd_backend == "sparse":
+        # sorted-space partner table -> caller rows (the SSD branch's
+        # translation, shared via ops/cd_sched.partners_to_caller)
+        from ..ops import cd_sched
+        n = ac.lat.shape[0]
+        n_tot = asas.partners_s.shape[0]
+        ptable = cd_sched.partners_to_caller(
+            asas.sort_perm, asas.partners_s, n, n_tot)
+        row = _partner_min_sep(ac, ptable)
+    else:                          # tiled / pallas: caller-space table
+        if asas.partners.size == 0:
+            return inf
+        row = _partner_min_sep(ac, asas.partners)
+    row = jnp.where(ac.active, row, jnp.inf)
+    return jnp.min(row.reshape(p, -1), axis=1).astype(jnp.float32)
+
+
+def fold(stats: ScanStats, state, cfg) -> ScanStats:
+    """One step's fold (post-step state -> accumulators).  Pure
+    reductions into the carry: no host syncs, no state writes, and no
+    cross-device traffic beyond what the step itself already does."""
+    from ..ops import aero
+    p = stats.occ_peak.shape[0]
+    ac, asas = state.ac, state.asas
+    part = lambda x: x.reshape(p, -1)
+
+    # --- replicated scalar folds (counts the CD kernels already reduce)
+    nconf = asas.nconf_cur.astype(jnp.int32)
+    nlos = asas.nlos_cur.astype(jnp.int32)
+    bounds = jnp.asarray(COUNT_BUCKETS, jnp.float32)
+    ci = jnp.searchsorted(bounds, nconf.astype(jnp.float32), side="left")
+    li = jnp.searchsorted(bounds, nlos.astype(jnp.float32), side="left")
+
+    # --- [P] per-partial folds (row split aligned with 'ac' shards)
+    live = ac.active
+    occ = jnp.sum(part(live), axis=1, dtype=jnp.int32)
+    engaged = jnp.sum(part(asas.active & live), axis=1, dtype=jnp.int32)
+    # envelope saturation: pilot targets are post-``perf.limits`` CLIPS,
+    # so a binding envelope leaves the commanded CAS/alt ON the bound —
+    # re-derive CAS from the arbitrated (allowed) TAS and compare
+    cas_cmd = aero.vtas2cas(state.pilot.tas, state.pilot.alt)
+    sat = live & ((cas_cmd <= state.perf.vmin + SAT_EPS_MS)
+                  | (cas_cmd >= state.perf.vmax - SAT_EPS_MS)
+                  | (state.pilot.alt >= state.perf.hmax - SAT_EPS_M))
+    nsat = jnp.sum(part(sat), axis=1, dtype=jnp.int32)
+    headroom = jnp.where(live, state.perf.hmax - ac.alt, jnp.inf)
+    hr_min = jnp.min(part(headroom), axis=1).astype(jnp.float32)
+    sep = _min_sep(state, cfg, p)
+
+    return ScanStats(
+        steps=stats.steps + 1,
+        conf_peak=jnp.maximum(stats.conf_peak, nconf),
+        conf_sum=stats.conf_sum + nconf,
+        conf_hist=stats.conf_hist.at[ci].add(1),
+        los_peak=jnp.maximum(stats.los_peak, nlos),
+        los_sum=stats.los_sum + nlos,
+        los_hist=stats.los_hist.at[li].add(1),
+        engaged_peak=jnp.maximum(stats.engaged_peak, engaged),
+        occ_peak=jnp.maximum(stats.occ_peak, occ),
+        clamp_sat=stats.clamp_sat + nsat,
+        live_rowsteps=stats.live_rowsteps + occ,
+        min_sep_m=jnp.minimum(stats.min_sep_m, sep),
+        headroom_min_m=jnp.minimum(stats.headroom_min_m, hr_min))
+
+
+# ------------------------------------------------------------------ host side
+
+def reduce_packs(packs):
+    """Merge host-side chunk packs into one: sums add, peaks max, mins
+    min — the edge-side reduction of the per-device/per-chunk partials,
+    and the oracle's 'twenty 1-step chunks == one 20-step chunk'."""
+    packs = list(packs)
+    if not packs:
+        raise ValueError("reduce_packs: need at least one pack")
+    out = {}
+    for f in SUM_FIELDS:
+        out[f] = np.sum([np.asarray(getattr(q, f)) for q in packs],
+                        axis=0)
+    for f in MAX_FIELDS:
+        out[f] = np.max([np.asarray(getattr(q, f)) for q in packs],
+                        axis=0)
+    for f in MIN_FIELDS:
+        out[f] = np.min([np.asarray(getattr(q, f)) for q in packs],
+                        axis=0)
+    return ScanStats(**out)
+
+
+def summarize(pack) -> dict:
+    """Edge-side reduction of one host pack to the HEALTH/heartbeat
+    summary: partials collapse here (sum/max/min over [P]), non-finite
+    mins map to None so the dict stays JSON/msgpack-clean."""
+    steps = int(np.asarray(pack.steps))
+    live = int(np.sum(np.asarray(pack.live_rowsteps)))
+    sat = int(np.sum(np.asarray(pack.clamp_sat)))
+    occ = np.asarray(pack.occ_peak)
+    min_sep = float(np.min(np.asarray(pack.min_sep_m)))
+    headroom = float(np.min(np.asarray(pack.headroom_min_m)))
+    return {
+        "steps": steps,
+        "conf_peak": int(np.asarray(pack.conf_peak)),
+        "conf_mean": round(float(np.asarray(pack.conf_sum))
+                           / max(steps, 1), 3),
+        "los_peak": int(np.asarray(pack.los_peak)),
+        # sum of per-partial peaks: exact single-device, an upper bound
+        # on the fleet-wide instantaneous peak under spatial stripes
+        "engaged_peak": int(np.sum(np.asarray(pack.engaged_peak))),
+        "occ_peak": int(np.max(occ)) if occ.size else 0,
+        "occ_imbalance": round(float(np.max(occ))
+                               / max(float(np.mean(occ)), 1e-9), 3)
+        if occ.size > 1 and float(np.mean(occ)) > 0 else 1.0,
+        "clamp_sat_ratio": round(sat / live, 6) if live else 0.0,
+        "min_sep_m": round(min_sep, 1) if np.isfinite(min_sep) else None,
+        "alt_headroom_min_m": round(headroom, 1)
+        if np.isfinite(headroom) else None,
+    }
+
+
+def merge_summaries(summaries):
+    """Worst-case merge of ``summarize`` dicts across worlds/workers
+    (the heartbeat + fleet-HEALTH reduction): steps add, peaks and
+    alert ratios take the worst offender, minima take the closest
+    call; the mean re-weights by steps so busy chunks dominate."""
+    summaries = [s for s in summaries if s]
+    if not summaries:
+        return None
+    steps = sum(int(s.get("steps", 0)) for s in summaries)
+    wmean = (sum(float(s.get("conf_mean", 0.0))
+                 * int(s.get("steps", 0)) for s in summaries)
+             / steps) if steps else 0.0
+
+    def _max(key):
+        return max((s.get(key) or 0) for s in summaries)
+
+    def _min(key):
+        vals = [s[key] for s in summaries if s.get(key) is not None]
+        return min(vals) if vals else None
+
+    return {
+        "steps": steps, "conf_peak": _max("conf_peak"),
+        "conf_mean": round(wmean, 3), "los_peak": _max("los_peak"),
+        "engaged_peak": _max("engaged_peak"),
+        "occ_peak": _max("occ_peak"),
+        "occ_imbalance": _max("occ_imbalance"),
+        "clamp_sat_ratio": _max("clamp_sat_ratio"),
+        "min_sep_m": _min("min_sep_m"),
+        "alt_headroom_min_m": _min("alt_headroom_min_m"),
+    }
+
+
+#: Registry series the drain feeds (docs/OBSERVABILITY.md catalogue).
+#: Counters/histograms ship fleet-wide through the existing heartbeat
+#: ``Registry.delta()`` path and add exactly; gauges are last-chunk.
+SERIES_HELP = {
+    "sim_scan_conf_per_step": "per-step conflict count (in-scan fold)",
+    "sim_scan_los_per_step": "per-step LoS count (in-scan fold)",
+    "sim_scan_steps": "steps folded by in-scan telemetry",
+    "sim_scan_clamp_sat_rowsteps":
+        "live row-steps with a binding perf envelope clamp",
+    "sim_scan_live_rowsteps": "live row-steps folded (ratio denominator)",
+    "sim_scan_conf_peak": "last chunk's peak per-step conflict count",
+    "sim_scan_los_peak": "last chunk's peak per-step LoS count",
+    "sim_scan_engaged_peak": "last chunk's peak resolver-engaged rows",
+    "sim_scan_occupancy_peak": "last chunk's peak per-stripe occupancy",
+    "sim_scan_min_sep_m": "last chunk's min engaged-pair separation [m]",
+    "sim_scan_alt_headroom_min_m":
+        "last chunk's min live-row ceiling headroom [m]",
+    "sim_scan_clamp_sat_ratio":
+        "last chunk's clamp-saturated fraction of live row-steps",
+}
+
+
+def drain(reg, pack) -> dict:
+    """Fold one chunk's host pack into a metrics Registry: histogram
+    bucket counts merge count-exactly (``Histogram.add_counts``),
+    totals ride counters (fleet-mergeable), last-chunk reductions land
+    in gauges.  Returns the ``summarize`` dict (HEALTH / heartbeat)."""
+    s = summarize(pack)
+    if s["steps"] == 0:
+        return s
+    hlp = SERIES_HELP
+    reg.histogram("sim_scan_conf_per_step", buckets=COUNT_BUCKETS,
+                  help=hlp["sim_scan_conf_per_step"]).add_counts(
+        np.asarray(pack.conf_hist).tolist(),
+        float(np.asarray(pack.conf_sum)))
+    reg.histogram("sim_scan_los_per_step", buckets=COUNT_BUCKETS,
+                  help=hlp["sim_scan_los_per_step"]).add_counts(
+        np.asarray(pack.los_hist).tolist(),
+        float(np.asarray(pack.los_sum)))
+    reg.counter("sim_scan_steps", help=hlp["sim_scan_steps"]).inc(
+        s["steps"])
+    reg.counter("sim_scan_clamp_sat_rowsteps",
+                help=hlp["sim_scan_clamp_sat_rowsteps"]).inc(
+        int(np.sum(np.asarray(pack.clamp_sat))))
+    reg.counter("sim_scan_live_rowsteps",
+                help=hlp["sim_scan_live_rowsteps"]).inc(
+        int(np.sum(np.asarray(pack.live_rowsteps))))
+    g = lambda name, v: reg.gauge(name, help=hlp[name]).set(v)
+    g("sim_scan_conf_peak", s["conf_peak"])
+    g("sim_scan_los_peak", s["los_peak"])
+    g("sim_scan_engaged_peak", s["engaged_peak"])
+    g("sim_scan_occupancy_peak", s["occ_peak"])
+    g("sim_scan_clamp_sat_ratio", s["clamp_sat_ratio"])
+    if s["min_sep_m"] is not None:
+        g("sim_scan_min_sep_m", s["min_sep_m"])
+    if s["alt_headroom_min_m"] is not None:
+        g("sim_scan_alt_headroom_min_m", s["alt_headroom_min_m"])
+    return s

@@ -115,6 +115,19 @@ def slot_inverse(perm, n, n_tot, fill=-1):
         jnp.clip(perm, 0, n_tot)].set(jnp.arange(n, dtype=jnp.int32))
 
 
+def partners_to_caller(perm, partners_s, n, n_tot):
+    """Translate a sorted-space partner table ``partners_s``
+    [n_tot, K] into a caller-space [n, K] table (-1 = empty), the
+    composition the sparse SSD-resolve branch performs: partner slot
+    ids map through ``slot_inverse`` and each caller row i reads the
+    row of its own slot ``perm[i]``.  Shared by core/asas (resolver
+    partner plumbing) and obs/scanstats (min-separation fold)."""
+    inv = slot_inverse(perm, n, n_tot)
+    pc = jnp.where(partners_s >= 0,
+                   inv[jnp.clip(partners_s, 0, n_tot)], -1)
+    return pc[jnp.clip(perm, 0, n_tot - 1), :]
+
+
 def reach_threshold_m(gs, active, tlookahead, rpz):
     """Worst-case reach radius [m]: the exact conservative CD bound at
     fleet-max closing speed (used to size stripes; per-block thresholds

@@ -165,16 +165,24 @@ def sharded_step_fn(mesh: Mesh, cfg: SimConfig, nsteps: int = 1):
     shardings; the Pallas backends ('pallas', 'sparse') additionally
     need the mesh itself for their shard_map row split, so it is filled
     into the config here (see ``ops/cd_sched.detect_resolve_sched``).
+
+    With ``cfg.scanstats`` the compiled program returns ``(state,
+    ScanStats)`` instead of bare state: the in-scan accumulators ride
+    the same scan carry (obs/scanstats.py) with their per-aircraft
+    folds kept as [ndev] per-device partials — GSPMD keeps the
+    row-split reductions shard-local, so the stats add ZERO in-scan
+    collectives (tests/test_hlo_collectives.py pins ON vs OFF equal).
     """
     if cfg.cd_backend in ("pallas", "sparse") and cfg.cd_mesh is None \
             and "ac" in mesh.shape:
         cfg = cfg._replace(cd_mesh=mesh, cd_mesh_axis="ac")
 
     def run(state):
-        def body(s, _):
-            return step(s, cfg), None
-        out, _ = jax.lax.scan(body, state, None, length=nsteps)
-        return out
+        from ..core.step import _scan_steps
+        out, _, stats = _scan_steps(state, cfg, nsteps, checked=False)
+        if stats is None:
+            return out
+        return out, stats
 
     return jax.jit(run, donate_argnums=0)
 

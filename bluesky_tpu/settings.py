@@ -194,6 +194,14 @@ metrics_export_path = ""          # Prometheus text-format dump file
                                   # processes each export their own).
 metrics_export_dt = 10.0          # [wall s] min interval between
                                   # metrics-export rewrites
+scanstats = False                 # in-scan telemetry: fold per-step
+                                  # device-side stats (conflict/LoS
+                                  # histograms, clamp saturation, min
+                                  # separation, stripe occupancy)
+                                  # through the chunk scan carry and
+                                  # drain them at each chunk edge.
+                                  # SCANSTATS stack command toggles at
+                                  # runtime; off traces identical HLO.
 
 # ----- device observability + perf sentinel (obs/devprof.py)
 devprof_compile_telemetry = True  # per-compile trace/lower/backend

@@ -40,8 +40,15 @@ class ChunkEdge:
 
     def __init__(self, telemetry, chunk: int,
                  simt_planned: Optional[float] = None,
-                 seq: int = -1, obs_sink=None):
+                 seq: int = -1, obs_sink=None, stats=None):
         self._telemetry = telemetry
+        # in-scan telemetry pack (obs/scanstats.ScanStats device pytree)
+        # when SimConfig.scanstats was on for the producing chunk; it
+        # rides the edge object so the drain happens at retirement,
+        # after the same completion fence as the guard word.  Must be
+        # set HERE, not lazily — __getattr__ forwards unknown names to
+        # the telemetry pack.
+        self.stats = stats
         self.chunk = int(chunk)
         self._simt_planned = simt_planned
         self._np = None

@@ -312,6 +312,14 @@ class Simulation:
         self._simt_next = 0.0        # predicted clock after that chunk
         self._last_edge = None       # newest retired edge (ACDATA cache)
         self._retiring = False       # reentrancy guard for drains
+        # In-scan telemetry (ISSUE-14, obs/scanstats.py): per-step
+        # device-side stats folded through the chunk scan, drained at
+        # each edge.  Settings knob at startup; the SCANSTATS stack
+        # command toggles at runtime (the flag is jit-static, so each
+        # value compiles its own chunk program).
+        if bool(getattr(_pipe_settings, "scanstats", False)):
+            self.cfg = self.cfg._replace(scanstats=True)
+        self._scan_last = None       # newest drained chunk summary dict
         # Observability (ISSUE-11, docs/OBSERVABILITY.md): a PER-SIM
         # metrics registry (two sims in one process — tests, W-world
         # packs — must not mix series) + the per-process flight
@@ -605,7 +613,11 @@ class Simulation:
         self.areas.reset()
         self.cond.reset()
         self.routes = RouteManager(self.traf, self.routes.wmax)
-        self.cfg = SimConfig()
+        # scanstats is an observability knob, not scenario state (like
+        # the TRACE recorder): the runtime toggle survives RESET while
+        # the rest of the config rebuilds to defaults
+        self.cfg = SimConfig(scanstats=self.cfg.scanstats)
+        self._scan_last = None
         # traf.reset rebuilt default-shape tables on the default device
         self.shard_mode, self.shard_mesh = "off", None
         self.shard_stats = {}
@@ -865,6 +877,30 @@ class Simulation:
                     last_refresh_ms=round(float(self._mesh_refresh_ms),
                                           3),
                     degraded=bool(self.mesh_degraded))
+
+    def scan_health(self):
+        """The HEALTH ``sim`` section: in-scan telemetry enablement plus
+        the newest drained chunk's summary (obs/scanstats.summarize) —
+        chunk-peak conflicts, min closest approach, clamp-saturation
+        ratio.  Pure host state: no device reads."""
+        d = dict(scanstats=bool(self.cfg.scanstats))
+        if self._scan_last is not None:
+            d.update(self._scan_last)
+        return d
+
+    def set_scanstats(self, on: bool) -> bool:
+        """Toggle in-scan telemetry.  Drains the pipeline first (the
+        in-flight chunk was compiled with the OLD flag and its edge
+        must retire under it); the next dispatch compiles the new chunk
+        program.  Returns True if the flag changed."""
+        on = bool(on)
+        if on == bool(self.cfg.scanstats):
+            return False
+        self.drain_pipeline()
+        self.cfg = self.cfg._replace(scanstats=on)
+        if not on:
+            self._scan_last = None
+        return True
 
     # ----------------------------------------------------- preempt/autosave
     def request_preempt(self):
@@ -1273,7 +1309,9 @@ class Simulation:
         """Enqueue the (due) spatial-sort refresh and the chunk program
         back-to-back — both are async dispatches with no host readback
         between them, so a re-sort edge costs one extra enqueue instead
-        of a host round-trip.  Returns ``(state, telemetry)`` futures.
+        of a host round-trip.  Returns ``(state, telemetry, stats)``
+        futures — ``stats`` is the in-scan accumulator pack when
+        ``cfg.scanstats`` is on, else None.
 
         ``keep=True`` selects the non-donating runner: the caller needs
         the *input* state buffers to stay valid (snapshot-ring capture
@@ -1325,7 +1363,13 @@ class Simulation:
                 if not keep:
                     dp.check_donation(state)
         self._last_dispatch_end = time.perf_counter()
-        return out
+        # Normalized return: (state, telemetry, scanstats-or-None) —
+        # the runner's output arity follows the static cfg.scanstats
+        # flag (core/step._edge_scan), the callers always see three.
+        if self.cfg.scanstats:
+            return out
+        state, telem = out
+        return state, telem, None
 
     def _next_seq(self) -> int:
         """Bump and return the host-side chunk-sequence correlation tag
@@ -1405,7 +1449,7 @@ class Simulation:
                              and self.guard.policy == "rollback")
                             or self.shard_mode != "off"))
         state_in = self.traf.state
-        new_state, telem = self._dispatch_chunk(
+        new_state, telem, sstats = self._dispatch_chunk(
             state_in, chunk, keep=capture_now, simt=simt)
         self.traf.state = new_state
         self._step_count += chunk
@@ -1414,7 +1458,8 @@ class Simulation:
         self._pending_edge = ChunkEdge(telem, chunk,
                                        simt_planned=self._simt_next,
                                        seq=self._seq_dispatched,
-                                       obs_sink=self._edge_pull_sink)
+                                       obs_sink=self._edge_pull_sink,
+                                       stats=sstats)
         self.pipe_stats["pipelined_chunks"] += 1
         if pend is not None:
             self._finish_edge(
@@ -1425,12 +1470,12 @@ class Simulation:
         then run every edge subsystem against the live state — the
         pre-pipeline behavior, bit-identical step math."""
         self.pipe_stats["sync_chunks"] += 1
-        state, telem = self._dispatch_chunk(self.traf.state, chunk,
-                                            keep=False, simt=simt)
-        self._apply_chunk_result(state, telem, chunk)
+        state, telem, sstats = self._dispatch_chunk(
+            self.traf.state, chunk, keep=False, simt=simt)
+        self._apply_chunk_result(state, telem, chunk, stats=sstats)
 
     def _apply_chunk_result(self, state, telem, chunk: int,
-                            seq: Optional[int] = None):
+                            seq: Optional[int] = None, stats=None):
         """Install one synchronously-completed chunk's result and run
         every edge subsystem against it — the post-dispatch half of
         ``_step_sync``.  The multi-world runner calls this per world
@@ -1444,7 +1489,8 @@ class Simulation:
         if seq is None:
             seq = self._seq_dispatched
         edge = ChunkEdge(telem, chunk,      # device clock, no prediction
-                         seq=seq, obs_sink=self._edge_pull_sink)
+                         seq=seq, obs_sink=self._edge_pull_sink,
+                         stats=stats)
         t_ret0 = time.perf_counter()
         tripped = False
         if self.guard.enabled:
@@ -1463,6 +1509,10 @@ class Simulation:
         # hooks can mutate traffic DIRECTLY, so a due hook clears it
         # explicitly after the subsystem block.
         self._last_edge = None if tripped else edge
+        # Drain the in-scan stats pack only off a CLEAN edge: a tripped
+        # chunk's accumulators are downstream of the poisoned step.
+        if not tripped:
+            self._drain_scanstats(edge)
         plugins_due = self.plugins.has_due(self.simt)
 
         # Chunk-edge subsystems: plugin updates, conditional triggers,
@@ -1532,6 +1582,7 @@ class Simulation:
                 self._pending_edge._simt_planned = self._simt_next
         # Passive consumers: each samples the edge state from the pack
         # (ONE bulk device->host copy, and only if somebody reads).
+        self._drain_scanstats(edge)
         self.metrics.update(edge)
         if self.traf.trails.active:
             pack = edge.fetch()
@@ -1547,6 +1598,33 @@ class Simulation:
                                    simt=edge.simt)
         self._last_edge = edge
         self._edge_retired(edge, t_ret0)
+
+    def _drain_scanstats(self, edge):
+        """Drain one clean edge's in-scan accumulator pack (ISSUE-14):
+        ONE device->host pull of the small ScanStats pytree, folded
+        into the registry (histogram bucket counts merge count-exactly,
+        so the series ship fleet-wide through the existing heartbeat
+        ``Registry.delta()`` path) and summarized for HEALTH/heartbeat
+        consumption; a recorder event carries the summary under the
+        chunk's correlation tag.  No-op when the edge carries no pack
+        (scanstats off for the producing chunk)."""
+        if edge.stats is None:
+            return
+        import jax as _jax
+        from ..obs import scanstats as ssmod
+        t0 = time.perf_counter()
+        pack = _jax.device_get(edge.stats)
+        summary = ssmod.drain(self.obs, pack)
+        self._scan_last = summary
+        rec = self.recorder
+        if rec.enabled:
+            rec.complete("scanstats", rec.wall_us(t0),
+                         (time.perf_counter() - t0) * 1e6,
+                         seq=edge.seq, chunk=edge.chunk,
+                         world=self.world_tag,
+                         conf_peak=summary.get("conf_peak"),
+                         min_sep_m=summary.get("min_sep_m"),
+                         clamp_sat_ratio=summary.get("clamp_sat_ratio"))
 
     def _edge_retired(self, edge, t_ret0: float):
         """Book one retired edge into the registry + recorder: the

@@ -149,6 +149,14 @@ def _make_simnode_class(base):
                 obs = self.worlds.obs_delta()
                 if obs:
                     info["obs"] = obs
+                # worst-case scan summary across the pack's worlds
+                # (peaks max, minima min) — host dicts only, no device
+                # reads, same contract as the single-sim branch below
+                scans = [s._scan_last for s in self.worlds.sims
+                         if s._scan_last is not None]
+                if scans:
+                    from ..obs import scanstats as _ss
+                    info["scan"] = _ss.merge_summaries(scans)
                 return info
             # "ff" gates the server's RATE-based hedging: sim-s/wall-s
             # is only comparable across workers running full speed — a
@@ -165,6 +173,11 @@ def _make_simnode_class(base):
             # the fleet's shard state without a round-trip per worker
             if sim.shard_mode != "off" or sim.mesh_epoch > 0:
                 info["mesh"] = sim.mesh_health()
+            # in-scan telemetry summary (newest drained chunk): a host
+            # dict stamped at the chunk edge — reading the device here
+            # would block the loop exactly like the planned-clock note
+            if sim.cfg.scanstats and sim._scan_last is not None:
+                info["scan"] = sim._scan_last
             # fleet telemetry: ship the metric increments since the
             # last heartbeat; the server merges them into its fleet
             # registry (METRICS DUMP shows the aggregate)

@@ -172,8 +172,15 @@ class WorldBatch:
                           chunk=chunk, nworlds=len(members),
                           worlds=[i for i, s, c, t in members],
                           seqs=seqs):
-                wstate, telem = run_steps_worlds_edge(
+                out = run_steps_worlds_edge(
                     stack_worlds(states), cfg, chunk, checked=checked)
+            # arity follows the static cfg.scanstats flag (same group
+            # key -> same arity); the [W]-leading accumulator pack
+            # demuxes per world exactly like the telemetry pack
+            if cfg.scanstats:
+                wstate, telem, wstats = out
+            else:
+                (wstate, telem), wstats = out, None
             self.stats["joint_dispatches"] += 1
             self.stats["worlds_stepped"] += len(members)
             self.stats["max_group"] = max(self.stats["max_group"],
@@ -189,7 +196,9 @@ class WorldBatch:
                 sim.pipe_stats["sync_chunks"] += 1
                 sim._apply_chunk_result(world_slice(wstate, k),
                                         world_slice(telem, k), chunk,
-                                        seq=seqs[k])
+                                        seq=seqs[k],
+                                        stats=None if wstats is None
+                                        else world_slice(wstats, k))
                 sim._after_chunk()
                 self._drain_echo(i)
                 self._maybe_finish(i)

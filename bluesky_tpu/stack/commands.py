@@ -1176,6 +1176,22 @@ def register_all(stack):
                          f"{mh['devices']} device(s), mode {mh['mode']}"
                          f", last refresh {mh['last_refresh_ms']:g} ms"
                          + (" [DEGRADED]" if mh["degraded"] else ""))
+        sh = sim.scan_health()
+        sim_line = ""
+        if sh.get("scanstats"):
+            if sh.get("steps"):
+                ms = sh.get("min_sep_m")
+                sim_line = (
+                    f"\nsim: last chunk {sh['steps']} steps, conflicts "
+                    f"peak {sh['conf_peak']}/mean {sh['conf_mean']:g}, "
+                    f"LoS peak {sh['los_peak']}, min sep "
+                    + (f"{ms:g} m" if ms is not None else "n/a")
+                    + f", clamp-sat {sh['clamp_sat_ratio']:.1%}"
+                    + f", occ peak {sh['occ_peak']}"
+                    + (f" (imbalance {sh['occ_imbalance']:g}x)"
+                       if sh.get("occ_imbalance", 1.0) != 1.0 else ""))
+            else:
+                sim_line = "\nsim: scanstats ON (no chunk drained yet)"
         return True, (f"detached sim: state {sim.state_flag}, simt "
                       f"{sim.simt_planned:.1f} s, {traf.ntraf} aircraft, "
                       f"{sim._step_count} steps done, chunks "
@@ -1183,8 +1199,40 @@ def register_all(stack):
                       f"{ps['sync_chunks']} sync"
                       + (", straggle STALLED"
                          if getattr(sim, 'straggle_stall', False)
-                         else "") + mesh_line
+                         else "") + mesh_line + sim_line
                       + f"\ncompiles: {sim.devprof.compile_summary()}")
+
+    def scanstatscmd(flag=None):
+        """SCANSTATS [ON/OFF]: in-scan telemetry — per-step device-side
+        stats (conflict/LoS histograms, resolver engagement, envelope
+        clamp saturation, min separation, stripe occupancy) folded
+        through the chunk scan and drained at every edge.  Bare call
+        reads back state + the newest chunk summary."""
+        if flag is None:
+            sh = sim.scan_health()
+            if not sh.get("scanstats"):
+                return True, "SCANSTATS OFF"
+            if not sh.get("steps"):
+                return True, "SCANSTATS ON (no chunk drained yet)"
+            ms = sh.get("min_sep_m")
+            hr = sh.get("alt_headroom_min_m")
+            return True, (
+                f"SCANSTATS ON: last chunk {sh['steps']} steps, "
+                f"conflicts peak {sh['conf_peak']}/mean "
+                f"{sh['conf_mean']:g}, LoS peak {sh['los_peak']}, "
+                f"engaged peak {sh['engaged_peak']}, min sep "
+                + (f"{ms:g} m" if ms is not None else "n/a")
+                + ", headroom "
+                + (f"{hr:g} m" if hr is not None else "n/a")
+                + f", clamp-sat {sh['clamp_sat_ratio']:.1%}, occ peak "
+                  f"{sh['occ_peak']}")
+        on = str(flag).upper() in ("ON", "TRUE", "1", "YES")
+        changed = sim.set_scanstats(on)
+        state = "ON" if on else "OFF"
+        return True, (f"SCANSTATS {state}"
+                      + ("" if changed else " (unchanged)")
+                      + (": next dispatch compiles the stats-carrying "
+                         "chunk program" if changed and on else ""))
 
     def optcmd(tend=None, iters=None, lr=None, restarts=None):
         """OPT [tend,iters,lr,restarts]: gradient-based trajectory
@@ -1625,6 +1673,9 @@ def register_all(stack):
                   "[txt,txt,txt]", shardcmd,
                   "Multi-chip mode: replicated columns or spatial "
                   "latitude-stripe decomposition (readback bare)"],
+        "SCANSTATS": ["SCANSTATS [ON/OFF]", "[txt]", scanstatscmd,
+                      "In-scan telemetry: per-step device-side stats "
+                      "folded through the chunk scan (readback bare)"],
         "SNAPSHOT": ["SNAPSHOT SAVE/LOAD fname", "txt,[word]", snapshot,
                      "Save/restore a binary state snapshot"],
         "WORLDS": ["WORLDS [ON/OFF | MAX n]", "[txt,txt]", worldscmd,

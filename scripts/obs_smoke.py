@@ -1,17 +1,18 @@
 """CI obs-smoke: the ISSUE-11 observability contract, measured.
 
-Two halves:
+Two halves, run twice (flight recorder, then ISSUE-14 scanstats):
 
-1. Parity — the flight recorder and metrics registry are host-side
-   only: a run with the recorder ENABLED must produce a bit-identical
-   stepped state to a run with it disabled (the instrumentation adds
-   zero device ops).  Hash mismatch is a hard failure.
+1. Parity — the instrumentation is carry/host-side only: a run with
+   the recorder ENABLED must produce a bit-identical stepped state to
+   a run with it disabled (zero added device ops), and a run with
+   SCANSTATS on must match both (the accumulator folds read state,
+   never write it).  Hash mismatch is a hard failure.
 
-2. Overhead — best-of-reps wall time for the same scenario with the
-   recorder off vs on.  The contract is <2% added wall; the CI lane
-   flags (non-blocking) above 5% because shared runners are noisy.
-   Rows land in BENCH_OBS.json; a sample merged Perfetto trace is
-   written next to it so every PR ships an openable timeline.
+2. Overhead — best-of-reps wall time for the same scenario with each
+   instrument off vs on.  The contract is <2% added wall; the CI lane
+   flags above 5% because shared runners are noisy.  A row pair per
+   instrument lands in BENCH_OBS.json; a sample merged Perfetto trace
+   is written next to it so every PR ships an openable timeline.
 
 Exit 0 on success, 1 on parity failure or >5% measured overhead.
 
@@ -59,7 +60,7 @@ def build(nmax=64):
     return sim
 
 
-def run_once(trace: bool, until=20.0):
+def run_once(trace: bool, until=20.0, scanstats=False):
     from bluesky_tpu.obs.trace import get_recorder
     rec = get_recorder()
     rec.clear()
@@ -68,6 +69,8 @@ def run_once(trace: bool, until=20.0):
     else:
         rec.disable()
     sim = build()
+    if scanstats:
+        sim.set_scanstats(True)
     t0 = time.perf_counter()
     sim.run(until_simt=until, max_iters=2000)
     wall = time.perf_counter() - t0
@@ -87,11 +90,25 @@ def main(argv=None):
 
     # warmup: pays every jit compile so the timed reps hit cache
     run_once(False)
+    run_once(False, scanstats=True)
 
-    # ---- parity: recorder on must not change the stepped state
+    # ---- parity: recorder on must not change the stepped state, and
+    # the scanstats fold (pure carry reads) must not either — all
+    # three hashes are the ISSUE-11/14 off-path bit-identity contract
     sim_off, _ = run_once(False)
+    h_off = state_hash(sim_off)
+    sim_ss, _ = run_once(False, scanstats=True)
+    h_ss = state_hash(sim_ss)
+    assert h_ss == h_off, (
+        f"scanstats on/off state hash diverged:\n"
+        f"  off {h_off}\n  on  {h_ss}")
+    assert sim_ss._scan_last is not None \
+        and sim_ss.obs.get("sim_scan_steps") is not None, \
+        "scanstats run drained no accumulator pack"
+    # the recorder run goes LAST: run_once clears the ring, and the
+    # sample-trace section below dumps this run's events
     sim_on, _ = run_once(True)
-    h_off, h_on = state_hash(sim_off), state_hash(sim_on)
+    h_on = state_hash(sim_on)
     assert h_off == h_on, (
         f"recorder on/off state hash diverged:\n"
         f"  off {h_off}\n  on  {h_on}")
@@ -122,16 +139,23 @@ def main(argv=None):
     rec.disable()
     rec.clear()
 
-    # ---- overhead: alternate off/on reps, keep the best of each
-    wall_off, wall_on = np.inf, np.inf
+    # ---- overhead: alternate off/on reps per instrument, keep the
+    # best of each (recorder row pair + scanstats row pair)
+    wall_off = wall_on = wall_ss = np.inf
     for _ in range(args.reps):
         _, w = run_once(False)
         wall_off = min(wall_off, w)
         _, w = run_once(True)
         wall_on = min(wall_on, w)
+        _, w = run_once(False, scanstats=True)
+        wall_ss = min(wall_ss, w)
     overhead = (wall_on - wall_off) / wall_off * 100.0
-    row = {
+    overhead_ss = (wall_ss - wall_off) / wall_off * 100.0
+    proto = (f"best-of-{args.reps}, alternating off/on, "
+             f"platform={os.environ.get('JAX_PLATFORMS', '?')}")
+    rows = [{
         "scenario": "obs_smoke 4-aircraft FF to simt=20",
+        "instrument": "recorder",
         "reps": args.reps,
         "wall_off_s": round(wall_off, 4),
         "wall_on_s": round(wall_on, 4),
@@ -139,18 +163,34 @@ def main(argv=None):
         "trace_events": n_events,
         "chunks": int(n_chunks),
         "parity": "bit-identical",
-        "protocol": f"best-of-{args.reps}, alternating off/on, "
-                    f"platform={os.environ.get('JAX_PLATFORMS', '?')}",
-    }
+        "protocol": proto,
+    }, {
+        "scenario": "obs_smoke 4-aircraft FF to simt=20",
+        "instrument": "scanstats",
+        "reps": args.reps,
+        "wall_off_s": round(wall_off, 4),
+        "wall_on_s": round(wall_ss, 4),
+        "overhead_pct": round(overhead_ss, 2),
+        "chunks": int(n_chunks),
+        "parity": "bit-identical",
+        "protocol": proto,
+    }]
     # shared writer: platform tag + BENCH_HISTORY append (the perf
     # sentinel's obs-overhead series)
     import bench
-    bench.write_bench_json(args.out, [row])
-    print(f"overhead: off {wall_off:.3f}s vs on {wall_on:.3f}s "
-          f"= {overhead:+.2f}% -> {args.out}")
+    bench.write_bench_json(args.out, rows)
+    print(f"recorder overhead: off {wall_off:.3f}s vs on "
+          f"{wall_on:.3f}s = {overhead:+.2f}% -> {args.out}")
+    print(f"scanstats overhead: off {wall_off:.3f}s vs on "
+          f"{wall_ss:.3f}s = {overhead_ss:+.2f}% -> {args.out}")
+    bad = []
     if overhead > 5.0:
-        print("OBS SMOKE: overhead above the 5% CI flag line",
-              file=sys.stderr)
+        bad.append(f"recorder {overhead:+.2f}%")
+    if overhead_ss > 5.0:
+        bad.append(f"scanstats {overhead_ss:+.2f}%")
+    if bad:
+        print("OBS SMOKE: overhead above the 5% CI flag line: "
+              + ", ".join(bad), file=sys.stderr)
         return 1
     print("obs smoke OK")
     return 0

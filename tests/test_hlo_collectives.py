@@ -138,6 +138,47 @@ def test_spatial_interval_collectives():
         f"column budget {90 * n_tot} B"
 
 
+def test_scanstats_adds_no_collectives():
+    """ISSUE-14 acceptance: turning ``SimConfig.scanstats`` on must add
+    ZERO collectives to the compiled spatial chunk scan.  The scalar
+    folds consume counts the kernels already reduce, and the [P]
+    per-aircraft folds are a shard-aligned row split GSPMD keeps local
+    — so the (op, dtype, shape) multiset of collectives in the ON
+    program equals the OFF program exactly."""
+    import jax.numpy as jnp
+    from bluesky_tpu.core.step import SimConfig
+    from bluesky_tpu.core.traffic import Traffic
+
+    mesh = sharding.make_mesh(8)
+    rng = np.random.default_rng(7)
+    nmax, n = 4096, 1200
+    traf = Traffic(nmax=nmax, dtype=jnp.float32, pair_matrix=False)
+    traf.create(n, "B744", rng.uniform(3000, 11000, n),
+                rng.uniform(130, 240, n), None,
+                rng.uniform(35, 60, n), rng.uniform(-10, 30, n),
+                rng.uniform(0, 360, n))
+    traf.flush()
+    cfg = SimConfig(cd_backend="sparse", cd_block=256,
+                    cd_shard_mode="spatial")
+    st, _, info = sharding.prepare_spatial(traf.state, mesh, cfg.asas)
+    cfg = cfg._replace(cd_halo_blocks=info["halo_blocks"])
+
+    def colls_for(c):
+        # 21 steps: one full CD interval inside the scan at dtasas=1 s
+        comp = sharding.sharded_step_fn(mesh, c, nsteps=21).lower(
+            st).compile()
+        return sorted((op, dtype, shape)
+                      for op, dtype, shape, _ in _collectives(
+                          comp.as_text()))
+
+    off = colls_for(cfg)
+    on = colls_for(cfg._replace(scanstats=True))
+    assert off, "spatial chunk program must contain halo collectives"
+    assert on == off, (
+        "scanstats changed the collective set:\n"
+        f"  off {off}\n  on  {on}")
+
+
 def test_sharded_sparse_interval_collectives():
     mesh = sharding.make_mesh(8)
     st = sharding.shard_state(make_mixed_scene(), mesh)

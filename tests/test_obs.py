@@ -163,6 +163,46 @@ class TestRegistry:
         assert 'lat_ms_bucket{le="+Inf"} 3' in txt
         assert "lat_ms_count 3" in txt
 
+    def test_prometheus_text_order_is_registration_independent(self):
+        """Exported files must diff cleanly between scrapes: series are
+        emitted in sorted-name order regardless of which code path
+        registered them first.  Lazily-registered series (the scanstats
+        drain registers on the first drained chunk) would otherwise
+        reshuffle the whole file mid-run."""
+        def fill(reg, names):
+            for n in names:
+                if n.startswith("h_"):
+                    reg.histogram(n, buckets=(1.0, 10.0)).observe(5.0)
+                elif n.startswith("g_"):
+                    reg.gauge(n).set(2)
+                else:
+                    reg.counter(n).inc(3)
+        names = ["c_steps", "h_lat", "g_depth", "c_chunks", "h_conf"]
+        a, b = Registry(), Registry()
+        fill(a, names)
+        fill(b, names[::-1])         # reversed registration order
+        assert a.prometheus_text() == b.prometheus_text()
+        emitted = [ln.split()[2] for ln in
+                   a.prometheus_text().splitlines()
+                   if ln.startswith("# TYPE")]
+        assert emitted == sorted(emitted)
+
+    def test_histogram_add_counts_merges_exactly(self):
+        """``add_counts`` (the scanstats drain path) must be count-
+        equivalent to observing the same values: bucket counts, total
+        count and sum all merge exactly — and a mis-sized vector is
+        refused, never silently misaligned."""
+        obs = Histogram("x", buckets=(1.0, 10.0))
+        for v in (0.5, 5.0, 5.0, 50.0):
+            obs.observe(v)
+        dev = Histogram("x", buckets=(1.0, 10.0))
+        dev.add_counts([1, 2, 1], sum=60.5)
+        assert dev.counts == obs.counts
+        assert dev.count == obs.count
+        assert dev.sum == pytest.approx(obs.sum)
+        with pytest.raises(ValueError):
+            dev.add_counts([1, 2])
+
     def test_export_atomic(self, tmp_path):
         reg = Registry()
         reg.counter("c").inc()
