@@ -230,7 +230,7 @@ def run_one(n_ac, backend=None, geometry=None, nsteps=1000, reps=3):
 
 def run_chunked(n_ac, backend=None, geometry=None, chunk=20,
                 total_steps=1000, pipeline=True, reps=3, shard="off",
-                shard_devices=0):
+                shard_devices=0, inscan=False):
     """Multi-chunk protocol with per-chunk-edge host work — the
     production ``Simulation.step`` loop's cost model, measurable with
     the pipeline on or off.
@@ -245,11 +245,17 @@ def run_chunked(n_ac, backend=None, geometry=None, chunk=20,
     carries the host-edge overhead breakdown: ``dispatch_gap_s`` (host
     time spent enqueueing work per run) and ``telemetry_pull_s`` (host
     time blocked reading the guard word + pack).
+
+    ``inscan=True`` (sparse backend only, ISSUE 15) folds the sort
+    refresh INTO the compiled chunk: no host refresh dispatch at the
+    edge, the due gate chained across chunks via the RefreshPack's
+    ``sort_t`` device scalar — the production SORTREFRESH ON loop.
     """
     import jax
     import jax.numpy as jnp
     from bluesky_tpu.core.asas import impl_for_backend, refresh_spatial_sort
-    from bluesky_tpu.core.step import SimConfig, run_steps_edge
+    from bluesky_tpu.core.step import (SimConfig, inscan_refresh_active,
+                                       run_steps_edge)
 
     backend = backend or _pick_backend(n_ac)
     geometry = geometry or ("continental" if n_ac > 16384 else "regional")
@@ -285,6 +291,11 @@ def run_chunked(n_ac, backend=None, geometry=None, chunk=20,
                 cfg = cfg._replace(cd_mesh=mesh, cd_mesh_axis="ac")
             state = shd.shard_state(state, mesh)
     nchunks = max(1, total_steps // chunk)
+    if inscan:
+        cfg = cfg._replace(inscan_refresh=True)
+        if not inscan_refresh_active(cfg):
+            raise SystemExit("--inscan needs the sparse backend "
+                             f"(got {backend!r})")
 
     def resort(st):
         if shard == "spatial":
@@ -302,8 +313,19 @@ def run_chunked(n_ac, backend=None, geometry=None, chunk=20,
         int(telem.bad)
         jax.device_get(telem)
 
+    def dispatch(st, sort_t):
+        # one chunk edge: host refresh + dispatch (classic), or the
+        # refresh-carrying program with the chained device sort_t
+        if inscan:
+            st, telem, rpack = run_steps_edge(st, cfg, chunk,
+                                              checked=True,
+                                              sort_t0=sort_t)
+            return st, telem, rpack.sort_t
+        st, telem = run_steps_edge(resort(st), cfg, chunk, checked=True)
+        return st, telem, None
+
     # warmup/compile
-    state, telem = run_steps_edge(resort(state), cfg, chunk, checked=True)
+    state, telem, sort_t = dispatch(state, None)
     jax.block_until_ready(state)
     consume(telem)
 
@@ -315,8 +337,7 @@ def run_chunked(n_ac, backend=None, geometry=None, chunk=20,
         prev = None
         for _k in range(nchunks):
             td = time.perf_counter()
-            state, telem = run_steps_edge(resort(state), cfg, chunk,
-                                          checked=True)
+            state, telem, sort_t = dispatch(state, sort_t)
             dispatch_gap += time.perf_counter() - td
             if not pipeline:
                 tp = time.perf_counter()
@@ -351,7 +372,10 @@ def run_chunked(n_ac, backend=None, geometry=None, chunk=20,
         if best is None or row["ac_steps_per_s"] > best["ac_steps_per_s"]:
             best = row
     best["reps"] = f"best-of-{reps}"
-    best["protocol"] = ("chunked, host re-sort per chunk, edge telemetry "
+    best["protocol"] = ("chunked, "
+                        + ("in-scan sort refresh" if inscan
+                           else "host re-sort per chunk")
+                        + ", edge telemetry "
                         + ("deferred (pipelined)" if pipeline
                            else "blocking (sync)"))
     return best
@@ -769,7 +793,8 @@ if __name__ == "__main__":
         chunk = int(args[1]) if len(args) > 1 else 20
         print(json.dumps(run_chunked(n, chunk=chunk,
                                      pipeline=(mode != "off"),
-                                     shard=shard)))
+                                     shard=shard,
+                                     inscan="--inscan" in sys.argv)))
     else:
         n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
         main(n_ac=n)

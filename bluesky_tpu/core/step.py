@@ -83,6 +83,18 @@ class SimConfig(NamedTuple):
     # pure carry folds and ZERO host syncs or in-scan collectives
     # (tests/test_hlo_collectives.py pins the collective budget).
     scanstats: bool = False
+    # In-scan sort refresh (sparse backend): fold the stripe re-sort —
+    # and, in spatial mode, the caller-slot re-bucketing — into the
+    # chunk scan as a scalar ``lax.cond`` on the sort_every*dtasas
+    # cadence, so chunk edges carry ZERO host refresh work and chunk
+    # length stops mattering (the 20-step interactive gap of
+    # BENCH_CHUNK_SWEEP.json).  The composed caller-slot bijection and
+    # a structured guard word ride the RefreshPack edge output; the
+    # host applies the permutation to ids/routes once per chunk and
+    # trips the fallback-to-replicate path on guard violations.  False
+    # — the default — takes the original scan code path at trace time
+    # (bit-identical HLO, same contract as ``scanstats``).
+    inscan_refresh: bool = False
 
 
 def step(state: SimState, cfg: SimConfig) -> SimState:
@@ -209,18 +221,143 @@ def step(state: SimState, cfg: SimConfig) -> SimState:
     return state.replace(ac=ac, simt=simt + simdt)
 
 
+# ---------------------------------------------------------- in-scan refresh
+# The sparse backend's spatial-sort refresh folded into the chunk scan
+# (SimConfig.inscan_refresh).  The refresh-due gate is a scalar
+# ``lax.cond`` on the sort_every*dtasas cadence — the same hoisted-gate
+# idiom as the worlds conds — invoking the already-jitted refresh
+# bodies in core/asas.py; the carry accumulates the RefreshPack below.
+
+
+def inscan_refresh_active(cfg: SimConfig) -> bool:
+    """True when this config folds the sort refresh into the scan: the
+    flag is on AND the backend is 'sparse' (the tiled/pallas Morton
+    refresh stays host-called — its argsort has no in-scan body) AND
+    ASAS runs at all.  Static: callers pivot output arity on it."""
+    return bool(cfg.inscan_refresh and cfg.asas.swasas
+                and cfg.cd_backend == "sparse")
+
+
+class RefreshPack(NamedTuple):
+    """In-scan refresh carry AND chunk-edge output (non-donated, rides
+    the EdgeTelemetry pull).  Everything the host needs to retire a
+    chunk's refreshes without having run any of them:
+
+    * ``sort_t``: sim time of the most recent refresh (same dtype as
+      ``state.simt``; -1 = never).  The host threads it into the NEXT
+      dispatch as ``sort_t0`` — as the raw device scalar in the
+      pipelined loop, so chaining costs zero host syncs.
+    * ``count``: int32 refreshes fired inside this chunk.
+    * ``guard``: int32 structured guard word, OR of bit 1 (spatial
+      stripe-occupancy overflow) and bit 2 (halo-coverage violation).
+      A violating refresh is SKIPPED on device (the stale sort stays
+      exact, only looser) and the host trips the fallback-to-replicate
+      path at the edge — never silently stepping a broken layout.
+    * ``newslot``: the composed old-caller -> new-caller slot bijection
+      across every in-chunk spatial refresh ([n] int32; empty [0] when
+      not spatial — the mode is jit-static so the pytree is fixed per
+      config key).  Applied to host-side objects (ids/routes/trails via
+      ``Traffic.apply_slot_permutation``) exactly once per chunk.
+    """
+    sort_t: jnp.ndarray
+    count: jnp.ndarray
+    guard: jnp.ndarray
+    newslot: jnp.ndarray
+
+
+def _refresh_init(state: SimState, cfg: SimConfig, sort_t0,
+                  worlds: bool = False) -> RefreshPack:
+    """Chunk-start RefreshPack: ``sort_t0`` is the host's last-refresh
+    sim time (scalar, [W] for worlds; None = never refreshed)."""
+    if worlds:
+        nw = state.simt.shape[0]
+        if sort_t0 is None:
+            sort_t0 = jnp.full((nw,), -1.0, state.simt.dtype)
+        zero = jnp.zeros((nw,), jnp.int32)
+    else:
+        if sort_t0 is None:
+            sort_t0 = jnp.full((), -1.0, state.simt.dtype)
+        zero = jnp.zeros((), jnp.int32)
+    spatial = (not worlds) and cfg.cd_shard_mode == "spatial"
+    n = state.ac.lat.shape[-1]
+    newslot = (jnp.arange(n, dtype=jnp.int32) if spatial
+               else jnp.zeros((0,), jnp.int32))
+    return RefreshPack(
+        sort_t=jnp.asarray(sort_t0, state.simt.dtype), count=zero,
+        guard=zero, newslot=newslot)
+
+
+def _refresh_gate(s: SimState, rc: RefreshPack, cfg: SimConfig):
+    """One scan-body iteration of the refresh schedule: fire the sparse
+    (or spatial) refresh when the cadence is due, BEFORE the step — the
+    same order as the host's pre-dispatch refresh.  Returns the
+    (possibly refreshed) state and updated carry."""
+    period = jnp.asarray(float(cfg.asas.sort_every * cfg.asas.dtasas),
+                         s.simt.dtype)
+    spatial = cfg.cd_shard_mode == "spatial"
+    block = min(cfg.cd_block, 256)
+    due = (rc.sort_t < 0) | (s.simt - rc.sort_t >= period)
+
+    def fire(args):
+        s, rc = args
+        if spatial:
+            ndev = cfg.cd_mesh.shape[cfg.cd_mesh_axis]
+            s2, newslot_r, gbits = asasmod.inscan_spatial_refresh(
+                s, cfg.asas, ndev, block=block,
+                halo_blocks=cfg.cd_halo_blocks)
+            newslot = newslot_r[rc.newslot]
+        else:
+            s2 = asasmod.inscan_sparse_refresh(s, cfg.asas, block=block)
+            newslot, gbits = rc.newslot, jnp.zeros((), jnp.int32)
+        # sort_t advances even on a guarded (skipped) refresh: the edge
+        # trips the fallback anyway, and refiring every step would hoist
+        # the full sort cost into every iteration.
+        return s2, RefreshPack(sort_t=s.simt, count=rc.count + 1,
+                               guard=rc.guard | gbits, newslot=newslot)
+
+    return jax.lax.cond(due, fire, lambda a: a, (s, rc))
+
+
+def _refresh_gate_worlds(s: SimState, rc: RefreshPack, cfg: SimConfig):
+    """Multi-world refresh gate: [W] due mask, hoisted ``any-world-due``
+    cond around the vmapped sparse refresh + per-world select (the
+    step_worlds gate idiom).  Worlds are single-device sparse only
+    (``_check_worlds_cfg`` refuses spatial), so no permutation/guard."""
+    period = jnp.asarray(float(cfg.asas.sort_every * cfg.asas.dtasas),
+                         s.simt.dtype)
+    block = min(cfg.cd_block, 256)
+    due = (rc.sort_t < 0) | (s.simt - rc.sort_t >= period)   # [W]
+
+    def fire(args):
+        s, rc = args
+        new = jax.vmap(lambda sw: asasmod.inscan_sparse_refresh(
+            sw, cfg.asas, block=block))(s)
+        s2 = _select_worlds(due, new, s)
+        return s2, RefreshPack(
+            sort_t=jnp.where(due, s.simt, rc.sort_t),
+            count=rc.count + due.astype(jnp.int32),
+            guard=rc.guard, newslot=rc.newslot)
+
+    return jax.lax.cond(jnp.any(due), fire, lambda a: a, (s, rc))
+
+
 def _scan_steps(state: SimState, cfg: SimConfig, nsteps: int,
-                checked: bool):
+                checked: bool, sort_t0=None):
     """The ONE chunk-scan body every runner shares: ``checked`` folds
     the integrity guard into the carry (first-bad-step index, -1 clean).
     Single source of truth so the guard semantics measured by
     guard_overhead.py are exactly the ones the sim runs.
 
-    Returns ``(state, bad, stats)``: ``bad`` is None unless checked,
-    ``stats`` is None unless ``cfg.scanstats`` rides the in-scan
-    telemetry accumulators (obs/scanstats.py) through the carry.  The
-    flag is jit-static, so the False branch below IS the pre-scanstats
-    scan, character for character — identical traced HLO."""
+    Returns ``(state, bad, stats, refresh)``: ``bad`` is None unless
+    checked, ``stats`` is None unless ``cfg.scanstats`` rides the
+    in-scan telemetry accumulators (obs/scanstats.py) through the
+    carry, ``refresh`` is None unless ``inscan_refresh_active(cfg)``
+    folds the sort refresh into the scan (RefreshPack; ``sort_t0`` is
+    the host's last-refresh time seeding its due gate).  Both flags are
+    jit-static, so the all-off branch below IS the original scan,
+    character for character — identical traced HLO."""
+    if inscan_refresh_active(cfg):
+        return _scan_steps_inscan(state, cfg, nsteps, checked, sort_t0)
     if cfg.scanstats:
         from ..obs import scanstats as ssmod
         stats0 = ssmod.init(state, cfg)
@@ -235,7 +372,7 @@ def _scan_steps(state: SimState, cfg: SimConfig, nsteps: int,
             (state, bad, stats), _ = jax.lax.scan(
                 body, (state, jnp.full((), -1, jnp.int32), stats0),
                 jnp.arange(nsteps, dtype=jnp.int32))
-            return state, bad, stats
+            return state, bad, stats, None
 
         def body(carry, _):
             s, st = carry
@@ -244,7 +381,7 @@ def _scan_steps(state: SimState, cfg: SimConfig, nsteps: int,
 
         (state, stats), _ = jax.lax.scan(body, (state, stats0), None,
                                          length=nsteps)
-        return state, None, stats
+        return state, None, stats, None
 
     if checked:
         def body(carry, i):
@@ -257,13 +394,70 @@ def _scan_steps(state: SimState, cfg: SimConfig, nsteps: int,
         (state, bad), _ = jax.lax.scan(
             body, (state, jnp.full((), -1, jnp.int32)),
             jnp.arange(nsteps, dtype=jnp.int32))
-        return state, bad, None
+        return state, bad, None, None
 
     def body(s, _):
         return step(s, cfg), None
 
     state, _ = jax.lax.scan(body, state, None, length=nsteps)
-    return state, None, None
+    return state, None, None, None
+
+
+def _scan_steps_inscan(state: SimState, cfg: SimConfig, nsteps: int,
+                       checked: bool, sort_t0):
+    """``_scan_steps`` with the refresh gate threaded through the carry
+    (``inscan_refresh_active``).  Kept as a separate function so the
+    refresh-off branches above stay the original scan verbatim."""
+    rc0 = _refresh_init(state, cfg, sort_t0)
+    if cfg.scanstats:
+        from ..obs import scanstats as ssmod
+        stats0 = ssmod.init(state, cfg)
+        if checked:
+            def body(carry, i):
+                s, bad, st, rc = carry
+                s, rc = _refresh_gate(s, rc, cfg)
+                s = step(s, cfg)
+                bad = jnp.where(bad >= 0, bad,
+                                jnp.where(state_finite(s), -1, i))
+                return (s, bad, ssmod.fold(st, s, cfg), rc), None
+
+            (state, bad, stats, rc), _ = jax.lax.scan(
+                body, (state, jnp.full((), -1, jnp.int32), stats0, rc0),
+                jnp.arange(nsteps, dtype=jnp.int32))
+            return state, bad, stats, rc
+
+        def body(carry, _):
+            s, st, rc = carry
+            s, rc = _refresh_gate(s, rc, cfg)
+            s = step(s, cfg)
+            return (s, ssmod.fold(st, s, cfg), rc), None
+
+        (state, stats, rc), _ = jax.lax.scan(
+            body, (state, stats0, rc0), None, length=nsteps)
+        return state, None, stats, rc
+
+    if checked:
+        def body(carry, i):
+            s, bad, rc = carry
+            s, rc = _refresh_gate(s, rc, cfg)
+            s = step(s, cfg)
+            bad = jnp.where(bad >= 0, bad,
+                            jnp.where(state_finite(s), -1, i))
+            return (s, bad, rc), None
+
+        (state, bad, rc), _ = jax.lax.scan(
+            body, (state, jnp.full((), -1, jnp.int32), rc0),
+            jnp.arange(nsteps, dtype=jnp.int32))
+        return state, bad, None, rc
+
+    def body(carry, _):
+        s, rc = carry
+        s, rc = _refresh_gate(s, rc, cfg)
+        return (step(s, cfg), rc), None
+
+    (state, rc), _ = jax.lax.scan(body, (state, rc0), None,
+                                  length=nsteps)
+    return state, None, None, rc
 
 
 @partial(jax.jit, static_argnames=("cfg", "nsteps"), donate_argnums=0)
@@ -274,7 +468,7 @@ def run_steps(state: SimState, cfg: SimConfig, nsteps: int) -> SimState:
     (simulation.py:216-223) as a single device program: host syncs once per
     chunk, matching SURVEY.md §2.10's "lax.scan over k steps inside one jit".
     """
-    state, _, _ = _scan_steps(state, cfg, nsteps, checked=False)
+    state, _, _, _ = _scan_steps(state, cfg, nsteps, checked=False)
     return state
 
 
@@ -312,7 +506,7 @@ def run_steps_checked(state: SimState, cfg: SimConfig, nsteps: int):
     for free: the fault is pinned to one simdt without re-running the
     chunk.
     """
-    state, bad, _ = _scan_steps(state, cfg, nsteps, checked=True)
+    state, bad, _, _ = _scan_steps(state, cfg, nsteps, checked=True)
     return state, bad
 
 
@@ -376,40 +570,50 @@ def pack_telemetry(state: SimState, bad=None) -> EdgeTelemetry:
 
 
 def _edge_scan(state: SimState, cfg: SimConfig, nsteps: int,
-               checked: bool):
-    """``(state, telemetry)`` — or ``(state, telemetry, stats)`` when
-    ``cfg.scanstats`` adds the in-scan accumulator pack.  The arity
-    pivots on a jit-STATIC flag, so each config key compiles one fixed
-    output pytree; the stats pack joins the telemetry as extra
-    non-donated outputs and rides the same lazy chunk-edge pull."""
-    state, bad, stats = _scan_steps(state, cfg, nsteps, checked)
+               checked: bool, sort_t0=None):
+    """``(state, telemetry)`` — extended with ``stats`` when
+    ``cfg.scanstats`` adds the in-scan accumulator pack and/or the
+    ``RefreshPack`` when ``inscan_refresh_active(cfg)`` (always in that
+    order).  The arity pivots on jit-STATIC flags, so each config key
+    compiles one fixed output pytree; the extra packs join the
+    telemetry as non-donated outputs and ride the same lazy chunk-edge
+    pull."""
+    state, bad, stats, refresh = _scan_steps(state, cfg, nsteps,
+                                             checked, sort_t0)
     telem = pack_telemetry(state, bad)
-    if stats is None:
-        return state, telem
-    return state, telem, stats
+    out = (state, telem)
+    if stats is not None:
+        out = out + (stats,)
+    if refresh is not None:
+        out = out + (refresh,)
+    return out
 
 
 @partial(jax.jit, static_argnames=("cfg", "nsteps", "checked"),
          donate_argnums=0)
 def run_steps_edge(state: SimState, cfg: SimConfig, nsteps: int,
-                   checked: bool = False):
+                   checked: bool = False, sort_t0=None):
     """``run_steps`` (or the guarded scan, ``checked=True``) returning
     ``(state, EdgeTelemetry)``.  State buffers are donated like
     ``run_steps``; the telemetry pack is materialized as separate
     buffers so it survives the next chunk's donation — the enabling
-    contract of the pipelined chunk loop (simulation/sim.py)."""
-    return _edge_scan(state, cfg, nsteps, checked)
+    contract of the pipelined chunk loop (simulation/sim.py).
+    ``sort_t0`` (traced scalar, or the previous chunk's RefreshPack
+    ``sort_t`` device buffer) seeds the in-scan refresh gate when
+    ``cfg.inscan_refresh`` is on; None otherwise (empty pytree — the
+    OFF program is unchanged)."""
+    return _edge_scan(state, cfg, nsteps, checked, sort_t0)
 
 
 @partial(jax.jit, static_argnames=("cfg", "nsteps", "checked"))
 def run_steps_edge_keep(state: SimState, cfg: SimConfig, nsteps: int,
-                        checked: bool = False):
+                        checked: bool = False, sort_t0=None):
     """``run_steps_edge`` WITHOUT input donation: the caller keeps the
     pre-chunk state buffers valid.  The pipelined loop uses this for
     the chunk after a snapshot-ring capture edge, so the full pre-chunk
     pytree can be copied to the host *while the next chunk runs*
     instead of blocking the dispatch (the off-critical-path capture)."""
-    return _edge_scan(state, cfg, nsteps, checked)
+    return _edge_scan(state, cfg, nsteps, checked, sort_t0)
 
 
 step_jit = jax.jit(step, static_argnames=("cfg",))
@@ -587,17 +791,23 @@ def step_worlds(state: SimState, cfg: SimConfig) -> SimState:
 
 
 def _scan_steps_worlds(state: SimState, cfg: SimConfig, nsteps: int,
-                       checked: bool):
+                       checked: bool, sort_t0=None):
     """The chunk scan with a leading world axis: a scan of the batched
     step (ONE scan, the batch dim pushed into the body), with the
     integrity guard widened to a [W] vector of first-bad-step indices
     (-1 clean) so a trip pins the (world, step) pair.
 
-    Same ``(state, bad, stats)`` contract as ``_scan_steps``; with
-    ``cfg.scanstats`` the accumulators get a leading [W] axis (vmapped
-    init/fold — worlds are single-device, so every fold stays the P=1
-    flavour) and demux per world via ``world_slice`` like telemetry."""
+    Same ``(state, bad, stats, refresh)`` contract as ``_scan_steps``;
+    with ``cfg.scanstats`` the accumulators get a leading [W] axis
+    (vmapped init/fold — worlds are single-device, so every fold stays
+    the P=1 flavour) and demux per world via ``world_slice`` like
+    telemetry.  With ``inscan_refresh_active(cfg)`` the RefreshPack
+    scalars widen to [W] the same way (``sort_t0`` is a [W] vector of
+    per-world last-refresh times)."""
     vstep = lambda s: step_worlds(s, cfg)
+    if inscan_refresh_active(cfg):
+        return _scan_steps_worlds_inscan(state, cfg, nsteps, checked,
+                                         sort_t0)
     if cfg.scanstats:
         from ..obs import scanstats as ssmod
         stats0 = jax.vmap(lambda s: ssmod.init(s, cfg))(state)
@@ -617,7 +827,7 @@ def _scan_steps_worlds(state: SimState, cfg: SimConfig, nsteps: int,
                 body, (state, jnp.full((nworlds,), -1, jnp.int32),
                        stats0),
                 jnp.arange(nsteps, dtype=jnp.int32))
-            return state, bad, stats
+            return state, bad, stats, None
 
         def body(carry, _):
             s, st = carry
@@ -626,7 +836,7 @@ def _scan_steps_worlds(state: SimState, cfg: SimConfig, nsteps: int,
 
         (state, stats), _ = jax.lax.scan(body, (state, stats0), None,
                                          length=nsteps)
-        return state, None, stats
+        return state, None, stats, None
 
     if checked:
         nworlds = state.simt.shape[0]
@@ -642,13 +852,79 @@ def _scan_steps_worlds(state: SimState, cfg: SimConfig, nsteps: int,
         (state, bad), _ = jax.lax.scan(
             body, (state, jnp.full((nworlds,), -1, jnp.int32)),
             jnp.arange(nsteps, dtype=jnp.int32))
-        return state, bad, None
+        return state, bad, None, None
 
     def body(s, _):
         return vstep(s), None
 
     state, _ = jax.lax.scan(body, state, None, length=nsteps)
-    return state, None, None
+    return state, None, None, None
+
+
+def _scan_steps_worlds_inscan(state: SimState, cfg: SimConfig,
+                              nsteps: int, checked: bool, sort_t0):
+    """``_scan_steps_worlds`` with the per-world refresh gate in the
+    carry; separate function so the refresh-off branches above stay the
+    original scan verbatim (the ``_scan_steps_inscan`` split)."""
+    vstep = lambda s: step_worlds(s, cfg)
+    rc0 = _refresh_init(state, cfg, sort_t0, worlds=True)
+    if cfg.scanstats:
+        from ..obs import scanstats as ssmod
+        stats0 = jax.vmap(lambda s: ssmod.init(s, cfg))(state)
+        vfold = jax.vmap(lambda st, s: ssmod.fold(st, s, cfg))
+        if checked:
+            nworlds = state.simt.shape[0]
+            vfinite = jax.vmap(state_finite)
+
+            def body(carry, i):
+                s, bad, st, rc = carry
+                s, rc = _refresh_gate_worlds(s, rc, cfg)
+                s = vstep(s)
+                bad = jnp.where(bad >= 0, bad,
+                                jnp.where(vfinite(s), -1, i))
+                return (s, bad, vfold(st, s), rc), None
+
+            (state, bad, stats, rc), _ = jax.lax.scan(
+                body, (state, jnp.full((nworlds,), -1, jnp.int32),
+                       stats0, rc0),
+                jnp.arange(nsteps, dtype=jnp.int32))
+            return state, bad, stats, rc
+
+        def body(carry, _):
+            s, st, rc = carry
+            s, rc = _refresh_gate_worlds(s, rc, cfg)
+            s = vstep(s)
+            return (s, vfold(st, s), rc), None
+
+        (state, stats, rc), _ = jax.lax.scan(
+            body, (state, stats0, rc0), None, length=nsteps)
+        return state, None, stats, rc
+
+    if checked:
+        nworlds = state.simt.shape[0]
+        vfinite = jax.vmap(state_finite)
+
+        def body(carry, i):
+            s, bad, rc = carry
+            s, rc = _refresh_gate_worlds(s, rc, cfg)
+            s = vstep(s)
+            bad = jnp.where(bad >= 0, bad,
+                            jnp.where(vfinite(s), -1, i))
+            return (s, bad, rc), None
+
+        (state, bad, rc), _ = jax.lax.scan(
+            body, (state, jnp.full((nworlds,), -1, jnp.int32), rc0),
+            jnp.arange(nsteps, dtype=jnp.int32))
+        return state, bad, None, rc
+
+    def body(carry, _):
+        s, rc = carry
+        s, rc = _refresh_gate_worlds(s, rc, cfg)
+        return (vstep(s), rc), None
+
+    (state, rc), _ = jax.lax.scan(body, (state, rc0), None,
+                                  length=nsteps)
+    return state, None, None, rc
 
 
 @partial(jax.jit, static_argnames=("cfg", "nsteps"), donate_argnums=0)
@@ -658,7 +934,8 @@ def run_steps_worlds(state: SimState, cfg: SimConfig,
     nsteps in one compiled scan.  W=1 is bit-identical to the unbatched
     path (tests/test_worlds.py pins this)."""
     _check_worlds_cfg(cfg)
-    state, _, _ = _scan_steps_worlds(state, cfg, nsteps, checked=False)
+    state, _, _, _ = _scan_steps_worlds(state, cfg, nsteps,
+                                        checked=False)
     return state
 
 
@@ -672,37 +949,46 @@ def run_steps_worlds_checked(state: SimState, cfg: SimConfig,
     host response (rollback/quarantine) stays per-world because the
     faulty (world, step) pair is pinned without re-running anything."""
     _check_worlds_cfg(cfg)
-    state, bad, _ = _scan_steps_worlds(state, cfg, nsteps, checked=True)
+    state, bad, _, _ = _scan_steps_worlds(state, cfg, nsteps,
+                                          checked=True)
     return state, bad
 
 
 def _edge_scan_worlds(state: SimState, cfg: SimConfig, nsteps: int,
-                      checked: bool):
-    state, bad, stats = _scan_steps_worlds(state, cfg, nsteps, checked)
+                      checked: bool, sort_t0=None):
+    state, bad, stats, refresh = _scan_steps_worlds(state, cfg, nsteps,
+                                                    checked, sort_t0)
     if bad is None:
         bad = jnp.full((state.simt.shape[0],), -1, jnp.int32)
     telem = jax.vmap(pack_telemetry)(state, bad)
-    if stats is None:
-        return state, telem
-    return state, telem, stats
+    out = (state, telem)
+    if stats is not None:
+        out = out + (stats,)
+    if refresh is not None:
+        out = out + (refresh,)
+    return out
 
 
 @partial(jax.jit, static_argnames=("cfg", "nsteps", "checked"),
          donate_argnums=0)
 def run_steps_worlds_edge(state: SimState, cfg: SimConfig, nsteps: int,
-                          checked: bool = False):
+                          checked: bool = False, sort_t0=None):
     """Multi-world ``run_steps_edge``: ``(state, EdgeTelemetry)`` with a
     leading world axis on every telemetry field.  ``world_slice(telem,
     w)`` is a plain per-world EdgeTelemetry — the serving layer demuxes
-    the pack back to the individual BATCH pieces with it."""
+    the pack back to the individual BATCH pieces with it.  ``sort_t0``
+    is the [W] vector of per-world last-refresh sim times when
+    ``cfg.inscan_refresh`` rides (the RefreshPack joins the outputs and
+    demuxes via ``world_slice`` like everything else)."""
     _check_worlds_cfg(cfg)
-    return _edge_scan_worlds(state, cfg, nsteps, checked)
+    return _edge_scan_worlds(state, cfg, nsteps, checked, sort_t0)
 
 
 @partial(jax.jit, static_argnames=("cfg", "nsteps", "checked"))
 def run_steps_worlds_edge_keep(state: SimState, cfg: SimConfig,
-                               nsteps: int, checked: bool = False):
+                               nsteps: int, checked: bool = False,
+                               sort_t0=None):
     """``run_steps_worlds_edge`` without input donation (snapshot
     capture overlapping the dispatched chunk, as run_steps_edge_keep)."""
     _check_worlds_cfg(cfg)
-    return _edge_scan_worlds(state, cfg, nsteps, checked)
+    return _edge_scan_worlds(state, cfg, nsteps, checked, sort_t0)

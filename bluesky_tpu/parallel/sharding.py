@@ -172,17 +172,26 @@ def sharded_step_fn(mesh: Mesh, cfg: SimConfig, nsteps: int = 1):
     folds kept as [ndev] per-device partials — GSPMD keeps the
     row-split reductions shard-local, so the stats add ZERO in-scan
     collectives (tests/test_hlo_collectives.py pins ON vs OFF equal).
+    With ``cfg.inscan_refresh`` the RefreshPack joins the outputs the
+    same way (after stats), its due gate seeded from the optional
+    ``sort_t0`` call argument (None = cold: sort_t = -1, so the first
+    due step refreshes).
     """
     if cfg.cd_backend in ("pallas", "sparse") and cfg.cd_mesh is None \
             and "ac" in mesh.shape:
         cfg = cfg._replace(cd_mesh=mesh, cd_mesh_axis="ac")
 
-    def run(state):
+    def run(state, sort_t0=None):
         from ..core.step import _scan_steps
-        out, _, stats = _scan_steps(state, cfg, nsteps, checked=False)
-        if stats is None:
-            return out
-        return out, stats
+        out, _, stats, refresh = _scan_steps(state, cfg, nsteps,
+                                             checked=False,
+                                             sort_t0=sort_t0)
+        ret = (out,)
+        if stats is not None:
+            ret = ret + (stats,)
+        if refresh is not None:
+            ret = ret + (refresh,)
+        return ret[0] if len(ret) == 1 else ret
 
     return jax.jit(run, donate_argnums=0)
 

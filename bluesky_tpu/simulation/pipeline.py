@@ -40,7 +40,8 @@ class ChunkEdge:
 
     def __init__(self, telemetry, chunk: int,
                  simt_planned: Optional[float] = None,
-                 seq: int = -1, obs_sink=None, stats=None):
+                 seq: int = -1, obs_sink=None, stats=None,
+                 refresh=None):
         self._telemetry = telemetry
         # in-scan telemetry pack (obs/scanstats.ScanStats device pytree)
         # when SimConfig.scanstats was on for the producing chunk; it
@@ -49,6 +50,12 @@ class ChunkEdge:
         # set HERE, not lazily — __getattr__ forwards unknown names to
         # the telemetry pack.
         self.stats = stats
+        # in-scan refresh pack (core/step.RefreshPack device pytree)
+        # when SimConfig.inscan_refresh was on for the producing chunk:
+        # the composed caller-slot bijection, refresh count and guard
+        # word the host retires once at this edge.  Same eager-set rule
+        # as ``stats`` (``__getattr__`` forwards unknown names).
+        self.refresh = refresh
         self.chunk = int(chunk)
         self._simt_planned = simt_planned
         self._np = None

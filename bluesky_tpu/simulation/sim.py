@@ -320,6 +320,19 @@ class Simulation:
         if bool(getattr(_pipe_settings, "scanstats", False)):
             self.cfg = self.cfg._replace(scanstats=True)
         self._scan_last = None       # newest drained chunk summary dict
+        # In-scan sort refresh (ISSUE-15): fold the sparse-backend sort
+        # refresh into the chunk scan so chunk edges carry zero host
+        # refresh work.  Settings knob at startup; the SORTREFRESH
+        # stack command toggles at runtime (jit-static flag, one chunk
+        # program per value, same contract as scanstats).
+        if bool(getattr(_pipe_settings, "inscan_refresh", False)):
+            self.cfg = self.cfg._replace(inscan_refresh=True)
+        self._sort_t_dev = None      # previous chunk's RefreshPack
+        #                              sort_t DEVICE scalar: chained
+        #                              into the next dispatch with zero
+        #                              host sync (pipelined chunks)
+        self._refresh_fired = 0      # in-scan refreshes retired so far
+        self._refresh_guard = 0      # guard words tripped so far
         # Observability (ISSUE-11, docs/OBSERVABILITY.md): a PER-SIM
         # metrics registry (two sims in one process — tests, W-world
         # packs — must not mix series) + the per-process flight
@@ -334,6 +347,8 @@ class Simulation:
                          help="integrity-guard trips (all policies)")
         self.obs.counter("sim_mesh_trips",
                          help="mesh-epoch events (mesh_lost+resharded)")
+        self.obs.counter("sim_inscan_refreshes",
+                         help="sort refreshes fired inside chunk scans")
         _h = self.obs.histogram
         _h("sim_chunk_latency_ms",
            help="chunk dispatch -> edge retirement wall ms")
@@ -417,7 +432,7 @@ class Simulation:
         # very next dispatch — the flush and the refresh sit in the
         # same host edge, so no chunk ever steps a blind aircraft.
         self.traf.create_hooks.append(
-            lambda slots: setattr(self, "_sort_simt", -1.0)
+            lambda slots: self._invalidate_sort()
             if self.shard_mode == "spatial" else None)
         self._shard_fallback = False
         # Mesh-epoch recovery (docs/FAULT_TOLERANCE.md, ISSUE-10): a
@@ -599,24 +614,23 @@ class Simulation:
         self.traf.reset()
         self.cond.reset()
         self.routes = RouteManager(self.traf, self.routes.wmax)
-        self._sort_simt = -1.0
-        self._sort_backend = None
+        self._invalidate_sort()
         return True
 
     def reset(self):
         self._retire_edge("reset")
         self._last_edge = None
         self.state_flag = INIT
-        self._sort_simt = -1.0
-        self._sort_backend = None
+        self._invalidate_sort()
         self.traf.reset()
         self.areas.reset()
         self.cond.reset()
         self.routes = RouteManager(self.traf, self.routes.wmax)
-        # scanstats is an observability knob, not scenario state (like
-        # the TRACE recorder): the runtime toggle survives RESET while
-        # the rest of the config rebuilds to defaults
-        self.cfg = SimConfig(scanstats=self.cfg.scanstats)
+        # scanstats/inscan_refresh are runtime knobs, not scenario
+        # state (like the TRACE recorder): the toggles survive RESET
+        # while the rest of the config rebuilds to defaults
+        self.cfg = SimConfig(scanstats=self.cfg.scanstats,
+                             inscan_refresh=self.cfg.inscan_refresh)
         self._scan_last = None
         # traf.reset rebuilt default-shape tables on the default device
         self.shard_mode, self.shard_mesh = "off", None
@@ -685,7 +699,7 @@ class Simulation:
             self.mesh_guard.set_mesh(None)
             self.cfg = self.cfg._replace(cd_mesh=None,
                                          cd_shard_mode="replicate")
-            self._sort_simt = -1.0
+            self._invalidate_sort()
             return True
         devs = list(devices) if devices is not None else _jax.devices()
         ndev = ndev or len(devs)
@@ -703,10 +717,11 @@ class Simulation:
             self.shard_stats = info
             self._sort_simt = self.simt
             self._sort_backend = "sparse"
+            self._sort_t_dev = None     # host value is the fresh truth
             self._last_edge = None      # slots moved: ACDATA cache stale
         else:
             self.traf.state = shd.shard_state(self.traf.state, mesh)
-            self._sort_simt = -1.0
+            self._invalidate_sort()
         self.shard_mode, self.shard_mesh = mode, mesh
         # bind the liveness sentinel to the new mesh (clears any kill
         # marks: a freshly formed mesh starts its epoch healthy)
@@ -882,8 +897,11 @@ class Simulation:
         """The HEALTH ``sim`` section: in-scan telemetry enablement plus
         the newest drained chunk's summary (obs/scanstats.summarize) —
         chunk-peak conflicts, min closest approach, clamp-saturation
-        ratio.  Pure host state: no device reads."""
-        d = dict(scanstats=bool(self.cfg.scanstats))
+        ratio — plus the sort-refresh readback (in-scan enablement,
+        last-refresh time, retired counters).  Pure host state: no
+        device reads."""
+        d = dict(scanstats=bool(self.cfg.scanstats),
+                 sort_refresh=self.refresh_health())
         if self._scan_last is not None:
             d.update(self._scan_last)
         return d
@@ -901,6 +919,111 @@ class Simulation:
         if not on:
             self._scan_last = None
         return True
+
+    # ------------------------------------------------- in-scan sort refresh
+    def _invalidate_sort(self):
+        """THE spatial-sort invalidation point (ISSUE-15): every event
+        that voids the cached stripe sort — creation flush, RESET,
+        snapshot restore, backend switch, shard-mode change — routes
+        through here, so the refresh due-gate (host edge OR the in-scan
+        RefreshPack seed) has a single source of truth.  Clearing
+        ``_sort_t_dev`` forces the next dispatch to seed the gate from
+        the host value (-1 = refresh at the first scan step)."""
+        self._sort_simt = -1.0
+        self._sort_backend = None
+        self._sort_t_dev = None
+
+    def _inscan_refresh_active(self) -> bool:
+        """Does the CURRENT config fold the sort refresh into the scan?
+        (core/step.inscan_refresh_active: flag on + sparse backend.)"""
+        from ..core.step import inscan_refresh_active
+        return inscan_refresh_active(self.cfg)
+
+    def set_inscan_refresh(self, on: bool) -> bool:
+        """Toggle the in-scan sort refresh (SORTREFRESH command).
+        Drains the pipeline first — the in-flight chunk was compiled
+        with the old flag and its edge must retire under it; the next
+        dispatch compiles the new chunk program.  Returns True if the
+        flag changed."""
+        on = bool(on)
+        if on == bool(self.cfg.inscan_refresh):
+            return False
+        self.drain_pipeline()
+        self.cfg = self.cfg._replace(inscan_refresh=on)
+        if not on:
+            # host refresh resumes from the last retired edge's sort_t
+            self._sort_t_dev = None
+        return True
+
+    def _sort_t0_for_dispatch(self, state):
+        """The in-scan due-gate seed for the next dispatch: the
+        previous chunk's RefreshPack ``sort_t`` DEVICE scalar when one
+        is chained (pipelined loop — a device-to-device dependency, no
+        host sync), else the host's last-refresh time (-1 after any
+        invalidation, and after a backend switch: 'sparse' stores
+        stripe destinations in sort_perm, the others a Morton
+        permutation, so a stale cross-backend sort must refresh at the
+        first step)."""
+        if self._sort_t_dev is not None:
+            return self._sort_t_dev
+        import jax.numpy as jnp
+        t = self._sort_simt
+        if self._sort_backend != self.cfg.cd_backend:
+            t = -1.0
+        return jnp.asarray(t, state.simt.dtype)
+
+    def _retire_refresh(self, edge):
+        """Retire one edge's in-scan RefreshPack: fold the device-side
+        refresh bookkeeping back into host state — last-refresh time,
+        the composed caller-slot bijection applied to ids/routes/
+        conditions/trails exactly ONCE per chunk
+        (Traffic.apply_slot_permutation), and the structured guard word
+        tripping the existing fallback-to-replicate path.  Runs BEFORE
+        the edge's other consumers so host-side slot arrays align with
+        the pack's (post-refresh) slot order.  No-op when the edge
+        carries no pack."""
+        pack = edge.refresh
+        if pack is None:
+            return
+        edge.refresh = None          # idempotent: permute exactly once
+        import jax as _jax
+        pack = _jax.device_get(pack)
+        self._sort_simt = float(pack.sort_t)
+        self._sort_backend = self.cfg.cd_backend
+        count, guard = int(pack.count), int(pack.guard)
+        if count > 0:
+            self._refresh_fired += count
+            self.obs.counter("sim_inscan_refreshes").inc(count)
+            if pack.newslot.size:
+                newslot = np.asarray(pack.newslot)
+                if not np.array_equal(newslot,
+                                      np.arange(newslot.size)):
+                    self.traf.apply_slot_permutation(newslot)
+                    # slots moved: any OLDER published edge pack is in
+                    # the pre-refresh order (the retiring edge is
+                    # re-published by the caller right after)
+                    self._last_edge = None
+        if guard != 0:
+            self._refresh_guard += 1
+            why = []
+            if guard & 1:
+                why.append("stripe occupancy overflow")
+            if guard & 2:
+                why.append("halo coverage violated")
+            self.scr.echo("SHARD SPATIAL contract violated in-scan: "
+                          + ", ".join(why)
+                          + " (refresh skipped; falling back)")
+            self._shard_fallback = True
+
+    def refresh_health(self):
+        """The HEALTH ``sim`` sort-refresh readback: mode, due-gate
+        state and retired in-scan counters (SORTREFRESH shows the same
+        numbers).  Pure host state: no device reads."""
+        return dict(inscan=bool(self.cfg.inscan_refresh),
+                    active=self._inscan_refresh_active(),
+                    last_refresh_simt=float(self._sort_simt),
+                    inscan_refreshes=int(self._refresh_fired),
+                    guard_trips=int(self._refresh_guard))
 
     # ----------------------------------------------------- preempt/autosave
     def request_preempt(self):
@@ -1309,9 +1432,13 @@ class Simulation:
         """Enqueue the (due) spatial-sort refresh and the chunk program
         back-to-back — both are async dispatches with no host readback
         between them, so a re-sort edge costs one extra enqueue instead
-        of a host round-trip.  Returns ``(state, telemetry, stats)``
-        futures — ``stats`` is the in-scan accumulator pack when
-        ``cfg.scanstats`` is on, else None.
+        of a host round-trip.  Returns ``(state, telemetry, stats,
+        refresh)`` futures — ``stats`` is the in-scan accumulator pack
+        when ``cfg.scanstats`` is on, ``refresh`` the in-scan
+        RefreshPack when ``cfg.inscan_refresh`` rides (None otherwise).
+        With the in-scan refresh the due-gate seed is chained from the
+        previous chunk's pack as a raw device scalar — zero host syncs
+        between pipelined dispatches.
 
         ``keep=True`` selects the non-donating runner: the caller needs
         the *input* state buffers to stay valid (snapshot-ring capture
@@ -1347,8 +1474,11 @@ class Simulation:
                 ("edge_keep" if keep else "edge")
                 + ("+checked" if self.guard.enabled else ""),
                 chunk, self.traf.nmax, nd)
+            inscan = self._inscan_refresh_active()
+            sort_t0 = self._sort_t0_for_dispatch(state) if inscan \
+                else None
             out = runner(state, self.cfg, chunk,
-                         checked=self.guard.enabled)
+                         checked=self.guard.enabled, sort_t0=sort_t0)
             if win:
                 # Attribution needs the device fence: block here so the
                 # compute section is the chunk alone, not whatever the
@@ -1363,13 +1493,19 @@ class Simulation:
                 if not keep:
                     dp.check_donation(state)
         self._last_dispatch_end = time.perf_counter()
-        # Normalized return: (state, telemetry, scanstats-or-None) —
-        # the runner's output arity follows the static cfg.scanstats
-        # flag (core/step._edge_scan), the callers always see three.
-        if self.cfg.scanstats:
-            return out
-        state, telem = out
-        return state, telem, None
+        # Normalized return: (state, telemetry, scanstats-or-None,
+        # refresh-or-None) — the runner's output arity follows the
+        # static cfg flags (core/step._edge_scan: stats before
+        # refresh), the callers always see four.
+        rest = list(out[2:])
+        sstats = rest.pop(0) if self.cfg.scanstats else None
+        rpack = rest.pop(0) if inscan else None
+        if rpack is not None:
+            # chain the due gate: the NEXT dispatch reads this chunk's
+            # final sort_t directly from the device output buffer
+            self._sort_t_dev = rpack.sort_t
+            self._sort_backend = self.cfg.cd_backend
+        return out[0], out[1], sstats, rpack
 
     def _next_seq(self) -> int:
         """Bump and return the host-side chunk-sequence correlation tag
@@ -1384,7 +1520,12 @@ class Simulation:
     def _pre_dispatch_refresh(self, state, simt: float):
         """The (due) chunk-edge spatial-sort refresh — split from
         ``_dispatch_chunk`` so the multi-world runner can refresh each
-        world's layout before stacking them into one joint dispatch."""
+        world's layout before stacking them into one joint dispatch.
+        With the in-scan refresh active this is a NO-OP (the acceptance
+        contract: ``sim_sort_refresh_ms`` observes zero edge refreshes)
+        — the refresh rides the scan and retires via the RefreshPack."""
+        if self._inscan_refresh_active():
+            return state
         if self.cfg.cd_backend in ("tiled", "pallas", "sparse"):
             due = self.cfg.asas.sort_every * self.cfg.asas.dtasas
             # Also force a refresh when the backend changed: 'sparse'
@@ -1449,7 +1590,7 @@ class Simulation:
                              and self.guard.policy == "rollback")
                             or self.shard_mode != "off"))
         state_in = self.traf.state
-        new_state, telem, sstats = self._dispatch_chunk(
+        new_state, telem, sstats, rpack = self._dispatch_chunk(
             state_in, chunk, keep=capture_now, simt=simt)
         self.traf.state = new_state
         self._step_count += chunk
@@ -1459,7 +1600,7 @@ class Simulation:
                                        simt_planned=self._simt_next,
                                        seq=self._seq_dispatched,
                                        obs_sink=self._edge_pull_sink,
-                                       stats=sstats)
+                                       stats=sstats, refresh=rpack)
         self.pipe_stats["pipelined_chunks"] += 1
         if pend is not None:
             self._finish_edge(
@@ -1470,12 +1611,14 @@ class Simulation:
         then run every edge subsystem against the live state — the
         pre-pipeline behavior, bit-identical step math."""
         self.pipe_stats["sync_chunks"] += 1
-        state, telem, sstats = self._dispatch_chunk(
+        state, telem, sstats, rpack = self._dispatch_chunk(
             self.traf.state, chunk, keep=False, simt=simt)
-        self._apply_chunk_result(state, telem, chunk, stats=sstats)
+        self._apply_chunk_result(state, telem, chunk, stats=sstats,
+                                 refresh=rpack)
 
     def _apply_chunk_result(self, state, telem, chunk: int,
-                            seq: Optional[int] = None, stats=None):
+                            seq: Optional[int] = None, stats=None,
+                            refresh=None):
         """Install one synchronously-completed chunk's result and run
         every edge subsystem against it — the post-dispatch half of
         ``_step_sync``.  The multi-world runner calls this per world
@@ -1490,8 +1633,15 @@ class Simulation:
             seq = self._seq_dispatched
         edge = ChunkEdge(telem, chunk,      # device clock, no prediction
                          seq=seq, obs_sink=self._edge_pull_sink,
-                         stats=stats)
+                         stats=stats, refresh=refresh)
         t_ret0 = time.perf_counter()
+        # Retire the in-scan refresh pack FIRST — before the guard
+        # response and every edge consumer — so the host slot arrays
+        # (ids/routes) align with the device's (post-refresh) slot
+        # order the pack and state are in.  The pack is integer sort
+        # bookkeeping, valid even off a tripped chunk (the device
+        # applied it consistently before the fault).
+        self._retire_refresh(edge)
         tripped = False
         if self.guard.enabled:
             # Integrity-guarded chunk: the isfinite check rides the scan
@@ -1567,6 +1717,10 @@ class Simulation:
         the passive edge consumers off the fused telemetry pack.  Runs
         while the next chunk computes on the device."""
         t_ret0 = time.perf_counter()
+        # In-scan refresh pack first (see _apply_chunk_result): the
+        # in-flight chunk already computes on the permuted state, so
+        # the host id/route remap must land even if this edge trips.
+        self._retire_refresh(edge)
         bad = edge.bad_step
         if self.guard.enabled and bad >= 0:
             self._deferred_trip(edge, bad)
@@ -1654,6 +1808,12 @@ class Simulation:
         ``quarantine`` deletes every aircraft non-finite NOW, catching
         any spread the extra chunk caused.  ``halt`` never defers
         (guard-halt is a sync fallback reason)."""
+        pend = self._pending_edge
+        if pend is not None:
+            # the dropped in-flight edge's refresh permutation still
+            # happened on device — land the host id/route remap before
+            # quarantine indexes the current state by slot
+            self._retire_refresh(pend)
         self._pending_edge = None
         self._last_edge = None
         self.pipe_stats["deferred_trips"] += 1

@@ -159,7 +159,7 @@ def restore_blob(sim, blob, full_reset: bool = True):
         traf.state = traf.state.replace(asas=traf.state.asas.replace(
             sort_perm=jnp.arange(traf.nmax, dtype=jnp.int32),
             partners_s=jnp.full_like(old_table, -1)))
-        sim._sort_simt = -1.0
+        sim._invalidate_sort()
     # Cross-MESH blobs (mesh-epoch recovery): a blob captured at a
     # different device count or shard mode carries stripe bucketing
     # keyed to the CAPTURING mesh even when the table shapes happen to
@@ -176,7 +176,7 @@ def restore_blob(sim, blob, full_reset: bool = True):
                 sort_perm=jnp.arange(traf.nmax, dtype=jnp.int32),
                 partners_s=jnp.full_like(traf.state.asas.partners_s,
                                          -1)))
-            sim._sort_simt = -1.0
+            sim._invalidate_sort()
     # Restore under an active mesh: re-place the (host-restored) arrays
     # with the mode's canonical shardings, and in spatial mode force a
     # re-bucketing refresh before the next chunk — the restored
@@ -191,7 +191,7 @@ def restore_blob(sim, blob, full_reset: bool = True):
             else shd.state_shardings(traf.state, sim.shard_mesh)
         traf.state = jax.tree.map(lambda x, s: jax.device_put(x, s),
                                   traf.state, sh)
-        sim._sort_simt = -1.0
+        sim._invalidate_sort()
     traf.ids = list(blob["ids"])
     traf.types = list(blob["types"])
     traf._id2slot = {acid: i for i, acid in enumerate(traf.ids)

@@ -42,6 +42,7 @@ METRICS = {
     "ac_steps_per_s_guarded": +1,
     "x_realtime": +1,
     "x_realtime_per_world": +1,
+    "gap_vs_ff": +1,
     "speedup": +1,
     "pairs_per_s_per_device": +1,
     "overhead_pct": -1,

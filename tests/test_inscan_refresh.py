@@ -1,0 +1,315 @@
+"""In-scan sort refresh (ISSUE 15): the stripe re-sort folded into the
+compiled chunk must be INVISIBLE except in cost — bit-identical state
+to the host-called refresh path at every configuration level.
+
+The alignment trick all parity tests share: dyadic ``simdt = 0.0625``
+with ``sort_every=2, dtasas=1.0`` makes the refresh period 2.0 s = 32
+steps EXACT in f32, so the in-scan due gate (evaluated before every
+step) fires at precisely the sim times the host edge refresh fires at
+32-step chunk boundaries — and the two paths become comparable
+bit-for-bit instead of merely statistically.
+
+Four levels:
+
+* sparse core: one 96-step chunk with 3 in-chunk refreshes vs 3x
+  (host refresh + 32-step scan);
+* spatial core (slow lane, 4-device stripes on the 8-device CPU mesh):
+  state parity through ``sharded_step_fn`` AND the composed caller-slot
+  bijection vs the host refreshes' permutation product;
+* worlds W=3: the [W] due-gate vector against per-world host loops;
+* production ``Simulation``: SORTREFRESH ON/OFF state parity, zero
+  host-edge refreshes in a 20-step-chunk run (the interactive-chunk
+  acceptance), and a mid-run creation flushing the due gate through
+  ``_invalidate_sort``.
+"""
+import hashlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bluesky_tpu.core import asas as asasmod
+from bluesky_tpu.core.asas import AsasConfig
+from bluesky_tpu.core.step import (RefreshPack, SimConfig,
+                                   inscan_refresh_active, run_steps,
+                                   run_steps_edge_keep,
+                                   run_steps_worlds_edge, stack_worlds,
+                                   world_slice)
+from bluesky_tpu.core.traffic import Traffic
+from bluesky_tpu.parallel import sharding
+
+# the dyadic alignment config (module docstring)
+ACFG = AsasConfig(sort_every=2, dtasas=1.0)
+SIMDT = 0.0625
+PERIOD_STEPS = 32            # 2.0 s / 0.0625 s, exact in f32
+
+
+def _scene(n, nmax, seed=7, lat=(35.0, 60.0)):
+    rng = np.random.default_rng(seed)
+    traf = Traffic(nmax=nmax, dtype=jnp.float32, pair_matrix=False)
+    traf.create(n, "B744", rng.uniform(3000, 11000, n),
+                rng.uniform(130, 240, n), None,
+                rng.uniform(lat[0], lat[1], n),
+                rng.uniform(-10, 30, n), rng.uniform(0, 360, n))
+    traf.flush()
+    return traf
+
+
+def _assert_trees_equal(got, want, ctx=""):
+    for (pg, a), (pw, b) in zip(jax.tree_util.tree_leaves_with_path(got),
+                                jax.tree_util.tree_leaves_with_path(want)):
+        a, b = np.asarray(a), np.asarray(b)
+        np.testing.assert_array_equal(
+            a, b, err_msg=f"{ctx}{jax.tree_util.keystr(pg)}")
+
+
+def _state_hash(tree):
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(tree):
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------- sparse core
+
+@pytest.mark.slow
+def test_sparse_inscan_multi_refresh_parity():
+    """One 96-step in-scan chunk (3 refreshes: simt 0, 2, 4) ==
+    3 x (host sparse refresh + 32-step scan), bit-for-bit."""
+    traf = _scene(150, 256)
+    cfg = SimConfig(simdt=SIMDT, asas=ACFG, cd_backend="sparse",
+                    cd_block=64, inscan_refresh=True)
+    assert inscan_refresh_active(cfg)
+
+    st, _, rpack = run_steps_edge_keep(traf.state, cfg, 96,
+                                       checked=False)
+    assert int(rpack.count) == 3
+    assert float(rpack.sort_t) == 4.0
+    assert int(rpack.guard) == 0
+    assert rpack.newslot.shape == (0,)       # sparse: no permutation
+
+    s = traf.state
+    cfg_off = cfg._replace(inscan_refresh=False)
+    for _ in range(3):
+        s = asasmod.refresh_spatial_sort(s, ACFG, block=64,
+                                         impl="sparse")
+        s = run_steps(s, cfg_off, PERIOD_STEPS)
+    _assert_trees_equal(st, s)
+
+
+@pytest.mark.slow
+def test_inscan_off_is_plain_scan():
+    """Flag off: same output arity and values as the baseline runner —
+    the refresh never traced (the scanstats arity contract)."""
+    traf = _scene(60, 128)
+    cfg = SimConfig(simdt=SIMDT, asas=ACFG, cd_backend="sparse",
+                    cd_block=64)
+    assert not inscan_refresh_active(cfg)
+    out = run_steps_edge_keep(traf.state, cfg, 8, checked=False)
+    assert len(out) == 2                      # (state, telemetry) only
+    ref = run_steps(traf.state, cfg, 8)
+    _assert_trees_equal(out[0], ref)
+
+
+def test_inscan_refresh_requires_sparse_backend():
+    """The tiled/pallas Morton refresh stays host-called: the flag is
+    inert (arity unchanged) outside the sparse backend."""
+    cfg = SimConfig(simdt=SIMDT, asas=ACFG, cd_backend="tiled",
+                    inscan_refresh=True)
+    assert not inscan_refresh_active(cfg)
+
+
+@pytest.mark.slow
+def test_sort_t_chains_across_chunks():
+    """Chunk 2 seeded with chunk 1's device sort_t refreshes on the
+    cadence, not on the chunk boundary: 2 x 48 steps == 96 steps."""
+    traf = _scene(150, 256)
+    cfg = SimConfig(simdt=SIMDT, asas=ACFG, cd_backend="sparse",
+                    cd_block=64, inscan_refresh=True)
+    st1, _, p1 = run_steps_edge_keep(traf.state, cfg, 48, checked=False)
+    st2, _, p2 = run_steps_edge_keep(st1, cfg, 48, checked=False,
+                                     sort_t0=p1.sort_t)
+    assert int(p1.count) + int(p2.count) == 3
+    ref, _, _ = run_steps_edge_keep(traf.state, cfg, 96, checked=False)
+    _assert_trees_equal(st2, ref)
+
+
+# -------------------------------------------------------------- spatial core
+
+@pytest.mark.slow
+def test_spatial_inscan_parity_and_composed_bijection():
+    """Spatial stripes (4 devices of the 8-device CPU mesh): one
+    96-step in-scan chunk through ``sharded_step_fn`` ==
+    host ``refresh_spatial_shard`` at the 32-step edges, bit-for-bit —
+    and the RefreshPack's composed newslot equals the product of the
+    host refreshes' individual permutations."""
+    ndev, nmax = 4, 1024
+    mesh = sharding.make_mesh(ndev)
+    traf = _scene(400, nmax)
+    st, _, info = sharding.prepare_spatial(traf.state, mesh, ACFG,
+                                           block=256)
+    cfg = SimConfig(simdt=SIMDT, asas=ACFG, cd_backend="sparse",
+                    cd_block=256, cd_shard_mode="spatial",
+                    cd_halo_blocks=info["halo_blocks"],
+                    inscan_refresh=True)
+
+    # prepare_spatial just refreshed: seed the gate at simt 0, so the
+    # chunk fires exactly the t=2.0 and t=4.0 refreshes
+    host = jax.tree.map(lambda x: jax.device_put(np.asarray(x)), st)
+    st2, rpack = sharding.sharded_step_fn(mesh, cfg, nsteps=96)(
+        jax.tree.map(lambda x: jax.device_put(np.asarray(x)), st),
+        sort_t0=jnp.asarray(0.0, st.simt.dtype))
+    assert int(rpack.count) == 2
+    assert int(rpack.guard) == 0
+
+    cfg_off = cfg._replace(inscan_refresh=False)
+    fn32 = sharding.sharded_step_fn(mesh, cfg_off, nsteps=PERIOD_STEPS)
+    comp_ref = np.arange(nmax)
+    s = host
+    for k in range(3):
+        if k > 0:
+            s, nsl, _ = asasmod.refresh_spatial_shard(
+                s, ACFG, ndev, block=256,
+                halo_blocks=info["halo_blocks"])
+            comp_ref = np.asarray(nsl)[comp_ref]
+        # re-put: fn32 donates its input
+        s = fn32(jax.tree.map(lambda x: jax.device_put(np.asarray(x)),
+                              s))
+    _assert_trees_equal(st2, s, ctx="spatial ")
+    np.testing.assert_array_equal(np.asarray(rpack.newslot), comp_ref,
+                                  err_msg="composed slot bijection")
+
+
+# -------------------------------------------------------------------- worlds
+
+@pytest.mark.slow
+def test_worlds_inscan_parity():
+    """W=3 stacked worlds, 96-step joint chunk: the [W] due-gate fires
+    per world and each world matches its own host-refresh loop."""
+    cfg = SimConfig(simdt=SIMDT, asas=ACFG, cd_backend="sparse",
+                    cd_block=64, inscan_refresh=True)
+    trafs = [_scene(40 + 8 * i, 64, seed=i, lat=(38 + 5 * i, 42 + 5 * i))
+             for i in range(3)]
+    states = [t.state for t in trafs]
+
+    out = run_steps_worlds_edge(
+        stack_worlds([jax.tree.map(jnp.copy, s) for s in states]),
+        cfg, 96, checked=False)
+    wstate, rpack = out[0], out[2]
+    assert isinstance(rpack, RefreshPack)
+    np.testing.assert_array_equal(np.asarray(rpack.count), [3, 3, 3])
+    np.testing.assert_array_equal(np.asarray(rpack.sort_t),
+                                  [4.0, 4.0, 4.0])
+
+    cfg_off = cfg._replace(inscan_refresh=False)
+    for k, s in enumerate(states):
+        for _ in range(3):
+            s = asasmod.refresh_spatial_sort(s, ACFG, block=64,
+                                             impl="sparse")
+            s = run_steps(s, cfg_off, PERIOD_STEPS)
+        _assert_trees_equal(world_slice(wstate, k), s,
+                            ctx=f"world {k} ")
+
+
+# -------------------------------------------------------- production Simulation
+
+def _make_sim(nmax=512, n=200, chunk_steps=None, seed=3):
+    from bluesky_tpu.simulation.sim import Simulation
+    sim = Simulation(nmax=nmax, chunk_steps=chunk_steps)
+    rng = np.random.default_rng(seed)
+    sim.traf.create(n, "B744", rng.uniform(4900, 5100, n),
+                    rng.uniform(140, 180, n), None,
+                    rng.uniform(35, 60, n), rng.uniform(-10, 30, n),
+                    rng.uniform(0, 360, n))
+    sim.traf.flush()
+    sim.cfg = sim.cfg._replace(simdt=SIMDT, asas=ACFG,
+                               cd_backend="sparse", cd_block=256)
+    return sim
+
+
+@pytest.mark.slow
+def test_sim_sortrefresh_parity_aligned_chunks():
+    """Production loop, 32-step chunks (edges ON the refresh cadence):
+    SORTREFRESH ON and OFF runs end in the identical device state."""
+    hashes = {}
+    for inscan in (False, True):
+        sim = _make_sim(chunk_steps=PERIOD_STEPS)
+        if inscan:
+            assert sim.set_inscan_refresh(True)
+        sim.op()
+        sim.run(until_simt=12.0)
+        sim.drain_pipeline()
+        if inscan:
+            rh = sim.refresh_health()
+            assert rh["active"]
+            assert rh["inscan_refreshes"] > 0
+            assert rh["guard_trips"] == 0
+        hashes[inscan] = _state_hash(sim.traf.state)
+    assert hashes[True] == hashes[False]
+
+
+@pytest.mark.slow
+def test_sim_20step_chunks_zero_edge_refreshes():
+    """The interactive-chunk acceptance: with in-scan ON a 20-step-chunk
+    run performs ZERO host edge refreshes (``sim_sort_refresh_ms``
+    stays empty) while the in-scan counter advances."""
+    sim = _make_sim(chunk_steps=20)
+    assert sim.set_inscan_refresh(True)
+    sim.op()
+    sim.run(until_simt=10.0)
+    sim.drain_pipeline()
+    h = sim.obs.get("sim_sort_refresh_ms")
+    assert h is None or int(h.count) == 0, \
+        f"host edge refresh ran {h.count}x with in-scan ON"
+    assert int(sim.obs.counter("sim_inscan_refreshes").value) > 0
+    assert sim.refresh_health()["last_refresh_simt"] >= 0
+
+
+@pytest.mark.slow
+def test_sim_creation_invalidates_due_gate():
+    """A creation flush mid-run routes through ``_invalidate_sort``:
+    the NEXT chunk's gate seeds cold (-1) and refreshes at its first
+    step, and the new aircraft's id->slot tracking stays correct."""
+    sim = _make_sim(chunk_steps=20)
+    assert sim.set_inscan_refresh(True)
+    sim.op()
+    sim.run(until_simt=3.0)
+    sim.drain_pipeline()
+    fired0 = sim.refresh_health()["inscan_refreshes"]
+    # spatial-mode creations invalidate via the create hook; sparse
+    # single-device creations only rebuild tables — exercise the
+    # explicit invalidation path the hook and RESET share
+    sim._invalidate_sort()
+    assert sim._sort_t_dev is None and sim._sort_simt < 0
+    sim.stack.stack("CRE KL999 B744 52 4 90 FL200 250")
+    sim.stack.process()
+    sim.run(until_simt=5.0)
+    sim.drain_pipeline()
+    rh = sim.refresh_health()
+    assert rh["inscan_refreshes"] > fired0
+    assert rh["last_refresh_simt"] >= 3.0   # gate re-fired after reseed
+    slot = sim.traf.id2idx("KL999")
+    assert slot >= 0
+    assert abs(float(np.asarray(sim.traf.state.ac.lat)[slot])
+               - 52.0) < 0.3
+
+
+def test_sortrefresh_command_readback():
+    """SORTREFRESH bare call reads back mode + counters; ON/OFF
+    round-trips through the config flag."""
+    sim = _make_sim(n=20, nmax=64)
+    sim.stack.stack("SORTREFRESH")
+    sim.stack.process()
+    assert "SORTREFRESH OFF" in sim.scr.echobuf[-1]
+    sim.stack.stack("SORTREFRESH ON")
+    sim.stack.process()
+    assert sim.cfg.inscan_refresh
+    sim.stack.stack("SORTREFRESH")
+    sim.stack.process()
+    assert "SORTREFRESH ON" in sim.scr.echobuf[-1]
+    assert "HEALTH" not in sim.scr.echobuf[-1]
+    sim.stack.stack("SORTREFRESH OFF")
+    sim.stack.process()
+    assert not sim.cfg.inscan_refresh
