@@ -27,13 +27,15 @@ test-fast:
 # overload/straggler suite (admission control, fairness, hedging,
 # HEALTH — incl. the slow 16-piece FAULT STRAGGLE acceptance case),
 # the packed multi-world serving suite (crash-mid-pack exactly-once
-# demux) and the slow fabric cases (kill -9 a real worker mid-BATCH,
+# demux), the self-healing mitigation suite (network/mitigate.py —
+# incl. the slow closed-loop FAULT STRAGGLE + LOADSPIKE acceptance
+# case) and the slow fabric cases (kill -9 a real worker mid-BATCH,
 # silent-worker reaping).
 chaos:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	$(PYTHON) -m pytest tests/test_chaos.py tests/test_durability.py \
 	tests/test_overload.py tests/test_fabric_hardening.py \
-	tests/test_world_serving.py -q $(XDIST)
+	tests/test_world_serving.py tests/test_mitigate.py -q $(XDIST)
 
 # Mesh-epoch recovery lane (docs/FAULT_TOLERANCE.md §mesh epochs):
 # MeshGuard unit + MESHKILL e2e + re-shard parity, the journal-replay

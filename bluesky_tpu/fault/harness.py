@@ -27,6 +27,11 @@ Usage (stack/commands.py registers it):
                              the sim re-forms a survivor mesh
   FAULT PARTITION [OFF]      heartbeat-only network partition: PONGs
                              dropped, completions still delivered
+  FAULT LOADSPIKE n [rate]   flood the server with n synthetic BATCH
+                             pieces ([rate]/s; default one burst): the
+                             queue-flood model — replay/exactly-once
+                             accounting ignores the filler; admission
+                             control + mitigation shedding respond
   FAULT SNAPTRUNC fname [keep]  truncate a snapshot file (torn write)
   FAULT LIST                 guard trip history
 
@@ -219,6 +224,20 @@ def fault_command(sim, *args):
         return True, (f"FAULT: network partition — dropping [{names}]; "
                       f"worker alive, completions still delivered")
 
+    if sub == "LOADSPIKE":
+        node = _node(sim)
+        if node is None:
+            return False, "FAULT LOADSPIKE: no network node (detached sim)"
+        try:
+            n = int(float(rest[0])) if rest else 16
+            rate = float(rest[1]) if len(rest) > 1 else 0.0
+        except ValueError:
+            return False, "FAULT LOADSPIKE n [rate]"
+        sent = injectors.load_spike(node, n, rate)
+        return True, (f"FAULT: load spike — {sent} synthetic piece(s) "
+                      + (f"at {rate:g}/s" if rate > 0 else "in one burst")
+                      + "; over-limit submissions bounce as BATCHREJECTED")
+
     if sub == "SNAPTRUNC":
         if not rest:
             return False, "FAULT SNAPTRUNC filename [keep_fraction]"
@@ -243,4 +262,4 @@ def fault_command(sim, *args):
     return False, ("FAULT NAN/INF [acid] | GUARD .. | RING .. | DROP/DUP/"
                    "DELAY p | NETOFF | STALL s | STRAGGLE f/STALL/OFF | "
                    "KILL | PREEMPT [s] | MESHKILL [g] | PARTITION [OFF] | "
-                   "SNAPTRUNC f | LIST")
+                   "LOADSPIKE n [rate] | SNAPTRUNC f | LIST")

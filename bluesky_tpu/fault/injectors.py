@@ -222,6 +222,60 @@ def straggle(sim, factor: float = 0.0, stall_progress: bool = False,
     return None
 
 
+_spike_seq = [0]                   # distinct piece content per injection
+
+
+def load_spike(node, n, rate=0.0, tag="LS"):
+    """Flood the server with ``n`` SYNTHETIC BATCH pieces — the
+    queue-flood / thundering-herd model that drives the admission and
+    load-shedding path (server-side mitigation is the response).
+
+    Pieces are tiny self-draining sweeps (SCEN/CRE/FF/HOLD, like a real
+    mini-sweep) submitted with ``synthetic: true``: the journal marks
+    their ``queued`` records so replay's exactly-once accounting skips
+    them — a resumed sweep is never owed load-spike noise.  Over-limit
+    submissions come back as normal ``BATCHREJECTED`` refusals (echoed
+    by the node), which is precisely the overload being modelled.
+
+    ``rate`` pieces/second paces the flood with one submission per
+    piece (``rate<=0``: one burst submission carrying all n).  Pacing
+    sleeps on the calling thread — the injecting worker's event loop
+    stalls for ``n/rate`` seconds, capped at 30 s — so keep paced
+    spikes short; the burst mode costs nothing.
+
+    Returns the number of pieces submitted."""
+    _spike_seq[0] += 1
+    nonce = f"{os.getpid():x}-{_spike_seq[0]:x}"
+    n = max(1, int(n))
+    rate = float(rate)
+
+    def _piece(i):
+        name = f"{tag}{nonce}-{i:04d}"
+        return ([0.0, 0.0, 0.0, 60.0],
+                [f"SCEN {name}",
+                 f"CRE {name} B744 {40 + (i % 20)} 4 90 FL200 250",
+                 "FF", "HOLD"])
+
+    if rate <= 0:
+        scentime, scencmd = [], []
+        for i in range(n):
+            t, c = _piece(i)
+            scentime += t
+            scencmd += c
+        node.send_event(b"BATCH", {"scentime": scentime,
+                                   "scencmd": scencmd,
+                                   "synthetic": True})
+        return n
+    n = min(n, max(1, int(rate * 30.0)))   # cap the loop-stall at 30 s
+    for i in range(n):
+        t, c = _piece(i)
+        node.send_event(b"BATCH", {"scentime": t, "scencmd": c,
+                                   "synthetic": True})
+        if i + 1 < n:
+            time.sleep(1.0 / rate)
+    return n
+
+
 # ------------------------------------------------------------- file faults
 def truncate_file(fname: str, keep_fraction: float = 0.5) -> int:
     """Truncate a file (snapshot, log) to a fraction of its size —

@@ -63,6 +63,21 @@ Record types (one JSON object per line, ``rec`` selects the type):
                                             queue math/exactly-once
                                             unaffected; surfaced by
                                             replay for inspection
+  ``mitigation``  {cause, signal, action, target, outcome}  the
+                                            mitigation engine
+                                            (network/mitigate.py) acted
+                                            on a sentinel signal —
+                                            hedge escalation, load
+                                            shed/unshed, re-pack,
+                                            accept-degraded.  AUDIT
+                                            only: queue math and
+                                            exactly-once never see it;
+                                            replay surfaces the history
+                                            under ``mitigations``.  May
+                                            carry a piece ``key`` when
+                                            the action targets one
+                                            piece; shed/repack actions
+                                            have none.
   ``device_profile`` {worker, dir, chunks}  PROFILE DEVICE window: the
                                             XLA trace dir a worker
                                             captured (audit; links the
@@ -79,6 +94,13 @@ audit field) as the worker's BATCHWORLD events arrive.  Replay needs no
 pack awareness: owed copies stay queued-minus-completed per content
 key, so a crash mid-pack requeues exactly the worlds whose pieces never
 completed.
+
+Synthetic pieces (the ``FAULT LOADSPIKE`` chaos injector): their
+``queued`` records carry ``synthetic: true`` and replay SKIPS them —
+load-spike filler exercises admission/shedding but must never be owed
+to a resumed sweep, so exactly-once accounting ignores the whole
+lifecycle of a synthetic key (its dispatched/completed records fall
+through the unknown-key filter).
 
 Piece identity is content-addressed (sha256 over the canonical JSON of
 ``(scentime, scencmd)``), so keys are stable across restarts and across
@@ -161,21 +183,25 @@ class BatchJournal:
         self._write([dict(rec=rec, **fields)])
 
     @classmethod
-    def _queued_rec(cls, piece):
+    def _queued_rec(cls, piece, synthetic=False):
         scentime, scencmd = piece
-        return dict(rec="queued", key=cls.piece_key(piece),
-                    scentime=[float(t) for t in scentime],
-                    scencmd=[str(c) for c in scencmd])
+        rec = dict(rec="queued", key=cls.piece_key(piece),
+                   scentime=[float(t) for t in scentime],
+                   scencmd=[str(c) for c in scencmd])
+        if synthetic:
+            # chaos filler (FAULT LOADSPIKE): replay must never owe it
+            rec["synthetic"] = True
+        return rec
 
-    def queued(self, piece):
-        self._write([self._queued_rec(piece)])
+    def queued(self, piece, synthetic=False):
+        self._write([self._queued_rec(piece, synthetic)])
 
-    def queued_many(self, pieces):
+    def queued_many(self, pieces, synthetic=False):
         """Journal a whole BATCH submission with ONE flush+fsync — the
         WAL guarantee only needs the batch on disk before any dispatch,
         and per-piece fsyncs would stall the broker poll loop for large
         sweeps."""
-        self._write([self._queued_rec(p) for p in pieces])
+        self._write([self._queued_rec(p, synthetic) for p in pieces])
 
     def dispatched(self, piece, worker: bytes = b"", world=None,
                    pack=None):
@@ -275,6 +301,23 @@ class BatchJournal:
             rec["factor"] = float(factor)
         self.append("perf_regression", **rec)
 
+    def mitigation(self, cause="", signal="", action="", target="",
+                   outcome="", piece=None, worker: bytes = b""):
+        """The mitigation engine (network/mitigate.py) took an action
+        on a sentinel signal.  AUDIT record — replay surfaces the
+        decision history under ``mitigations`` but the queue math and
+        exactly-once accounting never see it.  ``piece`` (when the
+        action targets one piece, e.g. a hedge escalation) adds the
+        content key so the decision links to the piece's lifecycle."""
+        rec = dict(cause=str(cause), signal=str(signal),
+                   action=str(action), target=str(target),
+                   outcome=str(outcome))
+        if piece is not None:
+            rec["key"] = self.piece_key(piece)
+        if worker:
+            rec["worker"] = worker.hex()
+        self.append("mitigation", **rec)
+
     def device_profile(self, worker: bytes = b"", dir="", chunks=None):
         """A worker opened a PROFILE DEVICE window: journal the XLA
         trace dir so the sweep's record links to the captured trace.
@@ -325,6 +368,8 @@ class BatchJournal:
         crashes, qcrashes = {}, {}
         opt_results = []
         perf_regressions = []
+        mitigations = []
+        synthetic = 0
         torn = 0
         # errors="replace": disk-level byte corruption must surface as
         # skipped torn lines, not a UnicodeDecodeError that escapes the
@@ -341,11 +386,28 @@ class BatchJournal:
                     continue
                 rec, key = r.get("rec"), r.get("key")
                 if rec == "queued" and key:
+                    if r.get("synthetic"):
+                        # LOADSPIKE chaos filler: never owed to a
+                        # resumed sweep — skipping the queued record
+                        # makes the key unknown, so the copy's later
+                        # dispatched/completed records fall through
+                        # the unknown-key filter below too
+                        synthetic += 1
+                        continue
                     if key not in pieces:
                         order.append(key)
                     pieces[key] = (list(r.get("scentime", [])),
                                    list(r.get("scencmd", [])))
                     n_queued[key] = n_queued.get(key, 0) + 1
+                elif rec == "mitigation":
+                    # mitigation-engine decision (audit; surfaced even
+                    # keyless — shed/repack actions target no piece)
+                    mitigations.append(
+                        {"key": key, "cause": r.get("cause", ""),
+                         "signal": r.get("signal", ""),
+                         "action": r.get("action", ""),
+                         "target": r.get("target", ""),
+                         "outcome": r.get("outcome", "")})
                 elif key not in pieces:
                     continue              # marker records / unknown key
                 elif rec in ("dispatched", "preempted", "hedged",
@@ -400,5 +462,7 @@ class BatchJournal:
             quarantined_crashes=qcrashes,
             opt_results=opt_results,
             perf_regressions=perf_regressions,
+            mitigations=mitigations,
+            synthetic_skipped=synthetic,
             torn_lines=torn,
         )

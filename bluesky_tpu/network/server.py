@@ -252,7 +252,7 @@ class Server(threading.Thread):
                  journal_path=None, resume_journal=None,
                  straggler_timeout=None, hedge_enabled=None,
                  batch_queue_max=None, world_pack=None,
-                 world_batch_max=None):
+                 world_batch_max=None, mitigate_enabled=None):
         super().__init__(daemon=True)
         # Observability (ISSUE-11, docs/OBSERVABILITY.md): the broker's
         # own registry (counters above, demux/queue series below), the
@@ -383,6 +383,13 @@ class Server(threading.Thread):
             journal_path,
             fsync=getattr(_settings, "batch_journal_fsync", True)) \
             if journal_path else None
+        # ----- self-healing serving (network/mitigate.py): the policy
+        # engine that turns sentinel flags into journaled actions.
+        # Disabled (default) it is completely inert — journal and
+        # HEALTH output stay bit-identical to a build without it.
+        from .mitigate import MitigationEngine
+        self.mitigator = MitigationEngine(self,
+                                          enabled=mitigate_enabled)
         # ----- server-to-server chaining
         self.upstream = upstream           # (host, event_port) or None
         self.link = None                   # DEALER to the upstream server
@@ -614,6 +621,26 @@ class Server(threading.Thread):
             self.scenarios.push_front(piece, owner)
             if self.journal:
                 self.journal.crashed(piece, count)
+        self._sweep_slo(piece)
+
+    def _sweep_slo(self, piece):
+        """Drop the SLO watch's bookkeeping for a piece leaving flight
+        (completed, requeued or quarantined) so week-long soaks never
+        grow ``_slo_flagged``/``_slo_recent`` unboundedly.  Sweeps
+        every worker's entry for the piece — a completion/requeue ends
+        the flight of ALL its copies (hedge halves included), and a
+        re-dispatch re-flags on its own merit."""
+        if not self._slo_flagged and not self._slo_recent:
+            return
+        from .journal import BatchJournal
+        key = BatchJournal.piece_key(piece)
+        for flag in [f for f in self._slo_flagged if f[1] == key]:
+            self._slo_flagged.discard(flag)
+        pname = self._piece_name(piece)
+        kept = [r for r in self._slo_recent if r.get("piece") != pname]
+        if len(kept) != len(self._slo_recent):
+            self._slo_recent.clear()
+            self._slo_recent.extend(kept)
 
     def _nodeschanged(self):
         """Notify clients; chained remote nodes are merged in (reference
@@ -715,6 +742,7 @@ class Server(threading.Thread):
                             # server will never requeue this piece
                             self.journal.completed(piece, sender)
                         self._resolve_hedge_win(sender, piece)
+                        self._sweep_slo(piece)
                     elif sender in self._cancel_pending:
                         # the hedge LOSER finished before its cancel
                         # landed (its BATCHCANCELLED ack would have
@@ -814,6 +842,16 @@ class Server(threading.Thread):
                     self.world_batch_max = max(1, int(data["max"]))
             sock.send_multipart(
                 [sender, b"WORLDS", packb(self.worlds_payload())])
+        elif name == b"MITIGATE":
+            # MITIGATE stack/client command: flip the mitigation
+            # engine (payload dict) and/or read its state back
+            # HEALTH-style.  Disabling restores every actuator the
+            # engine touched (mitigate.set_enabled).
+            data = unpackb(payload) if payload else None
+            if isinstance(data, dict) and "enabled" in data:
+                self.mitigator.set_enabled(data["enabled"])
+            sock.send_multipart(
+                [sender, b"MITIGATE", packb(self.mitigator.payload())])
         elif name == b"BATCHCANCELLED" and from_worker:
             # hedge loser acked the cancel (it had NOT completed: a
             # completion would have arrived first on the FIFO pair)
@@ -868,6 +906,7 @@ class Server(threading.Thread):
                 self.scenarios.push_front(piece, owner)
                 if self.journal:
                     self.journal.preempted(piece, sender)
+                self._sweep_slo(piece)
                 # hand the piece straight to an idle worker if one is
                 # available — the preempted worker's own STATECHANGE(-1)
                 # only spawns replacements, it does not dispatch
@@ -911,6 +950,12 @@ class Server(threading.Thread):
                                                epoch=epoch,
                                                ndev=ev.get("ndev"),
                                                mode=ev.get("mode"))
+                if ev.get("degraded") and piece is not None:
+                    # mitigation: accept the degraded epoch instead of
+                    # requeueing — journaled so the acceptance audits
+                    self.mitigator.on_mesh_degraded(sender, piece,
+                                                    epoch,
+                                                    ev.get("ndev"))
                 msg = (f"worker {sender.hex()} mesh epoch {epoch}: "
                        f"lost group(s) {lost}, resharded to "
                        f"{ev.get('ndev')} device(s) "
@@ -941,6 +986,7 @@ class Server(threading.Thread):
                     if self.journal:
                         self.journal.mesh_lost(piece, sender,
                                                epoch=epoch, lost=lost)
+                    self._sweep_slo(piece)
                     while self.avail_workers and self.scenarios:
                         self._send_pending_scenario()
                 msg = (f"worker {sender.hex()} mesh lost "
@@ -972,8 +1018,12 @@ class Server(threading.Thread):
                 return
             if self.journal:
                 # one flush+fsync for the whole submission — per-piece
-                # syncs would stall the poll loop on large sweeps
-                self.journal.queued_many(pieces)
+                # syncs would stall the poll loop on large sweeps.
+                # Synthetic pieces (FAULT LOADSPIKE chaos filler) are
+                # marked so replay's exactly-once accounting skips
+                # them: a resumed sweep is never owed load-spike noise.
+                self.journal.queued_many(
+                    pieces, synthetic=bool(data.get("synthetic")))
             self.scenarios.extend(pieces, owner=sender)
             while self.avail_workers and self.scenarios:
                 self._send_pending_scenario()
@@ -1144,8 +1194,14 @@ class Server(threading.Thread):
         to the busy-PING budget) but whose progress has not advanced
         for ``straggler_timeout`` — or whose rate sits far below the
         fleet median — is hedged to an idle worker.  First completion
-        wins; the loser is cancelled."""
-        if not self.hedge_enabled or self.straggler_timeout <= 0 \
+        wins; the loser is cancelled.
+
+        With ``hedge_enabled`` off but the mitigation engine on, a
+        detected straggler is handed to the engine instead: mitigation
+        IS the operator typing the hedge, gated by its rate limits,
+        backoff and budget (network/mitigate.py)."""
+        if not (self.hedge_enabled or self.mitigator.enabled) \
+                or self.straggler_timeout <= 0 \
                 or not self.avail_workers:
             return
         fresh = 3.0 * self.hb_interval     # report recency window
@@ -1175,9 +1231,12 @@ class Server(threading.Thread):
             slow = median is not None and prog.get("ff") \
                 and prog["rate"] < self.hedge_rate_factor * median
             if stalled or slow:
-                self._dispatch_hedge(
-                    wid, piece, "stalled" if stalled else
-                    f"rate {prog['rate']:.2f} << median {median:.2f}")
+                why = "stalled" if stalled else \
+                    f"rate {prog['rate']:.2f} << median {median:.2f}"
+                if self.hedge_enabled:
+                    self._dispatch_hedge(wid, piece, why)
+                else:
+                    self.mitigator.on_straggler(wid, piece, why, now)
 
     def _fresh_ff_median(self, now):
         """Fleet-median progress rate over fresh fast-forward reports
@@ -1239,6 +1298,12 @@ class Server(threading.Thread):
                 {"worker": wid.hex(), "piece": pname,
                  "rate": round(prog["rate"], 4),
                  "baseline": round(median, 4)})
+            # mitigation: escalate a hedge for the flagged piece (the
+            # engine gates with rate limit / backoff / budget; inert
+            # when disabled)
+            self.mitigator.on_perf_regression(wid, piece,
+                                              prog["rate"], median,
+                                              now)
 
     def _dispatch_hedge(self, wid, piece, why):
         """Send a second copy of ``wid``'s in-flight piece to an idle
@@ -1450,6 +1515,13 @@ class Server(threading.Thread):
             data["mesh"] = mesh
         if scan is not None:
             data["scan"] = scan
+        # mitigation section ONLY while the engine is enabled: with
+        # mitigate_enabled=0 the HEALTH payload must stay bit-identical
+        # to a build without the engine (the audit-only contract)
+        if self.mitigator.enabled:
+            data["mitigation"] = {
+                k: v for k, v in self.mitigator.payload().items()
+                if k != "text"}
         data["text"] = self._health_text(data)
         return data
 
@@ -1496,6 +1568,18 @@ class Server(threading.Thread):
                 + (f"{ms:g} m" if ms is not None else "n/a")
                 + f", clamp-sat {sc.get('clamp_sat_ratio', 0):.1%}, "
                   f"occ peak {sc.get('occ_peak', 0)}")
+        mi = d.get("mitigation")
+        if mi:
+            b = mi.get("budget", {})
+            taken = sum(mi.get("actions", {}).values())
+            supp = sum(mi.get("suppressed", {}).values())
+            lines.append(
+                f"mitigation: ON, {taken} action(s), {supp} "
+                "suppressed, budget "
+                + (f"{b.get('remaining')}/{b.get('total')} left"
+                   if b.get("total") else "unbounded")
+                + (", SHEDDING" if mi.get("shed_active") else "")
+                + (", REPACKED" if mi.get("repack_active") else ""))
         p = d.get("perf")
         if p:
             med = p.get("fleet_median_rate")
@@ -1674,6 +1758,7 @@ class Server(threading.Thread):
                 self._reap_dead_workers()
                 self._check_stragglers(now)
                 self._check_perf_slo(now)
+                self.mitigator.tick(now)
                 self.obs.gauge("server_queue_depth").set(
                     len(self.scenarios))
                 self.obs.maybe_export()

@@ -231,6 +231,40 @@ perf_slo_factor = 0.0             # serving SLO watch: journal a
                                   # sensible values sit BELOW the
                                   # hedge_rate_factor so hedging fires
                                   # first and the journal explains why)
+# ----- self-healing serving (network/mitigate.py; MITIGATE stack
+# command; docs/FAULT_TOLERANCE.md §mitigation).  The mitigation engine
+# maps sentinel signals (SLO perf_regression, straggler stall, degraded
+# mesh epochs, admission-queue pressure, memory watermarks) to the
+# actuators the fabric already has.  Every action passes a per-action
+# token-bucket rate limit, exponential per-target backoff and a global
+# budget; decisions are journaled as audit-only ``mitigation`` records.
+# With mitigate_enabled off the engine is inert: journal and HEALTH
+# output are bit-identical to a build without it.
+mitigate_enabled = False          # closed-loop mitigation on the server
+mitigate_budget = 64              # lifetime cap on degrading actions a
+                                  # server may take (0 = unbounded);
+                                  # restores (unshed/unrepack) are free
+mitigate_rate = 4                 # token-bucket capacity per action ...
+mitigate_rate_window = 60.0       # ... refilled over this window [s]
+mitigate_backoff_base = 5.0       # [s] first per-(action,target) delay
+mitigate_backoff_cap = 300.0      # [s] exponential-backoff ceiling
+mitigate_shed_hi = 0.8            # shed load (tighten batch_queue_max)
+                                  # when queue depth rises past this
+                                  # fraction of the admission limit ...
+mitigate_shed_lo = 0.3            # ... and restore it only once depth
+                                  # falls below this fraction
+                                  # (hysteresis: no shed/unshed flap)
+mitigate_shed_factor = 0.5        # shed tightens batch_queue_max to
+                                  # factor x the configured limit
+mitigate_mem_budget = 0           # [bytes] fleet live-bytes watermark
+                                  # budget (devprof_live_bytes_total
+                                  # from worker heartbeats; 0 = off)
+mitigate_mem_hi = 0.9             # re-pack (shrink world_batch_max)
+                                  # when fleet live bytes rise past
+                                  # this fraction of the budget ...
+mitigate_mem_lo = 0.6             # ... and restore below this fraction
+mitigate_repack_factor = 0.5      # re-pack shrinks world_batch_max to
+                                  # factor x the configured width
 bench_history_path = "BENCH_HISTORY.jsonl"
                                   # append-only bench-row history every
                                   # write_bench_json() call extends

@@ -246,6 +246,11 @@ def _make_simnode_class(base):
                 txt = data.get("text") if isinstance(data, dict) \
                     else str(data)
                 sim.scr.echo(txt or "no worlds data")
+            elif name == b"MITIGATE":
+                # reply to the stack MITIGATE command's server query/set
+                txt = data.get("text") if isinstance(data, dict) \
+                    else str(data)
+                sim.scr.echo(txt or "no mitigation data")
             elif name == b"METRICS":
                 # reply to METRICS DUMP's server query: broker + fleet
                 # registries rendered server-side

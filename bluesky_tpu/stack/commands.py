@@ -1360,6 +1360,37 @@ def register_all(stack):
             return True, f"WORLDS max {n} pieces/dispatch"
         return False, "WORLDS [ON/OFF | MAX n]"
 
+    def mitigatecmd(arg=None):
+        """MITIGATE [ON/OFF/STATUS]: the server's self-healing policy
+        engine (network/mitigate.py) — structured health signals (SLO
+        regressions, stragglers, degraded meshes, queue floods, memory
+        watermarks) mapped to the existing actuators (hedge, shed,
+        re-pack, accept-degraded) behind rate limits, backoff and a
+        global budget.  Bare MITIGATE / MITIGATE STATUS reads the
+        engine state back HEALTH-style; on a detached sim it reports
+        the local settings default a future server would inherit."""
+        from .. import settings as _settings
+        node = getattr(sim, "node", None)
+        networked = node is not None \
+            and getattr(node, "event_io", None) is not None
+        a = str(arg).upper() if arg is not None else ""
+        if a in ("", "STATUS"):
+            if networked:
+                node.send_event(b"MITIGATE", None)  # empty route -> server
+                return True, "MITIGATE status requested from the server"
+            return True, (
+                f"detached sim: mitigation "
+                f"{'ON' if getattr(_settings, 'mitigate_enabled', False) else 'OFF'}"
+                " (settings.mitigate_enabled; a server inherits this)")
+        if a in ("ON", "OFF", "TRUE", "FALSE", "1", "0"):
+            on = a in ("ON", "TRUE", "1")
+            _settings.mitigate_enabled = on
+            if networked:
+                node.send_event(b"MITIGATE", {"enabled": on})
+                return True, f"MITIGATE {'ON' if on else 'OFF'} sent"
+            return True, f"MITIGATE {'ON' if on else 'OFF'}"
+        return False, "MITIGATE [ON/OFF/STATUS]"
+
     def snapshot(sub, fname=None):
         """SNAPSHOT SAVE/LOAD fname: binary pytree state checkpoint
         (device-state snapshot the reference lacks, SURVEY 5.4)."""
@@ -1689,7 +1720,7 @@ def register_all(stack):
         "FAULT": ["FAULT NAN/INF [acid] | GUARD ../RING .. | DROP/DUP/"
                   "DELAY p | NETOFF | STALL s | STRAGGLE f/STALL/OFF | "
                   "KILL | PREEMPT [s] | MESHKILL [g] | PARTITION [OFF] "
-                  "| SNAPTRUNC f | LIST",
+                  "| LOADSPIKE n [rate] | SNAPTRUNC f | LIST",
                   "[word,...]", faultcmd,
                   "Fault-injection harness (chaos testing)"],
         "HEALTH": ["HEALTH", "", healthcmd,
@@ -1707,6 +1738,10 @@ def register_all(stack):
                         "into the compiled chunk (readback bare)"],
         "SNAPSHOT": ["SNAPSHOT SAVE/LOAD fname", "txt,[word]", snapshot,
                      "Save/restore a binary state snapshot"],
+        "MITIGATE": ["MITIGATE [ON/OFF/STATUS]", "[txt]", mitigatecmd,
+                     "Self-healing serving: signal->actuator policy "
+                     "engine behind rate limits, backoff and a budget "
+                     "(readback bare)"],
         "WORLDS": ["WORLDS [ON/OFF | MAX n]", "[txt,txt]", worldscmd,
                    "Multi-world BATCH packing: world-batch size + "
                    "per-bucket packing on/off (readback bare)"],

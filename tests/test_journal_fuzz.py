@@ -48,13 +48,25 @@ def _run_schedule(rng, journal, model):
         for _ in range(rng.randint(0, 3)):
             noise = rng.choice(["preempted", "hedged", "dup_completed",
                                 "mesh_lost", "resharded",
-                                "dispatched"])
+                                "dispatched", "perf_regression",
+                                "mitigation"])
             if noise == "preempted":
                 journal.preempted(p, w, world=rng.choice([None, 0, 1]))
             elif noise == "hedged":
                 journal.hedged(p, w, hedge_worker=b"\x99")
             elif noise == "dup_completed":
                 journal.dup_completed(p, b"\x99")
+            elif noise == "perf_regression":
+                journal.perf_regression(p, w, rate=rng.random(),
+                                        baseline=1.0, factor=0.5)
+            elif noise == "mitigation":
+                journal.mitigation(
+                    cause=rng.choice(["perf_regression", "queue_flood"]),
+                    signal="fuzz", target=w.hex(),
+                    action=rng.choice(["hedge_escalate", "shed",
+                                       "unshed"]),
+                    outcome="ok",
+                    piece=rng.choice([None, p]), worker=w)
             elif noise == "mesh_lost":
                 journal.mesh_lost(p, w, epoch=rng.randint(0, 3),
                                   lost=[1])
@@ -153,7 +165,8 @@ def test_replay_exactly_once_across_crashes(tmp_path, seed):
         except json.JSONDecodeError:
             continue
         if r.get("rec") in ("dispatched", "preempted", "hedged",
-                            "dup_completed", "mesh_lost", "resharded"):
+                            "dup_completed", "mesh_lost", "resharded",
+                            "perf_regression", "mitigation"):
             audit.append(ln)
     rng.shuffle(audit)
     with open(path, "a", encoding="utf-8") as f:
@@ -166,9 +179,11 @@ def test_replay_exactly_once_across_crashes(tmp_path, seed):
 
 
 def test_replay_pure_audit_noise_changes_nothing(tmp_path):
-    """mesh_lost / resharded / hedged / preempted / dup_completed are
-    narration: a journal with every piece completed must fold to an
-    empty pending queue no matter how much audit noise rides along."""
+    """mesh_lost / resharded / hedged / preempted / dup_completed /
+    perf_regression / mitigation are narration: a journal with every
+    piece completed must fold to an empty pending queue no matter how
+    much audit noise rides along — and replay surfaces the mitigation
+    history verbatim for the auditor."""
     path = str(tmp_path / "batch.jsonl")
     j = BatchJournal(path, fsync=False)
     pieces = [_piece(i) for i in range(3)]
@@ -179,10 +194,53 @@ def test_replay_pure_audit_noise_changes_nothing(tmp_path):
         j.resharded(p, b"\x01", epoch=1, ndev=4, mode="replicate")
         j.preempted(p, b"\x01")
         j.hedged(p, b"\x01", hedge_worker=b"\x02")
+        j.perf_regression(p, b"\x01", rate=0.5, baseline=2.0,
+                          factor=0.5)
+        j.mitigation(cause="perf_regression", signal="slo_watch",
+                     action="hedge_escalate", target="01",
+                     outcome="hedged to 02", piece=p, worker=b"\x01")
         j.completed(p, b"\x01")
         j.dup_completed(p, b"\x02")
+    # keyless mitigation records (shed/unshed target the admission
+    # path, not a piece) must survive the fold too
+    j.mitigation(cause="queue_flood", signal="queue_depth",
+                 action="shed", target="admission", outcome="max 32->16")
+    j.mitigation(cause="queue_drain", signal="queue_depth",
+                 action="unshed", target="admission", outcome="max 16->32")
     j.close()
     state = BatchJournal.replay(path)
     assert state["pending"] == []
     assert len(state["completed"]) == 3
     assert state["torn_lines"] == 0
+    # the decision history is surfaced, in journal order
+    mits = state["mitigations"]
+    assert len(mits) == 5
+    assert [m["action"] for m in mits] == ["hedge_escalate"] * 3 \
+        + ["shed", "unshed"]
+    assert mits[0]["cause"] == "perf_regression"
+    assert mits[0]["key"] == BatchJournal.piece_key(pieces[0])
+    assert mits[3]["key"] is None
+    assert mits[4]["outcome"] == "max 16->32"
+
+
+def test_replay_skips_synthetic_pieces(tmp_path):
+    """Load-spike filler (FAULT LOADSPIKE) is queued with
+    ``synthetic=True``: replay must never owe those pieces — a resumed
+    sweep owes real work only — and must count what it skipped."""
+    path = str(tmp_path / "batch.jsonl")
+    j = BatchJournal(path, fsync=False)
+    real = [_piece(i) for i in range(2)]
+    fake = [([0.0], [f"SCEN LS{i}", "FF"]) for i in range(3)]
+    j.queued_many(real)
+    j.queued_many(fake, synthetic=True)
+    j.completed(real[0], b"\x01")
+    # a synthetic piece completing (it drained before the crash) must
+    # not resurrect it either
+    j.dispatched(fake[0], b"\x01")
+    j.completed(fake[0], b"\x01")
+    j.close()
+    state = BatchJournal.replay(path)
+    assert state["synthetic_skipped"] == 3
+    pend = {BatchJournal.piece_key(p) for p in state["pending"]}
+    assert pend == {BatchJournal.piece_key(real[1])}
+    assert len(state["completed"]) == 1

@@ -1,0 +1,693 @@
+"""Self-healing serving: the mitigation policy engine
+(network/mitigate.py; docs/FAULT_TOLERANCE.md recovery matrix).
+
+* Gates: global budget, per-action token bucket, exponential
+  per-(action, target) backoff — suppressions counted, never journaled.
+* Actuation: SLO-regression / straggler hedge escalation, queue-
+  pressure shed/unshed with hysteresis, memory-watermark repack/
+  unrepack, accept-degraded mesh epochs — every decision journaled as
+  an audit-only ``mitigation`` record.
+* The off contract: with ``mitigate_enabled=0`` the server's journal,
+  HEALTH payload and registry are bit-identical to a build without the
+  engine.
+* Closed-loop chaos acceptance (slow): FAULT STRAGGLE + FAULT
+  LOADSPIKE against a live 3-worker fabric converge back inside SLO
+  with ZERO operator commands, proven from the journal alone.
+"""
+import time
+
+import pytest
+
+zmq = pytest.importorskip("zmq")
+
+from bluesky_tpu.network.common import make_id
+from bluesky_tpu.network.journal import BatchJournal
+from bluesky_tpu.network.mitigate import MitigationEngine, TokenBucket
+from bluesky_tpu.network.server import Server
+from tests.test_network import free_ports, wait_for
+from tests.test_overload import (FakeWorker, _batch, _batch_sweep,
+                                 _connect, _mkserver, _records)
+
+
+# ----------------------------------------------------------------- helpers
+def _piece(i, tag="MT"):
+    return ([0.0], [f"SCEN {tag}{i}"])
+
+
+def _bare(tmp_path=None, **kw):
+    """An unstarted broker (sockets bound, loop not running) — the
+    detectors and the engine are driven by hand."""
+    kw.setdefault("journal_path",
+                  str(tmp_path / "m.jsonl") if tmp_path else "")
+    s = Server(headless=True, spawn_workers=False, **kw)
+    return s
+
+
+def _close(s):
+    for sock in (s.fe_event, s.fe_stream, s.be_event, s.be_stream):
+        sock.close()
+    if s.journal:
+        s.journal.close()
+
+
+def _mits(jpath):
+    return [r for r in _records(jpath) if r["rec"] == "mitigation"]
+
+
+def _inject_slo(s, factor=0.5):
+    """Three in-flight FF workers, one at ~1/9 the median rate, plus
+    one idle worker the engine can hedge to (mirrors
+    test_overload.TestServingSLOWatch)."""
+    now = time.monotonic()
+    s.perf_slo_factor = factor
+    a, b, slow = (make_id() for _ in range(3))
+    pieces = {}
+    for w, rate in ((a, 10.0), (b, 9.0), (slow, 1.0)):
+        piece = ([0.0], [f"SCEN {w.hex()[:4]}"])
+        pieces[w] = piece
+        s.workers[w] = 2
+        s.last_seen[w] = now
+        s.inflight[w] = piece
+        s.inflight_t[w] = now - 5.0            # past dispatch grace
+        s.worker_progress[w] = {
+            "simt": 1.0, "chunks": 1, "rate": rate, "t": now,
+            "advance_t": now, "state": 2, "ff": True}
+    idle = make_id()
+    s.workers[idle] = 2
+    s.last_seen[idle] = now
+    s.avail_workers.append(idle)
+    return now, slow, pieces[slow], idle
+
+
+# ------------------------------------------------------------- token bucket
+class TestTokenBucket:
+    def test_capacity_then_continuous_refill(self):
+        b = TokenBucket(2, 10.0)               # 2 tokens per 10 s
+        assert b.take(0.0) and b.take(0.0)
+        assert not b.take(0.0)                 # drained
+        assert not b.take(4.0)                 # 0.8 refilled: still dry
+        assert b.take(5.0)                     # 1.0 token back
+        assert not b.take(5.0)
+
+    def test_never_exceeds_capacity(self):
+        b = TokenBucket(2, 1.0)
+        assert b.take(0.0)
+        # a long idle period refills to CAPACITY, not 1 + 100 windows
+        assert b.take(100.0) and b.take(100.0)
+        assert not b.take(100.0)
+
+
+# ------------------------------------------------------------------- gates
+class TestGates:
+    def _engine(self, **kw):
+        eng = MitigationEngine(None, enabled=True)
+        for k, v in kw.items():
+            setattr(eng, k, v)
+        return eng
+
+    def test_budget_exhausts_and_suppresses(self):
+        eng = self._engine(budget_total=2, rate=100.0)
+        assert eng._admit("shed", "a", 0.0)
+        assert eng._admit("shed", "b", 1.0)
+        assert not eng._admit("shed", "c", 2.0)
+        assert eng.suppressed["budget"] == 1
+        assert eng.budget_used == 2
+
+    def test_backoff_doubles_to_cap(self):
+        eng = self._engine(budget_total=0, rate=100.0,
+                           backoff_base=5.0, backoff_cap=20.0)
+        assert eng._admit("shed", "a", 0.0)    # arms next_ok=5, delay=5
+        assert not eng._admit("shed", "a", 1.0)
+        assert eng.suppressed["backoff"] == 1
+        assert eng._admit("shed", "a", 5.0)    # delay doubles to 10
+        assert not eng._admit("shed", "a", 14.0)
+        assert eng._admit("shed", "a", 15.0)   # delay doubles to 20
+        assert eng._admit("shed", "a", 35.0)   # capped at 20
+        assert eng._backoff[("shed", "a")][1] == 20.0
+        # a different target is not penalised
+        assert eng._admit("shed", "z", 35.0)
+
+    def test_token_bucket_rate_limits_per_action(self):
+        eng = self._engine(budget_total=0, rate=2.0,
+                           rate_window=1000.0, backoff_base=0.0)
+        assert eng._admit("shed", "a", 0.0)
+        assert eng._admit("shed", "b", 0.0)
+        assert not eng._admit("shed", "c", 0.0)
+        assert eng.suppressed["rate"] == 1
+        # a different ACTION draws from its own bucket
+        assert eng._admit("repack", "a", 0.0)
+
+    def test_backoff_map_is_bounded_by_tick(self, tmp_path):
+        s = _bare(tmp_path, mitigate_enabled=True)
+        try:
+            eng = s.mitigator
+            eng.backoff_cap = 10.0
+            now = time.monotonic()
+            assert eng._admit("shed", "a", now)
+            assert ("shed", "a") in eng._backoff
+            eng.tick(now + 100.0)              # idle past next_ok + cap
+            assert ("shed", "a") not in eng._backoff
+        finally:
+            _close(s)
+
+
+# ------------------------------------------------------- shed / unshed
+class TestShedHysteresis:
+    def test_flood_sheds_drain_restores(self, tmp_path):
+        jpath = str(tmp_path / "m.jsonl")
+        s = _bare(tmp_path, batch_queue_max=10, mitigate_enabled=True)
+        try:
+            s.scenarios.extend([_piece(i) for i in range(8)],
+                               owner=b"C")
+            now = time.monotonic()
+            s.mitigator.tick(now)
+            assert s.batch_queue_max == 5      # 10 * shed_factor 0.5
+            assert s.mitigator.shed_from == 10
+            # still flooded: level-triggered, but only ONE shed action
+            s.mitigator.tick(now + 1.0)
+            assert s.mitigator.actions["shed"] == 1
+            assert s.batch_queue_max == 5
+            # drain below shed_lo x the ORIGINAL limit (0.3 * 10 = 3)
+            while len(s.scenarios) > 2:
+                s.scenarios.pop_next()
+            s.mitigator.tick(now + 2.0)
+            assert s.batch_queue_max == 10
+            assert s.mitigator.shed_from is None
+            recs = _mits(jpath)
+            assert [r["action"] for r in recs] == ["shed", "unshed"]
+            assert recs[0]["signal"] == "queue_pressure"
+            assert "10 -> 5" in recs[0]["outcome"]
+            assert "5 -> 10" in recs[1]["outcome"]
+            assert s.obs.get("server_mitigations").value == 2
+            assert s.obs.get("server_mitigation_shed").value == 1
+            assert s.obs.get("server_mitigation_unshed").value == 1
+        finally:
+            _close(s)
+
+    def test_hysteresis_band_never_flaps(self, tmp_path):
+        s = _bare(tmp_path, batch_queue_max=10, mitigate_enabled=True)
+        try:
+            s.scenarios.extend([_piece(i) for i in range(8)],
+                               owner=b"C")
+            now = time.monotonic()
+            s.mitigator.tick(now)
+            assert s.mitigator.shed_from == 10
+            # depth 5: inside the band (above lo=3, below hi=8) —
+            # shed stays armed, no unshed, no re-shed, forever
+            while len(s.scenarios) > 5:
+                s.scenarios.pop_next()
+            for i in range(5):
+                s.mitigator.tick(now + 1.0 + i)
+            assert s.mitigator.shed_from == 10
+            assert s.mitigator.actions["shed"] == 1
+            assert "unshed" not in s.mitigator.actions
+        finally:
+            _close(s)
+
+    def test_unbounded_admission_has_nothing_to_shed(self, tmp_path):
+        s = _bare(tmp_path, batch_queue_max=0, mitigate_enabled=True)
+        try:
+            s.scenarios.extend([_piece(i) for i in range(50)],
+                               owner=b"C")
+            s.mitigator.tick(time.monotonic())
+            assert not s.mitigator.actions
+        finally:
+            _close(s)
+
+
+# ---------------------------------------------------- repack / unrepack
+class TestRepackWatermark:
+    def test_watermark_repacks_and_restores(self, tmp_path):
+        jpath = str(tmp_path / "m.jsonl")
+        s = _bare(tmp_path, mitigate_enabled=True, world_batch_max=8)
+        try:
+            eng = s.mitigator
+            eng.mem_budget = 1000              # bytes, via settings knob
+            g = s.fleet.gauge("devprof_live_bytes_total")
+            g.set(950)                         # >= 0.9 x budget
+            now = time.monotonic()
+            eng.tick(now)
+            assert s.world_batch_max == 4
+            assert eng.repack_from == 8
+            g.set(700)                         # inside the band
+            eng.tick(now + 1.0)
+            assert s.world_batch_max == 4
+            g.set(500)                         # <= 0.6 x budget
+            eng.tick(now + 2.0)
+            assert s.world_batch_max == 8
+            assert eng.repack_from is None
+            recs = _mits(jpath)
+            assert [r["action"] for r in recs] == ["repack", "unrepack"]
+            assert recs[0]["signal"] == "mem_watermark"
+        finally:
+            _close(s)
+
+    def test_no_budget_means_watch_off(self, tmp_path):
+        s = _bare(tmp_path, mitigate_enabled=True, world_batch_max=8)
+        try:
+            s.fleet.gauge("devprof_live_bytes_total").set(10 ** 12)
+            s.mitigator.tick(time.monotonic())  # mem_budget default 0
+            assert s.world_batch_max == 8
+            assert not s.mitigator.actions
+        finally:
+            _close(s)
+
+
+# --------------------------------------------------- hedge escalation
+class TestHedgeEscalation:
+    def test_slo_flag_escalates_hedge_sentinel_before_action(
+            self, tmp_path):
+        jpath = str(tmp_path / "m.jsonl")
+        s = _bare(tmp_path, hb_interval=0.1, straggler_timeout=1.0,
+                  hedge_enabled=False, mitigate_enabled=True)
+        try:
+            now, slow, piece, idle = _inject_slo(s)
+            if s.journal:
+                s.journal.queued(piece)
+                s.journal.dispatched(piece, slow)
+            s._check_perf_slo(now)
+            assert s.perf_regressions == 1
+            assert s.hedges_started == 1       # mitigation DID hedge
+            assert s.hedge_by[slow] == idle
+            key = BatchJournal.piece_key(piece)
+            recs = _records(jpath)
+            sentinel = next(i for i, r in enumerate(recs)
+                            if r["rec"] == "perf_regression")
+            action = next(i for i, r in enumerate(recs)
+                          if r["rec"] == "mitigation")
+            assert sentinel < action           # flag, THEN the response
+            m = recs[action]
+            assert m["signal"] == "perf_regression"
+            assert m["action"] == "hedge_escalate"
+            assert m["target"] == slow.hex() and m["key"] == key
+            assert idle.hex() in m["outcome"]
+            # once: the flag dedup upstream keeps the engine quiet
+            s._check_perf_slo(time.monotonic())
+            assert s.hedges_started == 1
+            assert len(_mits(jpath)) == 1
+        finally:
+            _close(s)
+
+    def test_straggler_hook_hedges_through_the_gates(self, tmp_path):
+        jpath = str(tmp_path / "m.jsonl")
+        s = _bare(tmp_path, hedge_enabled=False, mitigate_enabled=True)
+        try:
+            now, slow, piece, idle = _inject_slo(s, factor=0.0)
+            s.mitigator.on_straggler(slow, piece, "stalled", now)
+            assert s.hedges_started == 1 and s.hedge_by[slow] == idle
+            (m,) = _mits(jpath)
+            assert m["signal"] == "straggler" and m["cause"] == "stalled"
+            # no idle worker left: suppressed, never dispatched
+            other = make_id()
+            s.mitigator.on_straggler(other, _piece(9), "stalled", now)
+            assert s.hedges_started == 1
+            assert s.mitigator.suppressed["no_idle_worker"] == 1
+            assert len(_mits(jpath)) == 1
+        finally:
+            _close(s)
+
+    def test_mesh_degraded_accepted_once_per_epoch(self, tmp_path):
+        jpath = str(tmp_path / "m.jsonl")
+        s = _bare(tmp_path, mitigate_enabled=True)
+        try:
+            wid, piece = make_id(), _piece(0)
+            s.mitigator.on_mesh_degraded(wid, piece, 1, 4)
+            s.mitigator.on_mesh_degraded(wid, piece, 1, 4)  # same epoch
+            recs = _mits(jpath)
+            assert len(recs) == 1
+            assert recs[0]["action"] == "accept_degraded"
+            assert recs[0]["signal"] == "mesh_degraded"
+            assert recs[0]["key"] == BatchJournal.piece_key(piece)
+            s.mitigator.on_mesh_degraded(wid, piece, 2, 2)  # next epoch
+            assert len(_mits(jpath)) == 2
+        finally:
+            _close(s)
+
+
+# ----------------------------------------------------- control + readback
+class TestControl:
+    def test_disable_restores_actuators_and_goes_inert(self, tmp_path):
+        jpath = str(tmp_path / "m.jsonl")
+        s = _bare(tmp_path, batch_queue_max=10, mitigate_enabled=True)
+        try:
+            s.scenarios.extend([_piece(i) for i in range(9)],
+                               owner=b"C")
+            s.mitigator.tick(time.monotonic())
+            assert s.batch_queue_max == 5
+            s.mitigator.set_enabled(False)
+            assert s.batch_queue_max == 10     # restored on the way out
+            recs = _mits(jpath)
+            assert [r["action"] for r in recs] == ["shed", "unshed"]
+            assert recs[1]["cause"] == "MITIGATE OFF"
+            # inert now: the flood no longer sheds
+            s.mitigator.tick(time.monotonic())
+            assert s.batch_queue_max == 10
+            assert "mitigation" not in s.health_payload()
+        finally:
+            _close(s)
+
+    def test_payload_text_readback(self, tmp_path):
+        s = _bare(tmp_path, batch_queue_max=10, mitigate_enabled=True)
+        try:
+            s.scenarios.extend([_piece(i) for i in range(9)],
+                               owner=b"C")
+            s.mitigator.tick(time.monotonic())
+            d = s.mitigator.payload()
+            assert d["enabled"] and d["shed_active"]
+            assert d["budget"]["used"] == 1
+            assert d["actions"] == {"shed": 1}
+            assert d["recent"][-1]["action"] == "shed"
+            assert "MITIGATE ON" in d["text"] and "SHEDDING" in d["text"]
+            # HEALTH carries the same section + a text line
+            h = s.health_payload()
+            assert h["mitigation"]["shed_active"]
+            assert "mitigation: ON, 1 action(s)" in h["text"]
+        finally:
+            _close(s)
+
+    def test_mitigate_event_round_trip(self):
+        server, ev, st, wev = _mkserver()
+        client = _connect(ev, st)
+        replies = []
+        client.event_received.connect(
+            lambda n, d, s: replies.append(d)
+            if n == b"MITIGATE" else None)
+        try:
+            assert not server.mitigator.enabled    # settings default
+            client.send_event(b"MITIGATE", {"enabled": True},
+                              target=b"")
+            assert wait_for(lambda: (client.receive(10),
+                                     bool(replies))[1], timeout=10)
+            assert replies[0]["enabled"] is True
+            assert server.mitigator.enabled
+            assert replies[0]["text"].startswith("MITIGATE ON")
+            # bare status readback
+            client.send_event(b"MITIGATE", None, target=b"")
+            assert wait_for(lambda: (client.receive(10),
+                                     len(replies) >= 2)[1], timeout=10)
+            assert replies[1]["budget"]["used"] == 0
+        finally:
+            client.close()
+            server.stop()
+            server.join(timeout=5)
+
+
+# ------------------------------------------------- the off contract
+class TestOffBitIdentical:
+    def test_journal_health_and_registry_untouched(self, tmp_path):
+        """mitigate_enabled=0 (the default): same detectors fire, but
+        the journal, HEALTH payload and registry stay bit-identical to
+        a build without the engine."""
+        jpath = str(tmp_path / "m.jsonl")
+        s = _bare(tmp_path, hb_interval=0.1, straggler_timeout=1.0,
+                  hedge_enabled=False, batch_queue_max=4)
+        try:
+            assert not s.mitigator.enabled
+            now, slow, piece, idle = _inject_slo(s)
+            if s.journal:
+                s.journal.queued(piece)
+                s.journal.dispatched(piece, slow)
+            s._check_perf_slo(now)             # sentinel fires...
+            assert s.perf_regressions == 1
+            assert s.hedges_started == 0       # ...nothing actuates
+            s.scenarios.extend([_piece(i) for i in range(4)],
+                               owner=b"C")
+            s.mitigator.tick(now)
+            assert s.batch_queue_max == 4      # no shed
+            s.mitigator.on_straggler(slow, piece, "stalled", now)
+            s.mitigator.on_mesh_degraded(slow, piece, 1, 4)
+            assert s.hedges_started == 0
+            assert not _mits(jpath)
+            h = s.health_payload()
+            assert "mitigation" not in h
+            assert "mitigation" not in h["text"]
+            assert s.obs.get("server_mitigations") is None
+        finally:
+            _close(s)
+
+
+# --------------------------------------------------- SLO bookkeeping sweep
+class TestSloSweep:
+    def test_sweep_drops_flag_and_recent_for_the_piece(self, tmp_path):
+        """Satellite: completing/requeueing a piece sweeps the SLO
+        watch's ``_slo_flagged``/``_slo_recent`` so week-long sweeps
+        never grow them unboundedly."""
+        s = _bare(tmp_path, hb_interval=0.1, straggler_timeout=1.0,
+                  hedge_enabled=False)
+        try:
+            now, slow, piece, idle = _inject_slo(s)
+            s._check_perf_slo(now)
+            assert len(s._slo_flagged) == 1
+            assert len(s._slo_recent) == 1
+            other = _piece(7)                  # unrelated piece: kept
+            s._slo_recent.append({"worker": "ff", "piece": "MT7",
+                                  "rate": 0.1, "baseline": 9.0})
+            s._sweep_slo(other)
+            assert len(s._slo_recent) == 1     # only MT7 swept
+            s._sweep_slo(piece)
+            assert not s._slo_flagged
+            assert not s._slo_recent
+            # re-dispatch of the same content may flag again (fresh
+            # flight, fresh flag)
+            s._check_perf_slo(time.monotonic())
+            assert len(s._slo_flagged) == 1
+            assert s.perf_regressions == 2
+        finally:
+            _close(s)
+
+    def test_completion_path_calls_the_sweep(self, tmp_path):
+        server, ev, st, wev = _mkserver(tmp_path, hb_interval=0.1,
+                                        straggler_timeout=0.5,
+                                        hedge_enabled=False)
+        client = _connect(ev, st)
+        w = FakeWorker(wev)
+        try:
+            assert wait_for(lambda: w.id in server.workers, timeout=10)
+            client.send_event(b"BATCH", _batch(1, "SW"), target=b"")
+            assert wait_for(lambda: w.id in server.inflight,
+                            timeout=10)
+            piece = server.inflight[w.id]
+            key = BatchJournal.piece_key(piece)
+            server._slo_flagged.add((w.id, key))
+            server._slo_recent.append(
+                {"worker": w.id.hex(),
+                 "piece": server._piece_name(piece),
+                 "rate": 0.1, "baseline": 9.0})
+            w.statechange(2)
+            w.statechange(1)                   # piece completes
+            assert wait_for(lambda: not server.inflight, timeout=10)
+            assert wait_for(lambda: not server._slo_flagged, timeout=10)
+            assert not server._slo_recent
+        finally:
+            w.close()
+            client.close()
+            server.stop()
+            server.join(timeout=5)
+
+
+# ------------------------------------------------- MITIGATE stack command
+class TestMitigateCommandDetached:
+    def test_detached_readback_and_toggle(self, monkeypatch):
+        from bluesky_tpu import settings
+        from bluesky_tpu.simulation.sim import Simulation
+        monkeypatch.setattr(settings, "mitigate_enabled", False,
+                            raising=False)
+        sim = Simulation(nmax=8)
+
+        def do(line):
+            sim.stack.stack(line)
+            sim.stack.process()
+            out = "\n".join(sim.scr.echobuf)
+            sim.scr.echobuf.clear()
+            return out
+
+        out = do("MITIGATE")
+        assert "detached sim" in out and "OFF" in out
+        do("MITIGATE ON")
+        assert settings.mitigate_enabled is True
+        out = do("MITIGATE STATUS")
+        assert "ON" in out
+        do("MITIGATE OFF")
+        assert settings.mitigate_enabled is False
+
+
+# ------------------------------------------- closed-loop chaos (slow)
+@pytest.mark.slow
+def test_closed_loop_chaos_converges_without_operator(tmp_path,
+                                                      monkeypatch):
+    """The acceptance case: a live 3-worker fabric with hedging OFF and
+    mitigation ON absorbs FAULT STRAGGLE (leg 1) and a FAULT LOADSPIKE
+    queue flood (leg 2) and converges back inside SLO — queue drained,
+    nothing in flight, journal replay exactly-once — with ZERO operator
+    commands.  Every response is proven from the journal alone."""
+    from bluesky_tpu import settings
+    # widen the shed window so the fast drain cannot race the hb tick
+    monkeypatch.setattr(settings, "mitigate_shed_hi", 0.5,
+                        raising=False)
+    jpath = str(tmp_path / "chaos.jsonl")
+    ev, st, wev, wst = free_ports(4)
+    server = Server(headless=True,
+                    ports=dict(event=ev, stream=st, wevent=wev,
+                               wstream=wst),
+                    spawn_workers=True, max_nnodes=3,
+                    hb_interval=0.25, hb_timeout=30.0,
+                    straggler_timeout=3.0, hedge_enabled=False,
+                    mitigate_enabled=True, batch_queue_max=20,
+                    journal_path=jpath)
+    server.start()
+    time.sleep(0.2)
+    from bluesky_tpu.network.client import Client
+    client = Client()
+    client.connect(event_port=ev, stream_port=st, timeout=30.0)
+    echoes = []
+    client.event_received.connect(
+        lambda n, d, s: echoes.append(str(d))
+        if n == b"ECHO" else None)
+    try:
+        server.addnodes(3)
+        assert wait_for(lambda: (client.receive(10),
+                                 len(server.workers) == 3)[1],
+                        timeout=300), "3 real workers never registered"
+
+        # ---- leg 1: straggler -> mitigation hedge escalation
+        victim = next(iter(server.workers))
+        client.stack("FAULT STRAGGLE STALL", target=victim)
+        assert wait_for(lambda: (client.receive(10),
+                                 any("progress stalled" in e
+                                     for e in echoes))[1],
+                        timeout=60), f"STRAGGLE never acked: {echoes}"
+        client.send_event(b"BATCH", _batch_sweep(12), target=b"")
+        assert wait_for(lambda: (client.receive(10),
+                                 not server.scenarios
+                                 and not server.inflight)[1],
+                        timeout=900), "leg 1 sweep never completed"
+        recs = _records(jpath)
+        mits = [r for r in recs if r["rec"] == "mitigation"]
+        hedge_mits = [m for m in mits if m["action"] == "hedge_escalate"]
+        assert hedge_mits, "straggler was never escalated"
+        m = hedge_mits[0]
+        assert m["target"] == victim.hex()
+        # the decision is backed by an actual hedge on the same piece,
+        # and THAT piece completed exactly once
+        hedged = [r for r in recs if r["rec"] == "hedged"
+                  and r["key"] == m["key"]]
+        assert hedged, "mitigation record without a hedged record"
+        done = [r for r in recs if r["rec"] == "completed"
+                and r["key"] == m["key"]]
+        assert len(done) == 1
+        assert server.hedges_started >= 1
+
+        # ---- leg 2: queue flood -> shed, drain -> unshed.  The spike
+        # rides in through the FAULT harness on a healthy worker; the
+        # 20-piece burst fills the 20-slot queue past shed_hi=0.5.
+        healthy = next(w for w in server.workers if w != victim)
+        n_before = len(mits)
+        client.stack("FAULT LOADSPIKE 20", target=healthy)
+        assert wait_for(lambda: (client.receive(10),
+                                 any(m["action"] == "shed"
+                                     for m in _mits(jpath)))[1],
+                        timeout=120), "flood never shed"
+        # converge: filler drains, admission restored, nothing owed
+        assert wait_for(lambda: (client.receive(10),
+                                 any(m["action"] == "unshed"
+                                     for m in _mits(jpath)))[1],
+                        timeout=900), "drain never unshed"
+        assert wait_for(lambda: (client.receive(10),
+                                 not server.scenarios
+                                 and not server.inflight)[1],
+                        timeout=900), "leg 2 never drained"
+        assert server.batch_queue_max == 20    # actuator restored
+        shed = next(m for m in _mits(jpath)[n_before:]
+                    if m["action"] == "shed")
+        assert shed["signal"] == "queue_pressure"
+
+        # ---- fleet back inside SLO, proven from the journal alone
+        state = BatchJournal.replay(jpath)
+        assert state["pending"] == [], "replay still owes pieces"
+        assert len(state["completed"]) == 12   # the real sweep only
+        assert state["synthetic_skipped"] == 20
+        assert len(state["mitigations"]) == len(_mits(jpath))
+        # HEALTH tells the same story
+        h = server.health_payload()
+        assert h["queue_depth"] == 0
+        assert h["queue_limit"] == 20
+        assert h["mitigation"]["actions"].get("shed", 0) >= 1
+        assert h["mitigation"]["actions"].get("hedge_escalate", 0) >= 1
+    finally:
+        server.stop()
+        server.join(timeout=10)
+        client.close()
+        for proc in server.processes:
+            if proc.poll() is None:
+                proc.kill()
+
+
+@pytest.mark.slow
+def test_meshkill_degraded_epoch_journals_acceptance(tmp_path):
+    """FAULT MESHKILL leg: the worker re-forms a degraded survivor
+    mesh; with mitigation ON the server journals the accept_degraded
+    decision AFTER the mesh_lost/resharded sentinel pair, and the
+    batch still completes exactly-once with no requeue churn."""
+    import threading
+
+    jax = pytest.importorskip("jax")
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    from bluesky_tpu.network.client import Client
+    from bluesky_tpu.simulation.simnode import SimNode
+    scn = tmp_path / "mesh.scn"
+    scn.write_text(
+        "00:00:00.00>SCEN MITMESH\n"
+        "00:00:00.00>CRE AAA1 B744 52 4 90 FL200 250\n"
+        "00:00:00.00>SHARD REPLICATE 8\n"
+        "00:00:00.00>FF\n"
+        "00:01:00.00>FAULT MESHKILL 1\n"
+        "00:03:00.00>HOLD\n")
+    jpath = str(tmp_path / "batch.jsonl")
+    ev, st, wev, wst = free_ports(4)
+    server = Server(headless=True,
+                    ports=dict(event=ev, stream=st, wevent=wev,
+                               wstream=wst),
+                    spawn_workers=False, hb_interval=0.5,
+                    mitigate_enabled=True, journal_path=jpath)
+    server.start()
+    time.sleep(0.2)
+    node = SimNode(event_port=wev, stream_port=wst, nmax=16)
+    nthread = threading.Thread(target=node.run, daemon=True)
+    nthread.start()
+    client = Client()
+    try:
+        client.connect(event_port=ev, stream_port=st, timeout=5.0)
+        assert wait_for(lambda: (client.receive(10),
+                                 len(server.workers) == 1)[1],
+                        timeout=30)
+        client.stack(f"BATCH {scn}")
+
+        def batch_done():
+            client.receive(10)
+            return not server.scenarios and not server.inflight \
+                and any(r["rec"] == "completed"
+                        for r in _records(jpath))
+        assert wait_for(batch_done, timeout=480), _records(jpath)
+        recs = _records(jpath)
+        key = next(r["key"] for r in recs if r["rec"] == "completed")
+        idx = {}
+        for i, r in enumerate(recs):
+            if r.get("key") == key and r["rec"] not in idx:
+                idx[r["rec"]] = i
+        assert idx["mesh_lost"] < idx["resharded"] \
+            < idx["mitigation"] < idx["completed"]
+        m = recs[idx["mitigation"]]
+        assert m["action"] == "accept_degraded"
+        assert m["signal"] == "mesh_degraded"
+        assert "crashed" not in {r["rec"] for r in recs}   # no requeue
+        state = BatchJournal.replay(jpath)
+        assert state["pending"] == [] and len(state["completed"]) == 1
+        (mit,) = state["mitigations"]
+        assert mit["action"] == "accept_degraded"
+    finally:
+        node.quit()
+        nthread.join(timeout=10)
+        server.stop()
+        server.join(timeout=10)
+        client.close()
