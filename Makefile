@@ -29,24 +29,30 @@ test-fast:
 # the packed multi-world serving suite (crash-mid-pack exactly-once
 # demux), the self-healing mitigation suite (network/mitigate.py —
 # incl. the slow closed-loop FAULT STRAGGLE + LOADSPIKE acceptance
-# case) and the slow fabric cases (kill -9 a real worker mid-BATCH,
-# silent-worker reaping).
+# case), the SDC-defense suite (tests/test_sdc.py — fingerprint fold,
+# redundant-execution voting, quarantine, incl. the slow closed-loop
+# FAULT BITFLIP acceptance case) and the slow fabric cases (kill -9 a
+# real worker mid-BATCH, silent-worker reaping).
 chaos:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	$(PYTHON) -m pytest tests/test_chaos.py tests/test_durability.py \
 	tests/test_overload.py tests/test_fabric_hardening.py \
-	tests/test_world_serving.py tests/test_mitigate.py -q $(XDIST)
+	tests/test_world_serving.py tests/test_mitigate.py \
+	tests/test_sdc.py -q $(XDIST)
 
 # Mesh-epoch recovery lane (docs/FAULT_TOLERANCE.md §mesh epochs):
 # MeshGuard unit + MESHKILL e2e + re-shard parity, the journal-replay
 # fuzz suite, and the real-process chaos cases — 2-process gloo mesh
 # with one host SIGKILLed mid-BATCH, in-fabric FAULT MESHKILL, and the
-# heartbeat-only partition no-double-count case.  The gloo test spawns
+# heartbeat-only partition no-double-count case.  The SDC-defense
+# suite rides this BLOCKING lane too (the chaos lane is advisory):
+# fingerprint voting and quarantine are exactly-once-journal
+# invariants, same class as the fuzz suite.  The gloo test spawns
 # its own 4-device subprocesses, so no xdist here.
 mesh-chaos:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	$(PYTHON) -m pytest tests/test_meshguard.py tests/test_journal_fuzz.py \
-	tests/test_meshchaos.py -q
+	tests/test_meshchaos.py tests/test_sdc.py -q
 
 lint:
 	@$(PYTHON) -m pyflakes bluesky_tpu tests 2>/dev/null \

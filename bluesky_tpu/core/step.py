@@ -95,6 +95,15 @@ class SimConfig(NamedTuple):
     # — the default — takes the original scan code path at trace time
     # (bit-identical HLO, same contract as ``scanstats``).
     inscan_refresh: bool = False
+    # SDC-defense state fingerprint (obs/fingerprint.py): fold a 32-bit
+    # bit-pattern witness of the stepped state through the chunk-scan
+    # carry and emit it once per chunk next to EdgeTelemetry, so the
+    # serving layer can compare hedge-duplicate / shadow-audit / voted
+    # re-executions of the same piece bit-for-bit.  False — the default
+    # — takes the original scan code path at trace time (bit-identical
+    # HLO, the scanstats contract); True adds pure bitwise carry folds
+    # with ZERO host syncs and ZERO in-scan collectives.
+    fingerprint: bool = False
 
 
 def step(state: SimState, cfg: SimConfig) -> SimState:
@@ -348,14 +357,19 @@ def _scan_steps(state: SimState, cfg: SimConfig, nsteps: int,
     Single source of truth so the guard semantics measured by
     guard_overhead.py are exactly the ones the sim runs.
 
-    Returns ``(state, bad, stats, refresh)``: ``bad`` is None unless
+    Returns ``(state, bad, stats, refresh, fp)``: ``bad`` is None unless
     checked, ``stats`` is None unless ``cfg.scanstats`` rides the
     in-scan telemetry accumulators (obs/scanstats.py) through the
     carry, ``refresh`` is None unless ``inscan_refresh_active(cfg)``
     folds the sort refresh into the scan (RefreshPack; ``sort_t0`` is
-    the host's last-refresh time seeding its due gate).  Both flags are
-    jit-static, so the all-off branch below IS the original scan,
-    character for character — identical traced HLO."""
+    the host's last-refresh time seeding its due gate), ``fp`` is None
+    unless ``cfg.fingerprint`` folds the SDC state fingerprint
+    (obs/fingerprint.py) through the carry.  All flags are jit-static,
+    so the all-off branch below IS the original scan, character for
+    character — identical traced HLO (``cfg.fingerprint`` dispatches to
+    ``_scan_steps_fp`` FIRST, so the branches below never change)."""
+    if cfg.fingerprint:
+        return _scan_steps_fp(state, cfg, nsteps, checked, sort_t0)
     if inscan_refresh_active(cfg):
         return _scan_steps_inscan(state, cfg, nsteps, checked, sort_t0)
     if cfg.scanstats:
@@ -372,7 +386,7 @@ def _scan_steps(state: SimState, cfg: SimConfig, nsteps: int,
             (state, bad, stats), _ = jax.lax.scan(
                 body, (state, jnp.full((), -1, jnp.int32), stats0),
                 jnp.arange(nsteps, dtype=jnp.int32))
-            return state, bad, stats, None
+            return state, bad, stats, None, None
 
         def body(carry, _):
             s, st = carry
@@ -381,7 +395,7 @@ def _scan_steps(state: SimState, cfg: SimConfig, nsteps: int,
 
         (state, stats), _ = jax.lax.scan(body, (state, stats0), None,
                                          length=nsteps)
-        return state, None, stats, None
+        return state, None, stats, None, None
 
     if checked:
         def body(carry, i):
@@ -394,13 +408,56 @@ def _scan_steps(state: SimState, cfg: SimConfig, nsteps: int,
         (state, bad), _ = jax.lax.scan(
             body, (state, jnp.full((), -1, jnp.int32)),
             jnp.arange(nsteps, dtype=jnp.int32))
-        return state, bad, None, None
+        return state, bad, None, None, None
 
     def body(s, _):
         return step(s, cfg), None
 
     state, _ = jax.lax.scan(body, state, None, length=nsteps)
-    return state, None, None, None
+    return state, None, None, None, None
+
+
+def _scan_steps_fp(state: SimState, cfg: SimConfig, nsteps: int,
+                   checked: bool, sort_t0):
+    """``_scan_steps`` with the SDC fingerprint fold threaded through
+    the carry (``cfg.fingerprint``).  One generic dict-carry body
+    covers every checked/scanstats/inscan combination instead of
+    doubling the hand-split branches above — the fingerprint-ON program
+    has no bit-identity contract to preserve (OFF does, and never
+    reaches this function), so the carry pytree is assembled per
+    jit-static flag and the scan always runs over a step-index arange.
+    """
+    from ..obs import fingerprint as fpmod
+    inscan = inscan_refresh_active(cfg)
+    if cfg.scanstats:
+        from ..obs import scanstats as ssmod
+    carry = dict(s=state, fp=fpmod.init(state, cfg))
+    if checked:
+        carry["bad"] = jnp.full((), -1, jnp.int32)
+    if cfg.scanstats:
+        carry["st"] = ssmod.init(state, cfg)
+    if inscan:
+        carry["rc"] = _refresh_init(state, cfg, sort_t0)
+
+    def body(c, i):
+        s, rc = c["s"], c.get("rc")
+        if rc is not None:
+            s, rc = _refresh_gate(s, rc, cfg)
+        s = step(s, cfg)
+        out = dict(s=s, fp=fpmod.fold(c["fp"], s, cfg))
+        if checked:
+            out["bad"] = jnp.where(c["bad"] >= 0, c["bad"],
+                                   jnp.where(state_finite(s), -1, i))
+        if cfg.scanstats:
+            out["st"] = ssmod.fold(c["st"], s, cfg)
+        if rc is not None:
+            out["rc"] = rc
+        return out, None
+
+    carry, _ = jax.lax.scan(body, carry,
+                            jnp.arange(nsteps, dtype=jnp.int32))
+    return (carry["s"], carry.get("bad"), carry.get("st"),
+            carry.get("rc"), carry["fp"])
 
 
 def _scan_steps_inscan(state: SimState, cfg: SimConfig, nsteps: int,
@@ -424,7 +481,7 @@ def _scan_steps_inscan(state: SimState, cfg: SimConfig, nsteps: int,
             (state, bad, stats, rc), _ = jax.lax.scan(
                 body, (state, jnp.full((), -1, jnp.int32), stats0, rc0),
                 jnp.arange(nsteps, dtype=jnp.int32))
-            return state, bad, stats, rc
+            return state, bad, stats, rc, None
 
         def body(carry, _):
             s, st, rc = carry
@@ -434,7 +491,7 @@ def _scan_steps_inscan(state: SimState, cfg: SimConfig, nsteps: int,
 
         (state, stats, rc), _ = jax.lax.scan(
             body, (state, stats0, rc0), None, length=nsteps)
-        return state, None, stats, rc
+        return state, None, stats, rc, None
 
     if checked:
         def body(carry, i):
@@ -448,7 +505,7 @@ def _scan_steps_inscan(state: SimState, cfg: SimConfig, nsteps: int,
         (state, bad, rc), _ = jax.lax.scan(
             body, (state, jnp.full((), -1, jnp.int32), rc0),
             jnp.arange(nsteps, dtype=jnp.int32))
-        return state, bad, None, rc
+        return state, bad, None, rc, None
 
     def body(carry, _):
         s, rc = carry
@@ -457,7 +514,7 @@ def _scan_steps_inscan(state: SimState, cfg: SimConfig, nsteps: int,
 
     (state, rc), _ = jax.lax.scan(body, (state, rc0), None,
                                   length=nsteps)
-    return state, None, None, rc
+    return state, None, None, rc, None
 
 
 @partial(jax.jit, static_argnames=("cfg", "nsteps"), donate_argnums=0)
@@ -468,7 +525,7 @@ def run_steps(state: SimState, cfg: SimConfig, nsteps: int) -> SimState:
     (simulation.py:216-223) as a single device program: host syncs once per
     chunk, matching SURVEY.md §2.10's "lax.scan over k steps inside one jit".
     """
-    state, _, _, _ = _scan_steps(state, cfg, nsteps, checked=False)
+    state, _, _, _, _ = _scan_steps(state, cfg, nsteps, checked=False)
     return state
 
 
@@ -506,7 +563,7 @@ def run_steps_checked(state: SimState, cfg: SimConfig, nsteps: int):
     for free: the fault is pinned to one simdt without re-running the
     chunk.
     """
-    state, bad, _, _ = _scan_steps(state, cfg, nsteps, checked=True)
+    state, bad, _, _, _ = _scan_steps(state, cfg, nsteps, checked=True)
     return state, bad
 
 
@@ -573,19 +630,22 @@ def _edge_scan(state: SimState, cfg: SimConfig, nsteps: int,
                checked: bool, sort_t0=None):
     """``(state, telemetry)`` — extended with ``stats`` when
     ``cfg.scanstats`` adds the in-scan accumulator pack and/or the
-    ``RefreshPack`` when ``inscan_refresh_active(cfg)`` (always in that
+    ``RefreshPack`` when ``inscan_refresh_active(cfg)`` and/or the
+    ``FingerprintPack`` when ``cfg.fingerprint`` (always in that
     order).  The arity pivots on jit-STATIC flags, so each config key
     compiles one fixed output pytree; the extra packs join the
     telemetry as non-donated outputs and ride the same lazy chunk-edge
     pull."""
-    state, bad, stats, refresh = _scan_steps(state, cfg, nsteps,
-                                             checked, sort_t0)
+    state, bad, stats, refresh, fp = _scan_steps(state, cfg, nsteps,
+                                                 checked, sort_t0)
     telem = pack_telemetry(state, bad)
     out = (state, telem)
     if stats is not None:
         out = out + (stats,)
     if refresh is not None:
         out = out + (refresh,)
+    if fp is not None:
+        out = out + (fp,)
     return out
 
 
@@ -797,14 +857,19 @@ def _scan_steps_worlds(state: SimState, cfg: SimConfig, nsteps: int,
     integrity guard widened to a [W] vector of first-bad-step indices
     (-1 clean) so a trip pins the (world, step) pair.
 
-    Same ``(state, bad, stats, refresh)`` contract as ``_scan_steps``;
-    with ``cfg.scanstats`` the accumulators get a leading [W] axis
-    (vmapped init/fold — worlds are single-device, so every fold stays
-    the P=1 flavour) and demux per world via ``world_slice`` like
-    telemetry.  With ``inscan_refresh_active(cfg)`` the RefreshPack
-    scalars widen to [W] the same way (``sort_t0`` is a [W] vector of
-    per-world last-refresh times)."""
+    Same ``(state, bad, stats, refresh, fp)`` contract as
+    ``_scan_steps``; with ``cfg.scanstats`` the accumulators get a
+    leading [W] axis (vmapped init/fold — worlds are single-device, so
+    every fold stays the P=1 flavour) and demux per world via
+    ``world_slice`` like telemetry.  With ``inscan_refresh_active(cfg)``
+    the RefreshPack scalars widen to [W] the same way (``sort_t0`` is a
+    [W] vector of per-world last-refresh times); with
+    ``cfg.fingerprint`` the FingerprintPack does too (dispatched FIRST
+    to ``_scan_steps_worlds_fp`` so the branches below never change)."""
     vstep = lambda s: step_worlds(s, cfg)
+    if cfg.fingerprint:
+        return _scan_steps_worlds_fp(state, cfg, nsteps, checked,
+                                     sort_t0)
     if inscan_refresh_active(cfg):
         return _scan_steps_worlds_inscan(state, cfg, nsteps, checked,
                                          sort_t0)
@@ -827,7 +892,7 @@ def _scan_steps_worlds(state: SimState, cfg: SimConfig, nsteps: int,
                 body, (state, jnp.full((nworlds,), -1, jnp.int32),
                        stats0),
                 jnp.arange(nsteps, dtype=jnp.int32))
-            return state, bad, stats, None
+            return state, bad, stats, None, None
 
         def body(carry, _):
             s, st = carry
@@ -836,7 +901,7 @@ def _scan_steps_worlds(state: SimState, cfg: SimConfig, nsteps: int,
 
         (state, stats), _ = jax.lax.scan(body, (state, stats0), None,
                                          length=nsteps)
-        return state, None, stats, None
+        return state, None, stats, None, None
 
     if checked:
         nworlds = state.simt.shape[0]
@@ -852,13 +917,58 @@ def _scan_steps_worlds(state: SimState, cfg: SimConfig, nsteps: int,
         (state, bad), _ = jax.lax.scan(
             body, (state, jnp.full((nworlds,), -1, jnp.int32)),
             jnp.arange(nsteps, dtype=jnp.int32))
-        return state, bad, None, None
+        return state, bad, None, None, None
 
     def body(s, _):
         return vstep(s), None
 
     state, _ = jax.lax.scan(body, state, None, length=nsteps)
-    return state, None, None, None
+    return state, None, None, None, None
+
+
+def _scan_steps_worlds_fp(state: SimState, cfg: SimConfig, nsteps: int,
+                          checked: bool, sort_t0):
+    """``_scan_steps_fp`` with a leading world axis: the same generic
+    dict carry, with vmapped fingerprint/stats init+fold (worlds are
+    single-device, so every fold stays the P=1 flavour — the pack
+    demuxes per world via ``world_slice`` like telemetry)."""
+    from ..obs import fingerprint as fpmod
+    inscan = inscan_refresh_active(cfg)
+    if cfg.scanstats:
+        from ..obs import scanstats as ssmod
+        vsfold = jax.vmap(lambda st, s: ssmod.fold(st, s, cfg))
+    vstep = lambda s: step_worlds(s, cfg)
+    vfinite = jax.vmap(state_finite)
+    vffold = jax.vmap(lambda f, s: fpmod.fold(f, s, cfg))
+    nworlds = state.simt.shape[0]
+    carry = dict(s=state,
+                 fp=jax.vmap(lambda s: fpmod.init(s, cfg))(state))
+    if checked:
+        carry["bad"] = jnp.full((nworlds,), -1, jnp.int32)
+    if cfg.scanstats:
+        carry["st"] = jax.vmap(lambda s: ssmod.init(s, cfg))(state)
+    if inscan:
+        carry["rc"] = _refresh_init(state, cfg, sort_t0, worlds=True)
+
+    def body(c, i):
+        s, rc = c["s"], c.get("rc")
+        if rc is not None:
+            s, rc = _refresh_gate_worlds(s, rc, cfg)
+        s = vstep(s)
+        out = dict(s=s, fp=vffold(c["fp"], s))
+        if checked:
+            out["bad"] = jnp.where(c["bad"] >= 0, c["bad"],
+                                   jnp.where(vfinite(s), -1, i))
+        if cfg.scanstats:
+            out["st"] = vsfold(c["st"], s)
+        if rc is not None:
+            out["rc"] = rc
+        return out, None
+
+    carry, _ = jax.lax.scan(body, carry,
+                            jnp.arange(nsteps, dtype=jnp.int32))
+    return (carry["s"], carry.get("bad"), carry.get("st"),
+            carry.get("rc"), carry["fp"])
 
 
 def _scan_steps_worlds_inscan(state: SimState, cfg: SimConfig,
@@ -888,7 +998,7 @@ def _scan_steps_worlds_inscan(state: SimState, cfg: SimConfig,
                 body, (state, jnp.full((nworlds,), -1, jnp.int32),
                        stats0, rc0),
                 jnp.arange(nsteps, dtype=jnp.int32))
-            return state, bad, stats, rc
+            return state, bad, stats, rc, None
 
         def body(carry, _):
             s, st, rc = carry
@@ -898,7 +1008,7 @@ def _scan_steps_worlds_inscan(state: SimState, cfg: SimConfig,
 
         (state, stats, rc), _ = jax.lax.scan(
             body, (state, stats0, rc0), None, length=nsteps)
-        return state, None, stats, rc
+        return state, None, stats, rc, None
 
     if checked:
         nworlds = state.simt.shape[0]
@@ -915,7 +1025,7 @@ def _scan_steps_worlds_inscan(state: SimState, cfg: SimConfig,
         (state, bad, rc), _ = jax.lax.scan(
             body, (state, jnp.full((nworlds,), -1, jnp.int32), rc0),
             jnp.arange(nsteps, dtype=jnp.int32))
-        return state, bad, None, rc
+        return state, bad, None, rc, None
 
     def body(carry, _):
         s, rc = carry
@@ -924,7 +1034,7 @@ def _scan_steps_worlds_inscan(state: SimState, cfg: SimConfig,
 
     (state, rc), _ = jax.lax.scan(body, (state, rc0), None,
                                   length=nsteps)
-    return state, None, None, rc
+    return state, None, None, rc, None
 
 
 @partial(jax.jit, static_argnames=("cfg", "nsteps"), donate_argnums=0)
@@ -934,8 +1044,8 @@ def run_steps_worlds(state: SimState, cfg: SimConfig,
     nsteps in one compiled scan.  W=1 is bit-identical to the unbatched
     path (tests/test_worlds.py pins this)."""
     _check_worlds_cfg(cfg)
-    state, _, _, _ = _scan_steps_worlds(state, cfg, nsteps,
-                                        checked=False)
+    state, _, _, _, _ = _scan_steps_worlds(state, cfg, nsteps,
+                                           checked=False)
     return state
 
 
@@ -949,15 +1059,15 @@ def run_steps_worlds_checked(state: SimState, cfg: SimConfig,
     host response (rollback/quarantine) stays per-world because the
     faulty (world, step) pair is pinned without re-running anything."""
     _check_worlds_cfg(cfg)
-    state, bad, _, _ = _scan_steps_worlds(state, cfg, nsteps,
-                                          checked=True)
+    state, bad, _, _, _ = _scan_steps_worlds(state, cfg, nsteps,
+                                             checked=True)
     return state, bad
 
 
 def _edge_scan_worlds(state: SimState, cfg: SimConfig, nsteps: int,
                       checked: bool, sort_t0=None):
-    state, bad, stats, refresh = _scan_steps_worlds(state, cfg, nsteps,
-                                                    checked, sort_t0)
+    state, bad, stats, refresh, fp = _scan_steps_worlds(
+        state, cfg, nsteps, checked, sort_t0)
     if bad is None:
         bad = jnp.full((state.simt.shape[0],), -1, jnp.int32)
     telem = jax.vmap(pack_telemetry)(state, bad)
@@ -966,6 +1076,8 @@ def _edge_scan_worlds(state: SimState, cfg: SimConfig, nsteps: int,
         out = out + (stats,)
     if refresh is not None:
         out = out + (refresh,)
+    if fp is not None:
+        out = out + (fp,)
     return out
 
 

@@ -5,6 +5,12 @@ Usage (stack/commands.py registers it):
   FAULT                      status: guard, ring, transport faults, trips
   FAULT NAN [acid]           poison an aircraft's state with NaN
   FAULT INF [acid]           poison an aircraft's state with +Inf
+  FAULT BITFLIP [STATE|PAYLOAD] [acid|bit]   flip ONE bit: STATE flips
+                             a low mantissa bit of one aircraft's
+                             latitude (stays finite — invisible to the
+                             guard, caught ONLY by the SDC fingerprint
+                             comparison); PAYLOAD corrupts the shipped
+                             fingerprint word until RESET (wire model)
   FAULT GUARD ON/OFF         enable/disable the integrity guard
   FAULT GUARD QUARANTINE/ROLLBACK/HALT   set the recovery policy
   FAULT RING [depth] [dt]    report / configure the snapshot ring
@@ -96,6 +102,31 @@ def fault_command(sim, *args):
             return False, str(e)
         return True, (f"FAULT: injected {sub} into {acid} (slot {slot}) — "
                       f"guard {'armed' if sim.guard.enabled else 'OFF'}")
+
+    if sub == "BITFLIP":
+        which = rest[0].upper() if rest else "STATE"
+        if which == "PAYLOAD":
+            try:
+                bit = int(float(rest[1])) if len(rest) > 1 else 2
+            except ValueError:
+                return False, "FAULT BITFLIP PAYLOAD [bit]"
+            mask = injectors.inject_bitflip(sim, "payload", bit=bit)
+            return True, (f"FAULT: fingerprint wire corruption armed — "
+                          f"shipped words XOR {mask:#010x} until RESET")
+        acid = None
+        if which == "STATE":
+            acid = rest[1] if len(rest) > 1 else None
+        else:
+            acid = rest[0]         # FAULT BITFLIP <acid> shorthand
+        try:
+            slot, acid, old, new = injectors.inject_bitflip(
+                sim, "state", acid=acid)
+        except ValueError as e:
+            return False, str(e)
+        return True, (f"FAULT: flipped one mantissa bit of {acid} "
+                      f"(slot {slot}) lat {old!r} -> {new!r} — finite, "
+                      f"guard-invisible; only the SDC fingerprint "
+                      f"comparison can catch it")
 
     if sub == "GUARD":
         if not rest:
@@ -259,7 +290,8 @@ def fault_command(sim, *args):
             f"{t['action']} [{','.join(t['ids']) or '-'}]"
             for t in sim.guard.trips)
 
-    return False, ("FAULT NAN/INF [acid] | GUARD .. | RING .. | DROP/DUP/"
+    return False, ("FAULT NAN/INF [acid] | BITFLIP [STATE|PAYLOAD] | "
+                   "GUARD .. | RING .. | DROP/DUP/"
                    "DELAY p | NETOFF | STALL s | STRAGGLE f/STALL/OFF | "
                    "KILL | PREEMPT [s] | MESHKILL [g] | PARTITION [OFF] | "
                    "LOADSPIKE n [rate] | SNAPTRUNC f | LIST")

@@ -42,6 +42,49 @@ def inject_nonfinite(sim, acid=None, value=float("nan"), fields=None):
     return slot, traf.ids[slot]
 
 
+def inject_bitflip(sim, which="state", acid=None, bit=2):
+    """Flip ONE bit — the silent-data-corruption model (ISSUE-17).
+
+    ``which='state'``: flip a low mantissa bit of one live aircraft's
+    latitude IN the device state.  The value stays finite, so the
+    in-scan integrity guard (``isfinite``) can never catch it — only
+    the state-fingerprint comparison across redundant executions does.
+    Returns ``(slot, acid, old, new)``.
+
+    ``which='payload'``: corrupt the fingerprint ON THE WIRE — every
+    shipped summary word is XORed with ``1 << bit`` until the next
+    RESET, while the device state and fold stay untouched (the
+    readback/transport-corruption model).  Returns the active mask.
+    """
+    bit = int(bit)
+    if str(which).lower().startswith("payload"):
+        sim._fp_corrupt_mask ^= (1 << (bit % 32)) & 0xFFFFFFFF
+        return sim._fp_corrupt_mask
+    traf = sim.traf
+    traf.flush()
+    if acid:
+        slot = traf.id2idx(str(acid))
+        if not isinstance(slot, int) or slot < 0:
+            raise ValueError(f"{acid}: aircraft not found")
+    else:
+        live = [i for i, v in enumerate(traf.ids) if v is not None]
+        if not live:
+            raise ValueError("no aircraft to corrupt")
+        slot = live[0]
+    st = traf.state
+    ac = st.ac
+    lat = ac.lat
+    old = float(np.asarray(lat[slot]))
+    width = np.dtype(lat.dtype).itemsize
+    u = np.array([old], dtype=lat.dtype)
+    iv = u.view({4: np.uint32, 8: np.uint64}[width])
+    iv[0] ^= np.asarray(1, iv.dtype) << np.asarray(
+        bit % (8 * width), iv.dtype)
+    new = float(u[0])
+    traf.state = st.replace(ac=ac.replace(lat=lat.at[slot].set(new)))
+    return slot, traf.ids[slot], old, new
+
+
 # --------------------------------------------------------- flaky transport
 class FlakySocket:
     """Transport-fault wrapper over a ZMQ socket: drop / duplicate /

@@ -78,6 +78,26 @@ Record types (one JSON object per line, ``rec`` selects the type):
                                             the action targets one
                                             piece; shed/repack actions
                                             have none.
+  ``sdc_suspect``  {key, fps, via}          SDC defense (ISSUE-17): two
+                                            executions of the same piece
+                                            reported DIFFERENT state
+                                            fingerprints (``via`` names
+                                            the comparison — hedge_dup
+                                            or audit).  AUDIT only:
+                                            queue math and exactly-once
+                                            never see it; replay
+                                            surfaces it under ``sdc``.
+  ``sdc_vote``     {key, fps, deviant}      the 2-of-3 tie-break
+                                            re-execution resolved: the
+                                            fingerprint map names the
+                                            deviant worker (hex id, or
+                                            null when all three
+                                            disagreed).  AUDIT only,
+                                            surfaced under ``sdc``; the
+                                            quarantine that follows is
+                                            its own gated ``mitigation``
+                                            record (action
+                                            ``quarantine_worker``).
   ``device_profile`` {worker, dir, chunks}  PROFILE DEVICE window: the
                                             XLA trace dir a worker
                                             captured (audit; links the
@@ -126,6 +146,14 @@ class BatchJournal:
         self.fsync = bool(fsync)
         self._f = None
         self._dead = False        # set after a write failure
+        self._bytes = 0           # WAL size incl. pre-resume content
+
+    @property
+    def size_bytes(self) -> int:
+        """Current WAL size in bytes (existing file at open + every
+        line appended since) — the ``journal_bytes`` gauge's source, so
+        HEALTH can warn before an unbounded sweep fills the disk."""
+        return self._bytes
 
     # ------------------------------------------------------------ identity
     @staticmethod
@@ -156,6 +184,10 @@ class BatchJournal:
                             fa.write(b"\n")
             except (OSError, ValueError):
                 pass                      # absent or empty file
+            try:
+                self._bytes = os.path.getsize(self.path)
+            except OSError:
+                self._bytes = 0
             self._f = open(self.path, "a", encoding="utf-8")
         return self._f
 
@@ -170,7 +202,9 @@ class BatchJournal:
                                      fsync=self.fsync):
                 f = self._open()
                 for r in records:
-                    f.write(json.dumps(r, separators=(",", ":")) + "\n")
+                    line = json.dumps(r, separators=(",", ":")) + "\n"
+                    f.write(line)
+                    self._bytes += len(line.encode("utf-8"))
                 f.flush()
                 if self.fsync:
                     os.fsync(f.fileno())
@@ -318,6 +352,27 @@ class BatchJournal:
             rec["worker"] = worker.hex()
         self.append("mitigation", **rec)
 
+    def sdc_suspect(self, piece, fps=None, via=""):
+        """SDC defense (ISSUE-17): redundant executions of one piece
+        disagreed on their state fingerprints.  ``fps`` maps worker hex
+        id -> fingerprint hex word; ``via`` names the comparison that
+        caught it (``hedge_dup`` — winner vs hedge loser — or ``audit``
+        — original vs shadow re-execution).  AUDIT record: the piece's
+        queue state is untouched (the winner's ``completed`` stands
+        until a vote says otherwise); replay surfaces it under
+        ``sdc``."""
+        self.append("sdc_suspect", key=self.piece_key(piece),
+                    fps=dict(fps or {}), via=str(via))
+
+    def sdc_vote(self, piece, fps=None, deviant=""):
+        """The 2-of-3 tie-break re-execution of a suspect piece
+        resolved: ``fps`` holds all three fingerprints and ``deviant``
+        the out-voted worker's hex id ('' when no majority formed —
+        three distinct words name nobody).  AUDIT only, surfaced under
+        ``sdc``; quarantine is the mitigation engine's own record."""
+        self.append("sdc_vote", key=self.piece_key(piece),
+                    fps=dict(fps or {}), deviant=str(deviant))
+
     def device_profile(self, worker: bytes = b"", dir="", chunks=None):
         """A worker opened a PROFILE DEVICE window: journal the XLA
         trace dir so the sweep's record links to the captured trace.
@@ -369,6 +424,7 @@ class BatchJournal:
         opt_results = []
         perf_regressions = []
         mitigations = []
+        sdc = dict(suspects=[], votes=[], quarantines=[])
         synthetic = 0
         torn = 0
         # errors="replace": disk-level byte corruption must surface as
@@ -402,12 +458,29 @@ class BatchJournal:
                 elif rec == "mitigation":
                     # mitigation-engine decision (audit; surfaced even
                     # keyless — shed/repack actions target no piece)
-                    mitigations.append(
-                        {"key": key, "cause": r.get("cause", ""),
+                    m = {"key": key, "cause": r.get("cause", ""),
                          "signal": r.get("signal", ""),
                          "action": r.get("action", ""),
                          "target": r.get("target", ""),
-                         "outcome": r.get("outcome", "")})
+                         "outcome": r.get("outcome", "")}
+                    mitigations.append(m)
+                    if m["action"] == "quarantine_worker":
+                        # the SDC defense's actuation — cross-listed
+                        # under ``sdc`` next to the suspicion/vote
+                        # records that led to it
+                        sdc["quarantines"].append(m)
+                elif rec == "sdc_suspect":
+                    # fingerprint mismatch (audit; surfaced BEFORE the
+                    # unknown-key filter like mitigation — a suspect
+                    # raised by a synthetic shadow audit still matters
+                    # to the auditor even though its key is unowed)
+                    sdc["suspects"].append(
+                        {"key": key, "fps": r.get("fps", {}),
+                         "via": r.get("via", "")})
+                elif rec == "sdc_vote":
+                    sdc["votes"].append(
+                        {"key": key, "fps": r.get("fps", {}),
+                         "deviant": r.get("deviant", "")})
                 elif key not in pieces:
                     continue              # marker records / unknown key
                 elif rec in ("dispatched", "preempted", "hedged",
@@ -463,6 +536,7 @@ class BatchJournal:
             opt_results=opt_results,
             perf_regressions=perf_regressions,
             mitigations=mitigations,
+            sdc=sdc,
             synthetic_skipped=synthetic,
             torn_lines=torn,
         )

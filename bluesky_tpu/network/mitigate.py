@@ -26,6 +26,13 @@ Signals -> actions (docs/FAULT_TOLERANCE.md has the recovery matrix):
                         x the budget  -> re-pack (shrink
                         world_batch_max for the next packs); restore
                         below mitigate_mem_lo
+  ``sdc_deviant``       the SDC 2-of-3 fingerprint vote (ISSUE-17,
+                        server._finish_sdc_exec) out-voted a worker
+                        whose silently-corrupting device produced the
+                        minority state fingerprint  -> quarantine the
+                        worker (drain it from assignment — every piece
+                        it would run is suspect); MITIGATE OFF
+                        releases quarantined workers back to the pool
 
 Every DEGRADING action passes three gates before it fires:
 
@@ -55,9 +62,10 @@ import time
 
 
 #: action names that degrade service and therefore pass the full gate
-DEGRADING = ("hedge_escalate", "shed", "repack", "accept_degraded")
+DEGRADING = ("hedge_escalate", "shed", "repack", "accept_degraded",
+             "quarantine_worker")
 #: restore actions — journaled + counted, never gated
-RESTORING = ("unshed", "unrepack")
+RESTORING = ("unshed", "unrepack", "release_worker")
 
 
 class TokenBucket:
@@ -253,6 +261,31 @@ class MitigationEngine:
                      piece=piece if not _is_pack(piece) else None,
                      worker=wid)
 
+    def on_sdc_deviant(self, wid, piece, why="", now=None):
+        """The SDC 2-of-3 fingerprint vote named ``wid`` the deviant:
+        its device silently corrupts state, so every piece it would
+        run is suspect — quarantine it (drain from assignment).  The
+        ``sdc_vote`` audit record already names it; THIS record is the
+        gated actuation (the closed loop's recovery step)."""
+        if not self.enabled:
+            return
+        srv = self.server
+        if wid in srv.sdc_quarantine:
+            return                  # already quarantined
+        now = time.monotonic() if now is None else now
+        if not self._admit("quarantine_worker", wid.hex(), now):
+            return
+        srv.sdc_quarantine.add(wid)
+        if wid in srv.avail_workers:
+            srv.avail_workers.remove(wid)
+        srv.sdc_quarantined_workers += 1
+        self._decide(cause=str(why) or "fingerprint vote",
+                     signal="sdc_deviant", action="quarantine_worker",
+                     target=wid.hex(),
+                     outcome="worker drained from assignment",
+                     piece=piece if not _is_pack(piece) else None,
+                     worker=wid)
+
     # ------------------------------------------------------------ the tick
     def tick(self, now=None):
         """Level-triggered checks on the server's heartbeat cadence:
@@ -357,6 +390,22 @@ class MitigationEngine:
                              target="worlds",
                              outcome=f"world_batch_max {shrunk} -> "
                                      f"{restored}")
+            srv = self.server
+            while srv.sdc_quarantine:
+                # quarantine is this engine's actuation, so disabling
+                # it releases the workers — the operator overriding the
+                # vote gets the full pool back, journaled per worker
+                wid = srv.sdc_quarantine.pop()
+                self._decide(cause="MITIGATE OFF", signal="operator",
+                             action="release_worker", target=wid.hex(),
+                             outcome="worker returned to assignment",
+                             worker=wid)
+                if wid in srv.workers \
+                        and wid not in srv.avail_workers \
+                        and wid not in srv.inflight \
+                        and srv.workers.get(wid, 0) < 2:
+                    srv.avail_workers.append(wid)
+                    srv._send_pending_scenario()
         self.enabled = on
 
     # ------------------------------------------------------------ readback
@@ -376,6 +425,8 @@ class MitigationEngine:
              "repack_active": self.repack_from is not None,
              "queue_limit": self.server.batch_queue_max,
              "world_batch_max": self.server.world_batch_max,
+             "quarantined_workers": sorted(
+                 w.hex() for w in self.server.sdc_quarantine),
              "recent": list(self.recent)}
         taken = sum(self.actions.values())
         supp = sum(self.suppressed.values())
@@ -394,7 +445,10 @@ class MitigationEngine:
                if d["shed_active"] else "")
             + (", REPACKED (world max "
                f"{self.server.world_batch_max})"
-               if d["repack_active"] else ""))
+               if d["repack_active"] else "")
+            + (f", {len(d['quarantined_workers'])} worker(s) "
+               "QUARANTINED"
+               if d["quarantined_workers"] else ""))
         return d
 
 

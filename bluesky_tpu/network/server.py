@@ -244,6 +244,16 @@ class Server(threading.Thread):
     perf_regressions = _obs_counter(
         "server_perf_regressions",
         "serving SLO-watch perf_regression records journaled")
+    sdc_suspects = _obs_counter(
+        "server_sdc_suspects",
+        "fingerprint mismatches journaled (sdc_suspect)")
+    sdc_votes = _obs_counter(
+        "server_sdc_votes", "2-of-3 re-execution votes resolved")
+    sdc_audits = _obs_counter(
+        "server_sdc_audits", "shadow audit re-executions dispatched")
+    sdc_quarantined_workers = _obs_counter(
+        "server_sdc_quarantined_workers",
+        "workers quarantined by the SDC fingerprint vote")
 
     def __init__(self, headless=False, discoverable=False,
                  ports=None, max_nnodes=None, spawn_workers=True,
@@ -252,7 +262,8 @@ class Server(threading.Thread):
                  journal_path=None, resume_journal=None,
                  straggler_timeout=None, hedge_enabled=None,
                  batch_queue_max=None, world_pack=None,
-                 world_batch_max=None, mitigate_enabled=None):
+                 world_batch_max=None, mitigate_enabled=None,
+                 sdc_enabled=None, sdc_audit_rate=None):
         super().__init__(daemon=True)
         # Observability (ISSUE-11, docs/OBSERVABILITY.md): the broker's
         # own registry (counters above, demux/queue series below), the
@@ -390,6 +401,39 @@ class Server(threading.Thread):
         from .mitigate import MitigationEngine
         self.mitigator = MitigationEngine(self,
                                           enabled=mitigate_enabled)
+        # ----- silent-data-corruption defense (ISSUE-17,
+        # docs/FAULT_TOLERANCE.md §SDC): workers running with
+        # SimConfig.fingerprint ship a per-piece state fingerprint on
+        # completion (SDCFP precedes the STATECHANGE on the FIFO pair).
+        # Redundant executions of the same content — hedge duplicates,
+        # sampled shadow audits — must agree bit-for-bit; a mismatch
+        # journals an audit-only ``sdc_suspect`` and triggers a third
+        # re-execution whose 2-of-3 majority names the deviant worker
+        # (``sdc_vote``), which the mitigation engine then quarantines
+        # (its own gated ``mitigation`` record).
+        self.sdc_enabled = bool(getattr(_settings, "sdc_enabled",
+                                        False)) \
+            if sdc_enabled is None else bool(sdc_enabled)
+        self.sdc_audit_rate = float(
+            getattr(_settings, "sdc_audit_rate", 0.0)
+            if sdc_audit_rate is None else sdc_audit_rate)
+        self._sdc_fps = collections.OrderedDict()  # piece key ->
+        #                                            {wid hex: fp word}
+        self._sdc_execs = {}               # wid -> {kind, key, piece}
+        self._sdc_voted = set()            # keys with a vote placed
+        self.sdc_quarantine = set()        # voted-deviant worker ids
+        self.sdc_suspects = 0              # sdc_suspect records
+        self.sdc_votes = 0                 # sdc_vote records
+        self.sdc_audits = 0                # shadow audits dispatched
+        self.sdc_quarantined_workers = 0   # workers quarantined
+        self._audit_acc = 0.0              # deterministic sampling accum
+        # journal growth watch (ISSUE-17 satellite): the WAL of an
+        # unbounded sweep must warn before it fills the disk
+        self.journal_warn_bytes = int(getattr(_settings,
+                                              "journal_warn_bytes",
+                                              64 * 1024 * 1024))
+        self.obs.gauge("server_journal_bytes",
+                       help="BATCH journal (WAL) size on disk")
         # ----- server-to-server chaining
         self.upstream = upstream           # (host, event_port) or None
         self.link = None                   # DEALER to the upstream server
@@ -568,10 +612,18 @@ class Server(threading.Thread):
         journal record) are requeued/striked — the finished worlds'
         pieces stay exactly-once done."""
         self._cancel_pending.pop(wid, None)
+        self.sdc_quarantine.discard(wid)
         piece = self.inflight.pop(wid, None)
         owner = self.inflight_owner.pop(wid, b"")
         self.inflight_t.pop(wid, None)
         self.worker_progress.pop(wid, None)
+        if self._sdc_execs.pop(wid, None) is not None:
+            # a vote/audit re-execution lost its worker: the original
+            # piece is already complete — neither a requeue nor a
+            # circuit-breaker strike (the comparison is simply lost)
+            print(f"server: SDC re-execution worker {wid.hex()} lost — "
+                  f"comparison abandoned, piece stays complete")
+            return
         if piece is None:
             return
         if isinstance(piece, WorldPack):
@@ -664,6 +716,7 @@ class Server(threading.Thread):
                 # overwrite its in-flight piece A and silently drop A
                 if sender not in self.avail_workers \
                         and sender not in self.inflight \
+                        and sender not in self.sdc_quarantine \
                         and self.workers[sender] < 2:
                     self.avail_workers.append(sender)
                 self._send_pending_scenario()
@@ -711,6 +764,14 @@ class Server(threading.Thread):
                 # busy workers must not receive BATCH pieces
                 # (parity: server.py:234-247)
                 if state < 2:
+                    if sender in self._sdc_execs:
+                        # an SDC vote/audit re-execution retired: its
+                        # piece is ALREADY complete — never journal a
+                        # second ``completed`` (content-addressed keys
+                        # would double-count a repeat-trial sweep);
+                        # resolve the fingerprint comparison instead
+                        self._finish_sdc_exec(sender)
+                        return
                     piece = self.inflight.pop(sender, None)
                     if isinstance(piece, WorldPack):
                         # packed piece retired cleanly: per-world
@@ -743,6 +804,7 @@ class Server(threading.Thread):
                             self.journal.completed(piece, sender)
                         self._resolve_hedge_win(sender, piece)
                         self._sweep_slo(piece)
+                        self._maybe_sdc_audit(sender, piece)
                     elif sender in self._cancel_pending:
                         # the hedge LOSER finished before its cancel
                         # landed (its BATCHCANCELLED ack would have
@@ -753,7 +815,12 @@ class Server(threading.Thread):
                         self.dup_completions += 1
                         if self.journal:
                             self.journal.dup_completed(dup, sender)
-                    if sender not in self.avail_workers:
+                        # redundant-execution voting: the loser ran the
+                        # SAME content to completion — its fingerprint
+                        # is a free comparison word against the winner's
+                        self._sdc_compare(dup, via="hedge_dup")
+                    if sender not in self.avail_workers \
+                            and sender not in self.sdc_quarantine:
                         self.avail_workers.append(sender)
                         self._send_pending_scenario()
                 elif sender in self.avail_workers:
@@ -852,6 +919,34 @@ class Server(threading.Thread):
                 self.mitigator.set_enabled(data["enabled"])
             sock.send_multipart(
                 [sender, b"MITIGATE", packb(self.mitigator.payload())])
+        elif name == b"SDCFP" and from_worker:
+            # per-piece state fingerprint, shipped just BEFORE the
+            # worker's STATECHANGE out of OP (FIFO pair: the piece is
+            # still in ``inflight`` when this arrives) — record it for
+            # the redundant-execution comparisons
+            data = unpackb(payload) if payload else None
+            piece = self.inflight.get(sender)
+            if piece is None:
+                # hedge loser: its piece left inflight when the winner
+                # completed, but the cancelled copy still finished and
+                # its word is exactly the comparison the dup path needs
+                piece = self._cancel_pending.get(sender)
+            if isinstance(data, dict) and piece is not None \
+                    and not isinstance(piece, WorldPack):
+                self._note_sdc_fp(sender, piece, data)
+        elif name == b"SDC":
+            # SDC stack/client command: flip the defense / set the
+            # audit-sampling rate (payload dict) and/or read the state
+            # back HEALTH-style
+            data = unpackb(payload) if payload else None
+            if isinstance(data, dict):
+                if "enabled" in data:
+                    self.sdc_enabled = bool(data["enabled"])
+                if "audit_rate" in data:
+                    self.sdc_audit_rate = max(
+                        0.0, float(data["audit_rate"] or 0.0))
+            sock.send_multipart(
+                [sender, b"SDC", packb(self.sdc_payload())])
         elif name == b"BATCHCANCELLED" and from_worker:
             # hedge loser acked the cancel (it had NOT completed: a
             # completion would have arrived first on the FIFO pair)
@@ -1172,7 +1267,8 @@ class Server(threading.Thread):
                 "state": data.get("state"),
                 "ff": bool(data.get("ff", False)),
                 "mesh": data.get("mesh"),
-                "scan": data.get("scan")}
+                "scan": data.get("scan"),
+                "fp": data.get("fp")}
             return
         dt = now - prev["t"]
         if chunks > prev["chunks"] or simt > prev["simt"] + 1e-9:
@@ -1185,7 +1281,8 @@ class Server(threading.Thread):
                     state=data.get("state"),
                     ff=bool(data.get("ff", False)),
                     mesh=data.get("mesh", prev.get("mesh")),
-                    scan=data.get("scan", prev.get("scan")))
+                    scan=data.get("scan", prev.get("scan")),
+                    fp=data.get("fp", prev.get("fp")))
 
     def _check_stragglers(self, now):
         """Speculative straggler re-dispatch: an in-flight piece whose
@@ -1360,6 +1457,205 @@ class Server(threading.Thread):
               f"won '{self._piece_name(piece)}', cancelling "
               f"{partner.hex()}")
 
+    # --------------------------------------------- SDC defense (ISSUE-17)
+    def _note_sdc_fp(self, wid, piece, data):
+        """Record one execution's completion fingerprint, keyed by the
+        piece's CONTENT key — redundant executions of identical content
+        (hedge copies, votes, shadow audits) land in the same map and
+        must agree bit-for-bit (the device fold is order-sensitive and
+        deterministic for a fixed scenario)."""
+        if not self.sdc_enabled:
+            return
+        from .journal import BatchJournal
+        key = BatchJournal.piece_key(piece)
+        fps = self._sdc_fps.get(key)
+        if fps is None:
+            fps = self._sdc_fps[key] = {}
+            while len(self._sdc_fps) > 256:  # bound week-long sweeps
+                self._sdc_fps.popitem(last=False)
+        fps[wid.hex()] = str(data.get("fp", ""))
+        self.recorder.instant("sdc_fp", cat="server", worker=wid.hex(),
+                              key=key, fp=fps[wid.hex()])
+
+    def _sdc_compare(self, piece, via="hedge_dup"):
+        """Compare every fingerprint recorded for ``piece``'s content:
+        a disagreement journals an audit-only ``sdc_suspect`` and (once
+        per key) places the 2-of-3 tie-break re-execution."""
+        if not self.sdc_enabled:
+            return
+        from .journal import BatchJournal
+        key = BatchJournal.piece_key(piece)
+        fps = self._sdc_fps.get(key) or {}
+        words = {f for f in fps.values() if f}
+        if len(fps) < 2 or len(words) <= 1:
+            return                 # agreement, or nothing to compare
+        self.sdc_suspects += 1
+        pname = self._piece_name(piece)
+        self.recorder.instant("sdc_suspect", cat="server", piece=pname,
+                              via=via, fps=dict(fps))
+        if self.journal:
+            self.journal.sdc_suspect(piece, fps=fps, via=via)
+        msg = ("SDC: fingerprint mismatch on piece "
+               f"'{pname}' ({via}): "
+               + ", ".join(f"{w[:8]}:{f}"
+                           for w, f in sorted(fps.items()))
+               + " — suspect journaled")
+        print(f"server: {msg}")
+        self._report_clients(msg)
+        if key not in self._sdc_voted:
+            self._dispatch_sdc_exec(piece, "vote", key)
+
+    def _dispatch_sdc_exec(self, piece, kind, key):
+        """Place a ``vote``/``audit`` re-execution of ``piece`` on an
+        idle worker that has NOT already reported a word for this key
+        (a repeat on the same worker would overwrite its own entry and
+        can never break a tie).  The copy is journaled ``queued`` with
+        ``synthetic: true`` — replay must never owe it to a resumed
+        sweep — and its completion is intercepted by
+        ``_finish_sdc_exec``: it NEVER journals ``completed``
+        (content-addressed keys: a second completion would corrupt
+        repeat-trial multiset math)."""
+        fps = self._sdc_fps.get(key) or {}
+        wid = next((w for w in self.avail_workers
+                    if w not in self.sdc_quarantine
+                    and w.hex() not in fps), None)
+        if wid is None:
+            print(f"server: SDC {kind} wanted for piece "
+                  f"'{self._piece_name(piece)}' but no fresh idle "
+                  f"worker — comparison skipped")
+            return False
+        self.avail_workers.remove(wid)
+        self.inflight[wid] = piece
+        self.inflight_owner[wid] = b""
+        self.inflight_t[wid] = time.monotonic()
+        prog = self.worker_progress.get(wid)
+        if prog is not None:
+            prog["advance_t"] = self.inflight_t[wid]
+        self._sdc_execs[wid] = {"kind": kind, "key": key,
+                                "piece": piece}
+        if kind == "vote":
+            self._sdc_voted.add(key)
+        else:
+            self.sdc_audits += 1
+        if self.journal:
+            self.journal.queued(piece, synthetic=True)
+            self.journal.dispatched(piece, wid)
+        pname = self._piece_name(piece)
+        self.recorder.instant("sdc_exec", cat="server", kind=kind,
+                              worker=wid.hex(), piece=pname)
+        msg = (f"SDC: dispatching {kind} re-execution of piece "
+               f"'{pname}' to worker {wid.hex()}")
+        print(f"server: {msg}")
+        self._report_clients(msg)
+        scentime, scencmd = piece
+        self.be_event.send_multipart(
+            [wid, b"BATCH", packb({"scentime": scentime,
+                                   "scencmd": scencmd})])
+        return True
+
+    def _finish_sdc_exec(self, wid):
+        """A vote/audit re-execution left OP: resolve the comparison.
+        An audit copy raises the suspect (and the vote) on mismatch; a
+        vote resolves 2-of-3 — the out-voted worker is named in the
+        ``sdc_vote`` record and handed to the mitigation engine for
+        quarantine (its own gated ``mitigation`` record)."""
+        info = self._sdc_execs.pop(wid)
+        self.inflight.pop(wid, None)
+        self.inflight_owner.pop(wid, None)
+        self.inflight_t.pop(wid, None)
+        kind, key, piece = info["kind"], info["key"], info["piece"]
+        fps = dict(self._sdc_fps.get(key) or {})
+        if kind == "audit":
+            self._sdc_compare(piece, via="audit")
+        else:
+            self.sdc_votes += 1
+            counts = collections.Counter(
+                f for f in fps.values() if f)
+            top = counts.most_common(1)
+            deviants = []
+            if top and top[0][1] >= 2:
+                maj = top[0][0]
+                deviants = sorted(w for w, f in fps.items()
+                                  if f != maj)
+            deviant = ",".join(deviants)
+            pname = self._piece_name(piece)
+            self.recorder.instant("sdc_vote", cat="server",
+                                  piece=pname, fps=dict(fps),
+                                  deviant=deviant)
+            if self.journal:
+                self.journal.sdc_vote(piece, fps=fps, deviant=deviant)
+            msg = (f"SDC: vote on piece '{pname}' resolved: "
+                   + ", ".join(f"{w[:8]}:{f}"
+                               for w, f in sorted(fps.items()))
+                   + (f" — deviant {deviant}" if deviant
+                      else " — no majority (all words differ)"))
+            print(f"server: {msg}")
+            self._report_clients(msg)
+            for dhex in deviants:
+                try:
+                    dwid = bytes.fromhex(dhex)
+                except ValueError:
+                    continue
+                self.mitigator.on_sdc_deviant(
+                    dwid, piece,
+                    why=f"out-voted 2-of-3 fingerprint vote on "
+                        f"'{pname}'")
+            self._sdc_fps.pop(key, None)  # verdict reached
+        # the exec worker rejoins the pool — unless the vote it just
+        # completed named IT the deviant and quarantined it
+        if wid not in self.avail_workers \
+                and wid not in self.sdc_quarantine \
+                and wid not in self.inflight \
+                and self.workers.get(wid, 0) < 2:
+            self.avail_workers.append(wid)
+            self._send_pending_scenario()
+
+    def _maybe_sdc_audit(self, wid, piece):
+        """Deterministically sample completed fast-forward pieces for a
+        shadow re-execution at ``sdc_audit_rate`` (0 = off): corruption
+        that never hits a hedge duplicate still gets caught.  Wall-
+        clock paced pieces are skipped — re-running one doubles its
+        full wall time for a single comparison word."""
+        if not self.sdc_enabled or self.sdc_audit_rate <= 0.0:
+            return
+        from .journal import BatchJournal
+        key = BatchJournal.piece_key(piece)
+        if not self._sdc_fps.get(key):
+            return     # no fingerprint shipped: nothing to compare to
+        prog = self.worker_progress.get(wid)
+        if prog is not None and not prog.get("ff"):
+            return
+        self._audit_acc += min(1.0, self.sdc_audit_rate)
+        if self._audit_acc < 1.0:
+            return
+        self._audit_acc -= 1.0
+        self._dispatch_sdc_exec(piece, "audit", key)
+
+    def sdc_payload(self):
+        """Machine-readable SDC-defense state (the ``SDC`` command and
+        the HEALTH ``sdc`` section), with a human ``text`` rendering —
+        the HEALTH-style readback contract."""
+        d = {"enabled": bool(self.sdc_enabled),
+             "audit_rate": float(self.sdc_audit_rate),
+             "suspects": self.sdc_suspects,
+             "votes": self.sdc_votes,
+             "audits": self.sdc_audits,
+             "quarantined_workers": sorted(
+                 w.hex() for w in self.sdc_quarantine),
+             "tracked_pieces": len(self._sdc_fps),
+             "pending_execs": len(self._sdc_execs)}
+        d["text"] = (
+            f"SDC {'ON' if d['enabled'] else 'OFF'}: "
+            f"{d['suspects']} suspect(s), {d['votes']} vote(s), "
+            f"{d['audits']} audit(s), "
+            f"{len(d['quarantined_workers'])} worker(s) quarantined"
+            + (f", audit rate {d['audit_rate']:g}"
+               if d["audit_rate"] else "")
+            + (" [" + ", ".join(w[:8]
+                                for w in d["quarantined_workers"])
+               + "]" if d["quarantined_workers"] else ""))
+        return d
+
     def _retry_after(self, n_new):
         """Retry hint for a BATCHREJECTED: time for ``n_new`` slots to
         drain at the recently observed completion rate, else the
@@ -1459,6 +1755,10 @@ class Server(threading.Thread):
                     w["mesh"] = prog["mesh"]
                 if isinstance(prog.get("scan"), dict):
                     w["scan"] = prog["scan"]
+                if isinstance(prog.get("fp"), dict):
+                    w["fp"] = prog["fp"]
+            if wid in self.sdc_quarantine:
+                w["quarantined"] = True
             workers[wid.hex()] = w
         # fleet mesh summary: the most advanced epoch any worker
         # reports (after a loss that is the worker that re-formed)
@@ -1522,6 +1822,21 @@ class Server(threading.Thread):
             data["mitigation"] = {
                 k: v for k, v in self.mitigator.payload().items()
                 if k != "text"}
+        # SDC section ONLY while the defense is enabled (same
+        # audit-only contract as mitigation: sdc_enabled=0 keeps the
+        # payload bit-identical to a build without the defense)
+        if self.sdc_enabled:
+            data["sdc"] = {k: v for k, v in self.sdc_payload().items()
+                           if k != "text"}
+        # journal growth watch (ISSUE-17 satellite): size + warn flag
+        if self.journal is not None:
+            jb = int(self.journal.size_bytes)
+            self.obs.gauge("server_journal_bytes").set(jb)
+            data["journal"] = {
+                "path": self.journal.path, "bytes": jb,
+                "warn_bytes": self.journal_warn_bytes,
+                "warn": bool(self.journal_warn_bytes
+                             and jb >= self.journal_warn_bytes)}
         data["text"] = self._health_text(data)
         return data
 
@@ -1580,6 +1895,24 @@ class Server(threading.Thread):
                    if b.get("total") else "unbounded")
                 + (", SHEDDING" if mi.get("shed_active") else "")
                 + (", REPACKED" if mi.get("repack_active") else ""))
+        s = d.get("sdc")
+        if s:
+            lines.append(
+                f"sdc: ON, {s['suspects']} suspect(s), "
+                f"{s['votes']} vote(s), {s['audits']} audit(s), "
+                f"{len(s['quarantined_workers'])} worker(s) "
+                "quarantined"
+                + (f", audit rate {s['audit_rate']:g}"
+                   if s["audit_rate"] else "")
+                + (" [" + ", ".join(w[:8] for w
+                                    in s["quarantined_workers"]) + "]"
+                   if s["quarantined_workers"] else ""))
+        j = d.get("journal")
+        if j:
+            lines.append(
+                f"journal: {j['bytes']} bytes ({j['path']})"
+                + (f" — WARNING: past journal_warn_bytes "
+                   f"{j['warn_bytes']}" if j["warn"] else ""))
         p = d.get("perf")
         if p:
             med = p.get("fleet_median_rate")
@@ -1610,6 +1943,11 @@ class Server(threading.Thread):
             ws = w.get("scan")
             if isinstance(ws, dict) and ws.get("steps"):
                 line += (f", scan conf-peak {ws.get('conf_peak', 0)}")
+            wf = w.get("fp")
+            if isinstance(wf, dict) and wf.get("fp"):
+                line += f", fp {wf['fp']}"
+            if w.get("quarantined"):
+                line += " [SDC-QUARANTINED]"
             lines.append(line)
         return "\n".join(lines)
 
@@ -1761,6 +2099,9 @@ class Server(threading.Thread):
                 self.mitigator.tick(now)
                 self.obs.gauge("server_queue_depth").set(
                     len(self.scenarios))
+                if self.journal is not None:
+                    self.obs.gauge("server_journal_bytes").set(
+                        int(self.journal.size_bytes))
                 self.obs.maybe_export()
             if self.link is not None and self.link in events:
                 try:

@@ -265,6 +265,34 @@ mitigate_mem_hi = 0.9             # re-pack (shrink world_batch_max)
 mitigate_mem_lo = 0.6             # ... and restore below this fraction
 mitigate_repack_factor = 0.5      # re-pack shrinks world_batch_max to
                                   # factor x the configured width
+# ----- silent-data-corruption defense (ISSUE-17; network/server.py,
+# obs/fingerprint.py; SDC + FINGERPRINT stack commands;
+# docs/FAULT_TOLERANCE.md §SDC).  Workers fold a cheap int32
+# bit-pattern fingerprint of the sim state through the compiled chunk
+# scan and ship it on completion; the server compares redundant
+# executions (hedge duplicates, sampled shadow audits), journals
+# audit-only sdc_suspect/sdc_vote records, and — with the mitigation
+# engine on — quarantines the 2-of-3 out-voted deviant worker.
+fingerprint = False               # worker-side: fold the state
+                                  # fingerprint through the chunk scan
+                                  # carry (jit-static; off traces
+                                  # identical HLO, on adds no host
+                                  # syncs or collectives).  FINGERPRINT
+                                  # stack command toggles at runtime.
+sdc_enabled = False               # server-side: compare fingerprints
+                                  # of redundant executions, journal
+                                  # suspects, place 2-of-3 votes.  Off
+                                  # keeps journal and HEALTH output
+                                  # bit-identical to a build without
+                                  # the defense (audit-only contract).
+sdc_audit_rate = 0.0              # fraction of completed fast-forward
+                                  # pieces shadow re-executed for a
+                                  # fingerprint comparison (0 = off;
+                                  # deterministic accumulator sampling,
+                                  # 1.0 = audit every FF piece)
+journal_warn_bytes = 67108864     # [bytes] HEALTH warns when the BATCH
+                                  # journal (WAL) grows past this
+                                  # (64 MiB; 0 = never warn)
 bench_history_path = "BENCH_HISTORY.jsonl"
                                   # append-only bench-row history every
                                   # write_bench_json() call extends

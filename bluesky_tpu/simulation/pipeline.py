@@ -41,7 +41,7 @@ class ChunkEdge:
     def __init__(self, telemetry, chunk: int,
                  simt_planned: Optional[float] = None,
                  seq: int = -1, obs_sink=None, stats=None,
-                 refresh=None):
+                 refresh=None, fingerprint=None):
         self._telemetry = telemetry
         # in-scan telemetry pack (obs/scanstats.ScanStats device pytree)
         # when SimConfig.scanstats was on for the producing chunk; it
@@ -56,6 +56,11 @@ class ChunkEdge:
         # word the host retires once at this edge.  Same eager-set rule
         # as ``stats`` (``__getattr__`` forwards unknown names).
         self.refresh = refresh
+        # SDC fingerprint pack (obs/fingerprint.FingerprintPack device
+        # pytree) when SimConfig.fingerprint was on for the producing
+        # chunk; drained into the sim's running piece chain at
+        # retirement.  Same eager-set rule as ``stats``.
+        self.fingerprint = fingerprint
         self.chunk = int(chunk)
         self._simt_planned = simt_planned
         self._np = None

@@ -333,6 +333,19 @@ class Simulation:
         #                              host sync (pipelined chunks)
         self._refresh_fired = 0      # in-scan refreshes retired so far
         self._refresh_guard = 0      # guard words tripped so far
+        # SDC state fingerprint (ISSUE-17, obs/fingerprint.py): fold a
+        # 32-bit witness of the stepped state through the chunk scan,
+        # chained host-side per piece so completions/heartbeats ship one
+        # comparable word.  Settings knob at startup; the FINGERPRINT
+        # stack command toggles at runtime (jit-static flag, one chunk
+        # program per value, same contract as scanstats).
+        if bool(getattr(_pipe_settings, "fingerprint", False)):
+            self.cfg = self.cfg._replace(fingerprint=True)
+        self._fp_chain = 0           # running piece-chain fold (32-bit)
+        self._fp_chunks = 0          # chunks folded into the chain
+        self._fp_steps = 0           # steps folded into the chain
+        self._fp_corrupt_mask = 0    # FAULT BITFLIP PAYLOAD: XORed into
+        #                              the next shipped summary once
         # Observability (ISSUE-11, docs/OBSERVABILITY.md): a PER-SIM
         # metrics registry (two sims in one process — tests, W-world
         # packs — must not mix series) + the per-process flight
@@ -626,12 +639,20 @@ class Simulation:
         self.areas.reset()
         self.cond.reset()
         self.routes = RouteManager(self.traf, self.routes.wmax)
-        # scanstats/inscan_refresh are runtime knobs, not scenario
-        # state (like the TRACE recorder): the toggles survive RESET
-        # while the rest of the config rebuilds to defaults
+        # scanstats/inscan_refresh/fingerprint are runtime knobs, not
+        # scenario state (like the TRACE recorder): the toggles survive
+        # RESET while the rest of the config rebuilds to defaults
         self.cfg = SimConfig(scanstats=self.cfg.scanstats,
-                             inscan_refresh=self.cfg.inscan_refresh)
+                             inscan_refresh=self.cfg.inscan_refresh,
+                             fingerprint=self.cfg.fingerprint)
         self._scan_last = None
+        # a new scenario starts a fresh fingerprint chain: the chain is
+        # a witness of ONE piece's stepped states, comparable only
+        # between executions of the same scenario content
+        self._fp_chain = 0
+        self._fp_chunks = 0
+        self._fp_steps = 0
+        self._fp_corrupt_mask = 0
         # traf.reset rebuilt default-shape tables on the default device
         self.shard_mode, self.shard_mesh = "off", None
         self.shard_stats = {}
@@ -901,6 +922,7 @@ class Simulation:
         last-refresh time, retired counters).  Pure host state: no
         device reads."""
         d = dict(scanstats=bool(self.cfg.scanstats),
+                 fingerprint=bool(self.cfg.fingerprint),
                  sort_refresh=self.refresh_health())
         if self._scan_last is not None:
             d.update(self._scan_last)
@@ -919,6 +941,54 @@ class Simulation:
         if not on:
             self._scan_last = None
         return True
+
+    # ------------------------------------------------- SDC fingerprint
+    def set_fingerprint(self, on: bool) -> bool:
+        """Toggle the SDC state-fingerprint fold (``set_scanstats``
+        contract: drain the pipeline, then swap the jit-static flag).
+        Turning it ON mid-piece starts the chain at the current state —
+        comparable only to executions toggled at the same step, so the
+        serving layer flips it via scenario content (FINGERPRINT ON as
+        the first stacked command), never mid-flight."""
+        on = bool(on)
+        if on == bool(self.cfg.fingerprint):
+            return False
+        self.drain_pipeline()
+        self.cfg = self.cfg._replace(fingerprint=on)
+        self._fp_chain = 0
+        self._fp_chunks = 0
+        self._fp_steps = 0
+        return True
+
+    def fp_summary(self):
+        """The shipped fingerprint summary (heartbeats + the SDCFP
+        completion event), or None before any chunk folded.  A FAULT
+        BITFLIP PAYLOAD mask corrupts every shipped word until the next
+        RESET — the wire-corruption injection point: the stepped state
+        (and the device fold) stay untouched, only the reported witness
+        lies."""
+        if not self.cfg.fingerprint or self._fp_chunks == 0:
+            return None
+        from ..obs import fingerprint as fpmod
+        word = (self._fp_chain ^ self._fp_corrupt_mask) & 0xFFFFFFFF
+        return fpmod.summarize(word, self._fp_chunks, self._fp_steps)
+
+    def _drain_fingerprint(self, edge) -> None:
+        """Retire one edge's FingerprintPack into the running piece
+        chain (host-side rotate-XOR; registry counters ride along)."""
+        if edge.fingerprint is None:
+            return
+        import jax as _jax
+        from ..obs import fingerprint as fpmod
+        pack = _jax.device_get(edge.fingerprint)
+        edge.fingerprint = None
+        chunk_fp = fpmod.drain(self.obs, pack)
+        self._fp_chain = fpmod.chain(self._fp_chain, chunk_fp)
+        self._fp_chunks += 1
+        self._fp_steps += int(np.asarray(pack.steps))
+        self.recorder.instant("fingerprint_chunk", cat="sdc",
+                              fp=format(chunk_fp, "08x"),
+                              chain=format(self._fp_chain, "08x"))
 
     # ------------------------------------------------- in-scan sort refresh
     def _invalidate_sort(self):
@@ -1494,18 +1564,20 @@ class Simulation:
                     dp.check_donation(state)
         self._last_dispatch_end = time.perf_counter()
         # Normalized return: (state, telemetry, scanstats-or-None,
-        # refresh-or-None) — the runner's output arity follows the
-        # static cfg flags (core/step._edge_scan: stats before
-        # refresh), the callers always see four.
+        # refresh-or-None, fingerprint-or-None) — the runner's output
+        # arity follows the static cfg flags (core/step._edge_scan:
+        # stats before refresh before fingerprint), the callers always
+        # see five.
         rest = list(out[2:])
         sstats = rest.pop(0) if self.cfg.scanstats else None
         rpack = rest.pop(0) if inscan else None
+        fpack = rest.pop(0) if self.cfg.fingerprint else None
         if rpack is not None:
             # chain the due gate: the NEXT dispatch reads this chunk's
             # final sort_t directly from the device output buffer
             self._sort_t_dev = rpack.sort_t
             self._sort_backend = self.cfg.cd_backend
-        return out[0], out[1], sstats, rpack
+        return out[0], out[1], sstats, rpack, fpack
 
     def _next_seq(self) -> int:
         """Bump and return the host-side chunk-sequence correlation tag
@@ -1590,7 +1662,7 @@ class Simulation:
                              and self.guard.policy == "rollback")
                             or self.shard_mode != "off"))
         state_in = self.traf.state
-        new_state, telem, sstats, rpack = self._dispatch_chunk(
+        new_state, telem, sstats, rpack, fpack = self._dispatch_chunk(
             state_in, chunk, keep=capture_now, simt=simt)
         self.traf.state = new_state
         self._step_count += chunk
@@ -1600,7 +1672,8 @@ class Simulation:
                                        simt_planned=self._simt_next,
                                        seq=self._seq_dispatched,
                                        obs_sink=self._edge_pull_sink,
-                                       stats=sstats, refresh=rpack)
+                                       stats=sstats, refresh=rpack,
+                                       fingerprint=fpack)
         self.pipe_stats["pipelined_chunks"] += 1
         if pend is not None:
             self._finish_edge(
@@ -1611,14 +1684,14 @@ class Simulation:
         then run every edge subsystem against the live state — the
         pre-pipeline behavior, bit-identical step math."""
         self.pipe_stats["sync_chunks"] += 1
-        state, telem, sstats, rpack = self._dispatch_chunk(
+        state, telem, sstats, rpack, fpack = self._dispatch_chunk(
             self.traf.state, chunk, keep=False, simt=simt)
         self._apply_chunk_result(state, telem, chunk, stats=sstats,
-                                 refresh=rpack)
+                                 refresh=rpack, fingerprint=fpack)
 
     def _apply_chunk_result(self, state, telem, chunk: int,
                             seq: Optional[int] = None, stats=None,
-                            refresh=None):
+                            refresh=None, fingerprint=None):
         """Install one synchronously-completed chunk's result and run
         every edge subsystem against it — the post-dispatch half of
         ``_step_sync``.  The multi-world runner calls this per world
@@ -1633,7 +1706,8 @@ class Simulation:
             seq = self._seq_dispatched
         edge = ChunkEdge(telem, chunk,      # device clock, no prediction
                          seq=seq, obs_sink=self._edge_pull_sink,
-                         stats=stats, refresh=refresh)
+                         stats=stats, refresh=refresh,
+                         fingerprint=fingerprint)
         t_ret0 = time.perf_counter()
         # Retire the in-scan refresh pack FIRST — before the guard
         # response and every edge consumer — so the host slot arrays
@@ -1663,6 +1737,7 @@ class Simulation:
         # chunk's accumulators are downstream of the poisoned step.
         if not tripped:
             self._drain_scanstats(edge)
+            self._drain_fingerprint(edge)
         plugins_due = self.plugins.has_due(self.simt)
 
         # Chunk-edge subsystems: plugin updates, conditional triggers,
@@ -1737,6 +1812,7 @@ class Simulation:
         # Passive consumers: each samples the edge state from the pack
         # (ONE bulk device->host copy, and only if somebody reads).
         self._drain_scanstats(edge)
+        self._drain_fingerprint(edge)
         self.metrics.update(edge)
         if self.traf.trails.active:
             pack = edge.fetch()

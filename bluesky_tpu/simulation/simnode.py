@@ -178,6 +178,12 @@ def _make_simnode_class(base):
             # would block the loop exactly like the planned-clock note
             if sim.cfg.scanstats and sim._scan_last is not None:
                 info["scan"] = sim._scan_last
+            # SDC fingerprint chain summary: host ints stamped at each
+            # drained chunk edge — same no-device-read contract; the
+            # server records it per piece for hedge/vote comparison
+            fp = sim.fp_summary()
+            if fp is not None:
+                info["fp"] = fp
             # fleet telemetry: ship the metric increments since the
             # last heartbeat; the server merges them into its fleet
             # registry (METRICS DUMP shows the aggregate)
@@ -251,6 +257,11 @@ def _make_simnode_class(base):
                 txt = data.get("text") if isinstance(data, dict) \
                     else str(data)
                 sim.scr.echo(txt or "no mitigation data")
+            elif name == b"SDC":
+                # reply to the stack SDC command's server query/set
+                txt = data.get("text") if isinstance(data, dict) \
+                    else str(data)
+                sim.scr.echo(txt or "no sdc data")
             elif name == b"METRICS":
                 # reply to METRICS DUMP's server query: broker + fleet
                 # registries rendered server-side
@@ -299,7 +310,17 @@ def _make_simnode_class(base):
             if sim.state_flag != OP:
                 _time.sleep(0.02)   # idle pacing (~50 Hz stack polling)
             if sim.state_flag != self.prev_state:
+                was_op = self.prev_state == OP
                 self.prev_state = sim.state_flag
+                if was_op and sim.state_flag != OP:
+                    # completion fingerprint: SDCFP rides the FIFO
+                    # event pair ahead of the STATECHANGE, so the
+                    # server can journal/compare it against the piece
+                    # this worker still has in flight (the OPTRESULT
+                    # ordering contract)
+                    fp = sim.fp_summary()
+                    if fp is not None:
+                        self.send_event(b"SDCFP", fp)
                 self.send_event(b"STATECHANGE", sim.state_flag)
             if not alive or sim.state_flag == END:
                 self.quit()

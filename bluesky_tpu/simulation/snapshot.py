@@ -304,8 +304,12 @@ def read_blob(fname):
     unpickling, so a bit-flipped payload that would still unpickle is
     rejected; v4 files additionally surface the shard-layout header
     into ``blob["shard"]``; files without a magic fall back to the v2
-    plain pickle for back-compat."""
+    plain pickle for back-compat.  A v2 load carries NO integrity
+    check — the returned blob is tagged ``blob["unverified"]`` so the
+    restore path can surface it (the SDC defense treats an unverified
+    restore as a corruption blind spot, docs/FAULT_TOLERANCE.md)."""
     hdr_shard = None
+    unverified = None
     try:
         with open(fname, "rb") as f:
             raw = f.read()
@@ -325,6 +329,7 @@ def read_blob(fname):
             blob = pickle.loads(payload)
         else:
             blob = pickle.loads(raw)        # v2: bare pickle, no digest
+            unverified = "legacy v2 plain pickle, no checksum"
     except (OSError, EOFError, pickle.UnpicklingError, AttributeError,
             MemoryError, ImportError, IndexError, KeyError,
             UnicodeDecodeError, ValueError) as exc:
@@ -335,6 +340,8 @@ def read_blob(fname):
         return None, "unsupported snapshot format"
     if hdr_shard is not None:
         blob.setdefault("shard", hdr_shard)
+    if unverified:
+        blob["unverified"] = unverified
     return blob, None
 
 
@@ -348,7 +355,20 @@ def load(sim, fname):
     blob, err = read_blob(fname)
     if blob is None:
         return False, f"{fname}: {err}"
+    unverified = blob.get("unverified")
+    if unverified:
+        # A restore with no checksum is a silent-corruption blind spot:
+        # count it and journal a trace record so an operator (or the SDC
+        # audit) can tell which runs started from unvouched state.
+        sim.obs.counter(
+            "snapshot_unverified",
+            help="snapshot restores with no checksum verification").inc()
+        sim.recorder.instant("snapshot_unverified", cat="fault",
+                             file=str(fname), why=str(unverified))
     ok, msg = restore_blob(sim, blob)
+    if ok and unverified:
+        msg += (f" [UNVERIFIED: {unverified} — SNAPSHOT SAVE rewrites "
+                f"it as v{FORMAT} with a digest]")
     return ok, (f"Snapshot {fname} {msg}" if ok else f"{fname}: {msg}")
 
 

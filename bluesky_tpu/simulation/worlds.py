@@ -187,12 +187,14 @@ class WorldBatch:
                     stack_worlds(states), cfg, chunk, checked=checked,
                     sort_t0=sort_t0)
             # arity follows the static cfg flags (same group key ->
-            # same arity): stats then refresh join the pair, and the
-            # [W]-leading packs demux per world like the telemetry pack
+            # same arity): stats then refresh then fingerprint join the
+            # pair, and the [W]-leading packs demux per world like the
+            # telemetry pack
             wstate, telem = out[0], out[1]
             rest = list(out[2:])
             wstats = rest.pop(0) if cfg.scanstats else None
             wrpack = rest.pop(0) if inscan else None
+            wfpack = rest.pop(0) if cfg.fingerprint else None
             self.stats["joint_dispatches"] += 1
             self.stats["worlds_stepped"] += len(members)
             self.stats["max_group"] = max(self.stats["max_group"],
@@ -220,7 +222,10 @@ class WorldBatch:
                                         seq=seqs[k],
                                         stats=None if wstats is None
                                         else world_slice(wstats, k),
-                                        refresh=rp)
+                                        refresh=rp,
+                                        fingerprint=None
+                                        if wfpack is None
+                                        else world_slice(wfpack, k))
                 sim._after_chunk()
                 self._drain_echo(i)
                 self._maybe_finish(i)
@@ -256,10 +261,13 @@ class WorldBatch:
         failed = sim.guard.policy == "halt" and bool(sim.guard.trips)
         self.status[i] = "failed" if failed else "completed"
         if self.on_world_done is not None:
-            self.on_world_done(i, self.status[i],
-                               {"simt": sim.simt_planned,
-                                "ntraf": sim.traf.ntraf,
-                                "trips": len(sim.guard.trips)})
+            info = {"simt": sim.simt_planned,
+                    "ntraf": sim.traf.ntraf,
+                    "trips": len(sim.guard.trips)}
+            fp = sim.fp_summary()
+            if fp is not None:
+                info["fp"] = fp
+            self.on_world_done(i, self.status[i], info)
 
     # ------------------------------------------------------ preempt/echo
     def handle_preempt(self) -> dict:

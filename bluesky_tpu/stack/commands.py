@@ -1391,6 +1391,77 @@ def register_all(stack):
             return True, f"MITIGATE {'ON' if on else 'OFF'}"
         return False, "MITIGATE [ON/OFF/STATUS]"
 
+    def fingerprintcmd(flag=None):
+        """FINGERPRINT [ON/OFF]: device-side SDC state fingerprint — a
+        cheap int32 bit-pattern fold over the guarded state leaves,
+        threaded through the chunk-scan carry (jit-static: OFF traces
+        identical HLO, ON adds no host syncs or collectives) and
+        chained per piece.  The completion word ships to the server
+        for redundant-execution comparison (SDC defense).  Bare call
+        reads back state + the running chain."""
+        if flag is None:
+            if not sim.cfg.fingerprint:
+                return True, "FINGERPRINT OFF"
+            fp = sim.fp_summary()
+            if fp is None:
+                return True, "FINGERPRINT ON (no chunk drained yet)"
+            return True, (f"FINGERPRINT ON: chain {fp['fp']} over "
+                          f"{fp['chunks']} chunk(s) / {fp['steps']} "
+                          f"step(s)")
+        on = str(flag).upper() in ("ON", "TRUE", "1", "YES")
+        changed = sim.set_fingerprint(on)
+        state = "ON" if on else "OFF"
+        return True, (f"FINGERPRINT {state}"
+                      + ("" if changed else " (unchanged)")
+                      + (": next dispatch compiles the fingerprint-"
+                         "carrying chunk program"
+                         if changed and on else ""))
+
+    def sdccmd(arg=None, val=None):
+        """SDC [ON/OFF/STATUS | AUDIT rate]: the server's silent-data-
+        corruption defense — fingerprints of redundant executions
+        (hedge duplicates, sampled shadow audits) compared on
+        completion; mismatches journal audit-only sdc_suspect records,
+        a 2-of-3 re-execution vote names the deviant worker and the
+        mitigation engine quarantines it.  Bare SDC / SDC STATUS reads
+        the defense state back HEALTH-style; on a detached sim it
+        reports the local settings defaults a future server would
+        inherit."""
+        from .. import settings as _settings
+        node = getattr(sim, "node", None)
+        networked = node is not None \
+            and getattr(node, "event_io", None) is not None
+        a = str(arg).upper() if arg is not None else ""
+        if a in ("", "STATUS"):
+            if networked:
+                node.send_event(b"SDC", None)  # empty route -> server
+                return True, "SDC status requested from the server"
+            return True, (
+                f"detached sim: SDC "
+                f"{'ON' if getattr(_settings, 'sdc_enabled', False) else 'OFF'}"
+                f", audit rate "
+                f"{getattr(_settings, 'sdc_audit_rate', 0.0):g} "
+                "(settings.sdc_enabled / settings.sdc_audit_rate; a "
+                "server inherits these)")
+        if a in ("ON", "OFF", "TRUE", "FALSE", "1", "0"):
+            on = a in ("ON", "TRUE", "1")
+            _settings.sdc_enabled = on
+            if networked:
+                node.send_event(b"SDC", {"enabled": on})
+                return True, f"SDC {'ON' if on else 'OFF'} sent"
+            return True, f"SDC {'ON' if on else 'OFF'}"
+        if a == "AUDIT":
+            try:
+                rate = max(0.0, float(val))
+            except (TypeError, ValueError):
+                return False, "SDC AUDIT rate: need a fraction 0..1"
+            _settings.sdc_audit_rate = rate
+            if networked:
+                node.send_event(b"SDC", {"audit_rate": rate})
+                return True, f"SDC audit rate {rate:g} sent"
+            return True, f"SDC audit rate {rate:g}"
+        return False, "SDC [ON/OFF/STATUS | AUDIT rate]"
+
     def snapshot(sub, fname=None):
         """SNAPSHOT SAVE/LOAD fname: binary pytree state checkpoint
         (device-state snapshot the reference lacks, SURVEY 5.4)."""
@@ -1717,7 +1788,8 @@ def register_all(stack):
         "TRACE": ["TRACE [ON/OFF/DUMP]", "[txt]", tracecmd,
                   "Flight recorder: bounded span ring dumped as "
                   "Perfetto trace JSON (readback bare)"],
-        "FAULT": ["FAULT NAN/INF [acid] | GUARD ../RING .. | DROP/DUP/"
+        "FAULT": ["FAULT NAN/INF [acid] | BITFLIP [STATE|PAYLOAD] | "
+                  "GUARD ../RING .. | DROP/DUP/"
                   "DELAY p | NETOFF | STALL s | STRAGGLE f/STALL/OFF | "
                   "KILL | PREEMPT [s] | MESHKILL [g] | PARTITION [OFF] "
                   "| LOADSPIKE n [rate] | SNAPTRUNC f | LIST",
@@ -1742,6 +1814,14 @@ def register_all(stack):
                      "Self-healing serving: signal->actuator policy "
                      "engine behind rate limits, backoff and a budget "
                      "(readback bare)"],
+        "FINGERPRINT": ["FINGERPRINT [ON/OFF]", "[txt]", fingerprintcmd,
+                        "Device-side SDC state fingerprint folded "
+                        "through the compiled chunk scan "
+                        "(readback bare)"],
+        "SDC": ["SDC [ON/OFF/STATUS | AUDIT rate]", "[txt,txt]", sdccmd,
+                "Silent-data-corruption defense: redundant-execution "
+                "fingerprint voting + worker quarantine "
+                "(readback bare)"],
         "WORLDS": ["WORLDS [ON/OFF | MAX n]", "[txt,txt]", worldscmd,
                    "Multi-world BATCH packing: world-batch size + "
                    "per-bucket packing on/off (readback bare)"],

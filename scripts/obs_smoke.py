@@ -1,12 +1,15 @@
 """CI obs-smoke: the ISSUE-11 observability contract, measured.
 
-Two halves, run twice (flight recorder, then ISSUE-14 scanstats):
+Two halves, run three times (flight recorder, ISSUE-14 scanstats, and
+the ISSUE-17 SDC state fingerprint):
 
 1. Parity — the instrumentation is carry/host-side only: a run with
    the recorder ENABLED must produce a bit-identical stepped state to
-   a run with it disabled (zero added device ops), and a run with
+   a run with it disabled (zero added device ops), a run with
    SCANSTATS on must match both (the accumulator folds read state,
-   never write it).  Hash mismatch is a hard failure.
+   never write it), and a run with FINGERPRINT on must match too (the
+   fold is an int32 XOR chain riding the carry — it reads the state,
+   never writes it).  Hash mismatch is a hard failure.
 
 2. Overhead — best-of-reps wall time for the same scenario with each
    instrument off vs on.  The contract is <2% added wall; the CI lane
@@ -60,7 +63,8 @@ def build(nmax=64):
     return sim
 
 
-def run_once(trace: bool, until=20.0, scanstats=False):
+def run_once(trace: bool, until=20.0, scanstats=False,
+             fingerprint=False):
     from bluesky_tpu.obs.trace import get_recorder
     rec = get_recorder()
     rec.clear()
@@ -71,6 +75,8 @@ def run_once(trace: bool, until=20.0, scanstats=False):
     sim = build()
     if scanstats:
         sim.set_scanstats(True)
+    if fingerprint:
+        sim.set_fingerprint(True)
     t0 = time.perf_counter()
     sim.run(until_simt=until, max_iters=2000)
     wall = time.perf_counter() - t0
@@ -91,6 +97,7 @@ def main(argv=None):
     # warmup: pays every jit compile so the timed reps hit cache
     run_once(False)
     run_once(False, scanstats=True)
+    run_once(False, fingerprint=True)
 
     # ---- parity: recorder on must not change the stepped state, and
     # the scanstats fold (pure carry reads) must not either — all
@@ -105,6 +112,17 @@ def main(argv=None):
     assert sim_ss._scan_last is not None \
         and sim_ss.obs.get("sim_scan_steps") is not None, \
         "scanstats run drained no accumulator pack"
+    # fingerprint parity (ISSUE-17): the fold reads the carry, never
+    # writes state — ON must be bit-identical to OFF, and the run must
+    # actually have chained a per-chunk fingerprint word
+    sim_fp, _ = run_once(False, fingerprint=True)
+    h_fp = state_hash(sim_fp)
+    assert h_fp == h_off, (
+        f"fingerprint on/off state hash diverged:\n"
+        f"  off {h_off}\n  on  {h_fp}")
+    fp = sim_fp.fp_summary()
+    assert fp is not None and fp["chunks"] > 0, \
+        "fingerprint run chained no chunk fingerprints"
     # the recorder run goes LAST: run_once clears the ring, and the
     # sample-trace section below dumps this run's events
     sim_on, _ = run_once(True)
@@ -141,7 +159,7 @@ def main(argv=None):
 
     # ---- overhead: alternate off/on reps per instrument, keep the
     # best of each (recorder row pair + scanstats row pair)
-    wall_off = wall_on = wall_ss = np.inf
+    wall_off = wall_on = wall_ss = wall_fp = np.inf
     for _ in range(args.reps):
         _, w = run_once(False)
         wall_off = min(wall_off, w)
@@ -149,8 +167,11 @@ def main(argv=None):
         wall_on = min(wall_on, w)
         _, w = run_once(False, scanstats=True)
         wall_ss = min(wall_ss, w)
+        _, w = run_once(False, fingerprint=True)
+        wall_fp = min(wall_fp, w)
     overhead = (wall_on - wall_off) / wall_off * 100.0
     overhead_ss = (wall_ss - wall_off) / wall_off * 100.0
+    overhead_fp = (wall_fp - wall_off) / wall_off * 100.0
     proto = (f"best-of-{args.reps}, alternating off/on, "
              f"platform={os.environ.get('JAX_PLATFORMS', '?')}")
     rows = [{
@@ -174,6 +195,17 @@ def main(argv=None):
         "chunks": int(n_chunks),
         "parity": "bit-identical",
         "protocol": proto,
+    }, {
+        "scenario": "obs_smoke 4-aircraft FF to simt=20",
+        "instrument": "fingerprint",
+        "reps": args.reps,
+        "wall_off_s": round(wall_off, 4),
+        "wall_on_s": round(wall_fp, 4),
+        "overhead_pct": round(overhead_fp, 2),
+        "chunks": int(n_chunks),
+        "fp": fp["fp"],
+        "parity": "bit-identical",
+        "protocol": proto,
     }]
     # shared writer: platform tag + BENCH_HISTORY append (the perf
     # sentinel's obs-overhead series)
@@ -183,11 +215,16 @@ def main(argv=None):
           f"{wall_on:.3f}s = {overhead:+.2f}% -> {args.out}")
     print(f"scanstats overhead: off {wall_off:.3f}s vs on "
           f"{wall_ss:.3f}s = {overhead_ss:+.2f}% -> {args.out}")
+    print(f"fingerprint overhead: off {wall_off:.3f}s vs on "
+          f"{wall_fp:.3f}s = {overhead_fp:+.2f}% "
+          f"(chain {fp['fp']}) -> {args.out}")
     bad = []
     if overhead > 5.0:
         bad.append(f"recorder {overhead:+.2f}%")
     if overhead_ss > 5.0:
         bad.append(f"scanstats {overhead_ss:+.2f}%")
+    if overhead_fp > 5.0:
+        bad.append(f"fingerprint {overhead_fp:+.2f}%")
     if bad:
         print("OBS SMOKE: overhead above the 5% CI flag line: "
               + ", ".join(bad), file=sys.stderr)

@@ -49,7 +49,8 @@ def _run_schedule(rng, journal, model):
             noise = rng.choice(["preempted", "hedged", "dup_completed",
                                 "mesh_lost", "resharded",
                                 "dispatched", "perf_regression",
-                                "mitigation"])
+                                "mitigation", "sdc_suspect",
+                                "sdc_vote"])
             if noise == "preempted":
                 journal.preempted(p, w, world=rng.choice([None, 0, 1]))
             elif noise == "hedged":
@@ -61,12 +62,23 @@ def _run_schedule(rng, journal, model):
                                         baseline=1.0, factor=0.5)
             elif noise == "mitigation":
                 journal.mitigation(
-                    cause=rng.choice(["perf_regression", "queue_flood"]),
+                    cause=rng.choice(["perf_regression", "queue_flood",
+                                      "fingerprint vote"]),
                     signal="fuzz", target=w.hex(),
                     action=rng.choice(["hedge_escalate", "shed",
-                                       "unshed"]),
+                                       "unshed", "quarantine_worker",
+                                       "release_worker"]),
                     outcome="ok",
                     piece=rng.choice([None, p]), worker=w)
+            elif noise == "sdc_suspect":
+                journal.sdc_suspect(
+                    p, fps={w.hex(): "0000beef", "99": "0000dead"},
+                    via=rng.choice(["hedge_dup", "audit"]))
+            elif noise == "sdc_vote":
+                journal.sdc_vote(
+                    p, fps={w.hex(): "0000beef", "99": "0000dead",
+                            "aa": "0000beef"},
+                    deviant=rng.choice(["", w.hex()]))
             elif noise == "mesh_lost":
                 journal.mesh_lost(p, w, epoch=rng.randint(0, 3),
                                   lost=[1])
@@ -166,7 +178,8 @@ def test_replay_exactly_once_across_crashes(tmp_path, seed):
             continue
         if r.get("rec") in ("dispatched", "preempted", "hedged",
                             "dup_completed", "mesh_lost", "resharded",
-                            "perf_regression", "mitigation"):
+                            "perf_regression", "mitigation",
+                            "sdc_suspect", "sdc_vote"):
             audit.append(ln)
     rng.shuffle(audit)
     with open(path, "a", encoding="utf-8") as f:
@@ -180,10 +193,11 @@ def test_replay_exactly_once_across_crashes(tmp_path, seed):
 
 def test_replay_pure_audit_noise_changes_nothing(tmp_path):
     """mesh_lost / resharded / hedged / preempted / dup_completed /
-    perf_regression / mitigation are narration: a journal with every
-    piece completed must fold to an empty pending queue no matter how
-    much audit noise rides along — and replay surfaces the mitigation
-    history verbatim for the auditor."""
+    perf_regression / mitigation / sdc_suspect / sdc_vote are
+    narration: a journal with every piece completed must fold to an
+    empty pending queue no matter how much audit noise rides along —
+    and replay surfaces the mitigation history and the SDC suspicion/
+    vote/quarantine trail verbatim for the auditor."""
     path = str(tmp_path / "batch.jsonl")
     j = BatchJournal(path, fsync=False)
     pieces = [_piece(i) for i in range(3)]
@@ -199,6 +213,8 @@ def test_replay_pure_audit_noise_changes_nothing(tmp_path):
         j.mitigation(cause="perf_regression", signal="slo_watch",
                      action="hedge_escalate", target="01",
                      outcome="hedged to 02", piece=p, worker=b"\x01")
+        j.sdc_suspect(p, fps={"01": "0000beef", "02": "0000dead"},
+                      via="hedge_dup")
         j.completed(p, b"\x01")
         j.dup_completed(p, b"\x02")
     # keyless mitigation records (shed/unshed target the admission
@@ -207,6 +223,19 @@ def test_replay_pure_audit_noise_changes_nothing(tmp_path):
                  action="shed", target="admission", outcome="max 32->16")
     j.mitigation(cause="queue_drain", signal="queue_depth",
                  action="unshed", target="admission", outcome="max 16->32")
+    # the SDC trail: a 2-of-3 vote names worker 01, the mitigation
+    # engine quarantines it, MITIGATE OFF later releases it — all
+    # audit, none of it may touch the queue fold
+    j.sdc_vote(pieces[0], fps={"01": "0000dead", "02": "0000beef",
+                               "03": "0000beef"}, deviant="01")
+    j.mitigation(cause="fingerprint vote 2-of-3", signal="sdc_deviant",
+                 action="quarantine_worker", target="01",
+                 outcome="worker drained from assignment",
+                 piece=pieces[0], worker=b"\x01")
+    j.mitigation(cause="operator MITIGATE OFF", signal="operator",
+                 action="release_worker", target="01",
+                 outcome="worker returned to assignment",
+                 worker=b"\x01")
     j.close()
     state = BatchJournal.replay(path)
     assert state["pending"] == []
@@ -214,13 +243,27 @@ def test_replay_pure_audit_noise_changes_nothing(tmp_path):
     assert state["torn_lines"] == 0
     # the decision history is surfaced, in journal order
     mits = state["mitigations"]
-    assert len(mits) == 5
+    assert len(mits) == 7
     assert [m["action"] for m in mits] == ["hedge_escalate"] * 3 \
-        + ["shed", "unshed"]
+        + ["shed", "unshed", "quarantine_worker", "release_worker"]
     assert mits[0]["cause"] == "perf_regression"
     assert mits[0]["key"] == BatchJournal.piece_key(pieces[0])
     assert mits[3]["key"] is None
     assert mits[4]["outcome"] == "max 16->32"
+    # the SDC trail is surfaced exactly-once, in journal order, with
+    # the quarantine mitigation cross-listed next to the vote
+    sdc = state["sdc"]
+    assert len(sdc["suspects"]) == 3
+    assert [s["key"] for s in sdc["suspects"]] \
+        == [BatchJournal.piece_key(p) for p in pieces]
+    assert all(s["via"] == "hedge_dup" for s in sdc["suspects"])
+    assert len(sdc["votes"]) == 1
+    assert sdc["votes"][0]["deviant"] == "01"
+    assert sdc["votes"][0]["fps"]["02"] == "0000beef"
+    assert [q["action"] for q in sdc["quarantines"]] \
+        == ["quarantine_worker"]
+    assert sdc["quarantines"][0]["key"] == BatchJournal.piece_key(
+        pieces[0])
 
 
 def test_replay_skips_synthetic_pieces(tmp_path):
