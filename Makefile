@@ -31,14 +31,18 @@ test-fast:
 # incl. the slow closed-loop FAULT STRAGGLE + LOADSPIKE acceptance
 # case), the SDC-defense suite (tests/test_sdc.py — fingerprint fold,
 # redundant-execution voting, quarantine, incl. the slow closed-loop
-# FAULT BITFLIP acceptance case) and the slow fabric cases (kill -9 a
-# real worker mid-BATCH, silent-worker reaping).
+# FAULT BITFLIP acceptance case), the broker-HA suite
+# (tests/test_ha.py — lease/fence/reconcile units plus the slow FAULT
+# KILLSERVER failover chaos case: SIGKILL the leader mid-BATCH,
+# standby takes the lease, workers adopt in-flight pieces, journal-
+# verified exactly-once) and the slow fabric cases (kill -9 a real
+# worker mid-BATCH, silent-worker reaping).
 chaos:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	$(PYTHON) -m pytest tests/test_chaos.py tests/test_durability.py \
 	tests/test_overload.py tests/test_fabric_hardening.py \
 	tests/test_world_serving.py tests/test_mitigate.py \
-	tests/test_sdc.py -q $(XDIST)
+	tests/test_sdc.py tests/test_ha.py -q $(XDIST)
 
 # Mesh-epoch recovery lane (docs/FAULT_TOLERANCE.md §mesh epochs):
 # MeshGuard unit + MESHKILL e2e + re-shard parity, the journal-replay
@@ -47,12 +51,19 @@ chaos:
 # heartbeat-only partition no-double-count case.  The SDC-defense
 # suite rides this BLOCKING lane too (the chaos lane is advisory):
 # fingerprint voting and quarantine are exactly-once-journal
-# invariants, same class as the fuzz suite.  The gloo test spawns
-# its own 4-device subprocesses, so no xdist here.
+# invariants, same class as the fuzz suite.  The broker-HA fast units
+# (tests/test_ha.py -m 'not slow' — lease files, journal fencing,
+# reconciliation, discovery arbitration) gate here for the same
+# reason; the wall-clock failover chaos case stays in the advisory
+# chaos lane.  The gloo test spawns its own 4-device subprocesses, so
+# no xdist here.
 mesh-chaos:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	$(PYTHON) -m pytest tests/test_meshguard.py tests/test_journal_fuzz.py \
-	tests/test_meshchaos.py tests/test_sdc.py -q
+	tests/test_meshchaos.py tests/test_sdc.py -q \
+	&& JAX_PLATFORMS=cpu \
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PYTHON) -m pytest tests/test_ha.py -q -m 'not slow'
 
 lint:
 	@$(PYTHON) -m pyflakes bluesky_tpu tests 2>/dev/null \

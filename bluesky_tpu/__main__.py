@@ -52,6 +52,13 @@ def main(argv=None):
     parser.add_argument("--upstream", default="",
                         help="chain this server under another: host:port "
                              "of the upstream server's client event port")
+    parser.add_argument("--standby", action="store_true",
+                        help="broker HA: start the server as a warm "
+                             "standby that tails the shared journal "
+                             "(point --resume-batch at the leader's "
+                             "journal) and takes over leadership "
+                             "automatically when the leader's lease "
+                             "goes stale")
     parser.add_argument("--resume-batch", default="", metavar="JOURNAL",
                         help="replay a BATCH journal (JSONL WAL) from a "
                              "crashed/preempted server: completed pieces "
@@ -167,8 +174,10 @@ def run_server(args):
     server = Server(headless=True, discoverable=args.discoverable,
                     ports=ports, max_nnodes=settings.max_nnodes,
                     upstream=upstream,
-                    resume_journal=args.resume_batch or None)
-    print(f"bluesky_tpu server: clients on "
+                    resume_journal=args.resume_batch or None,
+                    ha_role="standby" if args.standby else None)
+    role = f" [{server.ha_role}]" if server.ha_role else ""
+    print(f"bluesky_tpu server{role}: clients on "
           f"{server.ports['event']}/{server.ports['stream']}, workers on "
           f"{server.ports['wevent']}/{server.ports['wstream']}")
     if server.journal:
@@ -279,7 +288,7 @@ def run_client(args):
     client.subscribe(b"SIMINFO")
 
     def on_event(name, data, sender):
-        if name in (b"ECHO", b"HEALTH"):
+        if name in (b"ECHO", b"HEALTH", b"HA"):
             print(data.get("text", data) if isinstance(data, dict)
                   else data)
         elif name == b"BATCHREJECTED":

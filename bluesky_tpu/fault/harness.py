@@ -25,6 +25,11 @@ Usage (stack/commands.py registers it):
                              [for sec]; server-side hedging recovers
   FAULT STRAGGLE OFF         clear the straggle fault
   FAULT KILL                 SIGKILL this worker (no goodbye)
+  FAULT KILLSERVER [delay]   SIGKILL the BROKER process [after delay s]
+                             (head-node loss model): with broker HA
+                             (network/ha.py) the warm standby takes the
+                             lease over and the sweep continues; without
+                             it, --resume-batch recovers at restart
   FAULT PREEMPT [delay]      preemption notice (SIGTERM model): drain
                              the chunk, checkpoint, notify, exit
   FAULT MESHKILL [group]     mark one device group of the active mesh
@@ -216,6 +221,24 @@ def fault_command(sim, *args):
     if sub == "KILL":
         injectors.kill_self()          # no return: SIGKILL
 
+    if sub == "KILLSERVER":
+        node = _node(sim)
+        pid = getattr(node, "server_pid", None)
+        if not pid:
+            return False, ("FAULT KILLSERVER: no broker pid known "
+                           "(detached sim, or the server predates the "
+                           "pid-carrying REGISTER ack)")
+        try:
+            delay = float(rest[0]) if rest else 0.0
+        except ValueError:
+            return False, "FAULT KILLSERVER [delay_s]"
+        injectors.kill_server(pid, delay)
+        return True, (f"FAULT: SIGKILL broker pid {pid}"
+                      + (f" in {delay:g} s" if delay > 0 else "")
+                      + " — the WAL is append-only, so a warm standby "
+                        "(or --resume-batch) recovers the sweep "
+                        "exactly-once")
+
     if sub == "PREEMPT":
         try:
             delay = float(rest[0]) if rest else 0.0
@@ -293,5 +316,6 @@ def fault_command(sim, *args):
     return False, ("FAULT NAN/INF [acid] | BITFLIP [STATE|PAYLOAD] | "
                    "GUARD .. | RING .. | DROP/DUP/"
                    "DELAY p | NETOFF | STALL s | STRAGGLE f/STALL/OFF | "
-                   "KILL | PREEMPT [s] | MESHKILL [g] | PARTITION [OFF] | "
+                   "KILL | KILLSERVER [s] | PREEMPT [s] | MESHKILL [g] "
+                   "| PARTITION [OFF] | "
                    "LOADSPIKE n [rate] | SNAPTRUNC f | LIST")

@@ -204,6 +204,24 @@ def kill_self():
     os.kill(os.getpid(), signal.SIGKILL)
 
 
+def kill_server(pid, delay_s: float = 0.0):
+    """SIGKILL the BROKER process after ``delay_s`` — the head-node
+    loss model (broker HA, network/ha.py).  The pid comes from the
+    server's REGISTER ack (node.server_pid).  No goodbye, no journal
+    shutdown marker: the warm standby must notice via lease silence,
+    take over the sweep journal-fenced, and surviving workers must
+    re-discover and re-REGISTER with their in-flight pieces."""
+    pid = int(pid)
+    if delay_s and float(delay_s) > 0:
+        t = threading.Timer(float(delay_s), os.kill,
+                            args=(pid, signal.SIGKILL))
+        t.daemon = True
+        t.start()
+        return t
+    os.kill(pid, signal.SIGKILL)
+    return None
+
+
 def preempt(sim, delay_s: float = 0.0):
     """Deliver a preemption notice to this sim after ``delay_s`` —
     the SIGTERM-from-the-scheduler model (spot/preemptible capacity

@@ -34,6 +34,15 @@ class Client:
         self.last_health = None     # latest HEALTH reply payload
         self.last_metrics = None    # latest METRICS (telemetry) reply
         self.last_trace = None      # latest TRACE reply (dump path)
+        self.last_ha = None         # latest HA (broker-HA) reply
+        # broker HA (network/ha.py): lease terms learned from an HA
+        # server's REGISTER ack — None epoch means a non-HA server and
+        # failover() has nothing to arbitrate with
+        self.host_pid = None
+        self.host_epoch = None
+        self.host_lease_ttl = 0.0
+        self.host_disc_port = None
+        self._endpoints = None      # (event, stream) currently connected
         self.opt_results = []       # BATCHOPT reports (OPT-piece
         #                             trajectory-optimization results:
         #                             offsets + objective trace)
@@ -64,8 +73,10 @@ class Client:
             else getattr(settings, "connect_backoff_base", 0.25)
         cap = backoff_cap if backoff_cap is not None \
             else getattr(settings, "connect_backoff_cap", 4.0)
-        self.event_io.connect(f"tcp://{host}:{event_port}")
-        self.stream_in.connect(f"tcp://{host}:{stream_port}")
+        self._endpoints = (f"tcp://{host}:{event_port}",
+                           f"tcp://{host}:{stream_port}")
+        self.event_io.connect(self._endpoints[0])
+        self.stream_in.connect(self._endpoints[1])
         deadline = time.perf_counter() + timeout
         delay = max(1e-3, float(base))
         self.connect_attempts = 0
@@ -84,6 +95,7 @@ class Client:
                     if name == b"REGISTER":
                         data = unpackb(payload)
                         self.host_id = data["host_id"]
+                        self._absorb_ha_ack(data)
                         self._set_nodes(data["nodes"])
                         return
                     self._dispatch(route, name, payload)
@@ -96,20 +108,84 @@ class Client:
         self.event_io.close()
         self.stream_in.close()
 
+    def _absorb_ha_ack(self, data):
+        """Fold an HA server's REGISTER-ack lease terms in (pid always
+        rides the ack; epoch/ttl/discovery only from an HA server)."""
+        if not isinstance(data, dict):
+            return
+        self.host_pid = data.get("pid", self.host_pid)
+        if "epoch" in data:
+            self.host_epoch = int(data["epoch"])
+            self.host_lease_ttl = float(data.get("lease_ttl", 0.0)
+                                        or 0.0)
+            self.host_disc_port = data.get("discovery",
+                                           self.host_disc_port)
+
     @staticmethod
-    def discover(timeout=3.0):
-        """Broadcast on the LAN and return the first discovery.Reply."""
-        disc = Discovery(make_id(), is_client=True)
+    def arbitrate(replies):
+        """Pick the server to talk to from a burst of discovery
+        replies: standbys are skipped (not serving), the highest lease
+        epoch wins (a deposed leader's stale reply advertises an older
+        one), first-seen breaks ties.  Returns a discovery.Reply or
+        None."""
+        best = None
+        for reply in replies:
+            if reply is None or reply.role == "standby":
+                continue
+            if best is None or reply.epoch > best.epoch:
+                best = reply
+        return best
+
+    @staticmethod
+    def discover(timeout=3.0, settle=0.25, port=None):
+        """Broadcast on the LAN and return the winning discovery.Reply.
+
+        After the first reply lands, keep collecting for a short
+        ``settle`` window so two-servers-one-leader setups (broker HA:
+        a live leader plus a deposed one or a warm standby) arbitrate
+        by epoch/role instead of by datagram race."""
+        disc = Discovery(make_id(), is_client=True,
+                         **({"port": port} if port else {}))
+        replies = []
         try:
             disc.send_request()
-            t0 = time.perf_counter()
-            while time.perf_counter() - t0 < timeout:
+            t_end = time.perf_counter() + timeout
+            while time.perf_counter() < t_end:
                 kind, reply = disc.recv_reqreply()
                 if kind == "rep":
-                    return reply
+                    replies.append(reply)
+                    t_end = min(t_end,
+                                time.perf_counter() + max(0.0, settle))
         finally:
             disc.close()
-        return None
+        return Client.arbitrate(replies)
+
+    def failover(self, timeout=3.0):
+        """Broker-HA failover: re-run discovery, move the DEALER/SUB
+        pair to the arbitration winner (a leader with a strictly higher
+        epoch than the one we registered with) and re-REGISTER.  The
+        DEALER identity is preserved, so the server sees the same
+        client.  Returns True if a newer leader was adopted."""
+        if self.host_epoch is None:
+            return False           # non-HA server: nothing to fail to
+        best = self.discover(timeout=timeout, port=self.host_disc_port)
+        if best is None or best.epoch <= self.host_epoch:
+            return False
+        old = self._endpoints
+        self._endpoints = (f"tcp://{best.ip}:{best.event_port}",
+                           f"tcp://{best.ip}:{best.stream_port}")
+        if old:
+            for sock, ep in ((self.event_io, old[0]),
+                             (self.stream_in, old[1])):
+                try:
+                    sock.disconnect(ep)
+                except zmq.ZMQError:
+                    pass
+        self.event_io.connect(self._endpoints[0])
+        self.stream_in.connect(self._endpoints[1])
+        self.host_epoch = best.epoch
+        self.send_event(b"REGISTER", target=b"")
+        return True
 
     # ----------------------------------------------------------------- I/O
     def send_event(self, name: bytes, data=None, target=None):
@@ -171,9 +247,12 @@ class Client:
         data = unpackb(payload) if payload else None
         if name in (b"NODESCHANGED", b"REGISTER"):
             # REGISTER here is the late ack of a retried handshake
-            # (backoff re-sends): absorb it as a node-table refresh
-            # instead of surfacing a duplicate handshake event
+            # (backoff re-sends) or of a failover re-REGISTER: absorb
+            # it as a node-table + HA-lease refresh instead of
+            # surfacing a duplicate handshake event
             self.host_id = data["host_id"]
+            if name == b"REGISTER":
+                self._absorb_ha_ack(data)
             self._set_nodes(data["nodes"])
         else:
             if name == b"BATCHREJECTED":
@@ -184,6 +263,8 @@ class Client:
                 self.last_metrics = data
             elif name == b"TRACE":
                 self.last_trace = data
+            elif name == b"HA":
+                self.last_ha = data
             elif name == b"BATCHOPT":
                 self.opt_results.append(data)
             sender = route[0] if route else b""

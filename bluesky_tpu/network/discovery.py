@@ -18,6 +18,18 @@ class Reply:
     ip: str
     event_port: int
     stream_port: int
+    # broker HA (network/ha.py): servers advertise their lease epoch
+    # and role so clients/workers can arbitrate between a deposed
+    # leader's stale reply and the real one (highest epoch wins) and
+    # skip warm standbys that are not serving yet.  Non-HA servers
+    # advertise the defaults, so pre-HA wire peers keep working.
+    epoch: int = 0
+    role: str = "leader"
+    # worker-side ports (HA replies only; 0 = not advertised): a
+    # failed-over WORKER must re-REGISTER on the new leader's worker
+    # ROUTER, not the client one — event/stream above are client-facing
+    wevent: int = 0
+    wstream: int = 0
 
 
 class Discovery:
@@ -43,11 +55,21 @@ class Discovery:
         msg = packb({"magic": _MAGIC, "kind": "req", "id": self.own_id})
         self.sock.sendto(msg, ("<broadcast>", self.port))
 
-    def send_reply(self, event_port: int, stream_port: int):
-        msg = packb({"magic": _MAGIC, "kind": "rep", "id": self.own_id,
-                     "ip": get_ownip(), "event": event_port,
-                     "stream": stream_port})
-        self.sock.sendto(msg, ("<broadcast>", self.port))
+    def send_reply(self, event_port: int, stream_port: int,
+                   epoch: int = None, role: str = None,
+                   wevent: int = None, wstream: int = None):
+        msg = {"magic": _MAGIC, "kind": "rep", "id": self.own_id,
+               "ip": get_ownip(), "event": event_port,
+               "stream": stream_port}
+        if epoch is not None:      # broker HA: advertise lease epoch
+            msg["epoch"] = int(epoch)
+        if role is not None:       # ... and role (leader/standby)
+            msg["role"] = str(role)
+        if wevent is not None:     # ... and the worker-facing ports
+            msg["wevent"] = int(wevent)
+        if wstream is not None:
+            msg["wstream"] = int(wstream)
+        self.sock.sendto(packb(msg), ("<broadcast>", self.port))
 
     def recv_reqreply(self):
         """Receive one datagram; returns ('req', None) | ('rep', Reply) |
@@ -68,5 +90,9 @@ class Discovery:
             return "req", None
         if msg.get("kind") == "rep":
             return "rep", Reply(msg.get("ip", addr[0]), msg["event"],
-                                msg["stream"])
+                                msg["stream"],
+                                int(msg.get("epoch", 0) or 0),
+                                str(msg.get("role", "leader")),
+                                int(msg.get("wevent", 0) or 0),
+                                int(msg.get("wstream", 0) or 0))
         return None, None

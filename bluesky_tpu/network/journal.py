@@ -103,8 +103,40 @@ Record types (one JSON object per line, ``rec`` selects the type):
                                             captured (audit; links the
                                             journal to the Perfetto
                                             merge)
+  ``lease``       {leader, epoch, ttl}      broker-HA leadership change
+                                            (network/ha.py): ``leader``
+                                            (server hex id) acquired
+                                            lease ``epoch``.  Replay
+                                            tracks the epoch in force
+                                            positionally; records a
+                                            writer appends after losing
+                                            the lease are FENCED (see
+                                            ``wepoch`` below).
+  ``adopted``     {key, worker}             broker-HA failover: the new
+                                            leader matched a replayed
+                                            owed copy against a
+                                            surviving worker's
+                                            re-REGISTER in-flight
+                                            report — the piece keeps
+                                            running where it is (no
+                                            requeue, no breaker strike;
+                                            the PREEMPTED model
+                                            generalized).  AUDIT only:
+                                            the copy stays owed until
+                                            its own ``completed``.
   ``resumed``     {pending, completed, quarantined}  replay marker
   ``shutdown``    {}                        clean server exit
+
+Writer epochs (broker HA, network/ha.py): when a server holds an HA
+lease it stamps every record it appends with ``wepoch`` (its lease
+epoch — a distinct field from the MESH ``epoch`` that mesh_lost/
+resharded already carry).  Replay folds the file positionally: a
+``lease`` record raises the epoch in force, and any LATER
+``dispatched``/``completed`` stamped with an older ``wepoch`` is a
+deposed leader's late append — fenced off as audit-only (counted
+under ``fenced``, never into the queue math) so a non-atomic
+leadership handover cannot double-count or lose work.  Journals from
+servers without HA carry no ``wepoch`` and replay exactly as before.
 
 Packed world-batches (WORLDS packing, network/server.py): a pack of W
 compatible pieces dispatches to ONE worker; its ``dispatched`` records
@@ -147,6 +179,9 @@ class BatchJournal:
         self._f = None
         self._dead = False        # set after a write failure
         self._bytes = 0           # WAL size incl. pre-resume content
+        # broker-HA writer epoch (network/ha.py): None = HA off, no
+        # stamping — journals stay byte-identical to a non-HA server's
+        self.epoch = None
 
     @property
     def size_bytes(self) -> int:
@@ -202,6 +237,8 @@ class BatchJournal:
                                      fsync=self.fsync):
                 f = self._open()
                 for r in records:
+                    if self.epoch is not None:
+                        r.setdefault("wepoch", int(self.epoch))
                     line = json.dumps(r, separators=(",", ":")) + "\n"
                     f.write(line)
                     self._bytes += len(line.encode("utf-8"))
@@ -373,6 +410,24 @@ class BatchJournal:
         self.append("sdc_vote", key=self.piece_key(piece),
                     fps=dict(fps or {}), deviant=str(deviant))
 
+    def lease(self, leader="", epoch=0, ttl=0.0):
+        """Broker-HA leadership acquisition (network/ha.py): ``leader``
+        (server hex id) now holds lease ``epoch``.  The durable half of
+        the lease file — replay uses it to fence a deposed leader's
+        late appends (see the ``wepoch`` notes in the module
+        docstring)."""
+        self.append("lease", leader=str(leader), epoch=int(epoch),
+                    ttl=float(ttl))
+
+    def adopted(self, piece, worker: bytes = b""):
+        """Broker-HA failover reconciliation: the new leader matched a
+        replayed owed copy of this piece against ``worker``'s in-flight
+        re-REGISTER report — the piece keeps running where it is.
+        AUDIT record: no requeue, no strike, and the copy stays owed
+        until its own ``completed`` lands."""
+        self.append("adopted", key=self.piece_key(piece),
+                    worker=worker.hex())
+
     def device_profile(self, worker: bytes = b"", dir="", chunks=None):
         """A worker opened a PROFILE DEVICE window: journal the XLA
         trace dir so the sweep's record links to the captured trace.
@@ -399,7 +454,7 @@ class BatchJournal:
 
     # ------------------------------------------------------------- replay
     @staticmethod
-    def replay(path: str) -> dict:
+    def replay(path: str, fence_strict: bool = True) -> dict:
         """Fold a journal into the queue state a restarted server needs.
 
         Returns a dict with ``pending`` (pieces to requeue, in original
@@ -416,6 +471,16 @@ class BatchJournal:
         of a key = queued count - completed count — so N submissions
         still yield N runs.  Quarantine applies to the content (a
         poison piece is poison for every copy).
+
+        Broker HA (network/ha.py): ``lease`` records raise the epoch in
+        force positionally; a later ``dispatched``/``completed`` whose
+        ``wepoch`` is older is a deposed leader's late append, counted
+        under ``fenced`` and — with ``fence_strict`` (the default,
+        settings.ha_fence_strict) — kept OUT of the queue math.
+        ``fence_strict=False`` still surfaces the count but lets stale
+        completions stand (forensic escape hatch: trust a deposed
+        leader's work anyway).  The highest epoch/leader seen and the
+        lease history come back under ``ha``.
         """
         pieces, order = {}, []
         n_queued, n_completed = {}, {}
@@ -427,6 +492,9 @@ class BatchJournal:
         sdc = dict(suspects=[], votes=[], quarantines=[])
         synthetic = 0
         torn = 0
+        cur_epoch, leader = None, ""   # HA epoch in force (positional)
+        leases = []
+        fenced = 0
         # errors="replace": disk-level byte corruption must surface as
         # skipped torn lines, not a UnicodeDecodeError that escapes the
         # resume path's OSError handling
@@ -441,7 +509,22 @@ class BatchJournal:
                     torn += 1
                     continue
                 rec, key = r.get("rec"), r.get("key")
-                if rec == "queued" and key:
+                # a record stamped with a writer epoch older than the
+                # lease in force at this POINT of the file is a deposed
+                # leader's late append (see module docstring)
+                wep = r.get("wepoch")
+                stale = (cur_epoch is not None and isinstance(wep, int)
+                         and wep < cur_epoch)
+                if rec == "lease":
+                    ep = r.get("epoch")
+                    if isinstance(ep, int) and \
+                            (cur_epoch is None or ep >= cur_epoch):
+                        cur_epoch = ep
+                        leader = str(r.get("leader", ""))
+                    leases.append({"leader": str(r.get("leader", "")),
+                                   "epoch": ep,
+                                   "ttl": r.get("ttl")})
+                elif rec == "queued" and key:
                     if r.get("synthetic"):
                         # LOADSPIKE chaos filler: never owed to a
                         # resumed sweep — skipping the queued record
@@ -483,8 +566,17 @@ class BatchJournal:
                          "deviant": r.get("deviant", "")})
                 elif key not in pieces:
                     continue              # marker records / unknown key
+                elif stale and rec in ("dispatched", "completed"):
+                    # FENCED: a deposed leader's late append — surfaced
+                    # for audit, kept out of the queue math (unless the
+                    # fence_strict escape hatch says to trust it)
+                    fenced += 1
+                    if rec == "completed" and not fence_strict:
+                        n_completed[key] = n_completed.get(key, 0) + 1
+                        crashes.pop(key, None)
                 elif rec in ("dispatched", "preempted", "hedged",
-                             "dup_completed", "mesh_lost", "resharded"):
+                             "dup_completed", "mesh_lost", "resharded",
+                             "adopted"):
                     # owed copies = queued - completed.  A hedge is a
                     # duplicate of an already-dispatched copy, and a
                     # dup_completed is the hedge loser finishing after
@@ -492,7 +584,9 @@ class BatchJournal:
                     # completion would break exactly-once for repeat-
                     # trial sweeps (identical content queued N times).
                     # mesh_lost/resharded likewise narrate one copy's
-                    # mesh-epoch transitions, never its queue state.
+                    # mesh-epoch transitions, never its queue state;
+                    # adopted narrates a failover reconciliation (the
+                    # copy stays owed until its own completed lands).
                     pass
                 elif rec == "crashed":
                     crashes[key] = int(r.get("crashes",
@@ -539,4 +633,6 @@ class BatchJournal:
             sdc=sdc,
             synthetic_skipped=synthetic,
             torn_lines=torn,
+            fenced=fenced,
+            ha=dict(epoch=cur_epoch, leader=leader, leases=leases),
         )

@@ -94,6 +94,16 @@ class Node:
         self.node_id = node_id or make_id()
         self.host_id = b""        # filled by REGISTER reply
         self.running = False
+        # broker HA (network/ha.py): learned from an HA server's
+        # REGISTER ack — a lease epoch in the ack is what ARMS the
+        # failover detector, so against a non-HA server every check
+        # below is inert
+        self.server_pid = None           # broker pid (FAULT KILLSERVER)
+        self.server_epoch = None         # lease epoch, None = HA off
+        self.server_lease_ttl = 0.0
+        self.server_disc_port = None     # where to re-run discovery
+        self._srv_last = time.monotonic()   # last traffic from server
+        self._ha_next_probe = 0.0        # failover probe rate limit
         from .. import settings
         self._wd_warn = watchdog_warn if watchdog_warn is not None \
             else getattr(settings, "node_watchdog_warn", 30.0)
@@ -119,7 +129,7 @@ class Node:
     def connect(self):
         self.event_io.connect(self._endpoints[0])
         self.stream_out.connect(self._endpoints[1])
-        self.send_event(b"REGISTER", None)
+        self.send_event(b"REGISTER", self.register_payload())
 
     def quit(self):
         self.running = False
@@ -190,6 +200,13 @@ class Node:
             self.watchdog.stop()
 
     # ------------------------------------------------------------ overrides
+    def register_payload(self):
+        """REGISTER payload.  The base node sends none; SimNode reports
+        its in-flight BATCH piece so a re-REGISTER after broker
+        failover lets the new leader ADOPT the running piece instead of
+        requeueing it (server._ha_adopt)."""
+        return None
+
     def heartbeat_payload(self, stamp):
         """PONG payload for a server PING.  The base node just echoes
         the stamp; SimNode returns a progress dict (simt, chunks done,
@@ -216,10 +233,20 @@ class Node:
             route, name, payload = split_envelope(
                 self.event_io.recv_multipart())
             n += 1
+            self._srv_last = time.monotonic()  # any traffic counts
             data = unpackb(payload) if payload else None
             if name == b"REGISTER":
-                # handshake ack: payload carries the server id
+                # handshake ack: payload carries the server id, the
+                # broker pid, and — from an HA server — the lease terms
+                # that arm the failover detector
                 self.host_id = data["host_id"]
+                self.server_pid = data.get("pid", self.server_pid)
+                if "epoch" in data:
+                    self.server_epoch = int(data["epoch"])
+                    self.server_lease_ttl = float(
+                        data.get("lease_ttl", 0.0) or 0.0)
+                    self.server_disc_port = data.get(
+                        "discovery", self.server_disc_port)
             elif name == b"PING":
                 # server liveness probe: echo the stamp back (the reply
                 # is protocol-level so every Node flavor is covered).
@@ -230,6 +257,76 @@ class Node:
                 self.quit()
             else:
                 self.event(name, data, route)
+
+    # ---------------------------------------------- broker-HA failover
+    def _check_failover(self):
+        """Broker-HA failover detector (network/ha.py): an HA server's
+        REGISTER ack carried a lease epoch — once the event socket has
+        been silent past 1.5x that lease ttl, re-run discovery and move
+        to whichever server replies as LEADER with a strictly higher
+        epoch (the promoted standby; a deposed leader's stale reply
+        loses the arbitration).  Against a non-HA server no epoch was
+        ever learned and this returns immediately."""
+        if self.server_epoch is None or self.server_disc_port is None:
+            return
+        now = time.monotonic()
+        ttl = self.server_lease_ttl or 10.0
+        if now - self._srv_last <= 1.5 * ttl \
+                or now < self._ha_next_probe:
+            return
+        self._ha_next_probe = now + max(0.5, ttl / 4.0)
+        from .discovery import Discovery
+        best = None
+        try:
+            disc = Discovery(self.node_id, is_client=True,
+                             port=self.server_disc_port)
+        except OSError:
+            return
+        try:
+            disc.send_request()
+            t_end = time.monotonic() + 0.5
+            while time.monotonic() < t_end:
+                kind, reply = disc.recv_reqreply()
+                if kind != "rep" or reply.role != "leader":
+                    continue
+                if reply.epoch > self.server_epoch \
+                        and (best is None or reply.epoch > best.epoch):
+                    best = reply
+        finally:
+            disc.close()
+        if best is None:
+            return
+        print(f"node {self.node_id.hex()[:8]}: server silent "
+              f"{now - self._srv_last:.1f}s — failing over to "
+              f"{best.ip}:{best.wevent or best.event_port} "
+              f"(epoch {best.epoch})")
+        self.server_epoch = best.epoch
+        # a Node is a WORKER: reconnect to the new leader's worker-side
+        # ROUTER pair, advertised separately in HA replies (the plain
+        # event/stream ports are client-facing — a REGISTER there would
+        # enrol us as a client and the in-flight report would be lost)
+        self._reconnect(best.ip, best.wevent or best.event_port,
+                        best.wstream or best.stream_port)
+
+    def _reconnect(self, host, event_port, stream_port):
+        """Move the DEALER/PUB pair to a new server.  The DEALER keeps
+        its identity, so the re-REGISTER is idempotent server-side;
+        frames queued to the dead endpoint are dropped with it — a lost
+        completion was never journaled, so the piece stays owed and
+        exactly-once holds."""
+        old = self._endpoints
+        self._endpoints = (f"tcp://{host}:{event_port}",
+                           f"tcp://{host}:{stream_port}")
+        for sock, ep in ((self.event_io, old[0]),
+                         (self.stream_out, old[1])):
+            try:
+                sock.disconnect(ep)
+            except zmq.ZMQError:
+                pass
+        self.event_io.connect(self._endpoints[0])
+        self.stream_out.connect(self._endpoints[1])
+        self.send_event(b"REGISTER", self.register_payload())
+        self._srv_last = time.monotonic()
 
     def run(self):
         """Blocking loop: events -> step -> wall-clock timers (node.py:55-80).
@@ -247,6 +344,7 @@ class Node:
             while self.running:
                 self._watchdog_beat()
                 self.process_events(timeout_ms=1)
+                self._check_failover()
                 self.step()
                 Timer.update_timers()
         finally:

@@ -263,7 +263,9 @@ class Server(threading.Thread):
                  straggler_timeout=None, hedge_enabled=None,
                  batch_queue_max=None, world_pack=None,
                  world_batch_max=None, mitigate_enabled=None,
-                 sdc_enabled=None, sdc_audit_rate=None):
+                 sdc_enabled=None, sdc_audit_rate=None,
+                 ha_role=None, ha_lease_ttl=None, ha_poll_dt=None,
+                 ha_fence_strict=None):
         super().__init__(daemon=True)
         # Observability (ISSUE-11, docs/OBSERVABILITY.md): the broker's
         # own registry (counters above, demux/queue series below), the
@@ -394,6 +396,50 @@ class Server(threading.Thread):
             journal_path,
             fsync=getattr(_settings, "batch_journal_fsync", True)) \
             if journal_path else None
+        # ----- broker high availability (network/ha.py, ISSUE-18):
+        # warm-standby failover with journal-fenced leadership.  With
+        # ha_role=None (and settings.ha_standby unset) every HA branch
+        # is inert — no lease records, no wepoch stamping, no HA
+        # HEALTH section: bit-identical to a build without HA.
+        from . import ha as _ha
+        if ha_role is None and bool(getattr(_settings, "ha_standby",
+                                            False)):
+            ha_role = "standby"
+        self.ha_role = ha_role             # None | "leader" | "standby"
+        self.ha_lease_ttl = float(
+            getattr(_settings, "ha_lease_ttl", 10.0)
+            if ha_lease_ttl is None else ha_lease_ttl)
+        self.ha_poll_dt = float(
+            getattr(_settings, "ha_poll_dt", 1.0)
+            if ha_poll_dt is None else ha_poll_dt)
+        self.ha_fence_strict = bool(
+            getattr(_settings, "ha_fence_strict", True)
+            if ha_fence_strict is None else ha_fence_strict)
+        if self.ha_role and self.journal is None:
+            # the journal IS the shared truth the standby tails — HA
+            # without one has nothing to fence or replay
+            print("server: HA needs a BATCH journal "
+                  "(journal_path='' disables both) — HA disabled")
+            self.ha_role = None
+        self.ha_epoch = 0                  # lease epoch held/last seen
+        self._ha_serving = self.ha_role != "standby"  # dispatch gate
+        self._ha_lease_file = _ha.lease_path(self.journal.path) \
+            if self.ha_role else None
+        self._ha_tail = _ha.JournalTail(self.journal.path) \
+            if self.ha_role == "standby" else None
+        self._ha_limbo = []                # replayed owed pieces held
+        #                                    for adoption during grace
+        self._ha_pieces = {}               # content key -> piece (replay)
+        self._ha_completed = {}            # content key -> completions
+        self._ha_grace_until = 0.0         # adoption window end (mono)
+        self._ha_next_renew = 0.0          # leader lease-renew stamp
+        self._ha_next_poll = 0.0           # standby poll stamp
+        self._ha_stale_since = None        # first sighting of a missing
+        #                                    lease file (standby)
+        self.ha_takeovers = 0              # leases this server acquired
+        #                                    by succession
+        self.ha_adoptions = 0              # pieces adopted in place
+        self.ha_dedup_cancels = 0          # raced completions cancelled
         # ----- self-healing serving (network/mitigate.py): the policy
         # engine that turns sentinel flags into journaled actions.
         # Disabled (default) it is completely inert — journal and
@@ -706,10 +752,18 @@ class Server(threading.Thread):
     def _handle_server_event(self, sock, sender, name, payload):
         from_worker = sock is self.be_event
         if name == b"REGISTER":
+            reg = unpackb(payload) if payload else None
             if from_worker:
                 if sender not in self.workers:
                     self.workers[sender] = 0
                     self._pending_spawns = max(0, self._pending_spawns - 1)
+                # broker-HA failover reconciliation: a surviving worker
+                # re-REGISTERs with its in-flight piece report — fold it
+                # BEFORE the availability check (an adopted piece puts
+                # the worker in ``inflight``, which keeps it unavailable
+                # exactly like any mid-BATCH worker)
+                if isinstance(reg, dict):
+                    self._ha_adopt(sender, reg.get("inflight"))
                 # duplicated/late REGISTER frames (flaky transport) must
                 # not double-book the worker: one mid-BATCH (in inflight
                 # or state OP) stays unavailable, or piece B would
@@ -727,11 +781,21 @@ class Server(threading.Thread):
                 # resend must ack, but only the first may register
                 self.clients.append(sender)
                 new_client = True
-            sock.send_multipart(
-                [sender, b"REGISTER",
-                 packb({"host_id": self.server_id,
-                        "nodes": list(self.workers)
-                        + list(self.remote_nodes)})])
+            ack = {"host_id": self.server_id,
+                   "nodes": list(self.workers)
+                   + list(self.remote_nodes),
+                   # broker pid: FAULT KILLSERVER's SIGKILL target
+                   "pid": os.getpid()}
+            if self.ha_role:
+                # HA peers learn the lease terms from the ack: epoch
+                # presence is what arms a node's failover detector, and
+                # the discovery port is where it re-runs arbitration
+                ack.update(epoch=int(self.ha_epoch),
+                           role="leader" if self._ha_serving
+                           else "standby",
+                           lease_ttl=float(self.ha_lease_ttl),
+                           discovery=self.ports["discovery"])
+            sock.send_multipart([sender, b"REGISTER", packb(ack)])
             if new_client:
                 # replay circuit-breaker verdicts so a late-joining /
                 # reattaching operator still sees what the sweep dropped
@@ -947,6 +1011,11 @@ class Server(threading.Thread):
                         0.0, float(data["audit_rate"] or 0.0))
             sock.send_multipart(
                 [sender, b"SDC", packb(self.sdc_payload())])
+        elif name == b"HA":
+            # HA STATUS stack/client command: broker-HA state readback
+            # (role, epoch, lease age, takeover/adoption counters)
+            sock.send_multipart(
+                [sender, b"HA", packb(self.ha_payload())])
         elif name == b"BATCHCANCELLED" and from_worker:
             # hedge loser acked the cancel (it had NOT completed: a
             # completion would have arrived first on the FIFO pair)
@@ -1093,6 +1162,16 @@ class Server(threading.Thread):
             self._report_clients(msg)
         elif name == b"BATCH":
             data = unpackb(payload)
+            if self.ha_role and not self._ha_serving:
+                # warm standby: NEVER admit work before holding the
+                # lease — admission would journal ``queued`` records
+                # into a file the live leader still owns
+                self.rejected_batches += 1
+                sock.send_multipart(
+                    [sender, b"BATCHREJECTED",
+                     packb({"reason": "standby",
+                            "epoch": int(self.ha_epoch)})])
+                return
             pieces = split_scenarios(data["scentime"], data["scencmd"])
             # Admission control: a flood of submissions must not grow
             # the pending queue (and its journal) without bound.  The
@@ -1135,6 +1214,8 @@ class Server(threading.Thread):
                 self.fe_event.send_multipart([cid, sender, name, payload])
 
     def _send_pending_scenario(self):
+        if self.ha_role and not self._ha_serving:
+            return                 # standby never dispatches pre-lease
         if not (self.avail_workers and self.scenarios):
             return
         wid = self.avail_workers.pop(0)
@@ -1241,6 +1322,271 @@ class Server(threading.Thread):
             [wid, b"BATCH",
              packb({"worlds": [{"scentime": p[0], "scencmd": p[1]}
                                for _o, p in picks]})])
+
+    # -------------------------------------------- broker HA (ISSUE-18)
+    def _ha_renew_dt(self):
+        """Lease-renew cadence: well inside the ttl (a renewal must
+        land several times per lease or a busy poll loop looks dead)."""
+        return min(self.ha_poll_dt, max(self.ha_lease_ttl / 3.0, 0.05))
+
+    def _ha_acquire(self):
+        """Leader start-up: take the lease.  The epoch is one past the
+        highest ever seen (journal lease records OR the lease file), so
+        a restarted/promoted leader always fences its predecessor's
+        late appends — and the lease record lands in the journal BEFORE
+        any sweep record this leader writes."""
+        from . import ha as _ha
+        tail = _ha.JournalTail(self.journal.path)
+        tail.poll()
+        lease = _ha.read_lease(self._ha_lease_file) or {}
+        seen = max(int(lease.get("epoch", 0) or 0), tail.epoch,
+                   self.ha_epoch)
+        self.ha_epoch = seen + 1
+        self.journal.epoch = self.ha_epoch
+        self.journal.lease(leader=self.server_id.hex(),
+                           epoch=self.ha_epoch, ttl=self.ha_lease_ttl)
+        _ha.write_lease(self._ha_lease_file, self.server_id.hex(),
+                        self.ha_epoch, self.ha_lease_ttl)
+        self._ha_next_renew = time.monotonic() + self._ha_renew_dt()
+        print(f"server: HA leader {self.server_id.hex()} acquired "
+              f"lease epoch {self.ha_epoch} "
+              f"(ttl {self.ha_lease_ttl:g}s)")
+
+    def _ha_renew(self, now):
+        """Refresh the lease file's stamp (the journal record is the
+        durable acquisition; renewal is file-only and cheap)."""
+        from . import ha as _ha
+        _ha.write_lease(self._ha_lease_file, self.server_id.hex(),
+                        self.ha_epoch, self.ha_lease_ttl)
+        self._ha_next_renew = now + self._ha_renew_dt()
+
+    def _ha_standby_poll(self, now):
+        """Standby heartbeat: tail the journal (warm replay state),
+        watch the lease, and take over only after the leader has been
+        silent for its full promised ttl."""
+        from . import ha as _ha
+        self._ha_tail.poll()
+        lease = _ha.read_lease(self._ha_lease_file)
+        if lease is not None:
+            ep = int(lease.get("epoch", 0) or 0)
+            if ep > self.ha_epoch:
+                self.ha_epoch = ep         # track the live leader
+        if not _ha.is_stale(lease, default_ttl=self.ha_lease_ttl):
+            self._ha_stale_since = None
+            return
+        if lease is None:
+            # no lease file at all: the leader may simply not have
+            # started yet — demand a full ttl of OBSERVED absence
+            if self._ha_stale_since is None:
+                self._ha_stale_since = now
+                return
+            if now - self._ha_stale_since < self.ha_lease_ttl:
+                return
+        self._ha_takeover(lease)
+
+    def _ha_takeover(self, stale_lease):
+        """The lease went silent: become the leader.  Succession is
+        journal-fenced — our own ``lease`` record (epoch N+1) is
+        appended FIRST, so everything the deposed leader manages to
+        append after it carries a stale ``wepoch`` and replay fences it
+        off as audit-only.  Then the whole sweep state carries over
+        from a full replay: quarantines, strikes, completions, and an
+        owed-pieces limbo that surviving workers' re-REGISTERs adopt
+        from during a grace window (leftovers requeue after it)."""
+        from . import ha as _ha
+        from .journal import BatchJournal
+        old = int((stale_lease or {}).get("epoch", 0) or 0)
+        self.ha_epoch = max(old, self._ha_tail.epoch,
+                            self.ha_epoch) + 1
+        self.ha_role = "leader"
+        self._ha_serving = True
+        self.ha_takeovers += 1
+        self._ha_stale_since = None
+        self.journal.epoch = self.ha_epoch
+        self.journal.lease(leader=self.server_id.hex(),
+                           epoch=self.ha_epoch, ttl=self.ha_lease_ttl)
+        _ha.write_lease(self._ha_lease_file, self.server_id.hex(),
+                        self.ha_epoch, self.ha_lease_ttl)
+        now = time.monotonic()
+        self._ha_next_renew = now + self._ha_renew_dt()
+        try:
+            state = BatchJournal.replay(
+                self.journal.path,
+                fence_strict=self.ha_fence_strict)
+        except OSError as e:
+            print(f"server: HA takeover replay failed ({e}) — "
+                  f"serving with an empty queue")
+            state = None
+        if state is not None:
+            self._ha_fold_state(state)
+        self.journal.append("resumed", pending=len(self._ha_limbo),
+                            completed=sum(self._ha_completed.values()),
+                            quarantined=len(self.quarantined),
+                            takeover=True)
+        # adoption grace: long enough for every surviving worker to
+        # notice the dead socket, re-discover and re-REGISTER.  A
+        # worker only declares the server dead after 1.5x ttl of
+        # silence, then probes (rate-limited to ttl/4) with a 0.5 s
+        # collect window — 3x ttl from takeover covers that worst case
+        # with slack; the 2 s floor absorbs scheduler jitter at tiny
+        # ttls.
+        grace = max(3.0 * self.ha_lease_ttl, 3.0 * self.hb_interval,
+                    2.0)
+        self._ha_grace_until = now + grace
+        msg = (f"HA: standby {self.server_id.hex()} took over as "
+               f"leader, epoch {self.ha_epoch} — "
+               f"{len(self._ha_limbo)} owed piece(s) awaiting "
+               f"adoption ({grace:g}s grace), "
+               f"{sum(self._ha_completed.values())} already complete")
+        print(f"server: {msg}")
+        self._report_clients(msg)
+
+    def _ha_fold_state(self, state):
+        """Carry the deposed leader's sweep state over from replay:
+        quarantines (with their client-visible reports), crash strikes,
+        the owed-pieces multiset (held in LIMBO for worker adoption,
+        not requeued yet), per-key completion counts for raced-
+        completion dedupe, placed SDC votes, and worker quarantines
+        from the mitigation decision history."""
+        from .journal import BatchJournal
+        for piece in state["quarantined"]:
+            self.quarantined.append(piece)
+            self.quarantine_reports.append(
+                {"piece": self._piece_name(piece),
+                 "crashes": state["quarantined_crashes"].get(
+                     BatchJournal.piece_key(piece), 0),
+                 "scencmd": list(piece[1]), "resumed": True})
+        for piece in state["pending"]:
+            jkey = BatchJournal.piece_key(piece)
+            if jkey in state["crashes"]:
+                self.piece_crashes[self._piece_key(piece)] = \
+                    state["crashes"][jkey]
+        self._ha_limbo = list(state["pending"])
+        self._ha_pieces = {}
+        for piece in state["pending"] + state["completed"]:
+            self._ha_pieces.setdefault(
+                BatchJournal.piece_key(piece), piece)
+        self._ha_completed = dict(collections.Counter(
+            BatchJournal.piece_key(p) for p in state["completed"]))
+        for vote in state.get("sdc", {}).get("votes", []):
+            if vote.get("key"):
+                self._sdc_voted.add(vote["key"])
+        for m in state.get("mitigations", []):
+            try:
+                wid = bytes.fromhex(m.get("target", ""))
+            except ValueError:
+                continue
+            if m.get("action") == "quarantine_worker":
+                self.sdc_quarantine.add(wid)
+            elif m.get("action") == "release_worker":
+                self.sdc_quarantine.discard(wid)
+
+    def _ha_adopt(self, wid, report):
+        """Fold one re-REGISTERing worker's in-flight report into the
+        post-takeover reconciliation.  A report matching an owed limbo
+        copy ADOPTS it: the piece keeps running where it is — no
+        requeue, no breaker strike (the PREEMPTED capacity-churn model
+        generalized to leadership churn), journaled ``adopted``.  A
+        report whose content is already fully counted is a completion
+        that raced the failover (or a surviving hedge twin): that copy
+        is cancelled, and a completion that still lands dedupes through
+        the existing ``dup_completed`` cancel path.  Inert (empty maps)
+        unless a takeover populated the limbo."""
+        if not isinstance(report, dict):
+            return
+        key = str(report.get("key") or "")
+        if not key or wid in self.inflight:
+            return                 # idempotent duplicate re-REGISTER
+        if not (self._ha_limbo or self._ha_pieces):
+            return
+        from .journal import BatchJournal
+        for i, piece in enumerate(self._ha_limbo):
+            if BatchJournal.piece_key(piece) == key:
+                self._ha_limbo.pop(i)
+                self.inflight[wid] = piece
+                self.inflight_owner[wid] = b""
+                self.inflight_t[wid] = time.monotonic()
+                self.ha_adoptions += 1
+                if self.journal:
+                    self.journal.adopted(piece, wid)
+                msg = (f"HA: piece '{self._piece_name(piece)}' still "
+                       f"running on surviving worker {wid.hex()} — "
+                       f"adopted in place, no requeue")
+                print(f"server: {msg}")
+                self._report_clients(msg)
+                return
+        piece = self._ha_pieces.get(key)
+        if piece is not None and self._ha_completed.get(key, 0) > 0:
+            # every owed copy of this content is accounted for: the
+            # completion raced the failover — cancel the survivor's
+            # redundant copy (a completion beating the cancel lands as
+            # an audit-only ``dup_completed``, exactly the hedge-loser
+            # path)
+            self._cancel_pending[wid] = piece
+            self.ha_dedup_cancels += 1
+            self.be_event.send_multipart(
+                [wid, b"BATCHCANCEL", packb(None)])
+            print(f"server: HA: worker {wid.hex()} reports already-"
+                  f"counted piece '{self._piece_name(piece)}' — "
+                  f"cancelled (raced-completion dedupe)")
+
+    def _ha_release_limbo(self):
+        """Adoption grace expired: requeue the owed copies nobody
+        adopted (their workers died with the old leader) and kick the
+        dispatch loop."""
+        pieces, self._ha_limbo = self._ha_limbo, []
+        self._ha_grace_until = 0.0
+        if not pieces:
+            return
+        print(f"server: HA adoption grace over — requeueing "
+              f"{len(pieces)} unadopted piece(s)")
+        self.scenarios.extend(pieces)
+        while self.avail_workers and self.scenarios:
+            self._send_pending_scenario()
+        if self.scenarios and self.spawn_workers:
+            self._spawn_for_backlog()
+
+    def ha_payload(self):
+        """Machine-readable broker-HA state (the ``HA`` command and the
+        HEALTH ``ha`` section), with a human ``text`` rendering — the
+        HEALTH-style readback contract."""
+        from . import ha as _ha
+        if not self.ha_role:
+            return {"enabled": False,
+                    "text": "HA OFF: single-broker mode (settings."
+                            "ha_standby / Server(ha_role=...) runs a "
+                            "warm standby)"}
+        lease = _ha.read_lease(self._ha_lease_file)
+        d = {"enabled": True,
+             "role": "leader" if self._ha_serving else "standby",
+             "epoch": int(self.ha_epoch),
+             "lease_ttl": float(self.ha_lease_ttl),
+             "poll_dt": float(self.ha_poll_dt),
+             "fence_strict": bool(self.ha_fence_strict),
+             "lease_file": self._ha_lease_file,
+             "lease_age": round(_ha.lease_age(lease), 3)
+             if lease else None,
+             "lease_leader": str(lease.get("leader", ""))
+             if lease else None,
+             "takeovers": self.ha_takeovers,
+             "adoptions": self.ha_adoptions,
+             "dedup_cancels": self.ha_dedup_cancels,
+             "limbo": len(self._ha_limbo)}
+        if self._ha_tail is not None:
+            d["tail"] = {"records": self._ha_tail.records,
+                         "leases": self._ha_tail.leases,
+                         "epoch": self._ha_tail.epoch}
+        d["text"] = (
+            f"HA {d['role'].upper()}: epoch {d['epoch']}, lease ttl "
+            f"{d['lease_ttl']:g}s"
+            + (f", lease age {d['lease_age']:g}s"
+               if d["lease_age"] is not None else ", no lease file")
+            + f"; {d['takeovers']} takeover(s), "
+              f"{d['adoptions']} adoption(s), "
+              f"{d['dedup_cancels']} dedup cancel(s)"
+            + (f", {d['limbo']} piece(s) in adoption limbo"
+               if d["limbo"] else ""))
+        return d
 
     # ------------------------------------------- stragglers / introspection
     def _note_progress(self, wid, data):
@@ -1828,6 +2174,12 @@ class Server(threading.Thread):
         if self.sdc_enabled:
             data["sdc"] = {k: v for k, v in self.sdc_payload().items()
                            if k != "text"}
+        # broker-HA section ONLY while HA is configured (same contract:
+        # ha_standby unset keeps HEALTH bit-identical to a build
+        # without the subsystem)
+        if self.ha_role:
+            data["ha"] = {k: v for k, v in self.ha_payload().items()
+                          if k != "text"}
         # journal growth watch (ISSUE-17 satellite): size + warn flag
         if self.journal is not None:
             jb = int(self.journal.size_bytes)
@@ -1907,6 +2259,19 @@ class Server(threading.Thread):
                 + (" [" + ", ".join(w[:8] for w
                                     in s["quarantined_workers"]) + "]"
                    if s["quarantined_workers"] else ""))
+        h = d.get("ha")
+        if h:
+            lines.append(
+                f"ha: {h['role'].upper()}, epoch {h['epoch']}, lease "
+                f"ttl {h['lease_ttl']:g}s"
+                + (f", lease age {h['lease_age']:g}s"
+                   if h.get("lease_age") is not None
+                   else ", no lease file")
+                + f", {h['takeovers']} takeover(s), "
+                  f"{h['adoptions']} adoption(s), "
+                  f"{h['dedup_cancels']} dedup cancel(s)"
+                + (f", {h['limbo']} in limbo" if h.get("limbo")
+                   else ""))
         j = d.get("journal")
         if j:
             lines.append(
@@ -2084,6 +2449,10 @@ class Server(threading.Thread):
             self.link.send_multipart([b"REGISTER", packb(None)])
             poller.register(self.link, zmq.POLLIN)
         self.running = not self._stop_requested
+        if self.ha_role == "leader":
+            # journal-fenced leadership: the lease record must precede
+            # every sweep record this leader writes (resume included)
+            self._ha_acquire()
         if self.resume_journal:
             self._replay_journal()
         if not self.headless:
@@ -2091,12 +2460,24 @@ class Server(threading.Thread):
         while self.running:
             events = dict(poller.poll(100))
             now = time.monotonic()
+            if self.ha_role:
+                if self._ha_serving:
+                    if now >= self._ha_next_renew:
+                        self._ha_renew(now)
+                    if self._ha_limbo and now >= self._ha_grace_until:
+                        self._ha_release_limbo()
+                elif now >= self._ha_next_poll:
+                    self._ha_next_poll = now + self.ha_poll_dt
+                    self._ha_standby_poll(now)
             if now >= self._next_hb:
                 self._next_hb = now + self.hb_interval
-                self._reap_dead_workers()
-                self._check_stragglers(now)
-                self._check_perf_slo(now)
-                self.mitigator.tick(now)
+                if self._ha_serving:
+                    # a standby only WATCHES: reaping, hedging, SLO and
+                    # mitigation resume on the new leader's first tick
+                    self._reap_dead_workers()
+                    self._check_stragglers(now)
+                    self._check_perf_slo(now)
+                    self.mitigator.tick(now)
                 self.obs.gauge("server_queue_depth").set(
                     len(self.scenarios))
                 if self.journal is not None:
@@ -2139,8 +2520,22 @@ class Server(threading.Thread):
                                    in events):
                 kind, _ = self.discovery.recv_reqreply()
                 if kind == "req":
-                    self.discovery.send_reply(self.ports["event"],
-                                              self.ports["stream"])
+                    if self.ha_role:
+                        # HA arbitration: replies carry epoch + role so
+                        # peers prefer the live leader over a deposed
+                        # one (highest epoch) and skip warm standbys
+                        self.discovery.send_reply(
+                            self.ports["event"], self.ports["stream"],
+                            epoch=self.ha_epoch,
+                            role="leader" if self._ha_serving
+                            else "standby",
+                            # failed-over WORKERS must land on the
+                            # worker-facing ROUTER, not the client one
+                            wevent=self.ports["wevent"],
+                            wstream=self.ports["wstream"])
+                    else:
+                        self.discovery.send_reply(self.ports["event"],
+                                                  self.ports["stream"])
             for sock in (self.fe_event, self.be_event):
                 if sock not in events:
                     continue

@@ -175,6 +175,24 @@ preempt_snapshot_dir = ""         # "" -> log_path; SIGTERM / FAULT
 batch_journal_fsync = True        # fsync each BATCH journal record (WAL
                                   # durability vs append latency)
 
+# ----- broker HA (network/ha.py; docs/FAULT_TOLERANCE.md §broker HA).
+# A warm-standby server tails the live journal and takes over when the
+# leader dies: leadership is a lease (journal record + atomic lease
+# file) with a monotonically-bumped epoch; every record an HA leader
+# appends carries its writer epoch so replay fences a deposed leader's
+# late appends off as audit-only.
+ha_standby = False                # start this server as a warm standby
+                                  # (tail the journal, serve nothing
+                                  # until the lease is acquired)
+ha_lease_ttl = 10.0               # [wall s] leader silence before the
+                                  # standby may acquire the lease
+ha_poll_dt = 1.0                  # [wall s] lease renewal (leader) /
+                                  # lease+journal polling (standby)
+ha_fence_strict = True            # replay drops a deposed leader's
+                                  # stale-epoch completions from the
+                                  # queue math (False surfaces them as
+                                  # fenced but trusts them anyway)
+
 # ----- observability (docs/OBSERVABILITY.md; bluesky_tpu/obs/)
 trace_enabled = False             # flight recorder on at startup (the
                                   # TRACE stack command toggles at

@@ -45,6 +45,13 @@ def _make_simnode_class(base):
             # every world sim shares the worker's nmax bucket.
             self.worlds = None
             self._world_simkw = dict(simkw)
+            # broker HA (network/ha.py): the solo BATCH piece currently
+            # running, kept so a re-REGISTER after broker failover can
+            # report it and the new leader ADOPTS it in place instead
+            # of requeueing.  Packs are not reported (their per-world
+            # completions already journaled; the rest requeues after
+            # the adoption grace).
+            self._batch_piece = None
             # Subsystems constructed before the swap hold the headless
             # Screen; repoint them at the streaming ScreenIO
             self.sim.areas.scr = self.sim.scr
@@ -89,6 +96,7 @@ def _make_simnode_class(base):
             if err:
                 info["error"] = err
             self.send_event(b"PREEMPTED", info)
+            self._batch_piece = None
             sim.stop()
             self.quit()
 
@@ -103,6 +111,7 @@ def _make_simnode_class(base):
             self.sim.reset()
             pieces = [(p["scentime"], p["scencmd"])
                       for p in worlds_payload]
+            self._batch_piece = None   # packs are not adoption-reported
             self.worlds = WorldBatch(
                 pieces, simkw=self._world_simkw,
                 host_tag=self.node_id.hex()[:8],
@@ -133,6 +142,20 @@ def _make_simnode_class(base):
             self.quit()
 
         # --------------------------------------------------------- heartbeat
+        def register_payload(self):
+            """REGISTER payload: the in-flight solo BATCH piece, keyed
+            by content (network/journal.py piece_key) — what lets the
+            post-failover leader adopt this worker's running piece
+            instead of requeueing a second copy (server._ha_adopt)."""
+            if self._batch_piece is None:
+                return None
+            from ..network.journal import BatchJournal
+            sim = self.sim
+            return {"inflight": {
+                "key": BatchJournal.piece_key(self._batch_piece),
+                "simt": float(sim.simt_planned),
+                "chunks": int(sim._step_count)}}
+
         def heartbeat_payload(self, stamp):
             """Progress piggybacked on the PONG reply: sim-time and
             chunks done let the server's straggler detector tell a
@@ -221,6 +244,8 @@ def _make_simnode_class(base):
                     self._start_worlds(data["worlds"])
                 else:
                     sim.reset()
+                    self._batch_piece = (data["scentime"],
+                                         data["scencmd"])
                     sim.stack.set_scendata(data["scentime"],
                                            data["scencmd"])
                     sim.op()
@@ -231,6 +256,7 @@ def _make_simnode_class(base):
                 # abandon the piece — the reset's STATECHANGE makes
                 # this worker available again
                 self.send_event(b"BATCHCANCELLED", None)
+                self._batch_piece = None
                 if self.worlds is not None:
                     self.worlds = None
                     self.prev_state = sim.state_flag
@@ -262,6 +288,11 @@ def _make_simnode_class(base):
                 txt = data.get("text") if isinstance(data, dict) \
                     else str(data)
                 sim.scr.echo(txt or "no sdc data")
+            elif name == b"HA":
+                # reply to the stack HA STATUS command's server query
+                txt = data.get("text") if isinstance(data, dict) \
+                    else str(data)
+                sim.scr.echo(txt or "no ha data")
             elif name == b"METRICS":
                 # reply to METRICS DUMP's server query: broker + fleet
                 # registries rendered server-side
@@ -313,6 +344,7 @@ def _make_simnode_class(base):
                 was_op = self.prev_state == OP
                 self.prev_state = sim.state_flag
                 if was_op and sim.state_flag != OP:
+                    self._batch_piece = None   # piece left flight
                     # completion fingerprint: SDCFP rides the FIFO
                     # event pair ahead of the STATECHANGE, so the
                     # server can journal/compare it against the piece

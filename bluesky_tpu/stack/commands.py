@@ -1462,6 +1462,32 @@ def register_all(stack):
             return True, f"SDC audit rate {rate:g}"
         return False, "SDC [ON/OFF/STATUS | AUDIT rate]"
 
+    def hacmd(arg=None):
+        """HA [STATUS]: broker high availability — a warm-standby
+        server tails the live journal and takes over leadership (lease
+        epoch bump + journal-fenced writes from the deposed leader)
+        when the leader's lease goes stale.  Bare HA / HA STATUS reads
+        the lease state back HEALTH-style: role, epoch, lease age,
+        takeover/adoption counters; on a detached sim it reports the
+        local settings a future server would inherit."""
+        from .. import settings as _settings
+        node = getattr(sim, "node", None)
+        networked = node is not None \
+            and getattr(node, "event_io", None) is not None
+        a = str(arg).upper() if arg is not None else ""
+        if a in ("", "STATUS"):
+            if networked:
+                node.send_event(b"HA", None)  # empty route -> server
+                return True, "HA status requested from the server"
+            return True, (
+                f"detached sim: HA standby "
+                f"{'ON' if getattr(_settings, 'ha_standby', False) else 'OFF'}"
+                f", lease ttl "
+                f"{getattr(_settings, 'ha_lease_ttl', 10.0):g} s "
+                "(settings.ha_standby / settings.ha_lease_ttl; a "
+                "server inherits these)")
+        return False, "HA [STATUS]"
+
     def snapshot(sub, fname=None):
         """SNAPSHOT SAVE/LOAD fname: binary pytree state checkpoint
         (device-state snapshot the reference lacks, SURVEY 5.4)."""
@@ -1791,7 +1817,8 @@ def register_all(stack):
         "FAULT": ["FAULT NAN/INF [acid] | BITFLIP [STATE|PAYLOAD] | "
                   "GUARD ../RING .. | DROP/DUP/"
                   "DELAY p | NETOFF | STALL s | STRAGGLE f/STALL/OFF | "
-                  "KILL | PREEMPT [s] | MESHKILL [g] | PARTITION [OFF] "
+                  "KILL | KILLSERVER [s] | PREEMPT [s] | MESHKILL [g] "
+                  "| PARTITION [OFF] "
                   "| LOADSPIKE n [rate] | SNAPTRUNC f | LIST",
                   "[word,...]", faultcmd,
                   "Fault-injection harness (chaos testing)"],
@@ -1822,6 +1849,9 @@ def register_all(stack):
                 "Silent-data-corruption defense: redundant-execution "
                 "fingerprint voting + worker quarantine "
                 "(readback bare)"],
+        "HA": ["HA [STATUS]", "[txt]", hacmd,
+               "Broker high availability: warm-standby lease state, "
+               "epoch, takeover/adoption counters (readback bare)"],
         "WORLDS": ["WORLDS [ON/OFF | MAX n]", "[txt,txt]", worldscmd,
                    "Multi-world BATCH packing: world-batch size + "
                    "per-bucket packing on/off (readback bare)"],

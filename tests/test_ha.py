@@ -1,0 +1,523 @@
+"""Broker high availability (network/ha.py + Server ha_role wiring):
+warm-standby failover with journal-fenced leadership.
+
+* Lease-file protocol: atomic write/read roundtrip, torn/absent files,
+  staleness by the lease's own promised ttl.
+* JournalTail: incremental reads, torn-tail hold-back, monotone lease
+  epoch tracking.
+* reconcile(): pure owed-pieces x in-flight-reports matcher.
+* Client.arbitrate / discovery hardening: two-servers-one-leader —
+  standbys skipped, highest lease epoch wins, first-seen tiebreak.
+* Standby gating: a warm standby REJECTS BATCH submissions (reason
+  "standby") and never dispatches or journals before holding a lease.
+* Takeover reconciliation: replayed owed pieces are held in limbo and
+  ADOPTED in place from a surviving worker's re-REGISTER (no requeue,
+  no breaker strike); an already-counted report is cancelled
+  (raced-completion dedupe).
+* Closed-loop chaos acceptance (slow): leader subprocess + in-process
+  warm standby + 3 real SimNode workers; FAULT KILLSERVER SIGKILLs
+  the leader mid-BATCH; the standby acquires the lease within 2x ttl,
+  workers fail over and their running pieces are adopted (not
+  requeued), the sweep completes journal-verified exactly-once — zero
+  operator commands.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+zmq = pytest.importorskip("zmq")
+
+from bluesky_tpu.network import ha
+from bluesky_tpu.network.client import Client
+from bluesky_tpu.network.common import make_id
+from bluesky_tpu.network.discovery import Reply
+from bluesky_tpu.network.journal import BatchJournal
+from bluesky_tpu.network.npcodec import packb
+from bluesky_tpu.network.server import Server
+from tests.test_network import free_ports, wait_for
+
+
+def _piece(tag):
+    return ([0.0], [f"SCEN {tag}", "CRE A1 B744 52 4 90 FL200 250"])
+
+
+def _records(jpath):
+    recs = []
+    for line in open(jpath, encoding="utf-8"):
+        try:
+            recs.append(json.loads(line))
+        except json.JSONDecodeError:
+            pass
+    return recs
+
+
+# ---------------------------------------------------------- lease file
+class TestLeaseFile:
+    def test_write_read_roundtrip(self, tmp_path):
+        path = ha.lease_path(str(tmp_path / "batch.jsonl"))
+        assert path.endswith(".lease")
+        assert ha.write_lease(path, "ab01", 3, 2.5)
+        lease = ha.read_lease(path)
+        assert lease["leader"] == "ab01" and lease["epoch"] == 3
+        assert lease["ttl"] == 2.5
+        assert ha.lease_age(lease) < 1.0
+        assert not ha.is_stale(lease)
+        # no tmp litter from the atomic replace
+        assert not os.path.exists(path + ".tmp")
+
+    def test_absent_and_torn_read_as_none(self, tmp_path):
+        missing = str(tmp_path / "nope.lease")
+        assert ha.read_lease(missing) is None
+        assert ha.read_lease("") is None
+        assert ha.is_stale(None)            # no lease = stale
+        torn = str(tmp_path / "torn.lease")
+        open(torn, "w").write('{"leader": "ab", "ep')
+        assert ha.read_lease(torn) is None
+        noepoch = str(tmp_path / "noepoch.lease")
+        open(noepoch, "w").write('{"leader": "ab"}')
+        assert ha.read_lease(noepoch) is None
+
+    def test_stale_by_own_ttl(self, tmp_path):
+        path = str(tmp_path / "j.lease")
+        # renewed 5 s ago with a 1 s promise: stale
+        ha.write_lease(path, "ab", 1, 1.0, stamp=time.time() - 5.0)
+        assert ha.is_stale(ha.read_lease(path))
+        # same age, 60 s promise: fresh
+        ha.write_lease(path, "ab", 1, 60.0, stamp=time.time() - 5.0)
+        assert not ha.is_stale(ha.read_lease(path))
+        # ttl 0 falls back to default_ttl
+        ha.write_lease(path, "ab", 1, 0.0, stamp=time.time() - 5.0)
+        assert not ha.is_stale(ha.read_lease(path), default_ttl=60.0)
+        assert ha.is_stale(ha.read_lease(path), default_ttl=1.0)
+
+
+# --------------------------------------------------------- JournalTail
+class TestJournalTail:
+    def test_incremental_with_torn_tail(self, tmp_path):
+        path = str(tmp_path / "batch.jsonl")
+        tail = ha.JournalTail(path)
+        assert tail.poll() == 0             # file not there yet
+        with open(path, "w") as f:
+            f.write('{"rec":"queued","key":"k1"}\n')
+            f.write('{"rec":"lease","leader":"aa","epoch":1,"ttl":1}\n')
+        assert tail.poll() == 2
+        assert tail.records == 2 and tail.leases == 1
+        assert tail.epoch == 1 and tail.leader == "aa"
+        # a torn final line is held back until its newline lands
+        with open(path, "a") as f:
+            f.write('{"rec":"lease","leader":"bb","ep')
+        assert tail.poll() == 0
+        assert tail.epoch == 1
+        with open(path, "a") as f:
+            f.write('och":2,"ttl":1}\n')
+        assert tail.poll() == 1
+        assert tail.epoch == 2 and tail.leader == "bb"
+        # an OLDER duplicated lease never lowers the epoch in force
+        with open(path, "a") as f:
+            f.write('{"rec":"lease","leader":"aa","epoch":1,"ttl":1}\n')
+        tail.poll()
+        assert tail.epoch == 2 and tail.leases == 3
+
+
+# ----------------------------------------------------------- reconcile
+class TestReconcile:
+    def test_adopt_requeue_extra(self):
+        a, b, c = _piece("A"), _piece("B"), _piece("C")
+        ka = BatchJournal.piece_key(a)
+        kb = BatchJournal.piece_key(b)
+        adopted, requeue, extra = ha.reconcile(
+            [a, b, c],
+            [("w1", ka), ("w2", "feedface"), ("w3", kb)])
+        assert adopted == [("w1", a), ("w3", b)]
+        assert requeue == [c]
+        assert extra == [("w2", "feedface")]
+
+    def test_multiset_copies_adopt_one_each(self):
+        a = _piece("A")
+        ka = BatchJournal.piece_key(a)
+        # two owed copies of the same content, three reporters: the
+        # third report has no copy left -> extra (dedupe/cancel)
+        adopted, requeue, extra = ha.reconcile(
+            [a, a], [("w1", ka), ("w2", ka), ("w3", ka)])
+        assert [w for w, _ in adopted] == ["w1", "w2"]
+        assert requeue == [] and extra == [("w3", ka)]
+
+
+# -------------------------------------------- discovery arbitration
+class TestArbitration:
+    def test_two_servers_one_leader(self):
+        """The deposed leader's stale reply (older epoch) loses to the
+        promoted standby; warm standbys are skipped outright."""
+        deposed = Reply("10.0.0.1", 9000, 9001, epoch=1, role="leader")
+        promoted = Reply("10.0.0.2", 9100, 9101, epoch=2, role="leader")
+        standby = Reply("10.0.0.3", 9200, 9201, epoch=2, role="standby")
+        assert Client.arbitrate([deposed, promoted]) is promoted
+        assert Client.arbitrate([promoted, deposed]) is promoted
+        assert Client.arbitrate([standby, deposed]) is deposed
+        assert Client.arbitrate([standby]) is None
+        assert Client.arbitrate([]) is None
+        assert Client.arbitrate([None, standby, None]) is None
+
+    def test_tie_breaks_first_seen(self):
+        first = Reply("10.0.0.1", 9000, 9001, epoch=3, role="leader")
+        second = Reply("10.0.0.2", 9100, 9101, epoch=3, role="leader")
+        assert Client.arbitrate([first, second]) is first
+
+    def test_pre_ha_replies_default_to_serving_leader(self):
+        plain = Reply("10.0.0.1", 9000, 9001)
+        assert plain.epoch == 0 and plain.role == "leader"
+        assert Client.arbitrate([plain]) is plain
+
+
+# ------------------------------------------------------ standby gating
+class TestStandbyGating:
+    def test_standby_rejects_batch_and_never_journals(self, tmp_path):
+        """A warm standby must not dispatch, journal, or accept work
+        before it holds the lease: BATCH comes back BATCHREJECTED with
+        reason "standby", and the shared journal stays untouched."""
+        jpath = str(tmp_path / "batch.jsonl")
+        # a fresh lease keeps the standby from ever taking over here
+        ha.write_lease(ha.lease_path(jpath), "other-leader", 1, 60.0)
+        ev, st, wev, wst = free_ports(4)
+        server = Server(headless=True,
+                        ports=dict(event=ev, stream=st, wevent=wev,
+                                   wstream=wst),
+                        spawn_workers=False, journal_path=jpath,
+                        ha_role="standby", ha_lease_ttl=60.0,
+                        ha_poll_dt=0.05)
+        server.start()
+        time.sleep(0.2)
+        client = Client()
+        sock = None
+        try:
+            client.connect(event_port=ev, stream_port=st, timeout=5.0)
+            # the REGISTER ack advertises the standby role + lease terms
+            assert client.host_epoch == 1       # tracked from the lease
+            assert client.host_lease_ttl == 60.0
+            ctx = zmq.Context.instance()
+            sock = ctx.socket(zmq.DEALER)
+            sock.setsockopt(zmq.IDENTITY, make_id())
+            sock.setsockopt(zmq.LINGER, 0)
+            sock.connect(f"tcp://127.0.0.1:{wev}")
+            sock.send_multipart([b"REGISTER", packb(None)])
+            assert wait_for(lambda: len(server.workers) == 1, timeout=10)
+            client.send_event(b"BATCH", {"scentime": [0.0],
+                                         "scencmd": ["SCEN S1"]},
+                              target=b"")
+            assert wait_for(lambda: (client.receive(10),
+                                     client.last_rejection is not None
+                                     )[1], timeout=10)
+            assert client.last_rejection["reason"] == "standby"
+            assert not server.scenarios and not server.inflight
+            assert server.rejected_batches == 1
+            # nothing was journaled: the file was never even created
+            assert not os.path.exists(jpath)
+            payload = server.ha_payload()
+            assert payload["role"] == "standby" and payload["epoch"] == 1
+            assert "ha" in server.health_payload()
+        finally:
+            if sock is not None:
+                sock.close()
+            server.stop()
+            server.join(timeout=5)
+            client.close()
+
+
+# --------------------------------------------- takeover reconciliation
+def _dead_leader_journal(jpath, pieces, completed, dispatched):
+    """A journal as the dead leader left it: lease epoch 1, all pieces
+    queued, ``completed`` finished, ``dispatched`` still in flight."""
+    j = BatchJournal(jpath, fsync=False)
+    j.epoch = 1
+    j.lease("dead-leader", 1, ttl=0.2)
+    j.queued_many(pieces)
+    for p in completed:
+        j.dispatched(p, b"\x01")
+        j.completed(p, b"\x01")
+    for p in dispatched:
+        j.dispatched(p, b"\x02")
+    j.close()
+    # the dead leader's lease went stale long ago
+    ha.write_lease(ha.lease_path(jpath), "dead-leader", 1, 0.2,
+                   stamp=time.time() - 60.0)
+
+
+class TestTakeoverReconciliation:
+    def _standby(self, jpath, **kw):
+        ports = dict(zip(("event", "stream", "wevent", "wstream"),
+                         free_ports(4)))
+        return Server(headless=True, ports=ports, spawn_workers=False,
+                      journal_path=jpath, ha_role="standby",
+                      ha_lease_ttl=0.2, ha_poll_dt=0.05, **kw)
+
+    def test_takeover_holds_owed_pieces_in_limbo(self, tmp_path):
+        jpath = str(tmp_path / "batch.jsonl")
+        a, b, c = _piece("A"), _piece("B"), _piece("C")
+        _dead_leader_journal(jpath, [a, b, c], completed=[a],
+                             dispatched=[b])
+        server = self._standby(jpath)
+        server._ha_standby_poll(time.monotonic())
+        assert server.ha_role == "leader" and server._ha_serving
+        assert server.ha_takeovers == 1
+        assert server.ha_epoch == 2         # deposed leader held 1
+        # owed copies (b in flight, c never dispatched) wait in limbo
+        # for adoption — NOT in the dispatch queue
+        assert sorted(p[1][0] for p in server._ha_limbo) \
+            == ["SCEN B", "SCEN C"]
+        assert not server.scenarios
+        # succession is journal-fenced: our lease precedes everything
+        # the new leader writes, and the takeover is journaled
+        recs = _records(jpath)
+        assert [r["epoch"] for r in recs if r["rec"] == "lease"] \
+            == [1, 2]
+        resumed = [r for r in recs if r["rec"] == "resumed"]
+        assert resumed and resumed[-1]["takeover"]
+        assert resumed[-1]["wepoch"] == 2
+        # the lease file now names this server
+        lease = ha.read_lease(ha.lease_path(jpath))
+        assert lease["leader"] == server.server_id.hex()
+        assert lease["epoch"] == 2
+
+    def test_adoption_no_requeue_no_strike(self, tmp_path):
+        jpath = str(tmp_path / "batch.jsonl")
+        a, b = _piece("A"), _piece("B")
+        _dead_leader_journal(jpath, [a, b], completed=[], dispatched=[a])
+        server = self._standby(jpath)
+        server._ha_standby_poll(time.monotonic())
+        wid = make_id()
+        server.workers[wid] = 0
+        # the surviving worker re-REGISTERs with its in-flight report
+        server._ha_adopt(wid,
+                         {"key": BatchJournal.piece_key(a), "simt": 1.0})
+        assert server.ha_adoptions == 1
+        assert server.inflight[wid] == a    # adopted IN PLACE
+        assert not server.piece_crashes     # no breaker strike
+        assert sorted(p[1][0] for p in server._ha_limbo) == ["SCEN B"]
+        assert any(r["rec"] == "adopted"
+                   and r["worker"] == wid.hex()
+                   for r in _records(jpath))
+        # a duplicated re-REGISTER is idempotent: still one adoption
+        server._ha_adopt(wid,
+                         {"key": BatchJournal.piece_key(a), "simt": 2.0})
+        assert server.ha_adoptions == 1
+
+    def test_raced_completion_is_cancelled_not_recounted(self, tmp_path):
+        jpath = str(tmp_path / "batch.jsonl")
+        a = _piece("A")
+        _dead_leader_journal(jpath, [a], completed=[a], dispatched=[])
+        server = self._standby(jpath)
+        server._ha_standby_poll(time.monotonic())
+        assert not server._ha_limbo         # nothing owed
+        wid = make_id()
+        server.workers[wid] = 0
+        # a hedge twin (or a completion that raced the failover) still
+        # reports the already-counted content: cancel, don't re-run
+        server._ha_adopt(wid, {"key": BatchJournal.piece_key(a)})
+        assert server.ha_dedup_cancels == 1
+        assert wid not in server.inflight
+        assert wid in server._cancel_pending
+        state = BatchJournal.replay(jpath)
+        assert state["pending"] == [] and len(state["completed"]) == 1
+
+    def test_grace_expiry_requeues_unadopted(self, tmp_path):
+        jpath = str(tmp_path / "batch.jsonl")
+        a, b = _piece("A"), _piece("B")
+        _dead_leader_journal(jpath, [a, b], completed=[], dispatched=[a])
+        server = self._standby(jpath)
+        server._ha_standby_poll(time.monotonic())
+        assert len(server._ha_limbo) == 2
+        # only a adopts; b's worker died with the old leader
+        wid = make_id()
+        server.workers[wid] = 0
+        server._ha_adopt(wid, {"key": BatchJournal.piece_key(a)})
+        server._ha_release_limbo()
+        assert not server._ha_limbo
+        assert [p[1][0] for p in server.scenarios] == ["SCEN B"]
+        assert server.inflight[wid] == a    # adoption survived
+
+    def test_fold_carries_quarantine_and_sdc_state(self, tmp_path):
+        jpath = str(tmp_path / "batch.jsonl")
+        good, poison = _piece("A"), _piece("POISON")
+        j = BatchJournal(jpath, fsync=False)
+        j.epoch = 1
+        j.lease("dead-leader", 1, ttl=0.2)
+        j.queued_many([good, poison])
+        j.dispatched(good, b"\x01")
+        j.completed(good, b"\x01")
+        j.quarantined(poison, 3)
+        j.sdc_vote(good, fps={"01": "dead", "02": "beef",
+                              "03": "beef"}, deviant="01")
+        j.mitigation(cause="fingerprint vote", signal="sdc_deviant",
+                     action="quarantine_worker", target="01",
+                     outcome="drained", worker=b"\x01")
+        j.close()
+        ha.write_lease(ha.lease_path(jpath), "dead-leader", 1, 0.2,
+                       stamp=time.time() - 60.0)
+        server = self._standby(jpath)
+        server._ha_standby_poll(time.monotonic())
+        assert len(server.quarantined) == 1
+        assert server.quarantine_reports \
+            and server.quarantine_reports[0]["resumed"]
+        assert b"\x01" in server.sdc_quarantine
+        assert BatchJournal.piece_key(good) in server._sdc_voted
+        assert not server._ha_limbo         # everything accounted for
+
+
+# ------------------------------------- closed-loop failover acceptance
+LEADER_SRC = """
+import sys
+from bluesky_tpu import settings
+settings.init("")
+from bluesky_tpu.network.server import Server
+server = Server(headless=True, discoverable=True,
+                ports=dict(event={ev}, stream={st}, wevent={wev},
+                           wstream={wst}, discovery={dp}),
+                spawn_workers=False, journal_path={jpath!r},
+                ha_role="leader", ha_lease_ttl={ttl}, ha_poll_dt=0.1,
+                hb_interval=0.5)
+print("leader up", server.server_id.hex(), flush=True)
+server.run()
+"""
+
+
+@pytest.mark.slow
+def test_failover_chaos_exactly_once(tmp_path):
+    """FAULT KILLSERVER mid-BATCH with a warm standby: the standby
+    acquires the lease within 2x ttl of the leader dying, surviving
+    workers fail over by discovery arbitration and their running
+    pieces are ADOPTED (no requeue, no strike, no re-dispatch), and
+    the sweep completes journal-verified exactly-once — with zero
+    operator recovery commands."""
+    from bluesky_tpu.simulation.simnode import SimNode
+
+    TTL = 1.0
+    jpath = str(tmp_path / "batch.jsonl")
+    ev, st, wev, wst, sev, sst, swev, swst = free_ports(8)
+    (dp,) = free_ports(1)
+    scn = tmp_path / "ha.scn"
+    scn.write_text("".join(
+        f"00:00:00.00>SCEN HA_{tag}\n"
+        f"00:00:00.00>CRE {tag}1 B744 52 4 90 FL200 250\n"
+        f"00:00:25.00>HOLD\n"              # wall-paced: in flight for
+        for tag in ("AAA", "BBB", "CCC")))  # ~25 s — spans the failover
+
+    leader_log = open(str(tmp_path / "leader.log"), "w")
+    code = LEADER_SRC.format(ev=ev, st=st, wev=wev, wst=wst, dp=dp,
+                             jpath=jpath, ttl=TTL)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=leader_log,
+                            stderr=subprocess.STDOUT, env=env)
+    standby = None
+    nodes, threads = [], []
+    client = Client()
+    try:
+        # the leader must hold the lease before the standby starts, or
+        # the standby would win the empty-file race and lead first
+        lease_file = ha.lease_path(jpath)
+        assert wait_for(lambda: ha.read_lease(lease_file) is not None,
+                        timeout=60), "leader never acquired its lease"
+
+        standby = Server(headless=True, discoverable=True,
+                         ports=dict(event=sev, stream=sst, wevent=swev,
+                                    wstream=swst, discovery=dp),
+                         spawn_workers=False, journal_path=jpath,
+                         ha_role="standby", ha_lease_ttl=TTL,
+                         ha_poll_dt=0.1, hb_interval=0.5)
+        standby.start()
+
+        nodes = [SimNode(event_port=wev, stream_port=wst, nmax=8)
+                 for _ in range(3)]
+        threads = [threading.Thread(target=n.run, daemon=True)
+                   for n in nodes]
+        for t in threads:
+            t.start()
+        client.connect(event_port=ev, stream_port=st, timeout=30.0)
+        assert wait_for(lambda: (client.receive(10),
+                                 len(client.nodes) == 3)[1],
+                        timeout=60), "workers never registered"
+        # the ack armed every worker's failover detector
+        assert wait_for(lambda: all(n.server_epoch == 1 and n.server_pid
+                                    for n in nodes), timeout=10)
+
+        client.stack(f"BATCH {scn}", target=nodes[0].node_id)
+        assert wait_for(lambda: (client.receive(10),
+                                 all(n._batch_piece is not None
+                                     for n in nodes))[1], timeout=60), \
+            "pieces never went in flight on all three workers"
+        assert not standby._ha_serving      # still only watching
+
+        # ---- chaos: SIGKILL the broker from inside the fabric
+        client.stack("FAULT KILLSERVER", target=nodes[0].node_id)
+        assert proc.wait(timeout=15) is not None
+        t_kill = time.monotonic()
+
+        # ---- acceptance 1: lease acquired within 2x ttl
+        assert wait_for(lambda: standby._ha_serving,
+                        timeout=2.0 * TTL), \
+            "standby never took the lease within 2x ttl"
+        assert time.monotonic() - t_kill <= 2.0 * TTL
+        assert standby.ha_takeovers == 1 and standby.ha_epoch == 2
+
+        # ---- acceptance 2: every running piece adopted, none requeued
+        assert wait_for(lambda: standby.ha_adoptions == 3, timeout=30), \
+            f"adoptions: {standby.ha_adoptions}, " \
+            f"limbo: {len(standby._ha_limbo)}"
+        assert not standby.piece_crashes    # no breaker strikes
+        assert all(n.server_epoch == 2 for n in nodes)
+
+        # ---- acceptance 3: sweep completes, journal-verified
+        def swept():
+            client.receive(10)
+            state = BatchJournal.replay(jpath)
+            return not state["pending"] and len(state["completed"]) == 3
+        assert wait_for(swept, timeout=180), _records(jpath)
+        recs = _records(jpath)
+        by = {}
+        for r in recs:
+            by.setdefault(r["rec"], []).append(r)
+        done = [r["key"] for r in by["completed"]]
+        assert len(done) == 3 and len(set(done)) == 3   # exactly-once
+        assert len(by["adopted"]) == 3
+        assert [r["epoch"] for r in by["lease"]] == [1, 2]
+        assert any(r.get("takeover") for r in by["resumed"])
+        # adoption, not re-dispatch: the new leader never sent a BATCH
+        assert not [r for r in by["dispatched"]
+                    if r.get("wepoch") == 2]
+        assert "crashed" not in by and "quarantined" not in by
+        # completions were accepted by the NEW leader under its epoch
+        assert all(r.get("wepoch") == 2 for r in by["completed"]
+                   if r["key"] in set(done))
+        state = BatchJournal.replay(jpath)
+        assert state["ha"]["epoch"] == 2
+        assert state["fenced"] == 0         # SIGKILL appends nothing
+
+        # the operator's client can arbitrate over to the new leader
+        assert client.failover(timeout=5.0)
+        assert client.host_epoch == 2
+    finally:
+        with open(str(tmp_path / "standby.log"), "w") as f:
+            try:
+                f.write(json.dumps(
+                    {k: v for k, v in
+                     (standby.ha_payload() if standby else {}).items()
+                     if k != "text"}, default=str, indent=2))
+            except Exception as exc:
+                f.write(f"standby state dump failed: {exc!r}")
+        for n in nodes:
+            n.quit()
+        for t in threads:
+            t.join(timeout=10)
+        if standby is not None:
+            standby.stop()
+            standby.join(timeout=10)
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=10)
+        leader_log.close()
+        client.close()

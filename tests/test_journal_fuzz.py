@@ -28,9 +28,16 @@ def _piece(i):
                     f"FF"])
 
 
-def _run_schedule(rng, journal, model):
+def _run_schedule(rng, journal, model, ha=None):
     """Random piece lifecycles: journal them AND fold them into the
-    reference model (n_queued/n_completed/quarantined per key)."""
+    reference model (n_queued/n_completed/quarantined per key).
+
+    With ``ha`` (a shared ``{"epoch": n}`` counter), broker-HA noise
+    rides along too: ``lease`` records with monotonically growing
+    epochs, ``adopted`` audit lines, and a deposed leader's STALE
+    late appends (``wepoch`` one below the lease in force) — replay
+    must fence the stale ones out of the fold and surface ``fenced``
+    while staying exactly-once on everything else."""
     npieces = rng.randint(1, 6)
     pieces = [_piece(rng.randint(0, 3)) for _ in range(npieces)]
     journal.queued_many(pieces)
@@ -50,7 +57,11 @@ def _run_schedule(rng, journal, model):
                                 "mesh_lost", "resharded",
                                 "dispatched", "perf_regression",
                                 "mitigation", "sdc_suspect",
-                                "sdc_vote"])
+                                "sdc_vote"]
+                               + (["lease", "adopted",
+                                   "stale_completed",
+                                   "stale_dispatched"]
+                                  if ha is not None else []))
             if noise == "preempted":
                 journal.preempted(p, w, world=rng.choice([None, 0, 1]))
             elif noise == "hedged":
@@ -85,6 +96,27 @@ def _run_schedule(rng, journal, model):
             elif noise == "resharded":
                 journal.resharded(p, w, epoch=rng.randint(1, 4),
                                   ndev=4, mode="replicate")
+            elif noise == "lease":
+                # a new leadership epoch: monotone across the whole
+                # test (the shared counter), so the schedule's own
+                # later records are never accidentally fenced
+                ha["epoch"] += 1
+                journal.epoch = ha["epoch"]
+                journal.lease("fuzz-leader", journal.epoch, ttl=1.0)
+            elif noise == "adopted":
+                journal.adopted(p, w)
+            elif noise in ("stale_completed", "stale_dispatched"):
+                # a deposed leader's late append: stamp one epoch
+                # below the lease in force — replay must fence it
+                # (the model does NOT count it)
+                if journal.epoch:
+                    cur = journal.epoch
+                    journal.epoch = cur - 1
+                    if noise == "stale_completed":
+                        journal.completed(p, b"\x99")
+                    else:
+                        journal.dispatched(p, b"\x99")
+                    journal.epoch = cur
             else:
                 journal.dispatched(p, w, world=0, pack=2)
         fate = rng.random()
@@ -127,8 +159,9 @@ def test_replay_exactly_once_across_crashes(tmp_path, seed):
     rng = random.Random(seed)
     path = str(tmp_path / "batch.jsonl")
     model = {}
+    ha = {"epoch": 0}      # lease epochs stay monotone across crashes
     journal = BatchJournal(path, fsync=False)
-    _run_schedule(rng, journal, model)
+    _run_schedule(rng, journal, model, ha=ha)
     journal.close()
 
     # crash 1: tear the file at a random byte (mid-line tears included),
@@ -141,13 +174,18 @@ def test_replay_exactly_once_across_crashes(tmp_path, seed):
     state = BatchJournal.replay(path)
     assert state["torn_lines"] <= 1
     journal = BatchJournal(path, fsync=False)
-    _run_schedule(rng, journal, model)
+    _run_schedule(rng, journal, model, ha=ha)
     journal.close()
 
     # the healed tail may have orphaned the torn line's record: rebuild
     # the model from what is ACTUALLY on disk (the reference fold reads
-    # whole parseable lines only — exactly the replay contract)
+    # whole parseable lines only — exactly the replay contract).  The
+    # rebuild mirrors positional HA fencing: a ``lease`` line raises
+    # the epoch in force (monotone), and a later ``completed`` stamped
+    # with an older ``wepoch`` is a deposed leader's late append that
+    # must NOT count (exactly replay's fence_strict fold)
     disk_model = {}
+    disk_epoch = None
     for line in open(path, encoding="utf-8"):
         line = line.strip()
         if not line:
@@ -157,12 +195,21 @@ def test_replay_exactly_once_across_crashes(tmp_path, seed):
         except json.JSONDecodeError:
             continue
         rec, k = r.get("rec"), r.get("key")
+        if rec == "lease":
+            ep = r.get("epoch")
+            if isinstance(ep, int) and (disk_epoch is None
+                                        or ep >= disk_epoch):
+                disk_epoch = ep
+            continue
+        wep = r.get("wepoch")
+        stale = (disk_epoch is not None and isinstance(wep, int)
+                 and wep < disk_epoch)
         if rec == "queued" and k:
             disk_model.setdefault(
                 k, dict(piece=(r["scentime"], r["scencmd"]),
                         queued=0, completed=0, quarantined=False))
             disk_model[k]["queued"] += 1
-        elif k in disk_model and rec == "completed":
+        elif k in disk_model and rec == "completed" and not stale:
             disk_model[k]["completed"] += 1
         elif k in disk_model and rec == "quarantined":
             disk_model[k]["quarantined"] = True
@@ -179,7 +226,11 @@ def test_replay_exactly_once_across_crashes(tmp_path, seed):
         if r.get("rec") in ("dispatched", "preempted", "hedged",
                             "dup_completed", "mesh_lost", "resharded",
                             "perf_regression", "mitigation",
-                            "sdc_suspect", "sdc_vote"):
+                            "sdc_suspect", "sdc_vote",
+                            "adopted", "lease"):
+            # duplicated "lease" lines are safe to interleave: the
+            # epoch in force is monotone (an older epoch never lowers
+            # it), and a duplicated stale "dispatched" is fenced audit
             audit.append(ln)
     rng.shuffle(audit)
     with open(path, "a", encoding="utf-8") as f:
@@ -264,6 +315,50 @@ def test_replay_pure_audit_noise_changes_nothing(tmp_path):
         == ["quarantine_worker"]
     assert sdc["quarantines"][0]["key"] == BatchJournal.piece_key(
         pieces[0])
+
+
+def test_replay_fences_deposed_leader(tmp_path):
+    """Broker-HA fencing (deterministic): a ``lease`` record raises
+    the epoch in force positionally, and a deposed leader's LATE
+    ``dispatched``/``completed`` (older ``wepoch`` after the new
+    lease) is fenced — surfaced under ``fenced``, kept out of the
+    queue math — while its PRE-takeover work still counts.  The
+    ``fence_strict=False`` escape hatch trusts the late completion
+    but still reports the count."""
+    path = str(tmp_path / "batch.jsonl")
+    j = BatchJournal(path, fsync=False)
+    pieces = [_piece(i) for i in range(3)]
+    j.epoch = 1
+    j.lease("leader-a", 1, ttl=0.5)
+    j.queued_many(pieces)
+    j.dispatched(pieces[0], b"\x01")
+    j.completed(pieces[0], b"\x01")     # epoch-1 work BEFORE takeover
+    j.dispatched(pieces[1], b"\x01")
+    # the standby takes over (epoch 2); then the deposed leader's
+    # late appends land AFTER the new lease, still stamped wepoch=1
+    j.epoch = 2
+    j.lease("leader-b", 2, ttl=0.5)
+    j.epoch = 1
+    j.completed(pieces[1], b"\x01")     # late completion -> fenced
+    j.dispatched(pieces[2], b"\x01")    # late dispatch -> fenced audit
+    j.epoch = 2
+    j.completed(pieces[2], b"\x02")     # new leader's work counts
+    j.close()
+
+    state = BatchJournal.replay(path)
+    assert state["fenced"] == 2
+    assert state["ha"]["epoch"] == 2
+    assert state["ha"]["leader"] == "leader-b"
+    assert [rec["epoch"] for rec in state["ha"]["leases"]] == [1, 2]
+    pend = {BatchJournal.piece_key(p) for p in state["pending"]}
+    # the fenced completion stays owed; pieces 0 and 2 are settled
+    assert pend == {BatchJournal.piece_key(pieces[1])}
+    assert len(state["completed"]) == 2
+
+    loose = BatchJournal.replay(path, fence_strict=False)
+    assert loose["fenced"] == 2         # still surfaced for audit
+    assert loose["pending"] == []       # ...but the completion stands
+    assert len(loose["completed"]) == 3
 
 
 def test_replay_skips_synthetic_pieces(tmp_path):
