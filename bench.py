@@ -259,20 +259,30 @@ def run_chunked(n_ac, backend=None, geometry=None, chunk=20,
 
     backend = backend or _pick_backend(n_ac)
     geometry = geometry or ("continental" if n_ac > 16384 else "regional")
-    # mesh-aware chunk runner (ISSUE 5): the production cost model on a
-    # device mesh — 'replicate' shards rows vs replicated columns,
-    # 'spatial' runs the latitude-stripe decomposition (sparse backend,
-    # nmax gets 2x re-bucketing headroom)
+    # mesh-aware chunk runner (ISSUE 5/19): the production cost model on
+    # a device mesh — 'replicate' shards rows vs replicated columns,
+    # 'spatial' runs the latitude-stripe decomposition, 'tiles' the 2-D
+    # lat x lon tile decomposition with corner-halo exchange (sparse
+    # backend; nmax gets 2x re-bucketing headroom)
     ndev = 0
     mesh = None
+    tiles = None
     if shard and shard != "off":
         import jax as _jax
         from bluesky_tpu.parallel import sharding as shd
         ndev = shard_devices or len(_jax.devices())
-        mesh = shd.make_mesh(ndev)
-        if shard == "spatial" and backend != "sparse":
+        if shard == "tiles":
+            # near-square R x C factorization with R >= C (8 -> 4x2)
+            c = int(np.sqrt(ndev))
+            while c > 1 and ndev % c:
+                c -= 1
+            tiles = (ndev // max(c, 1), max(c, 1))
+            mesh = shd.make_tile_mesh(tiles)
+        else:
+            mesh = shd.make_mesh(ndev)
+        if shard in ("spatial", "tiles") and backend != "sparse":
             backend = "sparse"
-    nmax = 2 * n_ac if shard == "spatial" else n_ac
+    nmax = 2 * n_ac if shard in ("spatial", "tiles") else n_ac
     if ndev:
         nmax = -(-nmax // ndev) * ndev
     traf = _make_traffic(n_ac, geometry, backend == "dense", jnp.float32,
@@ -281,7 +291,13 @@ def run_chunked(n_ac, backend=None, geometry=None, chunk=20,
     state = traf.state
     if mesh is not None:
         from bluesky_tpu.parallel import sharding as shd
-        if shard == "spatial":
+        if shard == "tiles":
+            state, _, tl_info = shd.prepare_tiles(state, mesh, cfg.asas,
+                                                  tiles=tiles)
+            cfg = cfg._replace(cd_shard_mode="tiles", cd_mesh=mesh,
+                               cd_tile_shape=tl_info["tile_shape"],
+                               cd_tile_budgets=tl_info["budgets"])
+        elif shard == "spatial":
             state, _, sp_info = shd.prepare_spatial(state, mesh, cfg.asas)
             cfg = cfg._replace(cd_shard_mode="spatial", cd_mesh=mesh,
                                cd_mesh_axis="ac",
@@ -298,6 +314,11 @@ def run_chunked(n_ac, backend=None, geometry=None, chunk=20,
                              f"(got {backend!r})")
 
     def resort(st):
+        if shard == "tiles":
+            from bluesky_tpu.core.asas import refresh_tile_shard
+            return refresh_tile_shard(
+                st, cfg.asas, tiles, block=min(cfg.cd_block, 256),
+                budgets=cfg.cd_tile_budgets)[0]
         if shard == "spatial":
             from bluesky_tpu.core.asas import refresh_spatial_shard
             return refresh_spatial_shard(
@@ -361,6 +382,8 @@ def run_chunked(n_ac, backend=None, geometry=None, chunk=20,
                    x_realtime=round(rate * cfg.simdt / n_ac, 1),
                    nsteps_chunk=chunk, nchunks=nchunks,
                    shard=shard, shard_devices=ndev,
+                   **(dict(tile_shape=f"{tiles[0]}x{tiles[1]}")
+                      if tiles else {}),
                    pipeline=bool(pipeline),
                    dispatch_gap_s=round(dispatch_gap, 4),
                    telemetry_pull_s=round(telem_pull, 4),
@@ -788,7 +811,8 @@ if __name__ == "__main__":
             if "--shard" in sys.argv else "off"
         args = [a for a in sys.argv[1:]
                 if not a.startswith("--")
-                and a not in ("on", "off", "replicate", "spatial")]
+                and a not in ("on", "off", "replicate", "spatial",
+                              "tiles")]
         n = int(args[0]) if args else 100_000
         chunk = int(args[1]) if len(args) > 1 else 20
         print(json.dumps(run_chunked(n, chunk=chunk,

@@ -268,6 +268,58 @@ def _sparse_sort_refresh(lat, lon, gs, alt, vs, active, old_perm,
     return dest, new_partners
 
 
+def _rebucket_callers(active, dest0, dev, n, n_tot, ndev, C):
+    """Caller-slot re-bucketing shared by the stripe and tile refreshes
+    (a full [n] bijection): device d's caller shard [d*C, (d+1)*C) gets
+    exactly the active aircraft whose sorted slots d owns (packed in
+    sorted order), inactive rows fill the per-shard tails.  Returns
+    ``(newslot [n], src [n], counts [ndev])`` — counts <= C is the
+    caller's occupancy contract to check."""
+    aidx = jnp.arange(n, dtype=jnp.int32)
+    key = jnp.where(active, dest0, n_tot + aidx)   # actives first, by slot
+    order = jnp.argsort(key)
+    act_o = active[order]
+    dev_o = dev[order]
+    oh = (dev_o[:, None] == jnp.arange(ndev, dtype=jnp.int32)[None, :]) \
+        & act_o[:, None]
+    counts = jnp.sum(oh, axis=0, dtype=jnp.int32)          # [ndev]
+    rank_o = jnp.sum((jnp.cumsum(oh, axis=0) - 1) * oh, axis=1)
+    slot_act_o = dev_o * C + rank_o
+    # free caller slots (per-shard tails) in ascending order for the
+    # inactive fillers; counts <= C is checked by the host caller
+    free = (aidx % C) >= counts[jnp.minimum(aidx // C, ndev - 1)]
+    free_slots = jnp.sort(jnp.where(free, aidx, n))
+    n_act = jnp.sum(active, dtype=jnp.int32)
+    inact_rank = jnp.clip(aidx - n_act, 0, n - 1)
+    newslot_o = jnp.where(act_o, slot_act_o,
+                          free_slots[inact_rank]).astype(jnp.int32)
+    newslot = jnp.zeros((n,), jnp.int32).at[order].set(newslot_o)
+    src = jnp.zeros((n,), jnp.int32).at[newslot].set(aidx)
+    return newslot, src, counts
+
+
+def _remap_partners_sorted(old_perm, partners_s, active, dest0,
+                           dest_sent, n, n_tot):
+    """Sorted-space partner-table remap old layout -> new layout (old
+    sorted -> old caller -> new sorted), shared by the stripe and tile
+    refreshes — same chain as ``_sparse_sort_refresh`` plus the caller
+    migration, which cancels out because the table is keyed in sorted
+    space."""
+    from ..ops import cd_sched
+    inv_old = cd_sched.slot_inverse(old_perm, n, n_tot)
+    pv = partners_s[:n_tot]
+    caller_vals = jnp.where(pv >= 0, inv_old[jnp.clip(pv, 0, n_tot)], -1)
+    cv = jnp.clip(caller_vals, 0, n - 1)
+    new_vals = jnp.where((caller_vals >= 0) & active[cv],
+                         dest0[cv], -1)
+    row_ok = (old_perm < n_tot) & active
+    per_caller = jnp.where(row_ok[:, None],
+                           new_vals[jnp.clip(old_perm, 0, n_tot - 1), :],
+                           -1)
+    return jnp.full((n_tot, pv.shape[1]), -1, jnp.int32) \
+        .at[dest_sent].set(per_caller, mode="drop")
+
+
 @functools.partial(jax.jit, static_argnames=(
     "block", "ndev", "extra", "halo", "tlookahead", "rpz",
     "min_reach_m", "margin_s"))
@@ -318,46 +370,12 @@ def _spatial_shard_refresh(lat, lon, gs, alt, vs, active, old_perm,
         lat, lon, gs, active, thresh, block, extra,
         alt=alt, vs=vs, spread_pad=True).astype(jnp.int32)
     dev = jnp.minimum(dest0 // S, ndev - 1)
-
-    # ---- caller-slot re-bucketing (a full [n] bijection) ----
-    aidx = jnp.arange(n, dtype=jnp.int32)
-    key = jnp.where(active, dest0, n_tot + aidx)   # actives first, by slot
-    order = jnp.argsort(key)
-    act_o = active[order]
-    dev_o = dev[order]
-    oh = (dev_o[:, None] == jnp.arange(ndev, dtype=jnp.int32)[None, :]) \
-        & act_o[:, None]
-    counts = jnp.sum(oh, axis=0, dtype=jnp.int32)          # [ndev]
-    rank_o = jnp.sum((jnp.cumsum(oh, axis=0) - 1) * oh, axis=1)
-    slot_act_o = dev_o * C + rank_o
-    # free caller slots (per-shard tails) in ascending order for the
-    # inactive fillers; counts <= C is checked by the host caller
-    free = (aidx % C) >= counts[jnp.minimum(aidx // C, ndev - 1)]
-    free_slots = jnp.sort(jnp.where(free, aidx, n))
-    n_act = jnp.sum(active, dtype=jnp.int32)
-    inact_rank = jnp.clip(aidx - n_act, 0, n - 1)
-    newslot_o = jnp.where(act_o, slot_act_o,
-                          free_slots[inact_rank]).astype(jnp.int32)
-    newslot = jnp.zeros((n,), jnp.int32).at[order].set(newslot_o)
-    src = jnp.zeros((n,), jnp.int32).at[newslot].set(aidx)
+    newslot, src, counts = _rebucket_callers(
+        active, dest0, dev, n, n_tot, ndev, C)
     dest_sent = jnp.where(active, dest0, n_tot)
     sort_perm_new = dest_sent[src]
-
-    # ---- partner-table remap: old sorted -> old caller -> new sorted
-    # (same chain as _sparse_sort_refresh, plus the caller migration,
-    # which cancels out because the table is keyed in sorted space) ----
-    inv_old = cd_sched.slot_inverse(old_perm, n, n_tot)
-    pv = partners_s[:n_tot]
-    caller_vals = jnp.where(pv >= 0, inv_old[jnp.clip(pv, 0, n_tot)], -1)
-    cv = jnp.clip(caller_vals, 0, n - 1)
-    new_vals = jnp.where((caller_vals >= 0) & active[cv],
-                         dest0[cv], -1)
-    row_ok = (old_perm < n_tot) & active
-    per_caller = jnp.where(row_ok[:, None],
-                           new_vals[jnp.clip(old_perm, 0, n_tot - 1), :],
-                           -1)
-    partners_new = jnp.full((n_tot, pv.shape[1]), -1, jnp.int32) \
-        .at[dest_sent].set(per_caller, mode="drop")
+    partners_new = _remap_partners_sorted(
+        old_perm, partners_s, active, dest0, dest_sent, n, n_tot)
 
     # ---- halo coverage check, drift-margin widened ----
     pcols = cd_sched.scatter_padded(
@@ -514,6 +532,265 @@ def refresh_spatial_shard(state: SimState, cfg: AsasConfig, ndev: int,
     return new_state, np.asarray(newslot), info
 
 
+@functools.partial(jax.jit, static_argnames=(
+    "block", "extra", "tiles", "budgets", "tlookahead", "rpz",
+    "min_reach_m", "margin_s"))
+def _tile_shard_refresh(lat, lon, gs, alt, vs, active, old_perm,
+                        partners_s, *, block, extra, tiles, budgets,
+                        tlookahead, rpz, min_reach_m, margin_s):
+    """Tiles-mode sort refresh: 2-D tile-major sort + device
+    re-bucketing + the corner-halo contract validation as one compiled
+    program — the lat x lon generalisation of
+    ``_spatial_shard_refresh`` (same return structure, same caller-slot
+    bijection and partner-remap shapes).
+
+    Validation replaces the stripe window check with TWO conditions on
+    the drift-margin-widened reachability: (1) every reachable block
+    pair stays inside the canonical edge+corner neighbourhood of
+    ``cd_sched.tile_offsets`` (a reach escaping it could not be shipped
+    by the per-offset exchange at all), and (2) with ``budgets`` given,
+    each offset's measured per-receiver import need fits its pinned
+    slab budget.  Because the interval's exports select from the SAME
+    (unwidened) reachability, margin-widened need >= interval need —
+    so a passing refresh guarantees no conflict pair can be missed
+    until the next one.
+
+    ``stats`` is ``(counts [ndev], halo_ok, budget_ok, needs [n_offs],
+    gsmax)`` — needs are the measured per-offset import-block maxima
+    (the host pins budgets at 1.25x these in auto mode).
+    """
+    from ..ops import cd_sched
+    n = lat.shape[0]
+    nb = -(-n // block) + extra
+    n_tot = nb * block
+    tR, tC = int(tiles[0]), int(tiles[1])
+    ndev = tR * tC
+    nb_t = nb // ndev
+    S = nb_t * block
+    C = n // ndev
+    thresh = cd_sched.reach_threshold_m(gs, active, tlookahead, rpz)
+    dest0 = cd_sched.tile_sort_dest(
+        lat, lon, gs, active, thresh, block, extra, (tR, tC),
+        alt=alt, vs=vs).astype(jnp.int32)
+    dev = jnp.minimum(dest0 // S, ndev - 1)
+    newslot, src, counts = _rebucket_callers(
+        active, dest0, dev, n, n_tot, ndev, C)
+    dest_sent = jnp.where(active, dest0, n_tot)
+    sort_perm_new = dest_sent[src]
+    partners_new = _remap_partners_sorted(
+        old_perm, partners_s, active, dest0, dest_sent, n, n_tot)
+
+    # ---- corner-halo contract check, drift-margin widened ----
+    pcols = cd_sched.scatter_padded(
+        [lat, lon, gs, active.astype(lat.dtype)], dest_sent, n_tot)
+    plat, plon, pgs, pact = pcols
+    summ = cd_tiled.block_summaries(plat, plon, pgs, pact > 0.5,
+                                    nb, block)
+    gsmax = jnp.max(jnp.where(active, gs, 0.0))
+    reach_m = cd_tiled.reachability_from_summaries(
+        summ, summ, float(rpz), float(tlookahead),
+        min_reach_m=float(min_reach_m),
+        margin_m=2.0 * gsmax * margin_s)
+    # column need per RECEIVER tile: any of tile v's rows reaching col b
+    cn_t = jnp.any(reach_m.reshape(ndev, nb_t, nb), axis=1) \
+        .reshape(ndev, ndev, nb_t)            # [recv, src tile, nb_t]
+    treach = jnp.any(cn_t, axis=2)                         # [recv, src]
+    offs = cd_sched.tile_offsets((tR, tC))
+    allowed = np.eye(ndev, dtype=bool)
+    for off in offs:
+        for u, v in cd_sched._offset_pairs((tR, tC), off):
+            allowed[v, u] = True               # v imports from sender u
+    halo_ok = ~jnp.any(treach & ~jnp.asarray(allowed))
+    needs = []
+    for off in offs:
+        uv = np.full(ndev, -1, np.int32)
+        for u, v in cd_sched._offset_pairs((tR, tC), off):
+            uv[v] = u
+        cnt = jnp.sum(
+            cn_t[jnp.arange(ndev), jnp.maximum(uv, 0)],
+            axis=-1, dtype=jnp.int32)                      # [recv]
+        needs.append(jnp.max(jnp.where(jnp.asarray(uv >= 0), cnt, 0)))
+    needs = jnp.stack(needs)
+    if budgets:
+        budget_ok = jnp.all(
+            needs <= jnp.asarray(budgets, jnp.int32))
+    else:
+        budget_ok = jnp.asarray(True)
+    return newslot, src, sort_perm_new, partners_new, \
+        (counts, halo_ok, budget_ok, needs, gsmax)
+
+
+def refresh_tile_shard(state: SimState, cfg: AsasConfig, tiles,
+                       block: int = 256, budgets=()):
+    """Tiles-mode chunk-edge refresh: 2-D tile sort, caller-slot
+    re-bucketing, partner remap and the corner-halo contract check as
+    one jitted program, then the state permutation applied host-side —
+    the lat x lon counterpart of ``refresh_spatial_shard``.
+
+    ``budgets`` = () is AUTO: validate the neighbourhood contract, then
+    pin each canonical offset's slab budget at 1.25x its measured need
+    (>= 4 blocks drift headroom, <= the whole tile) — the caller stores
+    the pinned tuple in SimConfig.cd_tile_budgets so every interval
+    compiles against the same static exchange.
+
+    Raises ``RuntimeError`` on a tile occupancy overflow (a tile's
+    population exceeding its caller-shard capacity), on reachability
+    escaping the edge+corner neighbourhood, or on a pinned budget
+    falling short of the measured need — never silently misses
+    conflicts; the caller falls back (tiles -> spatial -> replicate).
+    """
+    from ..ops import cd_sched
+    ac = state.ac
+    n = ac.lat.shape[0]
+    block = min(block, 256)
+    tR, tC = int(tiles[0]), int(tiles[1])
+    ndev = tR * tC
+    extra, nb, nb_t, n_tot = cd_sched.spatial_layout(n, block, ndev)
+    if state.asas.partners_s.shape[0] < n_tot:
+        raise RuntimeError(
+            f"tile refresh: partners_s holds "
+            f"{state.asas.partners_s.shape[0]} rows < n_tot={n_tot} — "
+            "enable tiles mode first (it resizes the sorted tables)")
+    min_reach = 0.0
+    if cfg.reso_on and cfg.reso_method.upper() == "SWARM":
+        from ..ops import cr_swarm
+        min_reach = float(cr_swarm.R_SWARM)
+    auto = not budgets
+    budgets = tuple(int(b) for b in budgets) if budgets else ()
+    newslot, srcidx, sort_perm, partners_new, stats = \
+        _tile_shard_refresh(
+            ac.lat, ac.lon, ac.gs, ac.alt, ac.vs, ac.active,
+            state.asas.sort_perm, state.asas.partners_s[:n_tot],
+            block=block, extra=extra, tiles=(tR, tC), budgets=budgets,
+            tlookahead=float(cfg.dtlookahead), rpz=float(cfg.rpz),
+            min_reach_m=min_reach,
+            margin_s=float(cfg.sort_every * cfg.dtasas))
+    counts, halo_ok, budget_ok, needs, gsmax = stats
+    counts = np.asarray(counts)
+    needs = np.asarray(needs)
+    C = n // ndev
+    if counts.max() > C:
+        t_bad = int(counts.argmax())
+        raise RuntimeError(
+            f"tile refresh: tile occupancy overflow — tile "
+            f"({t_bad // tC},{t_bad % tC}) owns {int(counts.max())} "
+            f"aircraft > caller-shard capacity {C} (nmax/{ndev}). Raise "
+            "nmax, use a different tile shape, or SHARD "
+            "SPATIAL/REPLICATE for this geometry.")
+    if not bool(halo_ok):
+        raise RuntimeError(
+            f"tile refresh: corner-halo contract violated — "
+            f"(drift-margin widened) reachability escapes the "
+            f"edge+corner neighbourhood of the {tR}x{tC} tile mesh. "
+            "Use SHARD SPATIAL/REPLICATE or fewer tiles for this "
+            "geometry.")
+    if not bool(budget_ok):
+        raise RuntimeError(
+            f"tile refresh: halo slab budget exceeded — measured "
+            f"per-offset import need {needs.tolist()} > pinned budgets "
+            f"{list(budgets)}. Re-run SHARD TILE {tR}x{tC} to re-pin, "
+            "or SHARD SPATIAL/REPLICATE for this geometry.")
+    if auto:
+        budgets = tuple(
+            int(min(max(4, -(-int(nd) * 5 // 4)), nb_t))
+            for nd in needs)
+
+    def permute(leaf):
+        if hasattr(leaf, "ndim") and leaf.ndim >= 1 \
+                and leaf.shape[0] == n:
+            return leaf[srcidx]
+        return leaf
+    new_state = jax.tree.map(permute, state)
+    asas_new = new_state.asas
+    # caller-space partner ids (tiled path) move WITH the slots
+    p = asas_new.partners
+    p = jnp.where(p >= 0, newslot[jnp.clip(p, 0, n - 1)], -1)
+    spad = state.asas.partners_s.shape[0] - n_tot
+    if spad > 0:
+        partners_new = jnp.concatenate(
+            [partners_new,
+             jnp.full((spad, partners_new.shape[1]), -1, jnp.int32)])
+    new_state = new_state.replace(asas=asas_new.replace(
+        sort_perm=sort_perm, partners_s=partners_new, partners=p))
+    offs = cd_sched.tile_offsets((tR, tC))
+    info = dict(counts=counts, occupancy=float(counts.max() / max(C, 1)),
+                tile_shape=(tR, tC), offsets=offs,
+                budgets=budgets, needs=needs.tolist(),
+                gsmax=float(gsmax), nb=nb, nb_local=nb_t, n_tot=n_tot,
+                extra_blocks=extra,
+                halo_rows=int(sum(budgets)) * block * ndev)
+    return new_state, np.asarray(newslot), info
+
+
+def inscan_tile_refresh(state: SimState, cfg: AsasConfig, tiles,
+                        block: int = 256, budgets=()):
+    """The tiles-mode refresh as a pure in-scan body: the device side
+    of ``refresh_tile_shard`` — 2-D tile sort, caller re-bucketing,
+    partner remap, occupancy + corner-halo/budget validation AND the
+    full-state slot permutation — with the host's RuntimeError
+    escalation replaced by a structured guard word (the tiles analogue
+    of ``inscan_spatial_refresh``).
+
+    Returns ``(state', newslot, guard)``: ``guard`` is int32, bit 2 =
+    corner-halo/budget contract violation, bit 4 = tile-occupancy
+    overflow.  A violating refresh is SKIPPED entirely (old layout
+    kept, identity newslot) — staleness is exact, only looser — and
+    the host trips the fallback chain (tiles -> spatial -> replicate)
+    when the word reaches the edge.
+    """
+    ac = state.ac
+    n = ac.lat.shape[0]
+    block = min(block, 256)
+    tR, tC = int(tiles[0]), int(tiles[1])
+    ndev = tR * tC
+    n_tot = state.asas.partners_s.shape[0]
+    nb0 = -(-n // block)
+    if n_tot % block or n_tot // block <= nb0:
+        raise ValueError(
+            f"in-scan tile refresh needs partners_s sized to the "
+            f"padded layout (got {n_tot} rows for n={n}, block={block}) "
+            "— enable tiles mode via Simulation.set_shard first")
+    nb = n_tot // block
+    extra = nb - nb0
+    min_reach = 0.0
+    if cfg.reso_on and cfg.reso_method.upper() == "SWARM":
+        from ..ops import cr_swarm
+        min_reach = float(cr_swarm.R_SWARM)
+    newslot, srcidx, sort_perm, partners_new, stats = \
+        _tile_shard_refresh(
+            ac.lat, ac.lon, ac.gs, ac.alt, ac.vs, ac.active,
+            state.asas.sort_perm, state.asas.partners_s,
+            block=block, extra=extra, tiles=(tR, tC),
+            budgets=tuple(int(b) for b in budgets) if budgets else (),
+            tlookahead=float(cfg.dtlookahead), rpz=float(cfg.rpz),
+            min_reach_m=min_reach,
+            margin_s=float(cfg.sort_every * cfg.dtasas))
+    counts, halo_ok, budget_ok, _needs, _gsmax = stats
+    overflow = jnp.max(counts) > (n // ndev)
+    contract_ok = halo_ok & budget_ok
+    guard = (jnp.where(overflow, 4, 0)
+             | jnp.where(contract_ok, 0, 2)).astype(jnp.int32)
+    ok = contract_ok & ~overflow
+
+    def apply(s):
+        def permute(leaf):
+            if hasattr(leaf, "ndim") and leaf.ndim >= 1 \
+                    and leaf.shape[0] == n:
+                return leaf[srcidx]
+            return leaf
+        s2 = jax.tree.map(permute, s)
+        # caller-space partner ids (tiled path) move WITH the slots
+        p = s2.asas.partners
+        p = jnp.where(p >= 0, newslot[jnp.clip(p, 0, n - 1)], -1)
+        return s2.replace(asas=s2.asas.replace(
+            sort_perm=sort_perm, partners_s=partners_new, partners=p))
+
+    state2 = jax.lax.cond(ok, apply, lambda s: s, state)
+    newslot_out = jnp.where(ok, newslot,
+                            jnp.arange(n, dtype=jnp.int32))
+    return state2, newslot_out, guard
+
+
 def inscan_sparse_refresh(state: SimState, cfg: AsasConfig,
                           block: int = 256) -> SimState:
     """The sparse sort refresh as a pure state -> state body, callable
@@ -618,8 +895,9 @@ def spatial_table_size(n, block=256, ndev=1):
 
 def update_tiled(state: SimState, cfg: AsasConfig, block: int = 512,
                  impl: str = "lax", mesh=None, mesh_axis: str = "ac",
-                 shard_mode: str = "replicate",
-                 halo_blocks: int = 0) -> Tuple[SimState, RowConflictData]:
+                 shard_mode: str = "replicate", halo_blocks: int = 0,
+                 tile_shape=None,
+                 tile_budgets=()) -> Tuple[SimState, RowConflictData]:
     """One ASAS interval via the blockwise large-N backend (ops/cd_tiled.py).
 
     Same pipeline as ``update`` — detect, resolve, bookkeep, resume
@@ -674,18 +952,20 @@ def update_tiled(state: SimState, cfg: AsasConfig, block: int = 512,
         block = min(block, 256)
         n = ac.lat.shape[0]
         extra_eff = 32
-        if shard_mode == "spatial":
-            # Spatial mode keys the padded layout off the sorted-space
-            # partner table, which SHARD sizing made EXACTLY the
-            # device-divisible padded size (a per-interval slice of a
-            # sharded table would reshard O(N*K) every interval).
+        if shard_mode in ("spatial", "tiles"):
+            # Spatial/tiles modes key the padded layout off the
+            # sorted-space partner table, which SHARD sizing made
+            # EXACTLY the device-divisible padded size (a per-interval
+            # slice of a sharded table would reshard O(N*K) every
+            # interval).
             n_tot = asas.partners_s.shape[0]
             nb0 = -(-n // block)
             if n_tot % block or n_tot // block <= nb0:
                 raise ValueError(
-                    f"spatial mode needs partners_s sized to the padded "
-                    f"layout (got {n_tot} rows for n={n}, block={block}) "
-                    "— enable it via Simulation.set_shard/SHARD SPATIAL")
+                    f"{shard_mode} mode needs partners_s sized to the "
+                    f"padded layout (got {n_tot} rows for n={n}, "
+                    f"block={block}) — enable it via "
+                    "Simulation.set_shard/SHARD SPATIAL|TILE")
             extra_eff = n_tot // block - nb0
         else:
             n_tot = cd_sched.padded_size(n, block)
@@ -700,7 +980,8 @@ def update_tiled(state: SimState, cfg: AsasConfig, block: int = 512,
             cas=ac.cas if kern_reso == "swarm" else None,
             reso=kern_reso, mesh=mesh, mesh_axis=mesh_axis,
             shard_mode=shard_mode, extra_blocks=extra_eff,
-            halo_blocks=halo_blocks)
+            halo_blocks=halo_blocks, tile_shape=tile_shape,
+            tile_budgets=tile_budgets)
         if kern_reso == "swarm":
             rd, partners_s, act_new, swarm_sums = out
         else:

@@ -66,6 +66,12 @@ class SimConfig(NamedTuple):
     # values cut the boundary exchange and are validated against the
     # exact reach bound + drift margin at every refresh).
     cd_halo_blocks: int = 0
+    # 2-D tile decomposition ('tiles' shard mode): (R, C) shape of the
+    # ('lat', 'lon') device mesh, and the per-canonical-offset halo
+    # slab budgets pinned by the tile refresh (() = unpinned, whole
+    # neighbour tiles).  Tuples, so the config stays hashable/static.
+    cd_tile_shape: tuple = ()
+    cd_tile_budgets: tuple = ()
     # Differentiable mode (bluesky_tpu/diff/): a diff.smooth.SmoothConfig
     # swaps the hard gates for the documented relaxations (conflict
     # sigmoid, softmin resolver reductions, straight-through clamps,
@@ -149,15 +155,22 @@ def step(state: SimState, cfg: SimConfig) -> SimState:
                 "dense CD&R path only: the tiled/pallas/sparse kernels "
                 "carry integer partner tables that do not differentiate."
                 "  Use cd_backend='dense' (diff workloads run small-N).")
-        if cfg.cd_shard_mode not in ("replicate", "spatial"):
+        if cfg.cd_shard_mode not in ("replicate", "spatial", "tiles"):
             raise ValueError(
                 f"Unknown SimConfig.cd_shard_mode {cfg.cd_shard_mode!r}; "
-                "expected 'replicate' or 'spatial'.")
-        if cfg.cd_shard_mode == "spatial" and cfg.cd_backend != "sparse":
+                "expected 'replicate', 'spatial' or 'tiles'.")
+        if cfg.cd_shard_mode in ("spatial", "tiles") \
+                and cfg.cd_backend != "sparse":
             raise ValueError(
-                "cd_shard_mode='spatial' is the sparse backend's "
-                "domain decomposition (latitude stripes are a property "
-                "of the stripe-sorted schedule); use cd_backend='sparse'")
+                f"cd_shard_mode='{cfg.cd_shard_mode}' is the sparse "
+                "backend's domain decomposition (stripes/tiles are a "
+                "property of the sorted schedule); use "
+                "cd_backend='sparse'")
+        if cfg.cd_shard_mode == "tiles" and (
+                not cfg.cd_tile_shape or len(cfg.cd_tile_shape) != 2):
+            raise ValueError(
+                "cd_shard_mode='tiles' needs cd_tile_shape=(R, C) — "
+                "set it via Simulation.set_shard / SHARD TILE RxC")
         if cfg.cd_backend == "dense" and state.asas.resopairs.size == 0:
             raise ValueError(
                 "State was allocated with pair_matrix=False (no [N,N] "
@@ -181,7 +194,9 @@ def step(state: SimState, cfg: SimConfig) -> SimState:
                     s, cfg.asas, block=cfg.cd_block, impl=impl,
                     mesh=cfg.cd_mesh, mesh_axis=cfg.cd_mesh_axis,
                     shard_mode=cfg.cd_shard_mode,
-                    halo_blocks=cfg.cd_halo_blocks)
+                    halo_blocks=cfg.cd_halo_blocks,
+                    tile_shape=cfg.cd_tile_shape or None,
+                    tile_budgets=cfg.cd_tile_budgets)
             else:
                 s2, _cd = asasmod.update(s, cfg.asas, smooth=cfg.smooth)
             return s2.replace(
@@ -258,7 +273,8 @@ class RefreshPack(NamedTuple):
       pipelined loop, so chaining costs zero host syncs.
     * ``count``: int32 refreshes fired inside this chunk.
     * ``guard``: int32 structured guard word, OR of bit 1 (spatial
-      stripe-occupancy overflow) and bit 2 (halo-coverage violation).
+      stripe-occupancy overflow), bit 2 (halo-coverage / tile-budget
+      violation) and bit 4 (tile-occupancy overflow).
       A violating refresh is SKIPPED on device (the stale sort stays
       exact, only looser) and the host trips the fallback-to-replicate
       path at the edge — never silently stepping a broken layout.
@@ -287,7 +303,7 @@ def _refresh_init(state: SimState, cfg: SimConfig, sort_t0,
         if sort_t0 is None:
             sort_t0 = jnp.full((), -1.0, state.simt.dtype)
         zero = jnp.zeros((), jnp.int32)
-    spatial = (not worlds) and cfg.cd_shard_mode == "spatial"
+    spatial = (not worlds) and cfg.cd_shard_mode in ("spatial", "tiles")
     n = state.ac.lat.shape[-1]
     newslot = (jnp.arange(n, dtype=jnp.int32) if spatial
                else jnp.zeros((0,), jnp.int32))
@@ -304,12 +320,18 @@ def _refresh_gate(s: SimState, rc: RefreshPack, cfg: SimConfig):
     period = jnp.asarray(float(cfg.asas.sort_every * cfg.asas.dtasas),
                          s.simt.dtype)
     spatial = cfg.cd_shard_mode == "spatial"
+    tiles = cfg.cd_shard_mode == "tiles"
     block = min(cfg.cd_block, 256)
     due = (rc.sort_t < 0) | (s.simt - rc.sort_t >= period)
 
     def fire(args):
         s, rc = args
-        if spatial:
+        if tiles:
+            s2, newslot_r, gbits = asasmod.inscan_tile_refresh(
+                s, cfg.asas, cfg.cd_tile_shape, block=block,
+                budgets=cfg.cd_tile_budgets)
+            newslot = newslot_r[rc.newslot]
+        elif spatial:
             ndev = cfg.cd_mesh.shape[cfg.cd_mesh_axis]
             s2, newslot_r, gbits = asasmod.inscan_spatial_refresh(
                 s, cfg.asas, ndev, block=block,
@@ -696,11 +718,13 @@ def _check_worlds_cfg(cfg: SimConfig):
     mesh decompositions put per-DEVICE structure on the aircraft axis
     (spatial stripes are a property of one world's sorted layout), so
     they compose with the world axis later, not now."""
-    if cfg.cd_mesh is not None or cfg.cd_shard_mode == "spatial":
+    if cfg.cd_mesh is not None \
+            or cfg.cd_shard_mode in ("spatial", "tiles"):
         raise ValueError(
             "world-batched stepping runs single-device per world: "
-            "cd_mesh must be None and cd_shard_mode != 'spatial' "
-            "(pack refuses sharded pieces — see WORLDS docs)")
+            "cd_mesh must be None and cd_shard_mode != "
+            "'spatial'/'tiles' (pack refuses sharded pieces — see "
+            "WORLDS docs)")
 
 
 def stack_worlds(states) -> SimState:

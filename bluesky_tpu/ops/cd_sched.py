@@ -338,10 +338,191 @@ def build_windows(reach, s_cap, wmax, pad_start):
     return st, ln, overflow
 
 
-def _sched_kernel(wl_ref, own_ref, *rest,
-                  block, kk, s_cap, wmax, rpz, hpz, tlookahead, mvpcfg,
-                  same_hemi=False, rpz_m=None, reso="mvp", rstride=1):
+def tile_offsets(tiles, hr=1, hc=1):
+    """Canonical neighbour offsets of the R x C tile mesh.
+
+    Offsets are ``(dr, dc)`` tile steps (edge AND corner neighbours of
+    the ``(2*hr+1) x (2*hc+1)`` block minus self).  Longitude wraps
+    (``dc`` mod C) and latitude does not, so offsets that alias under
+    the wrap are DEDUPED to one canonical ``(dr, dc mod C)`` entry —
+    e.g. a 4x2 mesh has 5 canonical offsets, not 8: (0,1) covers both
+    east and west, and each diagonal pair collapses likewise.  One
+    ppermute pair-set per canonical offset is the whole exchange."""
+    R, C = int(tiles[0]), int(tiles[1])
+    offs, seen = [], set()
+    for dr in range(-hr, hr + 1):
+        if abs(dr) >= R and dr != 0:
+            continue                     # no (src, dst) pair exists
+        for dc in range(-hc, hc + 1):
+            key = (dr, dc % C)
+            if key == (0, 0) or key in seen:
+                continue                 # self (incl. wrap-to-self)
+            seen.add(key)
+            offs.append(key)
+    return tuple(offs)
+
+
+def _offset_pairs(tiles, off):
+    """ppermute (src, dst) pairs for one canonical offset over the
+    flattened row-major (lat, lon) device space.  Longitude wraps,
+    latitude clips (edge tiles simply have no partner and receive the
+    collective's zero fill = invalid columns)."""
+    R, C = int(tiles[0]), int(tiles[1])
+    dr, dcm = off
+    return [(r * C + c, (r + dr) * C + (c + dcm) % C)
+            for r in range(R) for c in range(C) if 0 <= r + dr < R]
+
+
+def tile_wire_blocks(tiles, budgets=None, nb_t=0):
+    """Worst-case RECEIVED halo blocks per device for the canonical
+    offset set: sum of the per-offset budgets (or nb_t each when
+    unpinned).  Diagnostic/bench helper — the actual per-interval
+    wire is the reach-selected subset."""
+    offs = tile_offsets(tiles)
+    if budgets:
+        return int(sum(min(int(b), nb_t) if nb_t else int(b)
+                       for b in budgets))
+    return int(len(offs) * nb_t)
+
+
+def tile_sort_dest(lat, lon, gs, active, thresh_m, block, extra, tiles,
+                   alt=None, vs=None):
+    """Tile-major sort destinations for the 2-D lat x lon decomposition.
+
+    Tile ``t = r*C + c`` owns the contiguous slot range
+    ``[t*S_t, (t+1)*S_t)`` of the padded layout (``S_t = (nb/(R*C)) *
+    block``) — the direct 2-D analogue of the stripe layout's
+    device-contiguous ranges, so the spatial re-bucketing bijection and
+    partner-table remap apply unchanged.  Assignment is
+    count-proportional but GRANULARITY-LIMITED:
+
+    * latitude: the geometric reach-height stripes of
+      ``stripe_sort_dest`` are grouped into R bands by cumulative
+      active count — a stripe never splits across bands;
+    * longitude: fine fixed cells (0.35 deg) within each band are
+      grouped into C chunks by cumulative count — a cell never splits.
+
+    Equal-block tiles therefore hold ~equal aircraft on any smooth
+    density, but one over-dense stripe/cell CAN overflow its tile —
+    that is exactly what the refresh's tile-occupancy guard bit
+    detects (refuse / fall back, never silently spill).  Within a tile
+    aircraft pack contiguously ordered by (stripe, lon); the free
+    padding sits at each tile's tail (empty blocks are skipped exactly
+    by the reachability bound).  Inactive aircraft return the last
+    slot — callers only ever use ACTIVE rows' destinations (inactive
+    rows carry the sentinel via ``dest_sent``)."""
+    R, C = int(tiles[0]), int(tiles[1])
+    D = R * C
+    n = lat.shape[0]
+    nb = -(-n // block) + extra
+    n_tot = nb * block
+    S_t = (nb // D) * block
+    act = active
+    big = jnp.asarray(1e9, lat.dtype)
+    any_act = jnp.any(act)
+    latmin = jnp.where(any_act, jnp.min(jnp.where(act, lat, big)), 0.0)
+    latmax = jnp.where(any_act, jnp.max(jnp.where(act, lat, -big)), 1.0)
+    span = jnp.maximum(latmax - latmin, 1e-6)
+    h = jnp.maximum(jnp.maximum(thresh_m * 1.05 / 110000.0,
+                                span / (extra - 1)), 0.05)
+    s = jnp.clip(jnp.floor((lat - latmin) / h), 0,
+                 extra - 2).astype(jnp.int32)
+    s = jnp.where(act, s, extra - 1)
+    acti = act.astype(jnp.int32)
+
+    # stripe -> band: count-proportional over whole stripes
+    sc = jnp.zeros((extra,), jnp.int32).at[s].add(acti)
+    csum = jnp.cumsum(sc) - sc
+    n_act = jnp.maximum(jnp.sum(acti), 1)
+    band_of = jnp.clip(((csum + sc // 2) * R) // n_act, 0, R - 1)
+    band = band_of[s]
+
+    # (band, cell) -> lon chunk: count-proportional over whole cells
+    ncell = 1024
+    cell = jnp.clip(((lon + 180.0) * (ncell / 360.0)).astype(jnp.int32),
+                    0, ncell - 1)
+    bc = jnp.zeros((R, ncell), jnp.int32).at[band, cell].add(acti)
+    ccsum = jnp.cumsum(bc, axis=1) - bc
+    btot = jnp.maximum(jnp.sum(bc, axis=1), 1)
+    chunk_of = jnp.clip(((ccsum + bc // 2) * C) // btot[:, None],
+                        0, C - 1)
+    tile = band * C + chunk_of[band, cell]
+
+    # pack actives contiguously per tile, ordered (stripe, lon) within
+    qlon = jnp.clip((lon + 180.0) * (2 ** 19 / 360.0),
+                    0, 2 ** 19 - 1).astype(jnp.int32)
+    key = s * jnp.int32(2 ** 19) + qlon
+    tile_a = jnp.where(act, tile, D)
+    order1 = jnp.argsort(key)
+    order = order1[jnp.argsort(tile_a[order1], stable=True)]
+    ta_o = tile_a[order]
+    start = jnp.searchsorted(ta_o, jnp.arange(D + 1, dtype=jnp.int32),
+                             side="left").astype(jnp.int32)
+    rank_o = jnp.arange(n, dtype=jnp.int32) - start[jnp.clip(ta_o, 0, D)]
+    dest_o = jnp.where(ta_o < D,
+                       jnp.clip(ta_o * S_t + rank_o, 0, n_tot - 1),
+                       n_tot - 1)
+    return jnp.zeros((n,), jnp.int32).at[order].set(dest_o)
+
+
+def _tile_select(reach_any, budget, nb_t):
+    """Budget-capped export selection: the (ascending) local block ids
+    of the sender's blocks any receiver row can reach.  Returns
+    ``(sidx [budget] clipped ids, valid [budget])`` — deterministic, so
+    the mesh sender and the single-chip reference agree bit-for-bit."""
+    selkey = jnp.where(reach_any, jnp.arange(nb_t, dtype=jnp.int32),
+                       nb_t)
+    sidx = jnp.sort(selkey)[:budget]
+    valid = sidx < nb_t
+    return jnp.clip(sidx, 0, nb_t - 1), valid
+
+
+def _tile_windows(reach_rows, gkey, nb, s_cap_t, wmax):
+    """Sort the present (own + received) column slabs by global block
+    id and build this tile's segment windows over them — shared
+    VERBATIM by the per-device tiles shard_map body and the single-chip
+    tiles reference, so both visit IDENTICAL column sets (the tiles
+    bit-parity contract).  Overflow rows get a synthetic full-present
+    coverage (disjoint <= wmax segments over all present slabs) instead
+    of the 1-D full-grid fallback: the superset visit is exact (extra
+    tiles compute provably-empty pairs / invalid slabs are inactive),
+    and because both paths take this same construction, even the
+    resume-keep bits cannot diverge.
+
+    ``gkey`` [ncols]: candidate columns' global block ids, invalid
+    entries = ``nb``.  Returns ``(order, gid_tab, wl)``: the slab
+    reorder, the per-slab global-id table (invalid = nb) and the
+    bit-packed windows."""
+    ncols = gkey.shape[0]
+    order = jnp.argsort(gkey)                     # stable
+    gid_tab = gkey[order]
+    vcol = gid_tab < nb
+    reach_h = reach_rows[:, jnp.clip(gid_tab, 0, nb - 1)] & vcol[None, :]
+    st, ln, overflow = build_windows(reach_h, s_cap_t, wmax,
+                                     pad_start=ncols)
+    ist = jnp.arange(s_cap_t, dtype=jnp.int32) * wmax
+    fln = jnp.clip(ncols - ist, 0, wmax)
+    st = jnp.where(overflow[:, None], jnp.minimum(ist, ncols),
+                   jnp.clip(st, 0, ncols))
+    ln = jnp.where(overflow[:, None], fln, ln)
+    return order, gid_tab, (st | (ln << 20)).astype(jnp.int32)
+
+
+def _sched_kernel(wl_ref, *refs, block, kk, s_cap, wmax, rpz, hpz,
+                  tlookahead, mvpcfg, same_hemi=False, rpz_m=None,
+                  reso="mvp", rstride=1, gid_mode=False):
     resume = rpz_m is not None
+    if gid_mode:
+        # tiles mode: the column slabs are the tile's PRESENT set (own +
+        # reach-selected halo imports) ranked by global block id, which
+        # is NOT an affine window of the grid — a second scalar-prefetch
+        # table maps local slab index -> global block id (SMEM scalar
+        # reads, same budget class as the worklist itself).
+        gid_ref, own_ref = refs[0], refs[1]
+        rest = refs[2:]
+    else:
+        gid_ref, own_ref = None, refs[0]
+        rest = refs[1:]
     intr_refs = rest[:s_cap]
     rest = rest[s_cap:]
     if resume:
@@ -406,7 +587,10 @@ def _sched_kernel(wl_ref, own_ref, *rest,
                 def intr(f):
                     return islab_t[:, _IDX[f]:_IDX[f] + 1]
 
-                jb = col0 + base + k                       # GLOBAL block id
+                if gid_mode:
+                    jb = gid_ref[base + k]                 # GLOBAL block id
+                else:
+                    jb = col0 + base + k                   # GLOBAL block id
                 gid_int = jb * block + jax.lax.broadcasted_iota(
                     jnp.int32, (block, 1), 0)
                 act_i = intr("active") > 0.5
@@ -440,7 +624,7 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                          cols_per_prog=4, partners=None, resume_rpz_m=None,
                          tas=None, cas=None, reso="mvp", mesh=None,
                          mesh_axis="ac", shard_mode="replicate",
-                         halo_blocks=0):
+                         halo_blocks=0, tile_shape=None, tile_budgets=()):
     """Sparse-scheduled equivalent of ``cd_pallas.detect_resolve_pallas``.
 
     ``perm`` is the cached ``stripe_sort_dest`` destination table (NOT a
@@ -481,6 +665,30 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
     only switches the back-map to its sentinel-masked form (inactive
     rows carry the sentinel slot in spatial layouts).
 
+    With ``shard_mode='tiles'`` the decomposition generalises to 2-D
+    lat x lon tiles on a ``('lat', 'lon')`` device mesh of shape
+    ``tile_shape = (R, C)``: device (r, c) owns tile ``t = r*C + c``'s
+    contiguous block range of the tile-major layout
+    (``tile_sort_dest``), and the per-interval exchange ships only the
+    reach-SELECTED boundary slabs to the edge+corner neighbours — one
+    ``ppermute`` pair per canonical offset (``tile_offsets``; wrapped
+    lon offsets dedupe) with a per-offset block budget
+    (``tile_budgets``, pinned by the tile refresh at 1.25x measured
+    need), plus the same O(N/block) summary all-gather and scalar
+    psums as the stripe mode.  The halo wire therefore scales with
+    tile PERIMETER instead of stripe width.  Each device's kernel runs
+    over its PRESENT columns (own + imports, ranked by global block
+    id) with a scalar-prefetch gid table lifting pair/partner ids back
+    to global slots; window construction (incl. the synthetic
+    full-present coverage for overflow rows) is shared verbatim with
+    the single-chip ``shard_mode='tiles'`` reference, which makes the
+    mesh results bit-identical to it by construction
+    (tests/test_spatial.py).  The refresh contract
+    (core/asas.refresh_tile_shard) guarantees reachability never
+    escapes the canonical neighbourhood or the budgets until the next
+    refresh — violations refuse / fall back to replicate, never
+    silently miss conflicts.
+
     With ``partners`` ([n_tot, K] int32, SORTED-space ids, -1 empty) the
     kernels also run in-kernel resume-nav (keep evaluation on every
     visited partner pair + the candidate/old merge — reference
@@ -513,10 +721,20 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
     thresh = reach_threshold_m(gs.astype(dtype), active,
                                float(tlookahead), float(rpz))
     if perm is None:
-        perm = stripe_sort_dest(lat.astype(dtype), lon.astype(dtype),
-                                gs.astype(dtype), active, thresh, block,
-                                extra_blocks, alt=alt.astype(dtype),
-                                vs=vs.astype(dtype))
+        if shard_mode == "tiles" and tile_shape:
+            perm = tile_sort_dest(lat.astype(dtype), lon.astype(dtype),
+                                  gs.astype(dtype), active, thresh,
+                                  block, extra_blocks,
+                                  tuple(tile_shape),
+                                  alt=alt.astype(dtype),
+                                  vs=vs.astype(dtype))
+        else:
+            perm = stripe_sort_dest(lat.astype(dtype),
+                                    lon.astype(dtype),
+                                    gs.astype(dtype), active, thresh,
+                                    block, extra_blocks,
+                                    alt=alt.astype(dtype),
+                                    vs=vs.astype(dtype))
     nb = -(-n // block) + extra_blocks
     n_tot = nb * block
 
@@ -562,6 +780,53 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
             f"spatial shard mode: nmax={n} must be divisible by the "
             f"{ndev_sp}-device mesh")
 
+    tiles_on = shard_mode == "tiles"
+    mesh_tiles = False
+    if tiles_on:
+        if not tile_shape or len(tuple(tile_shape)) != 2:
+            raise ValueError(
+                "tiles shard mode needs tile_shape=(R, C) — set "
+                "SimConfig.cd_tile_shape / SHARD TILE RxC")
+        tR, tC = int(tile_shape[0]), int(tile_shape[1])
+        tD = tR * tC
+        if not resume:
+            raise ValueError(
+                "tiles shard mode requires the resume/partner-table "
+                "path (the production sparse backend always passes "
+                "`partners`)")
+        if nb % tD:
+            raise ValueError(
+                f"tiles shard mode: padded block count nb={nb} must "
+                f"divide into {tR}x{tC}={tD} tiles — build the layout "
+                f"with cd_sched.spatial_layout (extra_blocks="
+                f"{extra_blocks})")
+        mshape = dict(mesh.shape) if mesh is not None else {}
+        mesh_tiles = tD > 1 and mshape.get("lat") == tR \
+            and mshape.get("lon") == tC
+        if mesh is not None and not mesh_tiles and tD > 1:
+            raise ValueError(
+                f"tiles shard mode needs a ('lat', 'lon') mesh of "
+                f"shape {tR}x{tC}; got axes {mshape} — build it with "
+                "parallel.sharding.make_tile_mesh")
+        if mesh_tiles and n % tD:
+            raise ValueError(
+                f"tiles shard mode: nmax={n} must be divisible by the "
+                f"{tD}-device tile mesh")
+        offs = tile_offsets((tR, tC))
+        nb_t = nb // tD
+        if tile_budgets:
+            if len(tile_budgets) != len(offs):
+                raise ValueError(
+                    f"tile_budgets must carry one entry per canonical "
+                    f"offset ({len(offs)} for {tR}x{tC}); got "
+                    f"{len(tile_budgets)}")
+            budgets = tuple(max(1, min(int(b), nb_t))
+                            for b in tile_budgets)
+        else:
+            budgets = tuple(nb_t for _ in offs)
+        ncols_t = nb_t + sum(budgets)
+        s_cap_t = max(s_cap, -(-ncols_t // wmax))
+
     def make_fields(padded_cols):
         """Per-slot trig/velocity columns of the padded layout — shared
         verbatim by the single-chip prep and the per-device spatial
@@ -597,7 +862,7 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
     if reso == "swarm":
         backed_neutral.extend([0.0] * cd_pallas._N_SWARM)
 
-    if not spatial:
+    if not spatial and not mesh_tiles:
         padded = dict(zip(cols, scatter_padded(
             [v.astype(dtype) for v in cols.values()], perm, n_tot)))
         fields = make_fields(padded)
@@ -611,23 +876,28 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
             vs=padded["vs"], hpz=float(hpz), min_reach_m=min_reach,
             min_vreach_m=min_vreach)
 
-        # Segment windows + the Wmax-block pad region the sentinel slots
-        # point at (slots are clamped so every DMA stays in bounds);
-        # start and len ride one bit-packed scalar-prefetch array (SMEM
-        # budget, see _sched_kernel).
-        st, ln, overflow = build_windows(reach, s_cap, wmax, pad_start=nb)
-        st = jnp.clip(st, 0, nb)
-        wl = st | (ln << 20)
+        if not tiles_on:
+            # Segment windows + the Wmax-block pad region the sentinel
+            # slots point at (slots are clamped so every DMA stays in
+            # bounds); start and len ride one bit-packed scalar-prefetch
+            # array (SMEM budget, see _sched_kernel).  Tiles mode builds
+            # its windows PER TILE over the present sets instead
+            # (_tile_windows, below).
+            st, ln, overflow = build_windows(reach, s_cap, wmax,
+                                             pad_start=nb)
+            st = jnp.clip(st, 0, nb)
+            wl = st | (ln << 20)
+            reach_f = reach & overflow[:, None]
         packed16 = jnp.concatenate([
-            jnp.concatenate(                               # 13 -> 16 rows
-                [packed,
+            jnp.concatenate(                       # len(_FIELDS) -> _NFP
+                [packed,                           # (zero-width at 16)
                  jnp.zeros((nb, _NFP - len(_FIELDS), block), dtype)],
                 axis=1),
             jnp.zeros((wmax, _NFP, block), dtype)], axis=0)  # DMA pad
-        reach_f = reach & overflow[:, None]
 
     def run_rows(wl_r, own16_r, packedown_r, pold_r, reachf_r, overflow_r,
-                 row0, same_hemi, intr16, intr, rstride=1, col0=0):
+                 row0, same_hemi, intr16, intr, rstride=1, col0=0,
+                 gid_tab=None, fallback=True, s_cap_r=None):
         """Sched kernel + overflow fallback over one row subset.
 
         ``wl_r`` [rows, s_cap+2] carries (start|len) plus the global
@@ -637,21 +907,32 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         ``packedown_r`` are the subset's ownship slabs; ``intr16``/
         ``intr`` are the column slab arrays — the FULL grid (col0 == 0)
         on the single-chip and column-replicated paths, the device's
-        local halo window in the spatial mode."""
+        local halo window in the spatial mode.
+
+        ``gid_tab`` (tiles mode) replaces the affine col0 lift with a
+        per-slab global-block-id table riding a SECOND scalar-prefetch
+        array (column slabs are the present set ranked by gid, not a
+        contiguous window); ``fallback=False`` skips the full-grid
+        overflow cond entirely (tiles overflow rows already carry the
+        synthetic full-present windows, see _tile_windows);
+        ``s_cap_r`` overrides the segment cap (tiles rows straddle up
+        to 9 neighbour tiles, so their run count exceeds the 1-D
+        default)."""
         rows = wl_r.shape[0]
-        own_spec = pl.BlockSpec((1, _NFP, block), lambda i, wl: (i, 0, 0),
+        sc = s_cap if s_cap_r is None else s_cap_r
+        gidm = gid_tab is not None
+        imap_i = lambda i, *pf: (i, 0, 0)
+
+        def imap_w(s):
+            return lambda i, wl, *pf: (wl[i, s] & 0xFFFFF, 0, 0)
+
+        own_spec = pl.BlockSpec((1, _NFP, block), imap_i,
                                 memory_space=pltpu.VMEM)
-        intr_specs = [
-            _element_spec((wmax, _NFP, block),
-                          functools.partial(
-                              lambda i, wl, s=0: (wl[i, s] & 0xFFFFF, 0, 0),
-                              s=s))
-            for s in range(s_cap)]
-        acc_spec = lambda: pl.BlockSpec((1, 1, block),
-                                        lambda i, wl: (i, 0, 0),
+        intr_specs = [_element_spec((wmax, _NFP, block), imap_w(s))
+                      for s in range(sc)]
+        acc_spec = lambda: pl.BlockSpec((1, 1, block), imap_i,
                                         memory_space=pltpu.VMEM)
-        cand_spec = lambda: pl.BlockSpec((1, kk, block),
-                                         lambda i, wl: (i, 0, 0),
+        cand_spec = lambda: pl.BlockSpec((1, kk, block), imap_i,
                                          memory_space=pltpu.VMEM)
         out_shape = [jax.ShapeDtypeStruct((rows, 1, block), dtype)] * 8 + [
             jax.ShapeDtypeStruct((rows, kk, block), dtype),
@@ -666,14 +947,16 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                 jax.ShapeDtypeStruct((rows, 1, block), dtype)
             ] * cd_pallas._N_SWARM
         kern = functools.partial(
-            _sched_kernel, block=block, kk=kk, s_cap=s_cap, wmax=wmax,
+            _sched_kernel, block=block, kk=kk, s_cap=sc, wmax=wmax,
             rpz=float(rpz), hpz=float(hpz), tlookahead=float(tlookahead),
             mvpcfg=mvpcfg, same_hemi=same_hemi, rstride=rstride,
-            rpz_m=float(resume_rpz_m) if resume else None, reso=reso)
-        in_specs = [own_spec] + [intr_specs[s] for s in range(s_cap)]
+            rpz_m=float(resume_rpz_m) if resume else None, reso=reso,
+            gid_mode=gidm)
+        in_specs = [own_spec] + [intr_specs[s] for s in range(sc)]
         out_specs = [acc_spec() for _ in range(8)] \
             + [cand_spec(), cand_spec()]
-        args = [wl_r, own16_r] + [intr16] * s_cap
+        args = [wl_r] + ([gid_tab] if gidm else []) \
+            + [own16_r] + [intr16] * sc
         if resume:
             in_specs.append(cand_spec())               # pold
             args.append(pold_r)
@@ -683,7 +966,7 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
         outs_s = list(pl.pallas_call(
             kern,
             grid_spec=pltpu.PrefetchScalarGridSpec(
-                num_scalar_prefetch=1,
+                num_scalar_prefetch=2 if gidm else 1,
                 grid=(rows,),
                 in_specs=in_specs,
                 out_specs=out_specs,
@@ -691,6 +974,8 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
             out_shape=out_shape,
             interpret=interpret,
         )(*args))
+        if not fallback:
+            return tuple(outs_s)
 
         # Overflow rows (dense geometries): exact full-grid fallback on
         # the row-restricted reachability, merged row-disjointly.
@@ -875,7 +1160,215 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
                 tuple(backed[7:7 + cd_pallas._N_SWARM])
         return rd, partners_new, active_caller
 
-    if mesh is not None and mesh.shape[mesh_axis] > 1:
+    if mesh_tiles:
+        # ------------------------------------------------------------
+        # 2-D tile decomposition: device (r, c) OWNS tile t = r*C + c's
+        # contiguous block range of the tile-major layout — O(N/D)
+        # scatter/trig/reachability/windows/kernel rows per device —
+        # and exchanges only the reach-SELECTED boundary slabs with its
+        # edge+corner neighbours: ONE ppermute pair per canonical
+        # offset (wrapped lon offsets deduped), each budget-capped, so
+        # the halo wire scales with tile PERIMETER instead of stripe
+        # width.  The summary all-gather/psum structure matches the
+        # stripe mode (O(N/block) metadata, zero O(N) column
+        # collectives — asserted in tests/test_hlo_collectives.py).
+        # The tile refresh (core/asas.refresh_tile_shard) guarantees
+        # margin-widened reachability stays inside the canonical
+        # neighbourhood AND the per-offset budgets until the next
+        # refresh, and that each aircraft's caller slot lives on the
+        # device owning its sorted slot — scatter and back-map stay
+        # device-local.
+        # ------------------------------------------------------------
+        from jax.sharding import PartitionSpec as P
+        axes = ("lat", "lon")
+        S_t = nb_t * block
+        cols_f = {k: v.astype(dtype) for k, v in cols.items()}
+        pairs_o = [_offset_pairs((tR, tC), off) for off in offs]
+
+        def body(cols_l, perm_l, pold_l):
+            r_i = jax.lax.axis_index("lat")
+            c_i = jax.lax.axis_index("lon")
+            t = r_i * tC + c_i
+            base = t * jnp.int32(S_t)
+            in_dev = (perm_l >= base) & (perm_l < base + S_t)
+            dest_loc = jnp.where(in_dev, perm_l - base, S_t)
+            padded_l = {
+                k: jnp.zeros((S_t,), dtype).at[dest_loc].set(
+                    v, mode="drop")
+                for k, v in cols_l.items()}
+            fields_l = make_fields(padded_l)
+            packed_l = jnp.stack(
+                [fields_l[k] for k in _FIELDS]).reshape(
+                    len(_FIELDS), nb_t, block).transpose(1, 0, 2)
+            act_l = padded_l["active"] > 0.5
+
+            summ_l = cd_tiled.block_summaries(
+                padded_l["lat"], padded_l["lon"], padded_l["gs"], act_l,
+                nb_t, block, alt=padded_l["alt"], vs=padded_l["vs"])
+            summ_g = {k: jax.lax.all_gather(v, axes, tiled=True)
+                      for k, v in summ_l.items()}
+            reach_rows = cd_tiled.reachability_from_summaries(
+                summ_l, summ_g, float(rpz), float(tlookahead),
+                hpz=float(hpz), min_reach_m=min_reach,
+                min_vreach_m=min_vreach)                   # [nb_t, nb]
+
+            # Per-offset export: ship only the own blocks the RECEIVER
+            # tile's rows can reach.  Sender and the single-chip
+            # reference derive the selection from the SAME gathered
+            # summaries, so the shipped sets agree bit-for-bit; gids
+            # ride a parallel +1-coded int permute (0 = invalid — edge
+            # tiles without a partner receive the collective's zeros).
+            own_gid0 = t * jnp.int32(nb_t)
+            gparts = [own_gid0 + jnp.arange(nb_t, dtype=jnp.int32)]
+            sparts = [packed_l]
+            for off, E, prs in zip(offs, budgets, pairs_o):
+                dr, dcm = off
+                tdst = jnp.clip(r_i + dr, 0, tR - 1) * tC \
+                    + (c_i + dcm) % tC
+                summ_dst = {
+                    k: jax.lax.dynamic_slice(v, (tdst * nb_t,), (nb_t,))
+                    for k, v in summ_g.items()}
+                reach_out = cd_tiled.reachability_from_summaries(
+                    summ_dst, summ_l, float(rpz), float(tlookahead),
+                    hpz=float(hpz), min_reach_m=min_reach,
+                    min_vreach_m=min_vreach)       # [dst rows, own cols]
+                sidx, valid = _tile_select(
+                    jnp.any(reach_out, axis=0), E, nb_t)
+                buf = jnp.where(valid[:, None, None],
+                                packed_l[sidx], 0.0)
+                gidp = jnp.where(valid, own_gid0 + sidx + 1,
+                                 0).astype(jnp.int32)
+                rbuf = jax.lax.ppermute(buf, axes, prs)
+                rgid = jax.lax.ppermute(gidp, axes, prs)
+                gparts.append(jnp.where(rgid > 0, rgid - 1, nb))
+                sparts.append(rbuf)
+
+            gkey = jnp.concatenate(gparts)
+            order, gid_tab, wl_l = _tile_windows(
+                reach_rows, gkey, nb, s_cap_t, wmax)
+            halo13 = jnp.concatenate(sparts, axis=0)[order]
+            halo16 = jnp.concatenate([
+                jnp.concatenate(
+                    [halo13, jnp.zeros(
+                        (ncols_t, _NFP - len(_FIELDS), block), dtype)],
+                    axis=1),
+                jnp.zeros((wmax, _NFP, block), dtype)], axis=0)
+            own16 = jnp.concatenate(
+                [packed_l,
+                 jnp.zeros((nb_t, _NFP - len(_FIELDS), block), dtype)],
+                axis=1)
+            gid_pad = jnp.concatenate(
+                [gid_tab, jnp.full((wmax,), nb, jnp.int32)])
+
+            row0 = t * jnp.int32(nb_t)
+            outs_l = run_rows(
+                row0_col(wl_l, row0, 0), own16, packed_l, pold_l,
+                None, None, row0, False, halo16, halo13,
+                rstride=1, col0=0, gid_tab=gid_pad, fallback=False,
+                s_cap_r=s_cap_t)
+
+            # Back-map to THIS device's caller shard (device-local
+            # gather; sentinel rows read the accumulator identities)
+            (inconf_l, tcpamax_l, sdve_l, sdvn_l, sdvv_l, tsolv_l,
+             ncnt_l, lcnt_l, ctin_l, cidx_l) = outs_l[:10]
+            rows_l = [inconf_l, tcpamax_l, sdve_l, sdvn_l, sdvv_l,
+                      tsolv_l, outs_l[12]]                 # + active
+            if reso == "swarm":
+                rows_l.extend(outs_l[13:13 + cd_pallas._N_SWARM])
+            stacked_l = jnp.stack([o.reshape(S_t) for o in rows_l])
+            gsl = jnp.clip(dest_loc, 0, S_t - 1)
+            backed_l = jnp.where(
+                in_dev[None, :], stacked_l[:, gsl],
+                jnp.asarray(backed_neutral, dtype)[:, None])
+            tt_l = ctin_l.transpose(0, 2, 1).reshape(S_t, kk)[gsl]
+            ti_l = cidx_l.transpose(0, 2, 1).reshape(S_t, kk)[gsl]
+            tt_l = jnp.where(in_dev[:, None], tt_l, cd_pallas._BIG)
+            ti_l = jnp.where(in_dev[:, None], ti_l, jnp.int32(2 ** 30))
+            nconf_l = jax.lax.psum(
+                jnp.sum(ncnt_l.astype(jnp.int32), dtype=jnp.int32),
+                axes)
+            nlos_l = jax.lax.psum(
+                jnp.sum(lcnt_l.astype(jnp.int32), dtype=jnp.int32),
+                axes)
+            return backed_l, tt_l, ti_l, outs_l[11], nconf_l, nlos_l
+
+        col_specs = {k: P(axes) for k in cols_f}
+        backed, topk_tin, ti_raw, pmerged, nconf, nlos = \
+            cd_pallas.shard_map_compat(
+                body, mesh,
+                (col_specs, P(axes), P(axes)),
+                (P(None, axes), P(axes), P(axes),
+                 P(axes), P(), P()))(cols_f, perm, pold)
+
+        topk_idx = jnp.where(
+            (topk_tin < cd_pallas._BIG) & (ti_raw < n_tot), ti_raw, -1)
+        rd = RowConflictData(
+            inconf=backed[0] > 0.5,
+            tcpamax=backed[1],
+            sum_dve=backed[2], sum_dvn=backed[3], sum_dvv=backed[4],
+            tsolv=backed[5],
+            nconf=nconf, nlos=nlos,
+            topk_idx=topk_idx, topk_tin=topk_tin)
+        partners_new = pmerged.transpose(0, 2, 1).reshape(n_tot, kk)
+        active_caller = backed[6] > 0.5
+        if reso == "swarm":
+            return rd, partners_new, active_caller, \
+                tuple(backed[7:7 + cd_pallas._N_SWARM])
+        return rd, partners_new, active_caller
+
+    if tiles_on:
+        # Single-chip tiles reference: the SAME per-tile present-set
+        # construction and windows as the mesh body (shared helpers),
+        # run as one kernel call per tile over the global slab array —
+        # a parity/debug path, not a perf path (it re-gathers each
+        # tile's imports from the replicated grid).  Bit-parity with
+        # the mesh is by construction: identical selection, identical
+        # present ranking, identical windows, identical gid lift.
+        chunks = []
+        for t in range(tD):
+            r0t, c0t = divmod(t, tC)
+            rr = reach[t * nb_t:(t + 1) * nb_t]            # [nb_t, nb]
+            reach_any = jnp.any(rr, axis=0)
+            gparts = [t * nb_t + jnp.arange(nb_t, dtype=jnp.int32)]
+            sparts = [packed[t * nb_t:(t + 1) * nb_t]]
+            for off, E in zip(offs, budgets):
+                dr, dcm = off
+                ru, cu = r0t - dr, (c0t - dcm) % tC
+                if 0 <= ru < tR:
+                    u = ru * tC + cu
+                    sidx, valid = _tile_select(
+                        reach_any[u * nb_t:(u + 1) * nb_t], E, nb_t)
+                    gparts.append(jnp.where(valid, u * nb_t + sidx, nb))
+                    sparts.append(jnp.where(valid[:, None, None],
+                                            packed[u * nb_t + sidx],
+                                            0.0))
+                else:
+                    gparts.append(jnp.full((E,), nb, jnp.int32))
+                    sparts.append(jnp.zeros((E, len(_FIELDS), block),
+                                            dtype))
+            gkey = jnp.concatenate(gparts)
+            order, gid_tab, wl_t = _tile_windows(rr, gkey, nb,
+                                                 s_cap_t, wmax)
+            halo13_t = jnp.concatenate(sparts, axis=0)[order]
+            halo16_t = jnp.concatenate([
+                jnp.concatenate(
+                    [halo13_t, jnp.zeros(
+                        (ncols_t, _NFP - len(_FIELDS), block), dtype)],
+                    axis=1),
+                jnp.zeros((wmax, _NFP, block), dtype)], axis=0)
+            gid_pad = jnp.concatenate(
+                [gid_tab, jnp.full((wmax,), nb, jnp.int32)])
+            chunks.append(run_rows(
+                row0_col(wl_t, t * nb_t, 0),
+                packed16[t * nb_t:(t + 1) * nb_t],
+                packed[t * nb_t:(t + 1) * nb_t],
+                None if pold is None else pold[t * nb_t:(t + 1) * nb_t],
+                None, None, t * nb_t, False, halo16_t, halo13_t,
+                rstride=1, col0=0, gid_tab=gid_pad, fallback=False,
+                s_cap_r=s_cap_t))
+        outs = [parts[0] if tD == 1 else jnp.concatenate(parts)
+                for parts in zip(*chunks)]
+    elif mesh is not None and mesh.shape[mesh_axis] > 1:
         # shard_map over the row blocks: each device schedules and
         # sweeps its own rows against the replicated column slabs (the
         # all-gather rides ICI); row/partner ids stay global via the
@@ -966,9 +1459,9 @@ def detect_resolve_sched(lat, lon, trk, gs, alt, vs, gseast, gsnorth,
     if reso == "swarm":
         rows.extend(outs[sw_start:sw_start + cd_pallas._N_SWARM])
     stacked = jnp.stack([o.reshape(n_tot) for o in rows])
-    if shard_mode == "spatial":
-        # A spatial-mode refresh stores the SENTINEL slot n_tot for
-        # inactive rows (they are dropped from the padded scatter);
+    if shard_mode in ("spatial", "tiles"):
+        # A spatial/tiles-mode refresh stores the SENTINEL slot n_tot
+        # for inactive rows (they are dropped from the padded scatter);
         # mask their gathers to the accumulator identities so this
         # single-chip reference stays bit-identical to the mesh
         # decomposition's masked device-local back-map.

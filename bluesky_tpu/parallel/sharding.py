@@ -20,7 +20,7 @@ and the same code runs single-chip when the mesh has one device.
 A second mesh axis ('ens') replicates whole scenarios for Monte-Carlo
 ensembles (BASELINE config #4): see ``ensemble_step``.
 
-Two decompositions for the sparse backend's shard_map kernels
+Three decompositions for the sparse backend's shard_map kernels
 (SimConfig.cd_shard_mode / the SHARD stack command):
 
 * ``replicate`` — interleaved row blocks per device against the
@@ -33,7 +33,16 @@ Two decompositions for the sparse backend's shard_map kernels
   O(N/D) device-local and only boundary slabs + per-block summaries
   ride ICI.  Bit-identical to the single-chip sparse schedule
   (tests/test_spatial.py) with zero O(N) column all-gathers on the
-  compiled HLO (tests/test_hlo_collectives.py).
+  compiled HLO (tests/test_hlo_collectives.py);
+* ``tiles`` — 2-D lat x lon tiles on a ``('lat', 'lon')`` device mesh
+  (``make_tile_mesh`` + ``prepare_tiles``): stripes cut only latitude,
+  so on a global scene a D-device stripe still spans 360 degrees of
+  longitude and its halo slab scales with the full stripe WIDTH; tiles
+  cut both axes, halo wire scales with the tile PERIMETER (edge + 4
+  corner slabs, multi-hop ppermute along both mesh axes), and the
+  per-tile occupancy bound follows the 2-D population split.  Same
+  refusal contract: the tile refresh validates corner-halo coverage
+  per re-bucketing and REFUSES geometries it cannot cover.
 """
 import threading
 import time
@@ -74,14 +83,46 @@ def make_mesh(n_devices=None, devices=None):
     return Mesh(np.asarray(devices), ("ac",))
 
 
+def make_tile_mesh(tiles, devices=None):
+    """2-D ``('lat', 'lon')`` mesh for the tiles decomposition: device
+    (r, c) owns tile ``t = r*C + c`` of the R x C lat x lon grid.  The
+    flattened row-major device order matches the tile-major sorted
+    layout of ``ops/cd_sched.tile_sort_dest``, so ``P(('lat', 'lon'))``
+    on the aircraft axis IS the tile ownership map."""
+    tR, tC = int(tiles[0]), int(tiles[1])
+    if tR < 1 or tC < 1:
+        raise ValueError(f"tile mesh shape must be positive, got "
+                         f"{tR}x{tC}")
+    devices = devices if devices is not None else jax.devices()
+    if len(devices) < tR * tC:
+        raise ValueError(f"tile mesh {tR}x{tC} needs {tR * tC} devices, "
+                         f"have {len(devices)}")
+    return Mesh(np.asarray(devices[:tR * tC]).reshape(tR, tC),
+                ("lat", "lon"))
+
+
+def _ac_axes(mesh: Mesh):
+    """The mesh axis (or axis tuple) the aircraft dimension shards on:
+    'ac' on the 1-D mesh, the flattened ('lat', 'lon') product on a
+    tile mesh."""
+    if "ac" in mesh.shape:
+        return "ac"
+    if "lat" in mesh.shape and "lon" in mesh.shape:
+        return ("lat", "lon")
+    raise ValueError(f"mesh has neither an 'ac' nor a ('lat', 'lon') "
+                     f"axis set: {dict(mesh.shape)}")
+
+
 def state_shardings(state: SimState, mesh: Mesh):
     """NamedSharding pytree for a SimState: rank>=1 arrays with a leading
-    aircraft axis shard on 'ac'; scalars and the PRNG key replicate."""
+    aircraft axis shard on 'ac' (or the flattened ('lat', 'lon') tile
+    axes); scalars and the PRNG key replicate."""
     nmax = state.nmax
+    ax = _ac_axes(mesh)
 
     def spec(leaf):
         if hasattr(leaf, "ndim") and leaf.ndim >= 1 and leaf.shape[0] == nmax:
-            return NamedSharding(mesh, P("ac", *([None] * (leaf.ndim - 1))))
+            return NamedSharding(mesh, P(ax, *([None] * (leaf.ndim - 1))))
         return NamedSharding(mesh, P())
 
     return jax.tree.map(spec, state)
@@ -100,7 +141,7 @@ def spatial_state_shardings(state: SimState, mesh: Mesh):
     shard_map boundary would reshard O(N*K) every interval."""
     sh = state_shardings(state, mesh)
     return sh.replace(asas=sh.asas.replace(
-        partners_s=NamedSharding(mesh, P("ac", None))))
+        partners_s=NamedSharding(mesh, P(_ac_axes(mesh), None))))
 
 
 def prepare_spatial(state: SimState, mesh: Mesh, acfg, block: int = 256,
@@ -144,8 +185,50 @@ def prepare_spatial(state: SimState, mesh: Mesh, acfg, block: int = 256,
     return state, newslot, info
 
 
+def prepare_tiles(state: SimState, mesh: Mesh, acfg, tiles=None,
+                  block: int = 256, budgets=(), put: bool = True):
+    """Enter the 2-D tiles decomposition: size the sorted-space partner
+    table to the device-divisible padded layout of the R*C-device tile
+    grid, run the tile refresh (tile-major sort + caller-slot
+    re-bucketing + corner-halo coverage check, auto-pinning the
+    per-offset halo slab budgets at 1.25x the measured need when
+    ``budgets`` is empty), and place the state on the mesh.
+
+    ``tiles`` defaults to the mesh's own ('lat', 'lon') shape.  Returns
+    ``(state, newslot, info)`` like ``prepare_spatial``; pin
+    ``info['budgets']`` into ``SimConfig.cd_tile_budgets`` (and
+    ``info['tile_shape']`` into ``cd_tile_shape``) so the compiled
+    interval and every later refresh validate the SAME static window.
+    """
+    import jax.numpy as jnp
+    from ..core import asas as asasmod
+    if tiles is None:
+        try:
+            tiles = (mesh.shape["lat"], mesh.shape["lon"])
+        except KeyError:
+            raise ValueError(
+                "prepare_tiles needs a ('lat', 'lon') mesh (build it "
+                "with make_tile_mesh) or an explicit tiles=(R, C)")
+    tR, tC = int(tiles[0]), int(tiles[1])
+    ndev = tR * tC
+    n = state.nmax
+    if n % ndev:
+        raise ValueError(f"tiles mode: nmax={n} must divide into the "
+                         f"{tR}x{tC}={ndev}-tile grid")
+    n_tot = asasmod.spatial_table_size(n, block, ndev)
+    kk = state.asas.partners_s.shape[1]
+    state = state.replace(asas=state.asas.replace(
+        partners_s=jnp.full((n_tot, kk), -1, jnp.int32)))
+    state, newslot, info = asasmod.refresh_tile_shard(
+        state, acfg, (tR, tC), block=block, budgets=tuple(budgets))
+    if put:
+        state = jax.tree.map(lambda x, s: jax.device_put(x, s), state,
+                             spatial_state_shardings(state, mesh))
+    return state, newslot, info
+
+
 def unprepare_spatial(state: SimState):
-    """Leave spatial mode: restore the default-size sorted tables
+    """Leave spatial/tiles mode: restore the default-size sorted tables
     (hysteresis resets, like entering — conservative either way).
     Caller slots keep their last bucketing (valid, just no longer
     maintained)."""
@@ -177,20 +260,26 @@ def sharded_step_fn(mesh: Mesh, cfg: SimConfig, nsteps: int = 1):
     ``sort_t0`` call argument (None = cold: sort_t = -1, so the first
     due step refreshes).
     """
-    if cfg.cd_backend in ("pallas", "sparse") and cfg.cd_mesh is None \
-            and "ac" in mesh.shape:
-        cfg = cfg._replace(cd_mesh=mesh, cd_mesh_axis="ac")
+    if cfg.cd_backend in ("pallas", "sparse") and cfg.cd_mesh is None:
+        if "ac" in mesh.shape:
+            cfg = cfg._replace(cd_mesh=mesh, cd_mesh_axis="ac")
+        elif "lat" in mesh.shape and "lon" in mesh.shape:
+            # tile mesh: the shard_map body splits over both axes; the
+            # 1-D mesh_axis name is unused on that path
+            cfg = cfg._replace(cd_mesh=mesh)
 
     def run(state, sort_t0=None):
         from ..core.step import _scan_steps
-        out, _, stats, refresh = _scan_steps(state, cfg, nsteps,
-                                             checked=False,
-                                             sort_t0=sort_t0)
+        out, _, stats, refresh, fp = _scan_steps(state, cfg, nsteps,
+                                                 checked=False,
+                                                 sort_t0=sort_t0)
         ret = (out,)
         if stats is not None:
             ret = ret + (stats,)
         if refresh is not None:
             ret = ret + (refresh,)
+        if fp is not None:
+            ret = ret + (fp,)
         return ret[0] if len(ret) == 1 else ret
 
     return jax.jit(run, donate_argnums=0)

@@ -120,14 +120,21 @@ shard_mode = "off"                # "off" | "replicate" (row-interleaved
                                   # kernels vs replicated O(N) columns) |
                                   # "spatial" (device-owned latitude
                                   # stripes + halo exchange; sparse
-                                  # backend only).  SHARD stack command
-                                  # switches at runtime.
+                                  # backend only) | "tiles" (2-D lat x
+                                  # lon tiles + corner-halo exchange;
+                                  # sparse backend only).  SHARD stack
+                                  # command switches at runtime.
 shard_devices = 0                 # mesh size (0 = every visible device)
 shard_halo_blocks = 0             # spatial halo width in 256-slot blocks
                                   # per side (0 = one full neighbour
                                   # device; validated against the exact
                                   # reach bound + drift margin at every
                                   # refresh)
+shard_tile_shape = ""             # tiles mode: "RxC" lat x lon grid
+                                  # ("" = near-square factorization of
+                                  # the device count, e.g. 8 -> "4x2");
+                                  # per-offset halo slab budgets are
+                                  # auto-pinned by the tile refresh
 
 # ----- mesh-epoch recovery (docs/FAULT_TOLERANCE.md §mesh epochs):
 # losing a device group ends the mesh epoch, not the run — survivors

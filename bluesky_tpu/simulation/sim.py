@@ -446,7 +446,7 @@ class Simulation:
         # same host edge, so no chunk ever steps a blind aircraft.
         self.traf.create_hooks.append(
             lambda slots: self._invalidate_sort()
-            if self.shard_mode == "spatial" else None)
+            if self.shard_mode in ("spatial", "tiles") else None)
         self._shard_fallback = False
         # Mesh-epoch recovery (docs/FAULT_TOLERANCE.md, ISSUE-10): a
         # sharded run is a sequence of mesh EPOCHS — (device set, shard
@@ -471,24 +471,34 @@ class Simulation:
                                      "mesh_heartbeat_timeout", 10.0)))
         # Multi-chip decomposition (docs/PERF_ANALYSIS.md §multi-chip):
         # 'off' | 'replicate' (interleaved rows vs replicated columns) |
-        # 'spatial' (device-owned latitude stripes + halo exchange).
+        # 'spatial' (device-owned latitude stripes + halo exchange) |
+        # 'tiles' (2-D lat x lon tiles + corner-halo exchange).
         # SHARD stack command at runtime; settings.shard_mode at start.
         self.shard_mode = "off"
         self.shard_mesh = None
         self.shard_stats = {}
         from .. import settings as _shard_settings
         _sm = str(getattr(_shard_settings, "shard_mode", "off")).lower()
-        if _sm in ("replicate", "spatial"):
+        if _sm in ("replicate", "spatial", "tiles"):
             try:
-                if _sm == "spatial" and self.cfg.cd_backend != "sparse":
-                    # a settings-driven spatial deployment implies the
-                    # sparse backend (stripes are its schedule)
+                if _sm in ("spatial", "tiles") \
+                        and self.cfg.cd_backend != "sparse":
+                    # a settings-driven spatial/tiles deployment implies
+                    # the sparse backend (stripes/tiles are its schedule)
                     self.cfg = self.cfg._replace(cd_backend="sparse",
                                                  cd_block=256)
+                _tiles = None
+                if _sm == "tiles":
+                    _ts = str(getattr(_shard_settings,
+                                      "shard_tile_shape", "") or "")
+                    if "x" in _ts.lower():
+                        r, c = _ts.lower().split("x", 1)
+                        _tiles = (int(r), int(c))
                 self.set_shard(
                     _sm, int(getattr(_shard_settings, "shard_devices", 0)),
                     halo_blocks=int(getattr(_shard_settings,
-                                            "shard_halo_blocks", 0)))
+                                            "shard_halo_blocks", 0)),
+                    tiles=_tiles)
             except Exception as e:  # noqa: BLE001 — a bad knob must not
                 #                     kill the sim at construction
                 self.scr.echo(f"shard_mode={_sm} not enabled: {e}")
@@ -683,13 +693,31 @@ class Simulation:
         return True
 
     # -------------------------------------------------------------- sharding
+    @staticmethod
+    def _default_tile_shape(ndev: int):
+        """Near-square R x C factorization of ``ndev`` with R >= C
+        (more latitude bands than longitude buckets — traffic spreads
+        wider in latitude on continental scenes): 8 -> 4x2, 4 -> 2x2,
+        6 -> 3x2; a prime falls back to ndev x 1 (degenerate stripes)."""
+        ndev = int(ndev)
+        c = int(np.sqrt(ndev))
+        while c > 1 and ndev % c:
+            c -= 1
+        return (ndev // max(c, 1), max(c, 1))
+
+    def _shard_ndev(self, default=0):
+        """Device count of the bound shard mesh (works for both the
+        1-D 'ac' mesh and the 2-D ('lat', 'lon') tile mesh)."""
+        return int(self.shard_mesh.devices.size) if self.shard_mesh \
+            else int(default)
+
     def set_shard(self, mode: str, ndev: int = 0, halo_blocks: int = 0,
-                  devices=None):
+                  devices=None, tiles=None):
         """Select the multi-chip mode: ``off`` | ``replicate`` |
-        ``spatial`` over the first ``ndev`` devices (0 = all).
-        ``devices`` overrides the device list — the mesh-epoch recovery
-        path passes the SURVIVORS of a lost group so the re-formed mesh
-        excludes the dead devices.
+        ``spatial`` | ``tiles`` over the first ``ndev`` devices
+        (0 = all).  ``devices`` overrides the device list — the
+        mesh-epoch recovery path passes the SURVIVORS of a lost group
+        so the re-formed mesh excludes the dead devices.
 
         ``replicate``: the round-4 scheme — state sharded on the
         aircraft axis, sparse/pallas kernels row-split with replicated
@@ -697,29 +725,36 @@ class Simulation:
         halo exchange (sparse backend only) — aircraft are re-bucketed
         into the owning device's caller shard at every sort refresh,
         O(N/D) schedule/sort per device, O(halo) wire per interval.
-        Switching modes resets engagement hysteresis (conservative:
-        pairs re-detect next interval).
+        ``tiles``: 2-D lat x lon tiles on a ('lat', 'lon') mesh
+        (``tiles=(R, C)``, default a near-square factorization of
+        ndev): halo wire scales with the tile PERIMETER (edge + corner
+        slabs) instead of the stripe width.  Switching modes resets
+        engagement hysteresis (conservative: pairs re-detect next
+        interval).
         """
         import jax as _jax
         from ..parallel import sharding as shd
         mode = str(mode).lower()
-        if mode not in ("off", "replicate", "spatial"):
-            raise ValueError(f"SHARD {mode}: off/replicate/spatial")
+        if mode not in ("off", "replicate", "spatial", "tiles"):
+            raise ValueError(f"SHARD {mode}: off/replicate/spatial/tiles")
         self.drain_pipeline()
         self.traf.flush()
-        if mode == "spatial" and self.cfg.cd_backend != "sparse":
+        if mode in ("spatial", "tiles") and self.cfg.cd_backend != "sparse":
             raise ValueError(
-                "SHARD SPATIAL needs the sparse backend (latitude "
-                "stripes are a property of the stripe-sorted schedule) "
+                f"SHARD {mode.upper()} needs the sparse backend "
+                "(stripes/tiles are a property of the sorted schedule) "
                 "— CDMETHOD SPARSE first")
         # leave the previous mode's table layout
-        if self.shard_mode == "spatial" and mode != "spatial":
+        if self.shard_mode in ("spatial", "tiles") \
+                and mode not in ("spatial", "tiles"):
             self.traf.state = shd.unprepare_spatial(self.traf.state)
         if mode == "off":
             self.shard_mode, self.shard_mesh = "off", None
             self.mesh_guard.set_mesh(None)
             self.cfg = self.cfg._replace(cd_mesh=None,
-                                         cd_shard_mode="replicate")
+                                         cd_shard_mode="replicate",
+                                         cd_tile_shape=(),
+                                         cd_tile_budgets=())
             self._invalidate_sort()
             return True
         devs = list(devices) if devices is not None else _jax.devices()
@@ -727,8 +762,34 @@ class Simulation:
         if ndev > len(devs):
             raise ValueError(f"SHARD: {ndev} devices requested, "
                              f"{len(devs)} available")
-        mesh = shd.make_mesh(ndev, devices=devs)
-        if mode == "spatial":
+        if mode == "tiles":
+            if tiles is None:
+                cur = tuple(self.cfg.cd_tile_shape)
+                tiles = cur if len(cur) == 2 \
+                    and cur[0] * cur[1] == ndev \
+                    else self._default_tile_shape(ndev)
+            tiles = (int(tiles[0]), int(tiles[1]))
+            if tiles[0] * tiles[1] != ndev:
+                raise ValueError(
+                    f"SHARD TILE {tiles[0]}x{tiles[1]} needs "
+                    f"{tiles[0] * tiles[1]} devices, asked for {ndev}")
+            mesh = shd.make_tile_mesh(tiles, devices=devs)
+        else:
+            mesh = shd.make_mesh(ndev, devices=devs)
+        tile_budgets = ()
+        if mode == "tiles":
+            state, newslot, info = shd.prepare_tiles(
+                self.traf.state, mesh, self.cfg.asas, tiles=tiles,
+                block=min(self.cfg.cd_block, 256))
+            tile_budgets = tuple(info["budgets"])
+            self.traf.state = state
+            self.traf.apply_slot_permutation(newslot)
+            self.shard_stats = info
+            self._sort_simt = self.simt
+            self._sort_backend = "sparse"
+            self._sort_t_dev = None     # host value is the fresh truth
+            self._last_edge = None      # slots moved: ACDATA cache stale
+        elif mode == "spatial":
             state, newslot, info = shd.prepare_spatial(
                 self.traf.state, mesh, self.cfg.asas,
                 block=min(self.cfg.cd_block, 256),
@@ -753,33 +814,45 @@ class Simulation:
             halo_blocks = self.shard_stats["halo_blocks"]
         self.cfg = self.cfg._replace(
             cd_mesh=mesh, cd_mesh_axis="ac",
-            cd_shard_mode="spatial" if mode == "spatial" else "replicate",
-            cd_halo_blocks=halo_blocks)
+            cd_shard_mode=mode if mode in ("spatial", "tiles")
+            else "replicate",
+            cd_halo_blocks=halo_blocks,
+            # pin the (auto-sized) tile budgets the same way
+            cd_tile_shape=tiles if mode == "tiles" else (),
+            cd_tile_budgets=tile_budgets)
         return True
 
     def _spatial_refresh(self, state):
-        """Spatial-mode chunk-edge sort refresh: stripe re-sort +
-        caller-slot re-bucketing + halo check (one jitted program), the
-        host id/route remap, and stat capture for SHARD readback.
-        Unlike the plain refresh this must sync the device (the
-        occupancy/halo guards read scalars) — paid once per
+        """Spatial/tiles-mode chunk-edge sort refresh: stripe (or 2-D
+        tile) re-sort + caller-slot re-bucketing + halo check (one
+        jitted program), the host id/route remap, and stat capture for
+        SHARD readback.  Unlike the plain refresh this must sync the
+        device (the occupancy/halo guards read scalars) — paid once per
         ``sort_every`` intervals."""
-        from ..core.asas import refresh_spatial_shard
+        from ..core.asas import refresh_spatial_shard, refresh_tile_shard
         _t0 = time.perf_counter()
         try:
-            state, newslot, info = refresh_spatial_shard(
-                state, self.cfg.asas, self.shard_mesh.shape["ac"],
-                block=min(self.cfg.cd_block, 256),
-                halo_blocks=self.cfg.cd_halo_blocks)
+            if self.shard_mode == "tiles":
+                state, newslot, info = refresh_tile_shard(
+                    state, self.cfg.asas, self.cfg.cd_tile_shape,
+                    block=min(self.cfg.cd_block, 256),
+                    budgets=self.cfg.cd_tile_budgets)
+            else:
+                state, newslot, info = refresh_spatial_shard(
+                    state, self.cfg.asas, self.shard_mesh.shape["ac"],
+                    block=min(self.cfg.cd_block, 256),
+                    halo_blocks=self.cfg.cd_halo_blocks)
             self._mesh_refresh_ms = (time.perf_counter() - _t0) * 1e3
         except RuntimeError as e:
-            # The geometry broke the spatial contract (stripe occupancy
-            # past a shard's capacity, or reach past the halo window).
-            # Running on with a stale bucketing loses the drift-margin
-            # guarantee, so schedule a fallback to the column-replicated
-            # mode at the next step() boundary (a safe sync point) and
-            # step this one chunk on the still-margin-covered old sort.
-            self.scr.echo(f"SHARD SPATIAL contract violated: {e}")
+            # The geometry broke the decomposition contract (stripe/tile
+            # occupancy past a shard's capacity, or reach past the
+            # halo window / pinned slab budgets).  Running on with a
+            # stale bucketing loses the drift-margin guarantee, so
+            # schedule a fallback at the next step() boundary (a safe
+            # sync point: tiles -> spatial -> replicate) and step this
+            # one chunk on the still-margin-covered old sort.
+            self.scr.echo(f"SHARD {self.shard_mode.upper()} contract "
+                          f"violated: {e}")
             self._shard_fallback = True
             return state
         self.traf.apply_slot_permutation(newslot)
@@ -798,7 +871,8 @@ class Simulation:
         else the on-disk autosave (checksum-verified, shard header
         checked before unpickling); tear the mesh down; restore; re-form
         a smaller mesh from the survivors, degrading
-        spatial -> replicate -> single-chip until one layout holds; then
+        tiles -> spatial -> replicate -> single-chip until one layout
+        holds; then
         record the ``resharded`` trip, bump the epoch and queue a
         MESHLOST notice for the owning node.  Restoring onto a different
         D forces the full re-sort/re-bucket + conservative halo
@@ -807,7 +881,7 @@ class Simulation:
         from . import snapshot as snap
         old_epoch = self.mesh_epoch
         old_mode = self.shard_mode
-        old_nd = self.shard_mesh.shape["ac"] if self.shard_mesh else 0
+        old_nd = self._shard_ndev()
         lost = list(getattr(err, "lost_groups", ()))
         survivors = list(getattr(err, "survivors", ()) or [])
         # the in-flight chunk rode the dead mesh: its edge is void
@@ -867,8 +941,12 @@ class Simulation:
         nd = len(survivors)
         new_mode = "off"
         if nd >= 1:
-            chain = ["replicate"] if old_mode == "replicate" \
-                else [old_mode, "replicate"]
+            if old_mode == "tiles":
+                chain = ["tiles", "spatial", "replicate"]
+            elif old_mode == "replicate":
+                chain = ["replicate"]
+            else:
+                chain = [old_mode, "replicate"]
             for m in chain:
                 try:
                     self.set_shard(m, nd, devices=survivors)
@@ -877,7 +955,7 @@ class Simulation:
                 except (ValueError, RuntimeError) as e:
                     self.scr.echo(f"mesh recovery: SHARD "
                                   f"{m.upper()} {nd} failed ({e})")
-        nd_now = self.shard_mesh.shape["ac"] if self.shard_mesh else 1
+        nd_now = self._shard_ndev(default=1)
         self.mesh_epoch = old_epoch + 1
         self.mesh_guard.epoch = self.mesh_epoch
         self.mesh_degraded = (new_mode != old_mode) or (nd_now < old_nd)
@@ -907,12 +985,17 @@ class Simulation:
     def mesh_health(self):
         """The HEALTH ``mesh`` section: epoch, device count, shard
         mode, last shard-refresh wall ms, degradation state."""
-        nd = self.shard_mesh.shape["ac"] if self.shard_mesh else 0
-        return dict(epoch=int(self.mesh_epoch), devices=int(nd),
-                    mode=str(self.shard_mode),
-                    last_refresh_ms=round(float(self._mesh_refresh_ms),
-                                          3),
-                    degraded=bool(self.mesh_degraded))
+        d = dict(epoch=int(self.mesh_epoch),
+                 devices=self._shard_ndev(),
+                 mode=str(self.shard_mode),
+                 last_refresh_ms=round(float(self._mesh_refresh_ms),
+                                       3),
+                 degraded=bool(self.mesh_degraded))
+        if self.shard_mode == "tiles":
+            ts = tuple(self.cfg.cd_tile_shape)
+            d["tiles"] = f"{ts[0]}x{ts[1]}" if len(ts) == 2 else ""
+            d["tile_budgets"] = list(self.cfg.cd_tile_budgets)
+        return d
 
     def scan_health(self):
         """The HEALTH ``sim`` section: in-scan telemetry enablement plus
@@ -1079,9 +1162,11 @@ class Simulation:
             if guard & 1:
                 why.append("stripe occupancy overflow")
             if guard & 2:
-                why.append("halo coverage violated")
-            self.scr.echo("SHARD SPATIAL contract violated in-scan: "
-                          + ", ".join(why)
+                why.append("halo coverage/slab budget violated")
+            if guard & 4:
+                why.append("tile occupancy overflow")
+            self.scr.echo(f"SHARD {self.shard_mode.upper()} contract "
+                          "violated in-scan: " + ", ".join(why)
                           + " (refresh skipped; falling back)")
             self._shard_fallback = True
 
@@ -1288,10 +1373,24 @@ class Simulation:
         stacked device program."""
         if self._shard_fallback:
             self._shard_fallback = False
-            nd = self.shard_mesh.shape["ac"] if self.shard_mesh else 0
-            self.scr.echo("SHARD: falling back to REPLICATE "
-                          f"({nd} devices)")
-            self.set_shard("replicate", nd)
+            nd = self._shard_ndev()
+            if self.shard_mode == "tiles":
+                # degrade one rung at a time: stripes keep the O(N/D)
+                # schedule if the 1-D contract still holds; only then
+                # the column-replicated floor
+                try:
+                    self.scr.echo("SHARD: falling back to SPATIAL "
+                                  f"({nd} devices)")
+                    self.set_shard("spatial", nd)
+                except (ValueError, RuntimeError) as e:
+                    self.scr.echo(f"SHARD: SPATIAL fallback failed "
+                                  f"({e}); falling back to REPLICATE "
+                                  f"({nd} devices)")
+                    self.set_shard("replicate", nd)
+            else:
+                self.scr.echo("SHARD: falling back to REPLICATE "
+                              f"({nd} devices)")
+                self.set_shard("replicate", nd)
 
         # External TCP/telnet command lines (tools/network.py bridge)
         if self.telnet is not None:
@@ -1539,7 +1638,7 @@ class Simulation:
             halo_s = (time.perf_counter() - t_h0) if win else 0.0
             from ..core.step import run_steps_edge, run_steps_edge_keep
             runner = run_steps_edge_keep if keep else run_steps_edge
-            nd = self.shard_mesh.shape["ac"] if self.shard_mesh else 1
+            nd = self._shard_ndev(default=1)
             dp.note_dispatch(
                 ("edge_keep" if keep else "edge")
                 + ("+checked" if self.guard.enabled else ""),
@@ -1612,7 +1711,7 @@ class Simulation:
                                         backend=self.cfg.cd_backend,
                                         shard=self.shard_mode,
                                         world=self.world_tag):
-                    if self.shard_mode == "spatial":
+                    if self.shard_mode in ("spatial", "tiles"):
                         state = self._spatial_refresh(state)
                     else:
                         from ..core.asas import impl_for_backend, \

@@ -35,8 +35,9 @@ a stale tmp file, never a torn file under the final name.  ``load``
 verifies the digest before unpickling: a bit-flipped blob that would
 still unpickle (failure class #2, torn write / silent corruption) is
 rejected instead of restored.  The v4 header line carries the CAPTURING
-shard layout (mode, device count D, halo blocks) in plain JSON, so a
-mesh-epoch restore onto a different device count is detected from the
+shard layout (mode, device count D, halo blocks, and in tiles mode the
+R x C tile shape + pinned slab budgets) in plain JSON, so a mesh-epoch
+restore onto a different device count or tile grid is detected from the
 header (``peek_shard``) BEFORE the multi-hundred-MB payload is
 unpickled.  v3 files (digest, no shard line) and plain-pickle v2 files
 keep loading for back-compat.
@@ -63,12 +64,19 @@ def shard_meta(sim) -> dict:
     blob (and the v4 file header) so a restore onto a different device
     count / mode is detectable without touching the payload."""
     mesh = getattr(sim, "shard_mesh", None)
-    return dict(
+    meta = dict(
         mode=str(getattr(sim, "shard_mode", "off")),
-        ndev=int(mesh.shape["ac"]) if mesh is not None else 0,
+        ndev=int(mesh.devices.size) if mesh is not None else 0,
         halo_blocks=int(getattr(getattr(sim, "cfg", None),
                                 "cd_halo_blocks", 0) or 0),
     )
+    if meta["mode"] == "tiles":
+        cfg = getattr(sim, "cfg", None)
+        ts = tuple(getattr(cfg, "cd_tile_shape", ()) or ())
+        meta["tiles"] = [int(t) for t in ts]
+        meta["tile_budgets"] = [int(b) for b in
+                                getattr(cfg, "cd_tile_budgets", ())]
+    return meta
 
 
 def state_blob(sim, state=None) -> dict:
@@ -147,18 +155,31 @@ def restore_blob(sim, blob, full_reset: bool = True):
         traf.state, blob["state"])
     # Cross-shard-mode blobs: the sorted-space caches (sort_perm, the
     # partner table) are keyed to the CAPTURING mode's padded layout.
-    # Adopting a spatial-mode layout into a sim whose tables are sized
-    # differently would silently drop top-stripe aircraft from the
-    # sparse schedule (their sorted slots land past the smaller
-    # layout's row count and the padded scatter runs in drop mode).
-    # Reset the caches to the exact init layout instead — identity
-    # sort (the known-good stale layout; reachability is rebuilt from
-    # true positions every interval) and an empty partner table at the
-    # RUNNING tables' size — and force a re-sort before the next chunk.
-    if traf.state.asas.partners_s.shape != old_table.shape:
+    # Adopting a spatial/tiles-mode layout into a sim whose tables are
+    # sized differently would silently drop top-stripe aircraft from
+    # the sparse schedule (their sorted slots land past the smaller
+    # layout's row count and the padded scatter runs in drop mode) —
+    # and the reset above rebuilt DEFAULT-size tables, which are too
+    # small for an active spatial/tiles layout.  Size the caches to
+    # what the RUNNING sim's mode expects — identity sort (the
+    # known-good stale layout; reachability is rebuilt from true
+    # positions every interval) and an empty partner table — and force
+    # a re-sort before the next chunk whenever the blob's layout is
+    # not the running one.
+    from ..core.state import SORT_PAD
+    kk = old_table.shape[1]
+    if getattr(sim, "shard_mode", "off") in ("spatial", "tiles") \
+            and getattr(sim, "shard_mesh", None) is not None:
+        from ..core.asas import spatial_table_size
+        n_exp = spatial_table_size(
+            traf.nmax, min(sim.cfg.cd_block, 256),
+            int(sim.shard_mesh.devices.size))
+    else:
+        n_exp = traf.nmax + SORT_PAD
+    if traf.state.asas.partners_s.shape[0] != n_exp:
         traf.state = traf.state.replace(asas=traf.state.asas.replace(
             sort_perm=jnp.arange(traf.nmax, dtype=jnp.int32),
-            partners_s=jnp.full_like(old_table, -1)))
+            partners_s=jnp.full((n_exp, kk), -1, jnp.int32)))
         sim._invalidate_sort()
     # Cross-MESH blobs (mesh-epoch recovery): a blob captured at a
     # different device count or shard mode carries stripe bucketing
@@ -170,8 +191,9 @@ def restore_blob(sim, blob, full_reset: bool = True):
     bshard = blob.get("shard")
     if bshard is not None:
         cur = shard_meta(sim)
-        if (bshard.get("ndev"), bshard.get("mode")) \
-                != (cur["ndev"], cur["mode"]):
+        if (bshard.get("ndev"), bshard.get("mode"),
+                bshard.get("tiles")) \
+                != (cur["ndev"], cur["mode"], cur.get("tiles")):
             traf.state = traf.state.replace(asas=traf.state.asas.replace(
                 sort_perm=jnp.arange(traf.nmax, dtype=jnp.int32),
                 partners_s=jnp.full_like(traf.state.asas.partners_s,
@@ -187,7 +209,7 @@ def restore_blob(sim, blob, full_reset: bool = True):
             and getattr(sim, "shard_mode", "off") != "off":
         from ..parallel import sharding as shd
         sh = shd.spatial_state_shardings(traf.state, sim.shard_mesh) \
-            if sim.shard_mode == "spatial" \
+            if sim.shard_mode in ("spatial", "tiles") \
             else shd.state_shardings(traf.state, sim.shard_mesh)
         traf.state = jax.tree.map(lambda x, s: jax.device_put(x, s),
                                   traf.state, sh)

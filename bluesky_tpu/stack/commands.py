@@ -1119,15 +1119,18 @@ def register_all(stack):
                       f"(={n * sim.simdt:.2f} s sim){note}")
 
     def shardcmd(mode=None, ndev=None, halo=None):
-        """SHARD [OFF | REPLICATE [n] | SPATIAL [n [halo]]]: multi-chip
-        decomposition, with HEALTH-style readback when called bare."""
+        """SHARD [OFF | REPLICATE [n] | SPATIAL [n [halo]] | TILE RxC]:
+        multi-chip decomposition, with HEALTH-style readback when
+        called bare."""
         import jax as _jax
+        usage = ("SHARD [OFF | REPLICATE [n] | SPATIAL [n [halo]] | "
+                 "TILE RxC]")
         if mode is None:
             if sim.shard_mode == "off":
                 return True, (f"SHARD OFF ({len(_jax.devices())} "
                               f"device(s) visible; modes: REPLICATE, "
-                              "SPATIAL [sparse backend])")
-            nd = sim.shard_mesh.shape["ac"] if sim.shard_mesh else 0
+                              "SPATIAL, TILE [sparse backend])")
+            nd = sim._shard_ndev()
             msg = (f"SHARD {sim.shard_mode.upper()}: {nd} devices, "
                    f"backend {sim.cfg.cd_backend}")
             st = sim.shard_stats
@@ -1144,10 +1147,46 @@ def register_all(stack):
                     f"(need {st['halo_need']}) = "
                     f"{st['halo_rows']} exchanged rows/interval, "
                     f"gsmax {st['gsmax']:.0f} m/s")
+            elif sim.shard_mode == "tiles" and st:
+                cnt = st.get("counts")
+                imb = (float(cnt.max()) / max(float(cnt.mean()), 1e-9)
+                       if cnt is not None and cnt.size else 0.0)
+                tr, tc = st["tile_shape"]
+                msg += (
+                    f"; tiles {tr}x{tc} lat x lon "
+                    f"({st['nb_local']} blocks/tile, nb={st['nb']}, "
+                    f"extra={st['extra_blocks']}), "
+                    f"occupancy {st['occupancy']:.0%} of shard cap, "
+                    f"last-refresh imbalance {imb:.2f}x, "
+                    f"halo budgets {tuple(st['budgets'])} blocks/offset "
+                    f"(need {tuple(st['needs'])}) = "
+                    f"{st['halo_rows']} exchanged rows/interval, "
+                    f"gsmax {st['gsmax']:.0f} m/s")
             return True, msg
         m = str(mode).upper()
+        if m in ("TILE", "TILES"):
+            tiles, nd = None, 0
+            if ndev is not None:
+                ts = str(ndev).lower()
+                if "x" in ts:
+                    try:
+                        r, c = ts.split("x", 1)
+                        tiles = (int(r), int(c))
+                        nd = tiles[0] * tiles[1]
+                    except ValueError:
+                        return False, usage
+                else:
+                    try:
+                        nd = int(float(ndev))
+                    except ValueError:
+                        return False, usage
+            try:
+                sim.set_shard("tiles", nd, tiles=tiles)
+            except (ValueError, RuntimeError) as e:
+                return False, f"SHARD TILE: {e}"
+            return shardcmd()
         if m not in ("OFF", "REPLICATE", "SPATIAL"):
-            return False, "SHARD [OFF | REPLICATE [n] | SPATIAL [n [halo]]]"
+            return False, usage
         try:
             nd = int(float(ndev)) if ndev is not None else 0
             hb = int(float(halo)) if halo is not None else 0
@@ -1174,7 +1213,8 @@ def register_all(stack):
         if mh["mode"] != "off" or mh["epoch"] > 0:
             mesh_line = (f"\nmesh: epoch {mh['epoch']}, "
                          f"{mh['devices']} device(s), mode {mh['mode']}"
-                         f", last refresh {mh['last_refresh_ms']:g} ms"
+                         + (f" {mh['tiles']}" if mh.get("tiles") else "")
+                         + f", last refresh {mh['last_refresh_ms']:g} ms"
                          + (" [DEGRADED]" if mh["degraded"] else ""))
         sh = sim.scan_health()
         sim_line = ""
@@ -1825,10 +1865,12 @@ def register_all(stack):
         "HEALTH": ["HEALTH", "", healthcmd,
                    "Serving-fabric health: queue depth, worker "
                    "progress, hedges, drops"],
-        "SHARD": ["SHARD [OFF | REPLICATE [n] | SPATIAL [n [halo]]]",
+        "SHARD": ["SHARD [OFF | REPLICATE [n] | SPATIAL [n [halo]] | "
+                  "TILE RxC]",
                   "[txt,txt,txt]", shardcmd,
-                  "Multi-chip mode: replicated columns or spatial "
-                  "latitude-stripe decomposition (readback bare)"],
+                  "Multi-chip mode: replicated columns, spatial "
+                  "latitude stripes, or 2-D lat x lon tiles with "
+                  "corner-halo exchange (readback bare)"],
         "SCANSTATS": ["SCANSTATS [ON/OFF]", "[txt]", scanstatscmd,
                       "In-scan telemetry: per-step device-side stats "
                       "folded through the chunk scan (readback bare)"],

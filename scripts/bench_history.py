@@ -32,8 +32,8 @@ import sys
 # rows only compare within a group that agrees on all of these.
 IDENTITY_FIELDS = ("n", "backend", "geometry", "worlds", "mode",
                    "scenario", "nsteps_chunk", "nsteps", "chunk",
-                   "pipeline", "shard", "shard_devices", "protocol",
-                   "dense", "D")
+                   "pipeline", "shard", "shard_devices", "tile_shape",
+                   "protocol", "dense", "D")
 
 # Metric -> direction: +1 = higher is better, -1 = lower is better.
 METRICS = {
@@ -53,6 +53,15 @@ METRICS = {
     "smooth_over_hard": -1,
     "imbalance": -1,
     "kernel_ms_dev": -1,
+    # 2-D tile decomposition (ISSUE 19): halo exchange volume per
+    # device and wire totals must not creep up; occupancy headroom
+    # (occ = fullest tile / even split) must not drift toward the
+    # shard cap.  tile_shape is an IDENTITY field — a 4x2 row never
+    # compares against a 4x4 row.
+    "wire_mb_dev": -1,
+    "halo_bytes_dev": -1,
+    "halo_rows": -1,
+    "occ": -1,
 }
 
 

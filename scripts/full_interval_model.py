@@ -162,7 +162,7 @@ def measure(n):
 
 
 def project(m, sort_every=SORT_EVERY, mode="replicate",
-            spatial_fn=None, inscan=False):
+            spatial_fn=None, inscan=False, ds=None):
     """D -> projected ms/interval and x-realtime from the measured parts.
 
     ``mode='replicate'``: the column-replication scheme as implemented
@@ -181,17 +181,27 @@ def project(m, sort_every=SORT_EVERY, mode="replicate",
     its gather/argsort work rides the row sharding — it scales ~1/D in
     BOTH modes (spatial already did; the change is that the replicated
     decomposition loses its D-independent refresh floor, raising the
-    D->inf ceiling)."""
+    D->inf ceiling).
+
+    ``mode='tiles'`` (ISSUE 19): like spatial, but over the 2-D
+    R x C lat x lon tile mesh — ``spatial_fn(d)`` should return
+    scaling_table.tile_stats dicts, whose halo wire scales with the
+    tile PERIMETER (a few blocks per canonical edge/corner offset)
+    instead of the stripe width, and whose collective launch count is
+    2 ppermutes per canonical offset (slab + gid) plus the summary
+    gathers/psums."""
     per_row = np.asarray(m["per_row"])
     nb = len(per_row)
     # CD share splits: row-sharded pair work + the sched build that
     # runs inside it
     cd_rowshard = max(m["t_cd_ms"] - m["t_sched_ms"], 0.0)
-    spatial = mode == "spatial"
+    spatial = mode in ("spatial", "tiles")
     repl_fixed = 0.0 if spatial else m["t_sched_ms"]
     coll_bytes = COLL_BYTES_PER_AC * m["n"]
+    ds = ds or (1, 2, 4, 8, 16, 32, 0)
+    maxd = max(d for d in ds if d) if any(ds) else 32
     rows = []
-    for d in (1, 2, 4, 8, 16, 32, 0):      # 0 = the D->inf limit
+    for d in ds:                           # 0 = the D->inf limit
         stats = None
         if spatial and d > 1 and spatial_fn is not None:
             stats = spatial_fn(d)
@@ -210,14 +220,18 @@ def project(m, sort_every=SORT_EVERY, mode="replicate",
             coll = 0.0
         elif spatial:
             # halo slabs + summary metadata per device over ICI, ~12
-            # collective launches (2 permutes, summary gathers, count
-            # psums); D->inf keeps the (D-independent) halo volume of
-            # the largest measured layout
-            st = stats or (spatial_fn(32) if spatial_fn else None)
+            # collective launches for stripes (2 permutes, summary
+            # gathers, count psums); tiles pay 2 ppermutes per
+            # canonical offset (slab + gid) plus the same metadata
+            # launches; D->inf keeps the (D-independent) halo volume
+            # of the largest measured layout
+            st = stats or (spatial_fn(maxd) if spatial_fn else None)
             wire = (st["halo_bytes_dev"] + st["summ_bytes"]) \
                 if st else 2 * 16 * 256 * 16 * 4
+            launches = (2 * len(st["offsets"]) + 8) \
+                if st and "offsets" in st else 12
             coll = wire / (ICI_GBPS * 1e9) * 1e3 \
-                + 12 * COLL_LAT_US / 1e3
+                + launches * COLL_LAT_US / 1e3
         else:
             coll = coll_bytes / (ICI_GBPS * 1e9) * 1e3 \
                 + N_COLLECTIVES * COLL_LAT_US / 1e3
@@ -242,9 +256,27 @@ def _spatial_fn_for(n):
     schedule-measured division of scaling_table.spatial_stats)."""
     from scaling_table import make_fleet, spatial_stats
     fleet = make_fleet(n, "continental")
+    cache = {}
 
     def fn(d):
-        return spatial_stats(*fleet, ndev=d)
+        if d not in cache:
+            cache[d] = spatial_stats(*fleet, ndev=d)
+        return cache[d]
+    return fn
+
+
+def _tiles_fn_for(n, geom="continental"):
+    """Per-D 2-D tile layout/halo stats on the benchmark fleet: the
+    schedule-measured division of scaling_table.tile_stats on the
+    near-square R x C factorisation of d (the SHARD TILE default)."""
+    from scaling_table import make_fleet, near_square_tiles, tile_stats
+    fleet = make_fleet(n, geom)
+    cache = {}
+
+    def fn(d):
+        if d not in cache:
+            cache[d] = tile_stats(*fleet, tiles=near_square_tiles(d))
+        return cache[d]
     return fn
 
 
@@ -254,13 +286,27 @@ def emit(m, per_row=None):
     if per_row is not None:
         m = dict(m, per_row=per_row)
     sfn = _spatial_fn_for(m["n"])
+    tfn = _tiles_fn_for(m["n"])
+    tfn_g = _tiles_fn_for(m["n"], geom="global")
     proj = project(m)
     proj_in = project(m, inscan=True)
     proj_sp = project(m, mode="spatial", spatial_fn=sfn)
+    tile_ds = (1, 2, 4, 8, 16, 32, 64, 0)
+    proj_t = project(m, mode="tiles", spatial_fn=tfn, ds=tile_ds)
+    # D=64 occupancy check: count-proportional 2-D cuts should keep the
+    # GLOBAL fleet's per-tile occupancy close to the continental one
+    # (1-D stripes diverge — see scripts/scaling_table.py)
+    occ64 = {}
+    for geom, fn in (("continental", tfn), ("global", tfn_g)):
+        st64 = fn(64)
+        occ64[geom] = round(
+            float(st64["counts"].max() / (m["n"] / 64)), 3)
+    occ64["ratio"] = round(occ64["global"] / occ64["continental"], 3)
     mm = {k: v for k, v in m.items() if k != "per_row"}
     out = dict(measured=mm, projected=proj,
                projected_inscan=proj_in,
                projected_spatial=proj_sp,
+               projected_tiles=proj_t,
                model=dict(ici_gbps=ICI_GBPS, coll_lat_us=COLL_LAT_US,
                           n_collectives=N_COLLECTIVES,
                           coll_bytes_per_ac=COLL_BYTES_PER_AC,
@@ -283,7 +329,32 @@ def emit(m, per_row=None):
                                    if k in ("halo_blocks", "halo_need",
                                             "halo_bytes_dev",
                                             "summ_bytes", "nb_local")})
-                              for d in (2, 4, 8, 16, 32))))
+                              for d in (2, 4, 8, 16, 32)),
+                          tile_halo=dict(
+                              (d, dict(
+                                  tiles="x".join(map(str,
+                                                     tfn(d)["tiles"])),
+                                  offsets=len(tfn(d)["offsets"]),
+                                  halo_need=list(tfn(d)["halo_need"]),
+                                  budgets=list(tfn(d)["budgets"]),
+                                  wire_blocks=int(tfn(d)["wire_blocks"]),
+                                  halo_bytes_dev=int(
+                                      tfn(d)["halo_bytes_dev"]),
+                                  summ_bytes=int(tfn(d)["summ_bytes"]),
+                                  nb_local=int(tfn(d)["nb_local"]),
+                                  uncovered=int(tfn(d)["uncovered"])))
+                              for d in (4, 8, 16, 32, 64)),
+                          tiles_occupancy_d64=occ64,
+                          tiles_note=(
+                              "projected_tiles: 2-D lat x lon tile "
+                              "decomposition (ISSUE 19) — halo wire "
+                              "scales with the tile perimeter (a few "
+                              "blocks per canonical edge/corner "
+                              "offset) instead of the stripe width, "
+                              "and the count-proportional 2-D cuts "
+                              "keep global-geometry occupancy within "
+                              f"{occ64['ratio']}x of continental at "
+                              "D=64 where 1-D stripes diverge")))
     # fresh checkout: output/ may not exist yet — a multi-minute run
     # must not crash at the final dump
     os.makedirs("output", exist_ok=True)
@@ -292,7 +363,8 @@ def emit(m, per_row=None):
     print(json.dumps(mm))
     for title, p in (("column-replication (as implemented)", proj),
                      ("column-replication + in-scan refresh", proj_in),
-                     ("spatial decomposition (as implemented)", proj_sp)):
+                     ("spatial decomposition (as implemented)", proj_sp),
+                     ("2-D lat x lon tiles (as implemented)", proj_t)):
         print(f"\n{title}:")
         print("| D | CD | sched | base | refresh | coll | "
               "interval ms | x-realtime |")
@@ -329,6 +401,10 @@ def reproject(path="BENCH_FULL_INTERVAL.json"):
     per_row, _, _, _, _ = schedule_pairs_per_row(
         ac.lat, ac.lon, ac.gs, ac.alt, ac.vs)
     out = emit(m, per_row=per_row.tolist())
+    # sections emit() does not recompute (e.g. the measured host-CPU
+    # mesh rows from --cpu-mesh) survive the rewrite
+    for k, v in old.items():
+        out.setdefault(k, v)
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(f"\nwrote {path}")
@@ -392,8 +468,68 @@ def merge_projected_chunk_row(m, chunk=20,
     return row
 
 
+def measure_cpu_mesh(n=100_000, path="BENCH_FULL_INTERVAL.json",
+                     total_steps=40, chunk=20):
+    """Measured replicate-vs-stripes-vs-tiles rows on the host CPU
+    mesh (ISSUE 19 acceptance).  Run with
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` so all
+    three decompositions execute on a REAL 8-device mesh — the
+    collectives, halo exchange and re-bucketing all run for real; only
+    the absolute ms are host-CPU, so the rows are a mode-vs-mode
+    comparison, not a chip measurement (the chip terms above stay
+    authoritative).  Also records the schedule-measured halo wire of
+    stripes vs tiles on the GLOBAL scene — the acceptance bound is
+    tiles <= stripes there, where the 1-D stripe must ship its full
+    360-degree-wide boundary and the tile only its perimeter."""
+    import jax
+    ndev = len(jax.devices())
+    from scaling_table import (make_fleet, near_square_tiles,
+                               spatial_stats, tile_stats)
+    tiles = near_square_tiles(ndev)
+    rows = []
+    for shard in ("replicate", "spatial", "tiles"):
+        t0 = time.perf_counter()
+        row = bench.run_chunked(n, chunk=chunk, total_steps=total_steps,
+                                reps=1, shard=shard, shard_devices=ndev)
+        row["platform"] = bench.platform_tag()
+        row["protocol"] += (f"; {ndev}-device host-CPU mesh "
+                            "(mode-vs-mode comparison row)")
+        rows.append(row)
+        print(f"[cpu-mesh] {shard}: x_realtime {row['x_realtime']} "
+              f"({time.perf_counter() - t0:.0f}s)", flush=True)
+    fleet = make_fleet(n, "global")
+    sp = spatial_stats(*fleet, ndev=ndev)
+    ti = tile_stats(*fleet, tiles=tiles)
+    halo = dict(
+        n=n, geometry="global", ndev=ndev,
+        tiles="x".join(map(str, tiles)),
+        stripes_halo_bytes_dev=int(sp["halo_bytes_dev"]),
+        tiles_halo_bytes_dev=int(ti["halo_bytes_dev"]),
+        tiles_le_stripes=bool(int(ti["halo_bytes_dev"])
+                              <= int(sp["halo_bytes_dev"])),
+        stripes_wire_blocks=2 * int(sp["halo_blocks"]),
+        tiles_wire_blocks=int(ti["wire_blocks"]),
+        tiles_uncovered=int(ti["uncovered"]))
+    with open(path) as f:
+        doc = json.load(f)
+    doc["measured_cpu_mesh"] = dict(
+        ndev=ndev, chunk=chunk, total_steps=total_steps, rows=rows,
+        halo_global=halo,
+        note=("replicate vs 1-D stripes vs 2-D tiles on a forced "
+              f"{ndev}-device host-CPU mesh; collectives and halo "
+              "exchange execute for real, absolute ms are host-CPU"))
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps(doc["measured_cpu_mesh"]["halo_global"]))
+    print(f"wrote {path} (measured_cpu_mesh, {len(rows)} rows)")
+    return doc["measured_cpu_mesh"]
+
+
 if __name__ == "__main__":
-    if "--reproject" in sys.argv:
+    if "--cpu-mesh" in sys.argv:
+        args = [a for a in sys.argv[1:] if not a.startswith("--")]
+        measure_cpu_mesh(int(args[0]) if args else 100_000)
+    elif "--reproject" in sys.argv:
         reproject()
     else:
         main(int(sys.argv[1]) if len(sys.argv) > 1 else 100_000)

@@ -86,6 +86,106 @@ def schedule_pairs_per_row(lat, lon, gs, alt, vs, extra=EXTRA,
         np.asarray(reach)
 
 
+def _pairs_for_dest(arrs, dest, nb):
+    """Scheduled pairs per row block + reach matrix for an already
+    computed sort destination (shared by the stripe and tile stats)."""
+    lat, lon, gs, alt, vs, active = arrs
+    n_tot = nb * BLOCK
+    plat, plon, pgs, palt, pvs, pact = cd_sched.scatter_padded(
+        [lat, lon, gs, alt, vs, active.astype(jnp.float32)], dest, n_tot)
+    reach = block_reachability(plat, plon, pgs, pact > 0.5, nb, BLOCK,
+                               RPZ, TLOOK, alt=palt, vs=pvs,
+                               hpz=1000 * 0.3048)
+    st, ln, overflow = cd_sched.build_windows(reach, S_CAP, WMAX,
+                                              pad_start=nb)
+    win_pairs = jnp.sum(ln, axis=1) * BLOCK * BLOCK
+    grid_pairs = jnp.sum(reach, axis=1) * BLOCK * BLOCK
+    per_row = jnp.where(overflow, grid_pairs, win_pairs)
+    return (np.asarray(per_row), int(jnp.sum(overflow)),
+            np.asarray(reach))
+
+
+def near_square_tiles(ndev):
+    """R x C factorisation of ndev with R >= C, C as close to sqrt as
+    divides (8 -> 4x2, 16 -> 4x4, prime -> p x 1) — mirrors the
+    Simulation default for SHARD TILE without a shape argument."""
+    c = int(np.sqrt(ndev))
+    while c > 1 and ndev % c:
+        c -= 1
+    c = max(c, 1)
+    return (ndev // c, c)
+
+
+def tile_stats(lat, lon, gs, alt, vs, tiles, budgets=()):
+    """Measured per-tile division of the 2-D TILES decomposition on the
+    R x C lat x lon mesh: scheduled pairs per tile (contiguous slot
+    range split on the count-proportional tile layout), aircraft
+    occupancy per tile, the per-offset halo the reachability actually
+    needs (edge AND corner neighbours, lon-wrap deduped), and the halo
+    exchange volume per device per interval.  Halo wire scales with the
+    tile PERIMETER (a few blocks per canonical offset) instead of the
+    full stripe width — that is the point of the 2-D decomposition.
+    ``uncovered`` counts reachable block pairs OUTSIDE the neighbour
+    set; nonzero means the one-tile halo cannot cover the reach and the
+    refresh would refuse (guard bit 2) rather than silently miss."""
+    R, C = int(tiles[0]), int(tiles[1])
+    ndev = R * C
+    n = lat.shape[0]
+    extra, nb, nb_t, n_tot = cd_sched.spatial_layout(n, BLOCK, ndev)
+    active = jnp.ones((n,), bool)
+    thresh = cd_sched.reach_threshold_m(gs, active, TLOOK, RPZ)
+    dest = cd_sched.tile_sort_dest(lat, lon, gs, active, thresh, BLOCK,
+                                   extra, tiles, alt=alt, vs=vs)
+    per_row, n_over, reach = _pairs_for_dest(
+        (lat, lon, gs, alt, vs, active), dest, nb)
+    dev_pairs = per_row.reshape(ndev, nb_t).sum(axis=1)
+    dest_np = np.asarray(dest)
+    counts = np.bincount(np.minimum(dest_np // (nb_t * BLOCK), ndev - 1),
+                         minlength=ndev)
+    offs = cd_sched.tile_offsets(tiles)
+    t_of = np.arange(nb) // nb_t                     # owning tile per block
+    r_of, c_of = t_of // C, t_of % C
+    # per-offset measured need: widest sender-block set any receiver
+    # tile reaches at that offset (what the refresh pins budgets from)
+    needs = []
+    for dr, dcm in offs:
+        need = 0
+        for rt in range(R):
+            for ct in range(C):
+                sr, sc = rt + dr, (ct + dcm) % C
+                if not 0 <= sr < R:
+                    continue
+                recv = t_of == rt * C + ct
+                send = t_of == sr * C + sc
+                need = max(need, int(
+                    reach[np.ix_(recv, send)].any(axis=0).sum()))
+        needs.append(need)
+    # reachable pairs outside {self} + canonical neighbour offsets
+    dr_m = r_of[:, None] - r_of[None, :]
+    dc_m = (c_of[:, None] - c_of[None, :]) % C
+    neigh = (dr_m == 0) & (dc_m == 0)
+    for dr, dcm in offs:
+        # receiver i reaching sender j at offset (dr, dcm): j's tile is
+        # i's tile shifted by the offset, i.e. r_j - r_i == dr (sender
+        # minus receiver), dc likewise mod C
+        neigh |= ((r_of[None, :] - r_of[:, None]) == dr) & \
+                 (((c_of[None, :] - c_of[:, None]) % C) == dcm)
+    uncovered = int((reach & ~neigh).sum())
+    if not budgets:
+        budgets = tuple(int(min(max(4, -(-nd * 5 // 4)), nb_t))
+                        for nd in needs)
+    wire_blocks = cd_sched.tile_wire_blocks(tiles, budgets, nb_t)
+    # each received block: 16-row f32 summary slab + 1 int32 gid row
+    halo_bytes_dev = wire_blocks * (16 + 1) * BLOCK * 4
+    summ_bytes = 8 * nb * 4
+    return dict(ndev=ndev, tiles=(R, C), extra=extra, nb=nb,
+                nb_local=nb_t, dev_pairs=dev_pairs, counts=counts,
+                overflow_rows=n_over, offsets=offs,
+                halo_need=tuple(needs), budgets=budgets,
+                wire_blocks=wire_blocks, uncovered=uncovered,
+                halo_bytes_dev=halo_bytes_dev, summ_bytes=summ_bytes)
+
+
 def spatial_stats(lat, lon, gs, alt, vs, ndev, halo_blocks=0):
     """Measured per-device division of the SPATIAL decomposition at
     D=ndev: scheduled pairs per device (contiguous stripe split on the
@@ -134,8 +234,8 @@ def main():
           f"pair cost {ps_per_pair*1e12:.0f} ps (measured)")
     out_rows = []
 
-    def record(geom, d, mode, mx, mean, wire_mb, occ):
-        out_rows.append({
+    def record(geom, d, mode, mx, mean, wire_mb, occ, tile_shape=None):
+        row = {
             "n": n, "geometry": geom, "D": d, "mode": mode,
             "max_pairs_dev": float(mx), "mean_pairs_dev": float(mean),
             "imbalance": round(float(mx / max(mean, 1)), 3),
@@ -145,8 +245,12 @@ def main():
             "protocol": ("schedule-measured on the real round-4 "
                          "layout; kernel ms from the measured "
                          f"{ps_per_pair*1e12:.0f} ps/pair v5e cost"),
-        })
+        }
+        if tile_shape:
+            row["tile_shape"] = tile_shape
+        out_rows.append(row)
 
+    occ_div = {}                       # geom -> (spatial occ, tiles occ)
     for geom in ("continental", "global", "regional"):
         fleet = make_fleet(n, geom)
         per_row, nb, n_over, _, _ = schedule_pairs_per_row(*fleet)
@@ -186,6 +290,45 @@ def main():
                   f"{smx*ps_per_pair*1e3:>13.2f} {wire_mb:>11.2f} "
                   f"{occ:>5.2f}")
             record(geom, d, "spatial", smx, smean, wire_mb, occ)
+            # TILES: 2-D lat x lon mesh, contiguous tile ownership,
+            # edge+corner halo exchange (wire ~ tile perimeter, not
+            # stripe width)
+            tiles = near_square_tiles(d)
+            if tiles[1] == 1:
+                occ_div.setdefault(geom, {})[d] = (occ, None)
+                continue               # degenerate 1-D: same as spatial
+            ts = tile_stats(*fleet, tiles=tiles)
+            tmx, tmean = ts["dev_pairs"].max(), ts["dev_pairs"].mean()
+            twire_mb = (ts["halo_bytes_dev"] + ts["summ_bytes"]) / 1e6
+            tocc = ts["counts"].max() / (n / d)
+            label = f"tile{tiles[0]}x{tiles[1]}"
+            print(f"{d:>3} {label:>9} {tmx:>14.3e} {tmean:>14.3e} "
+                  f"{tmx/max(tmean,1):>9.2f} "
+                  f"{tmx*ps_per_pair*1e3:>13.2f} {twire_mb:>11.2f} "
+                  f"{tocc:>5.2f}")
+            if ts["uncovered"]:
+                print(f"    !! {ts['uncovered']} reachable block pairs "
+                      f"outside the 1-tile halo -> refresh would "
+                      f"refuse this shape (guard bit 2)")
+            record(geom, d, "tiles", tmx, tmean, twire_mb, tocc,
+                   tile_shape=f"{tiles[0]}x{tiles[1]}")
+            occ_div.setdefault(geom, {})[d] = (occ, tocc)
+    # stripe-vs-tile occupancy divergence on the GLOBAL geometry: 1-D
+    # latitude stripes get thinner as D grows while the fleet spans the
+    # whole sphere (a stripe must still hold its full lon extent), so
+    # stripe occupancy drifts from the even split; 2-D tiles keep both
+    # cuts count-proportional and stay near 1.0x.
+    gdiv = occ_div.get("global", {})
+    for d in sorted(gdiv):
+        so, to = gdiv[d]
+        if to is None:
+            continue
+        print(f"\n[global] D={d}: stripe occupancy {so:.2f}x even "
+              f"split vs tiles {to:.2f}x "
+              f"(divergence {so/max(to, 1e-9):.2f}x)")
+        record("global", d, "occ_divergence", 0.0, 0.0, 0.0,
+               so / max(to, 1e-9),
+               tile_shape="x".join(map(str, near_square_tiles(d))))
     if out:
         # shared writer: platform tag + BENCH_HISTORY series so the
         # perf sentinel watches schedule balance like any other bench

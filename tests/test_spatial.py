@@ -266,3 +266,291 @@ def test_spatial_requires_sparse_backend():
     sim.stack.process()
     assert sim.shard_mode == "off"
     assert any("sparse" in line.lower() for line in sim.scr.echobuf[-2:])
+
+
+# ---------------------------------------------------------------------------
+# 2-D lat x lon tiles (ISSUE 19): same three contracts on the 4x2 tile
+# mesh, plus the corner-halo exchange and the v4 snapshot tile header.
+
+TILES = (4, 2)
+TDEV = TILES[0] * TILES[1]
+
+
+@pytest.fixture(scope="module")
+def tile_mesh():
+    assert len(jax.devices()) >= 8, "conftest must provision 8 CPU devices"
+    return sharding.make_tile_mesh(TILES)
+
+
+def test_tiles_step_bit_identical_to_single_chip(tile_mesh):
+    """ISSUE 19 acceptance bar: full stepped state, BIT-equal, after 25
+    steps on the 8-device 4x2 lat x lon mesh vs the single-chip sparse
+    schedule on the same tile-bucketed layout — the tile windows, the
+    edge+corner ppermute halo exchange, overflow fallback, in-kernel
+    resume and the partner merge all engaged."""
+    cfg = SimConfig(cd_backend="sparse", cd_block=256,
+                    cd_shard_mode="tiles")
+    st, newslot, info = sharding.prepare_tiles(make_scene(), tile_mesh,
+                                               cfg.asas)
+    cfg = cfg._replace(cd_tile_shape=tuple(info["tile_shape"]),
+                       cd_tile_budgets=tuple(info["budgets"]))
+    assert tuple(info["tile_shape"]) == TILES
+    assert info["counts"].sum() == N
+    assert len(info["offsets"]) == 5   # 4x2: lon-wrap dedupes 8 -> 5
+    assert all(nd <= b for nd, b in zip(info["needs"], info["budgets"]))
+
+    # single-chip reference: SAME prepared state, no mesh
+    ref_state = jax.tree.map(lambda x: jax.device_put(np.asarray(x)), st)
+    nsteps = 25
+    ref = jax.block_until_ready(run_steps(ref_state, cfg, nsteps))
+    out = jax.block_until_ready(
+        sharding.sharded_step_fn(tile_mesh, cfg, nsteps=nsteps)(st))
+
+    assert float(out.simt) == pytest.approx(nsteps * cfg.simdt)
+    assert int(ref.asas.nconf_cur) > 0, "scene must produce conflicts"
+    assert int(jnp.sum(ref.asas.active)) > 0, "resolution must engage"
+    for name in FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(out.ac, name)),
+            np.asarray(getattr(ref.ac, name)), err_msg=name)
+    for name in ASAS_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(out.asas, name)),
+            np.asarray(getattr(ref.asas, name)), err_msg=f"asas.{name}")
+    assert int(out.asas.nconf_cur) == int(ref.asas.nconf_cur)
+    assert int(out.asas.nlos_cur) == int(ref.asas.nlos_cur)
+
+
+def test_tiles_migration_no_missed_los(tile_mesh):
+    """Randomized drifting scene over all 8 tiles, 12 CD intervals of
+    30 s drift with a 2-D re-bucketing refresh every 4: aircraft cross
+    tile seams in BOTH axes between refreshes — including an explicit
+    corner-crossing pair converging diagonally through a 4-tile corner
+    — and every ground-truth LoS pair is still counted every interval.
+    After each refresh, every aircraft's caller shard is the device
+    owning its sorted tile slot (2-D re-bucket correctness)."""
+    # the domain must hold the corner-halo contract: effective reach =
+    # rpz + 2*gsmax*(dtlookahead + sort_every*dtasas) ~ 124 km at
+    # 240 m/s, well under the ~2.5 deg count-proportional lat band of a
+    # 10-deg domain (the default 300 s lookahead would need ~1.9 deg
+    # bands — the refresh rightly refuses that on this grid).
+    acfg = AsasConfig(sort_every=4, dtasas=30.0, dtlookahead=120.0)
+    rng = np.random.default_rng(13)
+    n = 398
+    traf = Traffic(nmax=NMAX, dtype=jnp.float32, pair_matrix=False)
+    # spread across the full 4x2 tile grid with N/S/E/W crossers
+    traf.create(n, "B744",
+                rng.uniform(9000.0, 9400.0, n),
+                rng.uniform(130.0, 240.0, n), None,
+                rng.uniform(42.0, 52.0, n),
+                rng.uniform(0.0, 10.0, n),
+                rng.choice([0.0, 90.0, 180.0, 270.0], n)
+                + rng.uniform(-30.0, 30.0, n))
+    # explicit corner crossers: diagonal head-on through the center of
+    # the fleet (the count-median point, where four tiles meet)
+    traf.create(1, "B744", [9190.0], [230.0], None, [46.7], [4.7],
+                [45.0])
+    traf.create(1, "B744", [9190.0], [230.0], None, [47.3], [5.3],
+                [225.0])
+    traf.flush()
+    st, newslot, info = sharding.prepare_tiles(traf.state, tile_mesh,
+                                               acfg, block=256)
+    budgets = tuple(info["budgets"])
+    # the corner exchange is engaged: some diagonal offset carries need
+    diag = [nd for off, nd in zip(info["offsets"], info["needs"])
+            if off[0] != 0 and off[1] % TILES[1] != 0]
+    assert diag and max(diag) > 0, \
+        f"scene must engage a corner offset: {info['offsets']} " \
+        f"needs {info['needs']}"
+    nb = info["nb"]
+    nb_t = nb // TDEV
+    S_t = nb_t * 256
+    n_tot = nb * 256
+
+    @jax.jit
+    def interval(s):
+        s2, _ = asasmod.update_tiled(s, acfg, block=256, impl="sparse",
+                                     mesh=tile_mesh, shard_mode="tiles",
+                                     tile_shape=TILES,
+                                     tile_budgets=budgets)
+        return s2
+
+    missed = []
+    for k in range(12):
+        st = _advance(st, dt=30.0)
+        if k and k % 4 == 0:
+            st, newslot, info = asasmod.refresh_tile_shard(
+                st, acfg, TILES, block=256, budgets=budgets)
+            perm = np.asarray(st.asas.sort_perm)
+            act = np.asarray(st.ac.active)
+            slots = np.arange(NMAX)
+            caller_dev = slots // (NMAX // TDEV)
+            sorted_dev = np.minimum(perm // S_t, TDEV - 1)
+            assert (caller_dev[act] == sorted_dev[act]).all(), \
+                f"refresh {k}: aircraft bucketed off their tile device"
+            assert (perm[~act] == n_tot).all(), \
+                f"refresh {k}: inactive rows must carry the sentinel"
+        st = jax.block_until_ready(interval(st))
+        got = int(st.asas.nlos_cur)
+        want = _los_count(st, 0.95 * acfg.rpz, acfg.hpz / 1.3)
+        if got < want:
+            missed.append((k, got, want))
+    assert not missed, f"missed LoS pairs in tiles mode: {missed}"
+
+
+def test_tiles_refresh_rejects_overloaded_tile(tile_mesh):
+    """A clump putting one tile's population past its device's caller
+    capacity (one stripe x one lon cell cannot split) must be REFUSED
+    by the 2-D re-bucketing — the tile-occupancy guard contract —
+    never silently spilled into a neighbouring tile."""
+    rng = np.random.default_rng(5)
+    n = 600                     # > nmax/ndev = 128 per tile, in a dot
+    traf = Traffic(nmax=NMAX, dtype=jnp.float32, pair_matrix=False)
+    traf.create(n, "B744", rng.uniform(9000, 9400, n),
+                rng.uniform(130, 240, n), None,
+                rng.uniform(51.99, 52.01, n), rng.uniform(4.0, 4.1, n),
+                rng.uniform(0, 360, n))
+    traf.flush()
+    with pytest.raises(RuntimeError, match="occupancy|halo|tile"):
+        sharding.prepare_tiles(traf.state, tile_mesh, AsasConfig(),
+                               block=256)
+
+
+def test_shard_command_tiles_e2e():
+    """Production Simulation path: SHARD TILE 4x2 readback (tile shape,
+    per-offset halo budgets, occupancy), mid-run creation, HEALTH mesh
+    line carrying the tile shape, and SHARD OFF restoring defaults."""
+    from bluesky_tpu.simulation.sim import Simulation
+    sim = Simulation(nmax=1024)
+    rng = np.random.default_rng(3)
+    n = 300
+    sim.traf.create(n, "B744", rng.uniform(4900, 5100, n),
+                    rng.uniform(140, 180, n), None,
+                    rng.uniform(35, 60, n), rng.uniform(-10, 30, n),
+                    rng.uniform(0, 360, n))
+    sim.traf.flush()
+    sim.stack.stack("CDMETHOD SPARSE; SHARD TILE 4x2")
+    sim.stack.process()
+    assert sim.shard_mode == "tiles"
+    assert tuple(sim.cfg.cd_tile_shape) == (4, 2)
+    readback = sim.scr.echobuf[-1]
+    for token in ("SHARD TILES", "8 devices", "4x2", "occupancy",
+                  "imbalance", "halo budgets", "rows/interval"):
+        assert token in readback, readback
+    sim.op()
+    sim.run(until_simt=2.0)
+    assert sim.traf.ntraf == n
+
+    sim.stack.stack("CRE KL001 B744 52 4 90 FL200 250")
+    sim.stack.process()
+    sim.run(until_simt=4.0)
+    slot = sim.traf.id2idx("KL001")
+    assert slot >= 0
+    assert abs(float(np.asarray(sim.traf.state.ac.lat)[slot])
+               - 52.0) < 0.3, "id->slot stale after tile migration"
+    # re-bucketed caller shard matches the tile owner
+    perm = np.asarray(sim.traf.state.asas.sort_perm)
+    n_tot = sim.traf.state.asas.partners_s.shape[0]
+    act = np.asarray(sim.traf.state.ac.active)
+    S_t = n_tot // 8
+    caller_dev = np.arange(1024) // (1024 // 8)
+    assert (np.minimum(perm[act] // S_t, 7) == caller_dev[act]).all()
+
+    sim.stack.stack("HEALTH")
+    sim.stack.process()
+    health = "\n".join(sim.scr.echobuf[-12:])
+    assert "tiles" in health and "4x2" in health, health
+
+    sim.stack.stack("SHARD OFF")
+    sim.stack.process()
+    assert sim.shard_mode == "off"
+    assert sim.cfg.cd_tile_shape == ()
+    sim.run(until_simt=5.0)
+    assert sim.traf.id2idx("KL001") >= 0
+
+
+def test_tiles_snapshot_v4_roundtrip_across_shapes(tmp_path):
+    """The v4 shard header carries the tile shape: a blob captured
+    under 4x2 tiles restores bit-faithfully into the same layout, and
+    restoring it into a DIFFERENT tile shape (2x2 on 4 devices) is
+    detected from the (ndev, mode, tiles) triple — the sorted-space
+    caches reset to the identity layout and the sim re-buckets instead
+    of adopting the foreign tile bucketing.  Rollback restores
+    (full_reset=False) keep the running mesh, so this is the
+    elastic-mesh recovery path."""
+    from bluesky_tpu.simulation import snapshot as snap
+    from bluesky_tpu.simulation.sim import Simulation
+
+    def mk(shape_cmd):
+        sim = Simulation(nmax=1024)
+        rng = np.random.default_rng(3)
+        n = 300
+        sim.traf.create(n, "B744", rng.uniform(4900, 5100, n),
+                        rng.uniform(140, 180, n), None,
+                        rng.uniform(35, 60, n), rng.uniform(-10, 30, n),
+                        rng.uniform(0, 360, n))
+        sim.traf.flush()
+        sim.stack.stack(f"CDMETHOD SPARSE; SHARD TILE {shape_cmd}")
+        sim.stack.process()
+        assert sim.shard_mode == "tiles"
+        return sim
+
+    sim = mk("4x2")
+    sim.op()
+    sim.run(until_simt=2.0)
+    blob = snap.state_blob(sim)
+    assert blob["shard"]["mode"] == "tiles"
+    assert blob["shard"]["tiles"] == [4, 2]
+    assert blob["shard"]["ndev"] == 8
+    path = str(tmp_path / "tiles.snap")
+    snap.write_blob(blob, path)
+    shard, err = snap.peek_shard(path)
+    assert err is None and shard["tiles"] == [4, 2]
+
+    # same-layout round trip keeps stepping with the restored bucketing
+    same = mk("4x2")
+    rblob, err = snap.read_blob(path)
+    assert err is None, err
+    ok, msg = snap.restore_blob(same, rblob, full_reset=False)
+    assert ok, msg
+    assert same.shard_mode == "tiles"
+    # same layout: the captured tile bucketing is adopted as-is
+    assert (np.asarray(same.traf.state.asas.sort_perm)
+            == np.asarray(blob["state"].asas.sort_perm)).all()
+    same.op()
+    same.run(until_simt=3.0)
+    assert same.traf.ntraf == 300
+
+    # cross-shape restore: caches reset to identity, re-sort forced
+    other = mk("2x2")
+    rblob, err = snap.read_blob(path)
+    assert err is None, err
+    ok, msg = snap.restore_blob(other, rblob, full_reset=False)
+    assert ok, msg
+    assert other.shard_mode == "tiles"
+    assert tuple(other.cfg.cd_tile_shape) == (2, 2)
+    assert (np.asarray(other.traf.state.asas.sort_perm)
+            == np.arange(1024)).all(), \
+        "cross-shape restore must reset the sorted-space caches"
+    other.op()
+    other.run(until_simt=3.0)
+    assert other.traf.ntraf == 300
+    # the re-bucket after restore re-pinned a 2x2 ownership
+    perm = np.asarray(other.traf.state.asas.sort_perm)
+    act = np.asarray(other.traf.state.ac.active)
+    n_tot = other.traf.state.asas.partners_s.shape[0]
+    S_t = n_tot // 4
+    caller_dev = np.arange(1024) // (1024 // 4)
+    assert (np.minimum(perm[act] // S_t, 3) == caller_dev[act]).all()
+
+
+def test_tiles_require_sparse_backend_and_shape():
+    from bluesky_tpu.simulation.sim import Simulation
+    sim = Simulation(nmax=256)
+    sim.stack.stack("SHARD TILE 4x2")   # dense default backend
+    sim.stack.process()
+    assert sim.shard_mode == "off"
+    assert any("sparse" in line.lower() for line in sim.scr.echobuf[-2:])
+    sim.stack.stack("CDMETHOD SPARSE; SHARD TILE 3x5")  # 15 > devices? no: shape whose product != available request
+    sim.stack.process()
+    assert sim.shard_mode == "off"
